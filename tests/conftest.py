@@ -1,0 +1,33 @@
+"""Test env: force an 8-device virtual CPU mesh.
+
+Mirrors SURVEY.md section 4's recommendation: multi-device sharding
+logic is exercised on host CPU with xla_force_host_platform_device_count
+so tests don't need TPU hardware.
+
+Note: the environment's sitecustomize pre-imports jax with
+JAX_PLATFORMS=axon (a remote-TPU tunnel), so plain env vars are too
+late — we override the platform through jax.config before any backend
+is instantiated. XLA_FLAGS is still read lazily at backend init, so
+appending it here works.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"  # for any subprocesses
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,93 @@
+"""Box utility kernels vs numpy oracles."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from triton_client_tpu.ops import (
+    xywh2xyxy,
+    xyxy2xywh,
+    box_iou,
+    box_area,
+    scale_boxes,
+)
+
+
+def _np_iou(a, b):
+    lt = np.maximum(a[:, None, :2], b[None, :, :2])
+    rb = np.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = np.clip(rb - lt, 0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    area_b = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    return inter / np.maximum(area_a[:, None] + area_b[None, :] - inter, 1e-9)
+
+
+def test_xywh_roundtrip(rng):
+    boxes = rng.uniform(0, 100, size=(64, 4)).astype(np.float32)
+    out = np.asarray(xyxy2xywh(xywh2xyxy(jnp.asarray(boxes))))
+    np.testing.assert_allclose(out, boxes, rtol=1e-5, atol=1e-4)
+
+
+def test_xywh2xyxy_known():
+    box = jnp.asarray([[10.0, 20.0, 4.0, 6.0]])
+    np.testing.assert_allclose(
+        np.asarray(xywh2xyxy(box))[0], [8.0, 17.0, 12.0, 23.0]
+    )
+
+
+def test_box_iou_matches_numpy(rng):
+    a = rng.uniform(0, 50, size=(20, 2))
+    a = np.concatenate([a, a + rng.uniform(1, 30, size=(20, 2))], -1).astype(np.float32)
+    b = rng.uniform(0, 50, size=(30, 2))
+    b = np.concatenate([b, b + rng.uniform(1, 30, size=(30, 2))], -1).astype(np.float32)
+    got = np.asarray(box_iou(jnp.asarray(a), jnp.asarray(b)))
+    np.testing.assert_allclose(got, _np_iou(a, b), rtol=1e-4, atol=1e-5)
+
+
+def test_iou_identity():
+    a = jnp.asarray([[0.0, 0.0, 10.0, 10.0]])
+    assert np.asarray(box_iou(a, a))[0, 0] == 1.0
+
+
+def test_box_area_degenerate():
+    boxes = jnp.asarray([[0.0, 0.0, 5.0, 5.0], [3.0, 3.0, 1.0, 1.0]])
+    np.testing.assert_allclose(np.asarray(box_area(boxes)), [25.0, 0.0])
+
+
+def test_scale_boxes_plain():
+    # model 512x512 -> orig 1024x768 (h, w): x scales by 768/512, y by 2.
+    boxes = jnp.asarray([[64.0, 128.0, 128.0, 256.0]])
+    out = np.asarray(scale_boxes(boxes, (512, 512), (1024, 768)))
+    np.testing.assert_allclose(out[0], [96.0, 256.0, 192.0, 512.0])
+
+
+def test_scale_boxes_letterbox_roundtrip():
+    # orig 200x100 -> model 400x400: gain=2, pad_x=100; meta comes from
+    # the letterbox op itself so rounded geometry matches exactly.
+    from triton_client_tpu.ops import letterbox
+
+    _, meta = letterbox(jnp.zeros((200, 100, 3)), (400, 400))
+    out = np.asarray(
+        scale_boxes(
+            jnp.asarray([[100.0, 0.0, 300.0, 400.0]]),
+            (400, 400),
+            (200, 100),
+            letterbox_meta=meta,
+        )
+    )
+    np.testing.assert_allclose(out[0], [0.0, 0.0, 100.0, 200.0])
+
+
+def test_scale_boxes_letterbox_odd_geometry():
+    # Odd sizes exercise the rounded pads: meta from letterbox must
+    # invert its own geometry without pixel drift.
+    from triton_client_tpu.ops import letterbox
+
+    _, meta = letterbox(jnp.zeros((201, 100, 3)), (400, 400))
+    gain, pad_x, pad_y = np.asarray(meta)
+    # a box at the content's corners maps back to the full original
+    content = jnp.asarray(
+        [[float(pad_x), float(pad_y), float(pad_x) + 100 * gain, float(pad_y) + 201 * gain]]
+    )
+    out = np.asarray(scale_boxes(content, (400, 400), (201, 100), letterbox_meta=meta))
+    np.testing.assert_allclose(out[0], [0.0, 0.0, 100.0, 201.0], atol=1e-4)

@@ -1,0 +1,143 @@
+"""Fixed-shape NMS vs a numpy greedy-NMS oracle."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from triton_client_tpu.ops import nms, batched_nms, nms_padded
+from triton_client_tpu.ops.detect_postprocess import extract_boxes
+
+
+def _np_greedy_nms(boxes, scores, iou_thresh):
+    """Oracle: the classic O(n^2) greedy suppression."""
+    order = np.argsort(-scores)
+    keep = []
+    suppressed = np.zeros(len(boxes), bool)
+    for i in order:
+        if suppressed[i] or not np.isfinite(scores[i]):
+            continue
+        keep.append(i)
+        x1 = np.maximum(boxes[i, 0], boxes[:, 0])
+        y1 = np.maximum(boxes[i, 1], boxes[:, 1])
+        x2 = np.minimum(boxes[i, 2], boxes[:, 2])
+        y2 = np.minimum(boxes[i, 3], boxes[:, 3])
+        inter = np.clip(x2 - x1, 0, None) * np.clip(y2 - y1, 0, None)
+        area_i = (boxes[i, 2] - boxes[i, 0]) * (boxes[i, 3] - boxes[i, 1])
+        areas = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+        iou = inter / np.maximum(area_i + areas - inter, 1e-9)
+        suppressed |= iou > iou_thresh
+    return keep
+
+
+def _random_boxes(rng, n):
+    xy = rng.uniform(0, 400, size=(n, 2))
+    wh = rng.uniform(5, 80, size=(n, 2))
+    return np.concatenate([xy, xy + wh], axis=-1).astype(np.float32)
+
+
+def test_nms_matches_oracle(rng):
+    boxes = _random_boxes(rng, 200)
+    scores = rng.uniform(0, 1, size=200).astype(np.float32)
+    idx, valid = nms(jnp.asarray(boxes), jnp.asarray(scores), 0.5, max_det=200)
+    got = list(np.asarray(idx)[np.asarray(valid)])
+    want = _np_greedy_nms(boxes, scores, 0.5)
+    assert got == want
+
+
+def test_nms_max_det_truncates(rng):
+    boxes = _random_boxes(rng, 100)
+    scores = rng.uniform(0, 1, size=100).astype(np.float32)
+    idx, valid = nms(jnp.asarray(boxes), jnp.asarray(scores), 0.99, max_det=5)
+    # threshold ~1 => nothing suppressed => top-5 scores in order
+    got = np.asarray(idx)[np.asarray(valid)]
+    want = np.argsort(-scores)[:5]
+    np.testing.assert_array_equal(got, want)
+
+
+def test_nms_ignores_neg_inf_padding(rng):
+    boxes = _random_boxes(rng, 50)
+    scores = np.full(50, -np.inf, np.float32)
+    scores[7] = 0.9
+    idx, valid = nms(jnp.asarray(boxes), jnp.asarray(scores), 0.5, max_det=10)
+    v = np.asarray(valid)
+    assert v.sum() == 1
+    assert np.asarray(idx)[v][0] == 7
+
+
+def test_batched_nms_separates_classes():
+    # Two perfectly overlapping boxes with different classes both survive.
+    boxes = jnp.asarray([[0.0, 0.0, 10.0, 10.0], [0.0, 0.0, 10.0, 10.0]])
+    scores = jnp.asarray([0.9, 0.8])
+    classes = jnp.asarray([0, 1])
+    _, valid = batched_nms(boxes, scores, classes, 0.5, max_det=10)
+    assert np.asarray(valid).sum() == 2
+    _, valid_agnostic = batched_nms(
+        boxes, scores, classes, 0.5, max_det=10, class_agnostic=True
+    )
+    assert np.asarray(valid_agnostic).sum() == 1
+
+
+def test_nms_padded_packs_rows(rng):
+    boxes = _random_boxes(rng, 30)
+    scores = rng.uniform(0.1, 1, size=30).astype(np.float32)
+    classes = rng.integers(0, 3, size=30)
+    valid_in = np.ones(30, bool)
+    valid_in[::3] = False
+    out, valid = nms_padded(
+        jnp.asarray(boxes),
+        jnp.asarray(scores),
+        jnp.asarray(classes),
+        jnp.asarray(valid_in),
+        iou_thresh=0.5,
+        max_det=30,
+    )
+    out, valid = np.asarray(out), np.asarray(valid)
+    # no masked-out input slot may appear in the output
+    kept_scores = set(np.round(out[valid][:, 4], 6))
+    masked_scores = set(np.round(scores[~valid_in], 6))
+    assert not kept_scores & masked_scores
+    # invalid rows are zeroed
+    assert np.all(out[~valid] == 0)
+
+
+def test_extract_boxes_end_to_end(rng):
+    # Build a synthetic prediction with 3 clear detections and noise.
+    n, nc = 512, 4
+    pred = np.zeros((1, n, 5 + nc), np.float32)
+    pred[..., 4] = 0.01  # low obj everywhere
+    # detection 0: class 2 at (100, 100) size 40
+    pred[0, 10] = [100, 100, 40, 40, 0.95] + [0, 0, 0.99, 0]
+    # detection 1: duplicate of 0, lower conf (suppressed)
+    pred[0, 11] = [102, 101, 40, 40, 0.90] + [0, 0, 0.98, 0]
+    # detection 2: class 0 far away
+    pred[0, 50] = [300, 300, 20, 20, 0.9] + [0.97, 0, 0, 0]
+    dets, valid = extract_boxes(jnp.asarray(pred), conf_thresh=0.3, iou_thresh=0.45)
+    dets, valid = np.asarray(dets)[0], np.asarray(valid)[0]
+    kept = dets[valid]
+    assert kept.shape[0] == 2
+    # sorted by score: det0 (0.95*0.99) then det2 (0.9*0.97)
+    np.testing.assert_allclose(kept[0, 5], 2)  # class
+    np.testing.assert_allclose(kept[1, 5], 0)
+    np.testing.assert_allclose(kept[0, :4], [80, 80, 120, 120], atol=1e-3)
+    assert kept[0, 4] > 0.9 and kept[1, 4] > 0.8
+
+
+def test_extract_boxes_no_detections():
+    pred = np.zeros((2, 64, 10), np.float32)
+    dets, valid = extract_boxes(jnp.asarray(pred), conf_thresh=0.3)
+    assert not np.asarray(valid).any()
+    assert np.all(np.asarray(dets) == 0)
+
+
+def test_extract_boxes_multi_label(rng):
+    # One box confidently two classes -> multi_label yields both.
+    pred = np.zeros((1, 64, 8), np.float32)  # nc = 3
+    pred[0, 5] = [50, 50, 20, 20, 0.95, 0.9, 0.85, 0.0]
+    from triton_client_tpu.ops.detect_postprocess import extract_boxes as eb
+
+    dets, valid = eb(jnp.asarray(pred), conf_thresh=0.3, multi_label=True)
+    kept = np.asarray(dets)[0][np.asarray(valid)[0]]
+    assert kept.shape[0] == 2
+    assert set(kept[:, 5].astype(int)) == {0, 1}
+    dets_s, valid_s = eb(jnp.asarray(pred), conf_thresh=0.3, multi_label=False)
+    kept_s = np.asarray(dets_s)[0][np.asarray(valid_s)[0]]
+    assert kept_s.shape[0] == 1 and int(kept_s[0, 5]) == 0

@@ -1,0 +1,95 @@
+"""Preprocess + decode kernels."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from triton_client_tpu.ops import (
+    normalize_image,
+    letterbox,
+    resize_bilinear,
+    image_to_nchw,
+    decode_yolo_grid,
+)
+
+
+def test_normalize_modes(rng):
+    img = rng.integers(0, 255, size=(8, 8, 3)).astype(np.uint8)
+    x = jnp.asarray(img)
+    np.testing.assert_allclose(np.asarray(normalize_image(x, "yolo")), img / 255.0, rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(normalize_image(x, "inception")),
+        img / 127.5 - 1.0,
+        rtol=1e-5,
+        atol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(normalize_image(x, "coco")), img / 255.0, rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(normalize_image(x, "none")), img.astype(np.float32)
+    )
+    vgg = np.asarray(normalize_image(x, "vgg"))
+    np.testing.assert_allclose(vgg, img - np.array([123.0, 117.0, 104.0]), rtol=1e-5)
+
+
+def test_resize_shape():
+    img = jnp.zeros((480, 640, 3), jnp.uint8)
+    out = resize_bilinear(img, (512, 512))
+    assert out.shape == (512, 512, 3)
+
+
+def test_letterbox_geometry():
+    # 200x100 (h, w) into 400x400: gain 2 -> content 400x200, pad_x 100.
+    img = jnp.full((200, 100, 3), 255, jnp.uint8)
+    out, meta = letterbox(img, (400, 400))
+    out, meta = np.asarray(out), np.asarray(meta)
+    assert out.shape == (400, 400, 3)
+    np.testing.assert_allclose(meta, [2.0, 100.0, 0.0])
+    assert np.all(out[:, :100] == 114.0)  # left pad
+    assert np.all(out[:, 300:] == 114.0)  # right pad
+    assert np.all(out[:, 100:300] == 255.0)  # content
+
+
+def test_image_to_nchw():
+    img = jnp.zeros((512, 256, 3))
+    assert image_to_nchw(img).shape == (1, 3, 512, 256)
+
+
+def test_decode_v5_center_cell():
+    """A zero logit decodes to the cell center with anchor-sized box."""
+    h = w = 4
+    raw = np.zeros((1, h, w, 3, 7), np.float32)
+    anchors = np.array([[10, 13], [16, 30], [33, 23]], np.float32)
+    out = np.asarray(decode_yolo_grid(jnp.asarray(raw), anchors, stride=8))
+    assert out.shape == (1, h * w * 3, 7)
+    # sigmoid(0) = 0.5 -> xy = (2*0.5 - 0.5 + g) * 8 = (g + 0.5)*8
+    # wh = (2*0.5)^2 * anchor = anchor
+    first = out[0, 0]  # grid cell (0, 0), anchor 0
+    np.testing.assert_allclose(first[:2], [4.0, 4.0], rtol=1e-5)
+    np.testing.assert_allclose(first[2:4], [10.0, 13.0], rtol=1e-5)
+    np.testing.assert_allclose(first[4:], 0.5, rtol=1e-5)
+
+
+def test_decode_v4_normalized():
+    h = w = 2
+    raw = np.zeros((1, h, w, 1, 6), np.float32)
+    anchors = np.array([[32, 32]], np.float32)
+    out = np.asarray(
+        decode_yolo_grid(
+            jnp.asarray(raw), anchors, stride=16, variant="v4", normalize_hw=(32, 32)
+        )
+    )
+    # sigmoid(0)=0.5 -> xy=(0.5 + g)*16, normalized /32
+    np.testing.assert_allclose(out[0, 0, :2], [0.25, 0.25], rtol=1e-5)
+    # wh = exp(0)*32 / 32 = 1.0
+    np.testing.assert_allclose(out[0, 0, 2:4], [1.0, 1.0], rtol=1e-5)
+
+
+def test_decode_grid_offsets_distinct():
+    h = w = 8
+    raw = np.zeros((1, h, w, 3, 7), np.float32)
+    anchors = np.array([[10, 13], [16, 30], [33, 23]], np.float32)
+    out = np.asarray(decode_yolo_grid(jnp.asarray(raw), anchors, stride=8))
+    xy = out[0, :, :2]
+    # all 64 cells produce distinct centers per anchor
+    assert len({tuple(p) for p in xy[::3].tolist()}) == h * w

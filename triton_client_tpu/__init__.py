@@ -1,0 +1,22 @@
+"""tpu-perception-serving: a TPU-native perception inference framework.
+
+A brand-new JAX/XLA/Pallas re-design of the capabilities of
+niqbal996/triton_client (a ROS->gRPC client for remote Triton GPU
+inference). Instead of shipping frames over the network to a GPU server,
+models are jit-compiled and dispatched in-process on a TPU mesh; the
+gRPC/KServe-v2 protocol is retained only as an optional facade for
+drop-in ROS interop.
+
+Layer map (mirrors reference SURVEY.md section 1, re-designed TPU-first):
+
+  L5  cli/          entry points (detect2d, detect3d, replay, evaluate)
+  L4  drivers/      inference drivers (file/bag/ros sources, pipelined)
+  L3  channel/      transport seam (TPUChannel in-process, GRPCChannel)
+  L2  models/ + per-model pipelines (preprocess/forward/postprocess)
+  L1  ops/          numeric kernels (NMS, IoU, voxelize, decode) in XLA/Pallas
+
+plus parallel/ (mesh + sharding), runtime/ (serving: registry, queue,
+micro-batcher), eval/ (mAP evaluator), utils/.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,39 @@
+"""L1 numeric kernels: pure jittable functions on fixed shapes.
+
+TPU-native replacements for the reference's delegated hot loops
+(torchvision NMS, OpenPCDet voxelization, struct.unpack byte codecs).
+Everything here is shape-static and differentiable-friendly so XLA can
+fuse it into the surrounding model graph.
+"""
+
+from triton_client_tpu.ops.boxes import (
+    xywh2xyxy,
+    xyxy2xywh,
+    box_iou,
+    box_area,
+    scale_boxes,
+)
+from triton_client_tpu.ops.nms import nms, batched_nms, nms_padded
+from triton_client_tpu.ops.preprocess import (
+    normalize_image,
+    letterbox,
+    resize_bilinear,
+    image_to_nchw,
+)
+from triton_client_tpu.ops.yolo_decode import decode_yolo_grid
+
+__all__ = [
+    "xywh2xyxy",
+    "xyxy2xywh",
+    "box_iou",
+    "box_area",
+    "scale_boxes",
+    "nms",
+    "batched_nms",
+    "nms_padded",
+    "normalize_image",
+    "letterbox",
+    "resize_bilinear",
+    "image_to_nchw",
+    "decode_yolo_grid",
+]

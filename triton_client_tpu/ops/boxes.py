@@ -1,0 +1,72 @@
+"""Axis-aligned 2D box utilities (jittable, fixed-shape).
+
+Behavioral parity targets (semantics only, all-new implementation):
+reference utils/postprocess.py:12-103 and
+clients/postprocess/base_postprocess.py:39-110 (xywh2xyxy / box_iou /
+greedy NMS). The reference computes these per-frame on host CPU with
+numpy/torch; here they are jnp functions designed to live inside the
+jit-compiled postprocess so boxes never leave the device.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def xywh2xyxy(boxes: jnp.ndarray) -> jnp.ndarray:
+    """[cx, cy, w, h] -> [x1, y1, x2, y2]; boxes is (..., 4)."""
+    cx, cy, w, h = jnp.split(boxes, 4, axis=-1)
+    return jnp.concatenate(
+        [cx - w * 0.5, cy - h * 0.5, cx + w * 0.5, cy + h * 0.5], axis=-1
+    )
+
+
+def xyxy2xywh(boxes: jnp.ndarray) -> jnp.ndarray:
+    """[x1, y1, x2, y2] -> [cx, cy, w, h]; boxes is (..., 4)."""
+    x1, y1, x2, y2 = jnp.split(boxes, 4, axis=-1)
+    return jnp.concatenate(
+        [(x1 + x2) * 0.5, (y1 + y2) * 0.5, x2 - x1, y2 - y1], axis=-1
+    )
+
+
+def box_area(boxes: jnp.ndarray) -> jnp.ndarray:
+    """Area of (..., 4) xyxy boxes -> (...)."""
+    w = jnp.clip(boxes[..., 2] - boxes[..., 0], 0.0, None)
+    h = jnp.clip(boxes[..., 3] - boxes[..., 1], 0.0, None)
+    return w * h
+
+
+def box_iou(boxes1: jnp.ndarray, boxes2: jnp.ndarray) -> jnp.ndarray:
+    """Pairwise IoU matrix between (N, 4) and (M, 4) xyxy boxes -> (N, M)."""
+    lt = jnp.maximum(boxes1[:, None, :2], boxes2[None, :, :2])
+    rb = jnp.minimum(boxes1[:, None, 2:], boxes2[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0.0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    union = box_area(boxes1)[:, None] + box_area(boxes2)[None, :] - inter
+    return inter / jnp.maximum(union, 1e-9)
+
+
+def scale_boxes(
+    boxes: jnp.ndarray,
+    model_hw: tuple[int, int],
+    orig_hw: tuple[int, int],
+    letterbox_meta: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Rescale xyxy boxes from model input resolution back to the original image.
+
+    Parity: communicator/ros_inference.py:100-115 (_scale_boxes) which
+    multiplies by (orig/model) per-axis after a plain cv2.resize. When
+    ``letterbox_meta`` ([gain, pad_x, pad_y], as returned by
+    ``ops.preprocess.letterbox``) is given, undoes that exact
+    pad+scale instead — consuming the meta avoids recomputing the
+    rounded geometry and drifting by a pixel.
+    """
+    if letterbox_meta is None:
+        mh, mw = model_hw
+        oh, ow = orig_hw
+        sx = ow / mw
+        sy = oh / mh
+        return boxes * jnp.asarray([sx, sy, sx, sy], dtype=boxes.dtype)
+    gain, pad_x, pad_y = letterbox_meta[0], letterbox_meta[1], letterbox_meta[2]
+    pads = jnp.stack([pad_x, pad_y, pad_x, pad_y]).astype(boxes.dtype)
+    return (boxes - pads) / gain

@@ -1,0 +1,77 @@
+"""Jittable image preprocessing.
+
+The reference does host-side cv2.resize + a numpy /255 transpose
+(communicator/ros_inference.py:140, clients/preprocess/yolov5_preprocess.py:12-24).
+Here resize + normalize + layout live inside the compiled graph so the
+host only hands over the raw decoded frame once; XLA fuses the
+normalize into the first conv.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def normalize_image(img: jnp.ndarray, scaling: str = "yolo") -> jnp.ndarray:
+    """Pixel scaling modes.
+
+    Parity: utils/preprocess.py:127-157 (image_adjust) — NONE/INCEPTION/
+    VGG/COCO modes — plus the YOLOv5 /255 path
+    (clients/preprocess/yolov5_preprocess.py:20-24). Input is (..., 3)
+    RGB uint8/float; output float32.
+    """
+    x = img.astype(jnp.float32)
+    if scaling in ("yolo", "coco", "raw255"):
+        return x / 255.0
+    if scaling == "inception":
+        return x / 127.5 - 1.0
+    if scaling == "vgg":
+        return x - jnp.asarray([123.0, 117.0, 104.0], jnp.float32)
+    if scaling == "none":  # detectron-style: raw pixels, no scaling
+        return x
+    raise ValueError(f"unknown scaling mode: {scaling}")
+
+
+@functools.partial(jax.jit, static_argnames=("out_hw",))
+def resize_bilinear(img: jnp.ndarray, out_hw: tuple[int, int]) -> jnp.ndarray:
+    """Bilinear resize of (H, W, C) to out_hw (the cv2.resize default)."""
+    return jax.image.resize(
+        img.astype(jnp.float32),
+        (out_hw[0], out_hw[1], img.shape[-1]),
+        method="bilinear",
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("out_hw", "pad_value"))
+def letterbox(
+    img: jnp.ndarray, out_hw: tuple[int, int], pad_value: float = 114.0
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Aspect-preserving resize + center pad (YOLO letterbox).
+
+    Returns (out, meta) where meta = [gain, pad_x, pad_y] for undoing in
+    ``scale_boxes``. Shapes are static: the scale factor is computed from
+    the static input shape at trace time.
+    """
+    h, w = img.shape[0], img.shape[1]
+    oh, ow = out_hw
+    gain = min(oh / h, ow / w)
+    nh, nw = int(round(h * gain)), int(round(w * gain))
+    resized = jax.image.resize(
+        img.astype(jnp.float32), (nh, nw, img.shape[-1]), method="bilinear"
+    )
+    pad_y, pad_x = (oh - nh) // 2, (ow - nw) // 2
+    out = jnp.full((oh, ow, img.shape[-1]), pad_value, jnp.float32)
+    out = jax.lax.dynamic_update_slice(out, resized, (pad_y, pad_x, 0))
+    meta = jnp.asarray([gain, pad_x, pad_y], jnp.float32)
+    return out, meta
+
+
+def image_to_nchw(img: jnp.ndarray) -> jnp.ndarray:
+    """(H, W, C) -> (1, C, H, W), the reference wire layout
+    (yolov5_preprocess.py:20-24). Models here natively use NHWC (the TPU
+    conv layout); this exists for KServe-facade parity.
+    """
+    return jnp.transpose(img, (2, 0, 1))[None]

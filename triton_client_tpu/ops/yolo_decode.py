@@ -1,0 +1,73 @@
+"""YOLO anchor-grid decode (v5 and v4 conventions), vectorized for XLA.
+
+Parity target: tools/yolo_layer.py:148-288 (yolo_forward_dynamic), which
+decodes raw feature maps with per-scalar python/torch indexing on host.
+Here the decode is a closed-form jnp expression over the whole grid so
+it fuses into the model's jit and runs on the VPU.
+
+Conventions (b = batch, a = anchors-per-scale, h/w = grid, nc = classes):
+  v5: xy = (2*sig(t_xy) - 0.5 + grid) * stride
+      wh = (2*sig(t_wh))**2 * anchor_px
+      obj/cls = sig(t)
+  v4: xy = (sig(t_xy) + grid) * stride      (normalized variant: /input_size)
+      wh = exp(t_wh) * anchor_px
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _grid(h: int, w: int, dtype) -> jnp.ndarray:
+    """(h, w, 2) grid of (x, y) cell offsets."""
+    ys = jnp.arange(h, dtype=dtype)
+    xs = jnp.arange(w, dtype=dtype)
+    gx, gy = jnp.meshgrid(xs, ys)
+    return jnp.stack([gx, gy], axis=-1)
+
+
+def decode_yolo_grid(
+    raw: jnp.ndarray,
+    anchors: jnp.ndarray,
+    stride: int,
+    variant: str = "v5",
+    normalize_hw: tuple[int, int] | None = None,
+) -> jnp.ndarray:
+    """Decode one scale's raw head output.
+
+    Args:
+      raw: (b, h, w, a, 5 + nc) raw logits for one scale.
+      anchors: (a, 2) anchor sizes in input pixels.
+      stride: input-pixels per grid cell for this scale.
+      variant: "v5" or "v4" box parameterization.
+      normalize_hw: if set, divide boxes into [0, 1] by (H, W) — the
+        reference's YOLOv4 path emits normalized boxes
+        (tools/yolo_layer.py:281-287).
+
+    Returns:
+      (b, h*w*a, 5 + nc) decoded [cx, cy, w, h, obj, cls...] in input
+      pixels (or [0, 1] if normalize_hw).
+    """
+    b, h, w, a, no = raw.shape
+    dtype = raw.dtype
+    grid = _grid(h, w, dtype)[None, :, :, None, :]  # (1, h, w, 1, 2)
+    anchors = jnp.asarray(anchors, dtype).reshape(1, 1, 1, a, 2)
+
+    txy, twh, trest = raw[..., :2], raw[..., 2:4], raw[..., 4:]
+    if variant == "v5":
+        xy = (jax.nn.sigmoid(txy) * 2.0 - 0.5 + grid) * stride
+        wh = (jax.nn.sigmoid(twh) * 2.0) ** 2 * anchors
+    elif variant == "v4":
+        xy = (jax.nn.sigmoid(txy) + grid) * stride
+        wh = jnp.exp(twh) * anchors
+    else:
+        raise ValueError(f"unknown decode variant: {variant}")
+    rest = jax.nn.sigmoid(trest)
+
+    out = jnp.concatenate([xy, wh, rest], axis=-1)
+    if normalize_hw is not None:
+        nh, nw = normalize_hw
+        scale = jnp.asarray([nw, nh, nw, nh] + [1.0] * (no - 4), dtype)
+        out = out / scale
+    return out.reshape(b, h * w * a, no)
