@@ -1,0 +1,72 @@
+"""Benchmark: YOLOv5n fused pipeline frames/sec on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Methodology (BASELINE.md): the reference publishes no numbers; its
+serving path is one blocking gRPC round-trip per frame to a remote
+Triton GPU. The honest local anchor is real-time camera rate (30 fps) —
+the rate the reference's ROS pipeline must sustain per stream
+(sub_topic camera streams, SURVEY.md section 3.1). vs_baseline is
+frames/sec/chip divided by that 30 fps anchor; BENCH history tracks
+round-over-round gains.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BATCH = 8
+WARMUP = 3
+ITERS = 30
+CAMERA_FPS_BASELINE = 30.0
+
+
+def main() -> None:
+    from triton_client_tpu.models.yolov5 import init_yolov5
+    from triton_client_tpu.ops.detect_postprocess import extract_boxes
+    from triton_client_tpu.ops.preprocess import normalize_image
+
+    input_hw = (512, 512)
+    model, variables = init_yolov5(
+        jax.random.PRNGKey(0), num_classes=2, variant="n", input_hw=input_hw
+    )
+
+    @jax.jit
+    def pipeline(variables, images):
+        x = normalize_image(images, "yolo")
+        pred = model.decode(model.apply(variables, x, train=False))
+        return extract_boxes(pred, conf_thresh=0.3, iou_thresh=0.45)
+
+    rng = np.random.default_rng(0)
+    frames = jnp.asarray(
+        rng.integers(0, 255, (BATCH, *input_hw, 3)).astype(np.float32)
+    )
+
+    for _ in range(WARMUP):
+        dets, valid = pipeline(variables, frames)
+    jax.block_until_ready((dets, valid))
+
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        dets, valid = pipeline(variables, frames)
+    jax.block_until_ready((dets, valid))
+    dt = time.perf_counter() - t0
+
+    fps = BATCH * ITERS / dt
+    print(
+        json.dumps(
+            {
+                "metric": "yolov5n_512_e2e_frames_per_sec_per_chip",
+                "value": round(fps, 2),
+                "unit": "frames/sec",
+                "vs_baseline": round(fps / CAMERA_FPS_BASELINE, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

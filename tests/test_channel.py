@@ -1,0 +1,82 @@
+"""Channel seam + repository + end-to-end pipeline through TPUChannel."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from triton_client_tpu.channel import InferRequest, TPUChannel
+from triton_client_tpu.config import ModelSpec, TensorSpec
+from triton_client_tpu.parallel.mesh import MeshConfig
+from triton_client_tpu.pipelines import build_yolov5_pipeline
+from triton_client_tpu.runtime import ModelRepository
+
+
+@pytest.fixture(scope="module")
+def repo_with_toy_model():
+    repo = ModelRepository()
+    spec = ModelSpec(
+        name="double",
+        version="1",
+        inputs=(TensorSpec("x", (-1, 4), "FP32"),),
+        outputs=(TensorSpec("y", (-1, 4), "FP32"),),
+    )
+    repo.register(spec, jax.jit(lambda inputs: {"y": inputs["x"] * 2.0}))
+    return repo
+
+
+def test_repository_versioning():
+    repo = ModelRepository()
+    for v in ("1", "2", "10"):
+        spec = ModelSpec(name="m", version=v)
+        repo.register(spec, lambda i: i)
+    assert repo.get("m").spec.version == "10"  # numeric-aware latest
+    assert repo.get("m", "2").spec.version == "2"
+    with pytest.raises(KeyError):
+        repo.get("m", "3")
+    with pytest.raises(KeyError):
+        repo.get("absent")
+
+
+def test_tpu_channel_roundtrip(repo_with_toy_model):
+    chan = TPUChannel(repo_with_toy_model, MeshConfig(data=-1, model=1))
+    req = InferRequest("double", {"x": np.ones((8, 4), np.float32)})
+    resp = chan.do_inference(req)
+    np.testing.assert_allclose(resp.outputs["y"], 2.0)
+    assert resp.model_version == "1"
+    assert chan.get_metadata("double").inputs[0].name == "x"
+
+
+def test_channel_validates_shapes(repo_with_toy_model):
+    chan = TPUChannel(repo_with_toy_model)
+    with pytest.raises(ValueError, match="rank"):
+        chan.do_inference(InferRequest("double", {"x": np.ones((4,), np.float32)}))
+    with pytest.raises(ValueError, match="incompatible"):
+        chan.do_inference(InferRequest("double", {"x": np.ones((2, 5), np.float32)}))
+
+
+def test_channel_shards_batch_over_mesh(repo_with_toy_model):
+    chan = TPUChannel(repo_with_toy_model, MeshConfig(data=8, model=1))
+    assert chan.fetch_channel().shape["data"] == 8
+    resp = chan.do_inference(
+        InferRequest("double", {"x": np.ones((16, 4), np.float32)})
+    )
+    assert resp.outputs["y"].shape == (16, 4)
+
+
+@pytest.mark.slow
+def test_yolov5_pipeline_through_channel():
+    pipeline, spec, _ = build_yolov5_pipeline(
+        variant="n", num_classes=2, input_hw=(128, 128)
+    )
+    repo = ModelRepository()
+    repo.register(spec, pipeline.infer_fn())
+    chan = TPUChannel(repo)
+    frame = np.random.default_rng(0).integers(0, 255, (1, 96, 96, 3)).astype(np.float32)
+    resp = chan.do_inference(InferRequest("yolov5n", {"images": frame}))
+    assert resp.outputs["detections"].shape == (1, 300, 6)
+    assert resp.outputs["valid"].shape == (1, 300)
+    # random weights: boxes (if any) must be inside the original frame
+    dets = resp.outputs["detections"][0][resp.outputs["valid"][0]]
+    if dets.size:
+        assert dets[:, :4].min() >= -96 and dets[:, :4].max() <= 192

@@ -132,12 +132,23 @@ def test_extract_boxes_multi_label(rng):
     # One box confidently two classes -> multi_label yields both.
     pred = np.zeros((1, 64, 8), np.float32)  # nc = 3
     pred[0, 5] = [50, 50, 20, 20, 0.95, 0.9, 0.85, 0.0]
-    from triton_client_tpu.ops.detect_postprocess import extract_boxes as eb
-
-    dets, valid = eb(jnp.asarray(pred), conf_thresh=0.3, multi_label=True)
+    dets, valid = extract_boxes(jnp.asarray(pred), conf_thresh=0.3, multi_label=True)
     kept = np.asarray(dets)[0][np.asarray(valid)[0]]
     assert kept.shape[0] == 2
     assert set(kept[:, 5].astype(int)) == {0, 1}
-    dets_s, valid_s = eb(jnp.asarray(pred), conf_thresh=0.3, multi_label=False)
+    dets_s, valid_s = extract_boxes(jnp.asarray(pred), conf_thresh=0.3, multi_label=False)
     kept_s = np.asarray(dets_s)[0][np.asarray(valid_s)[0]]
     assert kept_s.shape[0] == 1 and int(kept_s[0, 5]) == 0
+
+
+def test_batched_nms_bf16_boxes():
+    # bf16 inputs must not corrupt the class-offset suppression.
+    boxes = jnp.asarray(
+        [[100.0, 100.0, 140.0, 140.0], [101.0, 100.0, 141.0, 140.0],
+         [100.0, 100.0, 140.0, 140.0]], jnp.bfloat16
+    )
+    scores = jnp.asarray([0.9, 0.8, 0.7], jnp.bfloat16)
+    classes = jnp.asarray([1, 1, 2])
+    _, valid = batched_nms(boxes, scores, classes, 0.5, max_det=10)
+    # boxes 0/1 same class overlap -> one survives; box 2 other class survives
+    assert np.asarray(valid).sum() == 2

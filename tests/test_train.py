@@ -1,0 +1,88 @@
+"""Sharded training step on the 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from triton_client_tpu.models.yolov5 import DEFAULT_ANCHORS, init_yolov5
+from triton_client_tpu.parallel.mesh import MeshConfig, make_mesh
+from triton_client_tpu.parallel.train import (
+    LossConfig,
+    detection_loss,
+    init_train_state,
+    make_train_step,
+)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    model, variables = init_yolov5(
+        jax.random.PRNGKey(0), num_classes=3, variant="n", input_hw=(64, 64)
+    )
+    cfg = LossConfig(num_classes=3, anchors=DEFAULT_ANCHORS)
+    return model, variables, cfg
+
+
+def _targets(b, t=4):
+    """Two real boxes + padding per image."""
+    targets = np.zeros((b, t, 5), np.float32)
+    targets[:, 0] = [1, 32, 32, 16, 16]
+    targets[:, 1] = [0, 10, 12, 8, 20]
+    return jnp.asarray(targets)
+
+
+def test_loss_finite_and_decomposes(setup):
+    model, variables, cfg = setup
+    heads = model.apply(variables, jnp.zeros((2, 64, 64, 3)), train=False)
+    loss, metrics = detection_loss(heads, _targets(2), cfg)
+    assert np.isfinite(float(loss))
+    for k in ("box", "obj", "cls"):
+        assert np.isfinite(float(metrics[k])) and float(metrics[k]) >= 0
+
+
+def test_empty_targets_only_obj_loss(setup):
+    model, variables, cfg = setup
+    heads = model.apply(variables, jnp.zeros((1, 64, 64, 3)), train=False)
+    loss, metrics = detection_loss(heads, jnp.zeros((1, 4, 5)), cfg)
+    assert float(metrics["box"]) == 0.0
+    assert float(metrics["cls"]) == 0.0
+    assert float(metrics["obj"]) > 0.0
+
+
+def test_train_step_dp_tp_mesh(setup):
+    """Full step on a 4x2 (data x model) mesh: loss decreases."""
+    model, variables, cfg = setup
+    mesh = make_mesh(MeshConfig(data=4, model=2))
+    optimizer = optax.adam(1e-3)
+    state = init_train_state(model, variables, optimizer, mesh)
+    step = make_train_step(model, optimizer, cfg, mesh)
+
+    images = jnp.ones((8, 64, 64, 3)) * 0.5
+    targets = _targets(8)
+    losses = []
+    for _ in range(6):
+        state, metrics = step(state, images, targets)
+        losses.append(float(metrics["loss"]))
+    assert int(state.step) == 6
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]  # optimizing the same batch must descend
+
+
+def test_tp_shards_wide_kernels(setup):
+    model, variables, cfg = setup
+    mesh = make_mesh(MeshConfig(data=2, model=4))
+    state = init_train_state(model, variables, optax.sgd(1e-3), mesh)
+    # At least one kernel must actually be sharded over 'model' when the
+    # variant has wide enough layers... yolov5n widest cout = 256.
+    # 256 / 4 = 64 < 128 -> policy replicates; use model=2 to check.
+    mesh2 = make_mesh(MeshConfig(data=4, model=2))
+    state2 = init_train_state(model, variables, optax.sgd(1e-3), mesh2)
+    specs = [
+        leaf.sharding.spec
+        for leaf in jax.tree.leaves(state2.variables["params"])
+        if hasattr(leaf, "sharding") and leaf.sharding.spec != ()
+    ]
+    sharded = [s for s in specs if any(x is not None for x in s)]
+    assert sharded, "expected at least one TP-sharded kernel on model=2"

@@ -1,0 +1,74 @@
+"""YOLOv5 model construction, shapes, decode contract."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from triton_client_tpu.models.yolov5 import (
+    YoloV5,
+    init_yolov5,
+    num_predictions,
+)
+
+
+@pytest.fixture(scope="module")
+def small_model():
+    # 128x128 keeps CPU compile fast; nc=2 matches the crop use-case.
+    model, variables = init_yolov5(
+        jax.random.PRNGKey(0), num_classes=2, variant="n", input_hw=(128, 128)
+    )
+    return model, variables
+
+
+def test_head_shapes(small_model):
+    model, variables = small_model
+    heads = model.apply(variables, jnp.zeros((2, 128, 128, 3)), train=False)
+    assert [h.shape for h in heads] == [
+        (2, 16, 16, 3, 7),
+        (2, 8, 8, 3, 7),
+        (2, 4, 4, 3, 7),
+    ]
+
+
+def test_decode_contract(small_model):
+    model, variables = small_model
+    heads = model.apply(variables, jnp.zeros((1, 128, 128, 3)), train=False)
+    pred = model.decode(heads)
+    assert pred.shape == (1, num_predictions((128, 128)), 7)
+    pred = np.asarray(pred)
+    # obj/cls are sigmoids in (0, 1); boxes are finite pixels
+    assert np.all(pred[..., 4:] > 0) and np.all(pred[..., 4:] < 1)
+    assert np.all(np.isfinite(pred))
+    # centers lie within the input canvas (sigmoid bounds the offset)
+    assert pred[..., 0].min() >= -16 and pred[..., 0].max() <= 144
+
+
+def test_num_predictions_reference_contract():
+    # examples/YOLOv5/config.pbtxt serves [1, 16128, 7] at 512x512.
+    assert num_predictions((512, 512)) == 16128
+
+
+def test_train_mode_updates_batch_stats(small_model):
+    model, variables = small_model
+    x = jnp.ones((2, 128, 128, 3)) * 0.5
+    _, mutated = model.apply(
+        variables, x, train=True, mutable=["batch_stats"]
+    )
+    old = jax.tree.leaves(variables["batch_stats"])
+    new = jax.tree.leaves(mutated["batch_stats"])
+    assert any(not np.allclose(a, b) for a, b in zip(old, new))
+
+
+def test_variant_scaling_param_counts():
+    n_params = {}
+    for variant in ("n", "s"):
+        model = YoloV5(num_classes=2, variant=variant)
+        variables = model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 64, 64, 3)), train=False
+        )
+        n_params[variant] = sum(
+            x.size for x in jax.tree.leaves(variables["params"])
+        )
+    # s roughly 4x n (width 0.50 vs 0.25)
+    assert 3.0 < n_params["s"] / n_params["n"] < 5.0

@@ -1,0 +1,72 @@
+"""Channel protocol: register / metadata / infer.
+
+Mirrors the seam of the reference's BaseChannel
+(communicator/channel/base_channel.py:12-34) with two deliberate
+departures:
+
+  * requests/responses are typed dicts of numpy arrays, not a mutable
+    protobuf ModelInferRequest the driver re-fills per frame
+    (grpc_channel.py:63-78) — no serialization on the in-process path;
+  * do_inference takes the request explicitly instead of reading
+    channel-held mutable state, so channels are thread-safe and the
+    driver can pipeline frame N+1's preprocess against frame N's infer.
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+import time
+from typing import Mapping
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class InferRequest:
+    model_name: str
+    inputs: Mapping[str, np.ndarray]
+    model_version: str = ""
+    request_id: str = ""
+
+
+@dataclasses.dataclass
+class InferResponse:
+    model_name: str
+    outputs: dict[str, np.ndarray]
+    model_version: str = ""
+    request_id: str = ""
+    # device-side compute seconds, for the observability stack
+    latency_s: float = 0.0
+
+
+class BaseChannel(abc.ABC):
+    """Transport abstraction between drivers (L4) and models."""
+
+    @abc.abstractmethod
+    def register_channel(self) -> None:
+        """Establish the transport (claim devices / dial the endpoint)."""
+
+    @abc.abstractmethod
+    def fetch_channel(self):
+        """Return the underlying transport handle."""
+
+    @abc.abstractmethod
+    def get_metadata(self, model_name: str, model_version: str = ""):
+        """Return the ModelSpec for a served model."""
+
+    @abc.abstractmethod
+    def do_inference(self, request: InferRequest) -> InferResponse:
+        """Run one inference round-trip."""
+
+
+class TimedInference:
+    """Small mixin: wraps do_inference with wall-clock timing."""
+
+    def timed_inference(
+        self: BaseChannel, request: InferRequest
+    ) -> InferResponse:
+        t0 = time.perf_counter()
+        resp = self.do_inference(request)
+        resp.latency_s = time.perf_counter() - t0
+        return resp

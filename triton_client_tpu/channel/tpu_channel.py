@@ -1,0 +1,84 @@
+"""TPUChannel: the in-process dispatch channel.
+
+This is the framework's answer to the reference's GRPCChannel
+(communicator/channel/grpc_channel.py): instead of serializing ~3 MB of
+image bytes into a protobuf and blocking on a remote GPU server
+(SURVEY.md section 3.1), do_inference is a function call — inputs are
+device_put onto the mesh with the batch axis sharded over `data`, the
+jit-compiled model runs, and outputs come back as numpy only at the
+driver boundary.
+
+"register_channel" claims the device mesh (the analogue of dialing the
+endpoint); "get_metadata" reads the local repository (the analogue of
+the two startup RPCs, grpc_channel.py:39-54).
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import numpy as np
+
+from jax.sharding import NamedSharding, PartitionSpec
+
+from triton_client_tpu.channel.base import BaseChannel, InferRequest, InferResponse
+from triton_client_tpu.config import ModelSpec
+from triton_client_tpu.parallel.mesh import MeshConfig, batch_sharding, make_mesh
+from triton_client_tpu.runtime.repository import ModelRepository
+
+
+class TPUChannel(BaseChannel):
+    def __init__(
+        self,
+        repository: ModelRepository,
+        mesh_config: MeshConfig | None = None,
+        devices=None,
+        validate: bool = True,
+    ) -> None:
+        self._repository = repository
+        self._mesh_config = mesh_config
+        self._devices = devices
+        self._mesh = None
+        self._validate = validate
+        self.register_channel()
+
+    # -- BaseChannel protocol -------------------------------------------------
+
+    def register_channel(self) -> None:
+        self._mesh = make_mesh(self._mesh_config, self._devices)
+
+    def fetch_channel(self):
+        return self._mesh
+
+    def get_metadata(self, model_name: str, model_version: str = "") -> ModelSpec:
+        return self._repository.metadata(model_name, model_version)
+
+    def do_inference(self, request: InferRequest) -> InferResponse:
+        model = self._repository.get(request.model_name, request.model_version)
+        if self._validate:
+            for tensor_spec in model.spec.inputs:
+                if tensor_spec.name in request.inputs:
+                    tensor_spec.validate(np.asarray(request.inputs[tensor_spec.name]))
+        sharding = batch_sharding(self._mesh)
+        device_inputs = {}
+        for name, arr in request.inputs.items():
+            # Shard batch-leading arrays over the data axis when the
+            # batch divides; otherwise replicate (single-frame path).
+            arr = np.asarray(arr)
+            use = (
+                sharding
+                if arr.ndim > 0 and arr.shape[0] % self._mesh.shape["data"] == 0
+                else NamedSharding(self._mesh, PartitionSpec())
+            )
+            device_inputs[name] = jax.device_put(arr, use)
+        t0 = time.perf_counter()
+        outputs = model.infer_fn(device_inputs)
+        outputs = {k: np.asarray(v) for k, v in outputs.items()}
+        return InferResponse(
+            model_name=request.model_name,
+            model_version=model.spec.version,
+            outputs=outputs,
+            request_id=request.request_id,
+            latency_s=time.perf_counter() - t0,
+        )

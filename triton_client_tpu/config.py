@@ -1,0 +1,95 @@
+"""Model specs: the in-tree equivalent of Triton's config.pbtxt.
+
+The reference declares each served model's tensor contract in a
+config.pbtxt (examples/YOLOv5/config.pbtxt, examples/pointpillar_kitti/
+config.pbtxt:27-73) and the client re-parses it over gRPC at startup
+(communicator/channel/grpc_channel.py:39-54, clients/base_client.py:32-104).
+Here the contract is a typed Python dataclass registered alongside the
+model function — metadata queries become dict lookups, and validation
+happens once at registration, not per client process.
+
+Specs are JSON-serializable for the model-repository-on-disk layout and
+for serving them over the KServe v2 facade.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any
+
+import numpy as np
+
+# KServe v2 dtype strings <-> numpy, per the wire contract the reference
+# asserts against (communicator/ros_inference3d.py:141-144).
+_DTYPES = {
+    "FP32": np.float32,
+    "FP16": np.float16,
+    "BF16": None,  # no numpy bf16; handled at the jax boundary
+    "INT32": np.int32,
+    "INT64": np.int64,
+    "UINT8": np.uint8,
+    "INT8": np.int8,
+    "BOOL": np.bool_,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class TensorSpec:
+    """One input/output tensor contract. -1 dims are dynamic (bucketed
+    at dispatch time — XLA itself only sees static shapes)."""
+
+    name: str
+    shape: tuple[int, ...]
+    dtype: str = "FP32"
+    layout: str = ""  # e.g. "NHWC" / "NCHW" for image inputs
+
+    def np_dtype(self) -> np.dtype:
+        if self.dtype not in _DTYPES or _DTYPES[self.dtype] is None:
+            raise ValueError(f"no numpy dtype for {self.dtype}")
+        return np.dtype(_DTYPES[self.dtype])
+
+    def validate(self, arr: np.ndarray) -> None:
+        if len(arr.shape) != len(self.shape):
+            raise ValueError(
+                f"tensor '{self.name}': rank {len(arr.shape)} != spec rank "
+                f"{len(self.shape)}"
+            )
+        for got, want in zip(arr.shape, self.shape):
+            if want != -1 and got != want:
+                raise ValueError(
+                    f"tensor '{self.name}': shape {arr.shape} incompatible "
+                    f"with spec {self.shape}"
+                )
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelSpec:
+    """A model's full serving contract (name, version, tensors, limits)."""
+
+    name: str
+    version: str = "1"
+    platform: str = "jax"
+    inputs: tuple[TensorSpec, ...] = ()
+    outputs: tuple[TensorSpec, ...] = ()
+    max_batch_size: int = 1
+    # Free-form model config (class names file, thresholds, anchor sets,
+    # voxel grid params, ...) — the analogue of the reference's
+    # data/*.yaml hyperparameter files.
+    extra: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def input_by_name(self, name: str) -> TensorSpec:
+        for t in self.inputs:
+            if t.name == name:
+                return t
+        raise KeyError(f"model '{self.name}' has no input '{name}'")
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), indent=2)
+
+    @staticmethod
+    def from_json(text: str) -> "ModelSpec":
+        raw = json.loads(text)
+        raw["inputs"] = tuple(TensorSpec(**t) for t in raw.get("inputs", ()))
+        raw["outputs"] = tuple(TensorSpec(**t) for t in raw.get("outputs", ()))
+        return ModelSpec(**raw)

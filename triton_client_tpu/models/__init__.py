@@ -1,0 +1,9 @@
+"""L2 model zoo: flax modules jit-compiled for TPU.
+
+Where the reference executes networks server-side via onnxruntime /
+libtorch / OpenPCDet-CUDA behind Triton (examples/*/config.pbtxt), the
+models here are first-party JAX: NHWC layouts, bfloat16-friendly,
+static shapes, fused pre/post-processing.
+"""
+
+from triton_client_tpu.models.yolov5 import YoloV5, YOLOV5_VARIANTS

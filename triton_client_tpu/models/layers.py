@@ -1,0 +1,143 @@
+"""Shared conv building blocks (flax.linen, NHWC, bf16-friendly).
+
+All convs are NHWC with explicit SAME-style padding so XLA tiles them
+onto the MXU; channel counts are kept multiples of 8 by the width
+scaler in yolov5.py. BatchNorm runs in inference mode by default
+(use_running_average) and can be trained with mutable batch_stats for
+the fine-tuning/training path.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+def autopad(kernel: int, padding: int | None = None) -> int:
+    """'same' padding for odd kernels (the YOLO convention)."""
+    return kernel // 2 if padding is None else padding
+
+
+class ConvBnAct(nn.Module):
+    """Conv2D + BatchNorm + SiLU — the universal YOLO block."""
+
+    features: int
+    kernel: int = 1
+    stride: int = 1
+    padding: int | None = None
+    groups: int = 1
+    act: bool = True
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        p = autopad(self.kernel, self.padding)
+        x = nn.Conv(
+            self.features,
+            (self.kernel, self.kernel),
+            strides=(self.stride, self.stride),
+            padding=((p, p), (p, p)),
+            feature_group_count=self.groups,
+            use_bias=False,
+            dtype=self.dtype,
+            name="conv",
+        )(x)
+        x = nn.BatchNorm(
+            use_running_average=not train,
+            momentum=0.97,
+            epsilon=1e-3,
+            dtype=self.dtype,
+            name="bn",
+        )(x)
+        if self.act:
+            x = nn.silu(x)
+        return x
+
+
+class Bottleneck(nn.Module):
+    """Two convs with optional residual add."""
+
+    features: int
+    shortcut: bool = True
+    expansion: float = 0.5
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        hidden = int(self.features * self.expansion)
+        y = ConvBnAct(hidden, 1, dtype=self.dtype, name="cv1")(x, train)
+        y = ConvBnAct(self.features, 3, dtype=self.dtype, name="cv2")(y, train)
+        if self.shortcut and x.shape[-1] == self.features:
+            y = x + y
+        return y
+
+
+class C3(nn.Module):
+    """CSP bottleneck with 3 convs: split, stack bottlenecks, merge."""
+
+    features: int
+    depth: int = 1
+    shortcut: bool = True
+    expansion: float = 0.5
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        hidden = int(self.features * self.expansion)
+        a = ConvBnAct(hidden, 1, dtype=self.dtype, name="cv1")(x, train)
+        b = ConvBnAct(hidden, 1, dtype=self.dtype, name="cv2")(x, train)
+        for i in range(self.depth):
+            a = Bottleneck(
+                hidden, self.shortcut, expansion=1.0, dtype=self.dtype, name=f"m{i}"
+            )(a, train)
+        return ConvBnAct(self.features, 1, dtype=self.dtype, name="cv3")(
+            jnp.concatenate([a, b], axis=-1), train
+        )
+
+
+class SPPF(nn.Module):
+    """Spatial pyramid pooling (fast): 3 chained stride-1 maxpools."""
+
+    features: int
+    pool: int = 5
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        hidden = x.shape[-1] // 2
+        x = ConvBnAct(hidden, 1, dtype=self.dtype, name="cv1")(x, train)
+        p = self.pool // 2
+        pools = [x]
+        for _ in range(3):
+            pools.append(
+                nn.max_pool(
+                    pools[-1],
+                    (self.pool, self.pool),
+                    strides=(1, 1),
+                    padding=((p, p), (p, p)),
+                )
+            )
+        return ConvBnAct(self.features, 1, dtype=self.dtype, name="cv2")(
+            jnp.concatenate(pools, axis=-1), train
+        )
+
+
+def upsample2x(x: jnp.ndarray) -> jnp.ndarray:
+    """Nearest-neighbor 2x upsample (NHWC)."""
+    b, h, w, c = x.shape
+    x = jnp.broadcast_to(x[:, :, None, :, None, :], (b, h, 2, w, 2, c))
+    return x.reshape(b, h * 2, w * 2, c)
+
+
+def make_divisible(v: float, divisor: int = 8) -> int:
+    """Round channel counts to a hardware-friendly multiple."""
+    return max(divisor, int(round(v / divisor) * divisor))
+
+
+def scale_depth(n: int, depth_multiple: float) -> int:
+    return max(1, round(n * depth_multiple))
+
+
+Shape = Sequence[int]

@@ -1,0 +1,143 @@
+"""YOLOv5 in flax (NHWC, TPU-first).
+
+The reference serves YOLOv5 as a server-side ONNX artifact
+(examples/YOLOv5/config.pbtxt: 3x512x512 FP32 in -> [1, 16128, 7] out)
+and never owns the network. Here the network is first-party so the
+whole pre->forward->decode->NMS path compiles into one XLA program.
+
+Architecture: v6.0-style CSP backbone + SPPF + PANet neck + anchor
+Detect head at strides 8/16/32. Variant scaling via
+(depth_multiple, width_multiple) as in upstream YOLOv5 (n/s/m/l/x).
+With nc=2 and 512x512 input the decoded output is (1, 16128, 7) —
+matching the reference's served tensor contract.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from triton_client_tpu.models.layers import (
+    C3,
+    SPPF,
+    ConvBnAct,
+    make_divisible,
+    scale_depth,
+    upsample2x,
+)
+from triton_client_tpu.ops.yolo_decode import decode_yolo_grid
+
+# (depth_multiple, width_multiple), upstream YOLOv5 scaling table.
+YOLOV5_VARIANTS: dict[str, tuple[float, float]] = {
+    "n": (0.33, 0.25),
+    "s": (0.33, 0.50),
+    "m": (0.67, 0.75),
+    "l": (1.0, 1.0),
+    "x": (1.33, 1.25),
+}
+
+# COCO-default anchor grid per stride (P3/8, P4/16, P5/32), pixels.
+DEFAULT_ANCHORS: tuple[tuple[tuple[int, int], ...], ...] = (
+    ((10, 13), (16, 30), (33, 23)),
+    ((30, 61), (62, 45), (59, 119)),
+    ((116, 90), (156, 198), (373, 326)),
+)
+STRIDES = (8, 16, 32)
+
+
+class YoloV5(nn.Module):
+    """YOLOv5 detector. ``__call__`` returns raw per-scale head tensors
+    (for the training loss); ``decode`` maps them to (B, N, 5+nc)."""
+
+    num_classes: int = 80
+    variant: str = "n"
+    anchors: Sequence[Sequence[tuple[int, int]]] = DEFAULT_ANCHORS
+    dtype: jnp.dtype = jnp.float32
+
+    def _c(self, ch: int) -> int:
+        return make_divisible(ch * YOLOV5_VARIANTS[self.variant][1])
+
+    def _d(self, n: int) -> int:
+        return scale_depth(n, YOLOV5_VARIANTS[self.variant][0])
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> list[jnp.ndarray]:
+        """x: (B, H, W, 3) float in [0, 1]. Returns raw head outputs
+        [(B, H/8, W/8, a, 5+nc), (B, H/16, ...), (B, H/32, ...)]."""
+        c, d, dt = self._c, self._d, self.dtype
+        na = len(self.anchors[0])
+        no = 5 + self.num_classes
+
+        x = x.astype(dt)
+        # Backbone
+        x = ConvBnAct(c(64), 6, 2, padding=2, dtype=dt, name="stem")(x, train)
+        x = ConvBnAct(c(128), 3, 2, dtype=dt, name="down2")(x, train)
+        x = C3(c(128), d(3), dtype=dt, name="c3_2")(x, train)
+        x = ConvBnAct(c(256), 3, 2, dtype=dt, name="down3")(x, train)
+        p3 = C3(c(256), d(6), dtype=dt, name="c3_3")(x, train)
+        x = ConvBnAct(c(512), 3, 2, dtype=dt, name="down4")(p3, train)
+        p4 = C3(c(512), d(9), dtype=dt, name="c3_4")(x, train)
+        x = ConvBnAct(c(1024), 3, 2, dtype=dt, name="down5")(p4, train)
+        x = C3(c(1024), d(3), dtype=dt, name="c3_5")(x, train)
+        p5 = SPPF(c(1024), 5, dtype=dt, name="sppf")(x, train)
+
+        # PANet neck: top-down then bottom-up.
+        t5 = ConvBnAct(c(512), 1, dtype=dt, name="lat5")(p5, train)
+        x = jnp.concatenate([upsample2x(t5), p4], axis=-1)
+        n4 = C3(c(512), d(3), shortcut=False, dtype=dt, name="c3_up4")(x, train)
+        t4 = ConvBnAct(c(256), 1, dtype=dt, name="lat4")(n4, train)
+        x = jnp.concatenate([upsample2x(t4), p3], axis=-1)
+        out3 = C3(c(256), d(3), shortcut=False, dtype=dt, name="c3_up3")(x, train)
+        x = ConvBnAct(c(256), 3, 2, dtype=dt, name="pan3")(out3, train)
+        x = jnp.concatenate([x, t4], axis=-1)
+        out4 = C3(c(512), d(3), shortcut=False, dtype=dt, name="c3_pan4")(x, train)
+        x = ConvBnAct(c(512), 3, 2, dtype=dt, name="pan4")(out4, train)
+        x = jnp.concatenate([x, t5], axis=-1)
+        out5 = C3(c(1024), d(3), shortcut=False, dtype=dt, name="c3_pan5")(x, train)
+
+        # Detect head: 1x1 conv per scale -> (B, h, w, a, no). Kept in
+        # f32 regardless of compute dtype: box regression is
+        # precision-sensitive at the output.
+        heads = []
+        for i, feat in enumerate((out3, out4, out5)):
+            h = nn.Conv(na * no, (1, 1), dtype=jnp.float32, name=f"detect{i}")(
+                feat.astype(jnp.float32)
+            )
+            b, hh, ww, _ = h.shape
+            heads.append(h.reshape(b, hh, ww, na, no))
+        return heads
+
+    def decode(self, heads: list[jnp.ndarray]) -> jnp.ndarray:
+        """Raw head outputs -> (B, sum(h*w*a), 5+nc) decoded predictions
+        in input-pixel units (the reference's served [1, 16128, 7]
+        contract for 512x512 / nc=2)."""
+        decoded = [
+            decode_yolo_grid(
+                head, np.asarray(self.anchors[i], np.float32), STRIDES[i], "v5"
+            )
+            for i, head in enumerate(heads)
+        ]
+        return jnp.concatenate(decoded, axis=1)
+
+
+def num_predictions(input_hw: tuple[int, int], num_anchors: int = 3) -> int:
+    """Total prediction slots for an input size (e.g. 512 -> 16128)."""
+    h, w = input_hw
+    return sum((h // s) * (w // s) * num_anchors for s in STRIDES)
+
+
+def init_yolov5(
+    rng: Any,
+    num_classes: int = 80,
+    variant: str = "n",
+    input_hw: tuple[int, int] = (512, 512),
+    dtype: jnp.dtype = jnp.float32,
+):
+    """Build module + init variables. Returns (module, variables)."""
+    model = YoloV5(num_classes=num_classes, variant=variant, dtype=dtype)
+    dummy = jnp.zeros((1, input_hw[0], input_hw[1], 3), jnp.float32)
+    variables = model.init(rng, dummy, train=False)
+    return model, variables

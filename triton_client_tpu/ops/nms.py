@@ -93,10 +93,14 @@ def batched_nms(
     class_agnostic: bool = False,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Class-aware NMS via the per-class coordinate offset trick."""
+    # Offsets are computed in f32 regardless of input dtype: at bf16,
+    # coords shifted by class*4096 lose all sub-32px structure and the
+    # suppression becomes garbage for classes >= 1.
+    boxes32 = boxes.astype(jnp.float32)
     if class_agnostic:
-        offset_boxes = boxes
+        offset_boxes = boxes32
     else:
-        offset_boxes = boxes + (classes.astype(boxes.dtype) * MAX_WH)[:, None]
+        offset_boxes = boxes32 + (classes.astype(jnp.float32) * MAX_WH)[:, None]
     return nms(offset_boxes, scores, iou_thresh=iou_thresh, max_det=max_det)
 
 

@@ -50,7 +50,11 @@ def decode_yolo_grid(
       pixels (or [0, 1] if normalize_hw).
     """
     b, h, w, a, no = raw.shape
-    dtype = raw.dtype
+    # Decode in f32 always: grid offsets and pixel boxes are not
+    # representable in bf16 past ~128 cells (spacing 1 at [128, 256)),
+    # which would snap centers to cell edges on large inputs.
+    raw = raw.astype(jnp.float32)
+    dtype = jnp.float32
     grid = _grid(h, w, dtype)[None, :, :, None, :]  # (1, h, w, 1, 2)
     anchors = jnp.asarray(anchors, dtype).reshape(1, 1, 1, a, 2)
 

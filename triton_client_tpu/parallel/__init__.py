@@ -1,0 +1,15 @@
+"""Device mesh + sharding policy.
+
+The reference has no device parallelism at all (SURVEY.md section 2.10
+— one blocking RPC per frame, NCCL/MPI absent). This package supplies
+the TPU-native scale story: a named `jax.sharding.Mesh` with XLA
+collectives over ICI/DCN, batch/data sharding for multi-camera serving,
+and the sharded training step used for fine-tuning.
+"""
+
+from triton_client_tpu.parallel.mesh import (
+    MeshConfig,
+    make_mesh,
+    batch_sharding,
+    replicated,
+)

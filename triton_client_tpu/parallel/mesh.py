@@ -1,0 +1,56 @@
+"""Mesh construction and canonical shardings.
+
+Axes:
+  data   — batch / camera-stream data parallelism (the BASELINE.json
+           "ensemble multi-camera over v5e-8" config maps cameras here)
+  model  — tensor parallelism for wide layers (conv channel sharding,
+           voxel-axis sharding for the 3D stack)
+
+On a single host this is `jax.devices()` reshaped; on multi-host the
+same code runs under `jax.distributed` with DCN-attached hosts, with
+the data axis laid out across hosts (DCN) and model across the
+intra-slice ICI ring, so heavy collectives stay on ICI.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    data: int = -1  # -1: all remaining devices
+    model: int = 1
+
+    def resolve(self, n_devices: int) -> tuple[int, int]:
+        model = max(1, self.model)
+        data = self.data if self.data > 0 else n_devices // model
+        if data * model != n_devices:
+            raise ValueError(
+                f"mesh {data}x{model} != {n_devices} devices available"
+            )
+        return data, model
+
+
+def make_mesh(config: MeshConfig | None = None, devices=None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    config = config or MeshConfig()
+    data, model = config.resolve(len(devices))
+    arr = np.asarray(devices).reshape(data, model)
+    return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the leading (batch) dim over the data axis, replicate rest."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

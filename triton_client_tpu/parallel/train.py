@@ -1,0 +1,256 @@
+"""Sharded fine-tuning step for the detector zoo.
+
+The reference is inference-only (weights arrive as server-side .pth/
+ONNX artifacts, SURVEY.md section 5 "checkpoint/resume"); this module
+adds the training capability TPU-natively so models can be fine-tuned
+(e.g. the crop/weed classes) on the same mesh that serves them:
+
+  * data parallelism over the `data` mesh axis (batch sharding),
+  * tensor parallelism over `model` for wide conv kernels (output-
+    channel sharding; XLA inserts the all-gathers/reduce-scatters),
+  * loss: YOLOv5-style anchor-matched detection loss — wh-ratio anchor
+    matching, CIoU box loss, BCE objectness (IoU-weighted targets), BCE
+    class loss — written gather/scatter-style with static shapes
+    (targets padded to max_boxes per image).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Mapping
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from triton_client_tpu.models.yolov5 import STRIDES, YoloV5
+from triton_client_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+
+# --------------------------------------------------------------------------
+# Sharding policy
+# --------------------------------------------------------------------------
+
+def param_spec(path: tuple, leaf: jnp.ndarray, model_size: int) -> P:
+    """Output-channel TP for wide conv kernels; everything else replicated.
+
+    Conv kernels are (kh, kw, cin, cout); sharding cout over `model`
+    splits both the matmul and the activations feeding the next layer.
+    Only kernels whose cout divides evenly and is wide enough to keep
+    per-device tiles MXU-friendly (>= 128 per shard) are sharded.
+    """
+    if leaf.ndim >= 2:
+        cout = leaf.shape[-1]
+        if cout % model_size == 0 and cout // model_size >= 128:
+            return P(*([None] * (leaf.ndim - 1) + [MODEL_AXIS]))
+    return P()
+
+
+def shard_variables(variables: Mapping, mesh: Mesh):
+    """device_put model variables per the TP policy."""
+    model_size = mesh.shape[MODEL_AXIS]
+
+    def place(path, leaf):
+        spec = param_spec(path, leaf, model_size)
+        # np.asarray forces a host copy first: device_put alone can alias
+        # the caller's buffer (same-device zero-copy), and the train step
+        # donates its state — donation must not delete the caller's arrays.
+        return jax.device_put(np.asarray(leaf), NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map_with_path(place, dict(variables))
+
+
+# --------------------------------------------------------------------------
+# Detection loss
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class LossConfig:
+    num_classes: int
+    anchors: tuple  # ((a, 2) per scale), pixels
+    box_w: float = 0.05
+    obj_w: float = 1.0
+    cls_w: float = 0.5
+    anchor_t: float = 4.0  # wh-ratio match threshold (YOLOv5 default)
+
+
+def _bce(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
+    """Elementwise binary cross-entropy on logits."""
+    return jnp.maximum(logits, 0) - logits * targets + jnp.log1p(
+        jnp.exp(-jnp.abs(logits))
+    )
+
+
+def _ciou(box1: jnp.ndarray, box2: jnp.ndarray) -> jnp.ndarray:
+    """Complete-IoU between (..., 4) cxcywh boxes."""
+    b1x1, b1y1 = box1[..., 0] - box1[..., 2] / 2, box1[..., 1] - box1[..., 3] / 2
+    b1x2, b1y2 = box1[..., 0] + box1[..., 2] / 2, box1[..., 1] + box1[..., 3] / 2
+    b2x1, b2y1 = box2[..., 0] - box2[..., 2] / 2, box2[..., 1] - box2[..., 3] / 2
+    b2x2, b2y2 = box2[..., 0] + box2[..., 2] / 2, box2[..., 1] + box2[..., 3] / 2
+    inter = jnp.clip(jnp.minimum(b1x2, b2x2) - jnp.maximum(b1x1, b2x1), 0) * jnp.clip(
+        jnp.minimum(b1y2, b2y2) - jnp.maximum(b1y1, b2y1), 0
+    )
+    w1, h1 = box1[..., 2], box1[..., 3]
+    w2, h2 = box2[..., 2], box2[..., 3]
+    union = w1 * h1 + w2 * h2 - inter
+    iou = inter / jnp.maximum(union, 1e-9)
+    # enclosing box diagonal
+    cw = jnp.maximum(b1x2, b2x2) - jnp.minimum(b1x1, b2x1)
+    ch = jnp.maximum(b1y2, b2y2) - jnp.minimum(b1y1, b2y1)
+    c2 = cw**2 + ch**2 + 1e-9
+    rho2 = (box2[..., 0] - box1[..., 0]) ** 2 + (box2[..., 1] - box1[..., 1]) ** 2
+    v = (4 / jnp.pi**2) * (jnp.arctan(w2 / jnp.maximum(h2, 1e-9))
+                           - jnp.arctan(w1 / jnp.maximum(h1, 1e-9))) ** 2
+    alpha = v / jnp.maximum(1 - iou + v, 1e-9)
+    return iou - rho2 / c2 - jax.lax.stop_gradient(alpha) * v
+
+
+def detection_loss(
+    heads: list[jnp.ndarray],
+    targets: jnp.ndarray,
+    cfg: LossConfig,
+) -> tuple[jnp.ndarray, dict[str, jnp.ndarray]]:
+    """YOLOv5-style loss over raw head outputs.
+
+    targets: (B, T, 5) rows [cls, cx, cy, w, h] in input pixels, padded
+    with w == 0 rows. Assignment: a target matches anchor `a` at its
+    center cell when max(wh/anchor, anchor/wh) < anchor_t.
+    """
+    total_box = total_obj = total_cls = 0.0
+    tw = targets[..., 3]
+    t_valid = tw > 0  # (B, T)
+
+    for si, raw in enumerate(heads):
+        b, h, w, na, no = raw.shape
+        stride = STRIDES[si]
+        anchors = jnp.asarray(cfg.anchors[si], jnp.float32)  # (na, 2)
+
+        # --- matching (static shapes: B x T x na candidate grid)
+        t_wh = targets[..., 3:5]  # (B, T, 2)
+        ratio = t_wh[:, :, None, :] / anchors[None, None]  # (B, T, na, 2)
+        worst = jnp.maximum(ratio, 1.0 / jnp.maximum(ratio, 1e-9)).max(-1)
+        matched = (worst < cfg.anchor_t) & t_valid[:, :, None]  # (B, T, na)
+
+        gi = jnp.clip((targets[..., 1] / stride).astype(jnp.int32), 0, w - 1)
+        gj = jnp.clip((targets[..., 2] / stride).astype(jnp.int32), 0, h - 1)
+
+        # --- gather predictions at each (target, anchor) slot
+        def per_image(raw_i, gi_i, gj_i):
+            return raw_i[gj_i, gi_i]  # (T, na, no)
+
+        pred_t = jax.vmap(per_image)(raw, gi, gj)  # (B, T, na, no)
+
+        # decode boxes at matched cells (v5 parameterization)
+        pxy = (jax.nn.sigmoid(pred_t[..., :2]) * 2.0 - 0.5
+               + jnp.stack([gi, gj], -1)[:, :, None, :]) * stride
+        pwh = (jax.nn.sigmoid(pred_t[..., 2:4]) * 2.0) ** 2 * anchors[None, None]
+        pbox = jnp.concatenate([pxy, pwh], -1)
+        tbox = jnp.broadcast_to(
+            targets[:, :, None, 1:5], pbox.shape
+        )
+        ciou = _ciou(pbox, tbox)  # (B, T, na)
+        n_matched = jnp.maximum(matched.sum(), 1)
+        total_box += ((1.0 - ciou) * matched).sum() / n_matched
+
+        # --- objectness: scatter IoU targets into the (B, h, w, na) grid
+        obj_tgt = jnp.zeros((b, h, w, na), jnp.float32)
+        bidx = jnp.broadcast_to(jnp.arange(b)[:, None, None], matched.shape)
+        aidx = jnp.broadcast_to(jnp.arange(na)[None, None, :], matched.shape)
+        gjb = jnp.broadcast_to(gj[:, :, None], matched.shape)
+        gib = jnp.broadcast_to(gi[:, :, None], matched.shape)
+        iou_tgt = jnp.where(matched, jnp.clip(ciou, 0.0), 0.0)
+        obj_tgt = obj_tgt.at[
+            bidx.reshape(-1), gjb.reshape(-1), gib.reshape(-1), aidx.reshape(-1)
+        ].max(iou_tgt.reshape(-1))
+        total_obj += _bce(raw[..., 4], jax.lax.stop_gradient(obj_tgt)).mean()
+
+        # --- classification at matched slots
+        if cfg.num_classes > 1:
+            t_cls = jax.nn.one_hot(targets[..., 0].astype(jnp.int32), cfg.num_classes)
+            t_cls = jnp.broadcast_to(t_cls[:, :, None, :], pred_t[..., 5:].shape)
+            cls_bce = _bce(pred_t[..., 5:], t_cls).sum(-1)
+            total_cls += (cls_bce * matched).sum() / n_matched
+
+    loss = (
+        cfg.box_w * total_box + cfg.obj_w * total_obj + cfg.cls_w * total_cls
+    )
+    return loss, {
+        "loss": loss,
+        "box": total_box,
+        "obj": total_obj,
+        "cls": total_cls,
+    }
+
+
+# --------------------------------------------------------------------------
+# Train step
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class TrainState:
+    variables: Any  # {'params': ..., 'batch_stats': ...}
+    opt_state: Any
+    step: jnp.ndarray
+
+
+def make_train_step(
+    model: YoloV5,
+    optimizer: optax.GradientTransformation,
+    loss_cfg: LossConfig,
+    mesh: Mesh,
+):
+    """Build the pjit-compiled train step: (state, images, targets) ->
+    (state, metrics). Images are sharded over `data`; params follow the
+    TP policy; optimizer state inherits param shardings."""
+
+    def step_fn(state: TrainState, images: jnp.ndarray, targets: jnp.ndarray):
+        def loss_fn(params):
+            variables = {**state.variables, "params": params}
+            heads, mutated = model.apply(
+                variables, images, train=True, mutable=["batch_stats"]
+            )
+            loss, metrics = detection_loss(heads, targets, loss_cfg)
+            return loss, (metrics, mutated["batch_stats"])
+
+        grads, (metrics, new_stats) = jax.grad(loss_fn, has_aux=True)(
+            state.variables["params"]
+        )
+        updates, new_opt = optimizer.update(
+            grads, state.opt_state, state.variables["params"]
+        )
+        new_params = optax.apply_updates(state.variables["params"], updates)
+        new_state = TrainState(
+            variables={"params": new_params, "batch_stats": new_stats},
+            opt_state=new_opt,
+            step=state.step + 1,
+        )
+        return new_state, metrics
+
+    data_sharding = NamedSharding(mesh, P(DATA_AXIS))
+    jitted = jax.jit(
+        step_fn,
+        in_shardings=(None, data_sharding, data_sharding),
+        donate_argnums=(0,),
+    )
+    return jitted
+
+
+def init_train_state(
+    model: YoloV5,
+    variables: Mapping,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+) -> TrainState:
+    sharded = shard_variables(variables, mesh)
+    opt_state = optimizer.init(sharded["params"])
+    return TrainState(
+        variables=sharded, opt_state=opt_state, step=jnp.zeros((), jnp.int32)
+    )
+
+
+jax.tree_util.register_dataclass(
+    TrainState, data_fields=["variables", "opt_state", "step"], meta_fields=[]
+)

@@ -1,0 +1,15 @@
+"""L2 per-model pipelines (the reference's clients/ layer, re-designed).
+
+A reference "client" is a strategy bundle of parse_model + preprocess +
+postprocess objects that run on host around a remote RPC
+(clients/yolov5_client.py, clients/base_client.py). A pipeline here is
+the same bundle compiled into ONE jitted device function:
+resize/normalize -> forward -> decode -> NMS -> box rescale, so a frame
+crosses host<->device exactly once each way per inference.
+"""
+
+from triton_client_tpu.pipelines.detect2d import (
+    Detect2DConfig,
+    Detect2DPipeline,
+    build_yolov5_pipeline,
+)

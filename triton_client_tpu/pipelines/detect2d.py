@@ -1,0 +1,166 @@
+"""2D detection pipeline: raw frame(s) in, packed detections out.
+
+Fuses the reference's five host/device hops (cv2.resize -> numpy
+normalize -> gRPC -> torch NMS -> numpy rescale; SURVEY.md section 3.1)
+into one XLA program per input resolution. Re-traces once per distinct
+camera resolution (static shapes), then every frame is a single
+dispatch.
+
+Output contract per image: (max_det, 6) rows [x1, y1, x2, y2, conf,
+class] in ORIGINAL image pixels + validity mask — the fixed-shape
+analogue of the reference's variable-length list
+(yolov5_postprocess.py:34 + ros_inference.py:100-115 rescale).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from triton_client_tpu.config import ModelSpec, TensorSpec
+from triton_client_tpu.models.yolov5 import YoloV5, num_predictions
+from triton_client_tpu.ops.boxes import scale_boxes
+from triton_client_tpu.ops.detect_postprocess import extract_boxes
+from triton_client_tpu.ops.preprocess import normalize_image
+
+
+@dataclasses.dataclass(frozen=True)
+class Detect2DConfig:
+    """Pipeline hyperparameters (reference: argparse FLAGS main.py:51-113
+    + per-model thresholds ros_inference.py:148)."""
+
+    model_name: str = "yolov5"
+    input_hw: tuple[int, int] = (512, 512)
+    num_classes: int = 80
+    conf_thresh: float = 0.3
+    iou_thresh: float = 0.45
+    max_det: int = 300
+    max_nms: int = 1024
+    scaling: str = "yolo"
+    multi_label: bool = False
+    class_names: tuple[str, ...] = ()
+
+
+class Detect2DPipeline:
+    """Wraps a detector apply-fn into the fused frame->detections jit."""
+
+    def __init__(
+        self,
+        config: Detect2DConfig,
+        forward: Callable[[jnp.ndarray], jnp.ndarray],
+    ) -> None:
+        """``forward``: (B, H, W, 3) float input -> (B, N, 5+nc) decoded
+        predictions in input-pixel units."""
+        self.config = config
+        self._forward = forward
+        self._jit = jax.jit(self._pipeline, static_argnames=("orig_hw",))
+
+    def _pipeline(
+        self, frames: jnp.ndarray, orig_hw: tuple[int, int]
+    ) -> tuple[jnp.ndarray, jnp.ndarray]:
+        cfg = self.config
+        x = frames.astype(jnp.float32)
+        if orig_hw != cfg.input_hw:
+            b = x.shape[0]
+            x = jax.image.resize(
+                x, (b, cfg.input_hw[0], cfg.input_hw[1], 3), method="bilinear"
+            )
+        x = normalize_image(x, cfg.scaling)
+        pred = self._forward(x)
+        dets, valid = extract_boxes(
+            pred,
+            conf_thresh=cfg.conf_thresh,
+            iou_thresh=cfg.iou_thresh,
+            max_det=cfg.max_det,
+            max_nms=cfg.max_nms,
+            multi_label=cfg.multi_label,
+        )
+        boxes = scale_boxes(dets[..., :4], cfg.input_hw, orig_hw)
+        dets = jnp.concatenate([boxes, dets[..., 4:]], axis=-1)
+        dets = jnp.where(valid[..., None], dets, 0.0)
+        return dets, valid
+
+    def infer(self, frames: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """frames: (B, H, W, 3) or (H, W, 3) uint8/float RGB. Returns
+        ((B, max_det, 6), (B, max_det)) numpy; batch dim added if absent."""
+        squeeze = frames.ndim == 3
+        if squeeze:
+            frames = frames[None]
+        orig_hw = (frames.shape[1], frames.shape[2])
+        dets, valid = self._jit(jnp.asarray(frames), orig_hw)
+        dets, valid = np.asarray(dets), np.asarray(valid)
+        return (dets[0], valid[0]) if squeeze else (dets, valid)
+
+    def infer_fn(self):
+        """Repository-facing dict->dict adapter."""
+
+        def fn(inputs):
+            frames = inputs["images"]
+            orig_hw = (frames.shape[1], frames.shape[2])
+            dets, valid = self._jit(frames, orig_hw)
+            return {"detections": dets, "valid": valid}
+
+        return fn
+
+
+def load_class_names(path: str) -> tuple[str, ...]:
+    """data/*.names loader (one class per line; reference
+    yolov5_postprocess.py:19-26)."""
+    with open(path) as f:
+        return tuple(line.strip() for line in f if line.strip())
+
+
+def build_yolov5_pipeline(
+    rng: jax.Array | None = None,
+    variant: str = "n",
+    num_classes: int = 80,
+    input_hw: tuple[int, int] = (512, 512),
+    variables=None,
+    dtype: jnp.dtype = jnp.float32,
+    config: Detect2DConfig | None = None,
+) -> tuple[Detect2DPipeline, ModelSpec, dict]:
+    """Construct model + pipeline + serving spec in one call.
+
+    The spec mirrors the reference's served contract
+    (examples/YOLOv5/config.pbtxt: images in, [1, N, 5+nc] out) plus the
+    packed-detections outputs unique to the fused pipeline.
+    """
+    model = YoloV5(num_classes=num_classes, variant=variant, dtype=dtype)
+    if variables is None:
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        dummy = jnp.zeros((1, input_hw[0], input_hw[1], 3), jnp.float32)
+        variables = model.init(rng, dummy, train=False)
+
+    def forward(x: jnp.ndarray) -> jnp.ndarray:
+        return model.decode(model.apply(variables, x, train=False))
+
+    cfg = config or Detect2DConfig(
+        model_name=f"yolov5{variant}", input_hw=input_hw, num_classes=num_classes
+    )
+    pipeline = Detect2DPipeline(cfg, forward)
+    spec = ModelSpec(
+        name=cfg.model_name,
+        version="1",
+        platform="jax",
+        # Any camera resolution is accepted; the jitted graph re-traces
+        # once per distinct resolution and resizes to input_hw on-device.
+        inputs=(TensorSpec("images", (-1, -1, -1, 3), "FP32", "NHWC"),),
+        outputs=(
+            TensorSpec("detections", (-1, cfg.max_det, 6), "FP32"),
+            TensorSpec("valid", (-1, cfg.max_det), "BOOL"),
+        ),
+        extra={
+            "conf_thresh": cfg.conf_thresh,
+            "iou_thresh": cfg.iou_thresh,
+            "model_input_hw": list(input_hw),
+            "num_predictions": num_predictions(input_hw),
+            "num_classes": num_classes,
+        },
+    )
+    return pipeline, spec, variables

@@ -1,0 +1,9 @@
+"""Serving runtime: model repository, request queue, micro-batcher.
+
+The in-tree replacement for the Triton Inference Server runtime the
+reference deploys in docker (docker/server/Dockerfile:23-27): model
+versioning + registry, dispatch to pjit-compiled functions, optional
+micro-batching, and the KServe v2 gRPC facade for ROS interop.
+"""
+
+from triton_client_tpu.runtime.repository import ModelRepository, RegisteredModel

@@ -1,0 +1,73 @@
+"""Model repository: versioned registry of jit-compiled model functions.
+
+Triton's model repository is a directory tree of config.pbtxt + backend
+artifacts loaded by a C++ backend manager (reference examples/ layout,
+SURVEY.md section 2 #20-21). Here a model is a ModelSpec plus a python
+callable over jax arrays; versions are kept in a sorted dict and "the
+latest version" is the default serve target, matching Triton's
+version_policy default.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Callable, Mapping
+
+from triton_client_tpu.config import ModelSpec
+
+# An infer function maps {input_name: jax.Array} -> {output_name: jax.Array}.
+InferFn = Callable[[Mapping[str, object]], dict[str, object]]
+
+
+@dataclasses.dataclass
+class RegisteredModel:
+    spec: ModelSpec
+    infer_fn: InferFn
+    # Optional warmup callable (compile-ahead on register)
+    warmup: Callable[[], None] | None = None
+
+
+class ModelRepository:
+    """Thread-safe name -> version -> model registry."""
+
+    def __init__(self) -> None:
+        self._models: dict[str, dict[str, RegisteredModel]] = {}
+        self._lock = threading.Lock()
+
+    def register(
+        self,
+        spec: ModelSpec,
+        infer_fn: InferFn,
+        warmup: Callable[[], None] | None = None,
+    ) -> None:
+        with self._lock:
+            self._models.setdefault(spec.name, {})[spec.version] = RegisteredModel(
+                spec, infer_fn, warmup
+            )
+
+    def unregister(self, name: str, version: str = "") -> None:
+        with self._lock:
+            if version:
+                self._models.get(name, {}).pop(version, None)
+            else:
+                self._models.pop(name, None)
+
+    def get(self, name: str, version: str = "") -> RegisteredModel:
+        with self._lock:
+            versions = self._models.get(name)
+            if not versions:
+                raise KeyError(f"model '{name}' is not registered")
+            if version:
+                if version not in versions:
+                    raise KeyError(f"model '{name}' has no version '{version}'")
+                return versions[version]
+            latest = max(versions, key=lambda v: (len(v), v))
+            return versions[latest]
+
+    def metadata(self, name: str, version: str = "") -> ModelSpec:
+        return self.get(name, version).spec
+
+    def list_models(self) -> list[tuple[str, str]]:
+        with self._lock:
+            return [(n, v) for n, vs in self._models.items() for v in vs]
