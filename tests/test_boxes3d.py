@@ -1,0 +1,107 @@
+"""Rotated BEV IoU vs Monte-Carlo oracle + exact known cases."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from triton_client_tpu.ops.boxes3d import (
+    bev_corners,
+    boxes7_to_bev,
+    nms_bev,
+    rotated_iou_bev,
+)
+
+
+def _mc_iou(a, b, n=200_000, seed=0):
+    """Monte-Carlo IoU oracle for two [cx, cy, dx, dy, h] rects."""
+    rng = np.random.default_rng(seed)
+
+    def inside(pts, r):
+        c, s = np.cos(r[4]), np.sin(r[4])
+        rel = pts - r[:2]
+        lx = rel[:, 0] * c + rel[:, 1] * s
+        ly = -rel[:, 0] * s + rel[:, 1] * c
+        return (np.abs(lx) <= r[2] / 2) & (np.abs(ly) <= r[3] / 2)
+
+    lo = np.minimum(a[:2] - np.hypot(a[2], a[3]), b[:2] - np.hypot(b[2], b[3]))
+    hi = np.maximum(a[:2] + np.hypot(a[2], a[3]), b[:2] + np.hypot(b[2], b[3]))
+    pts = rng.uniform(lo, hi, size=(n, 2))
+    ia, ib = inside(pts, a), inside(pts, b)
+    inter = (ia & ib).mean()
+    union = (ia | ib).mean()
+    return inter / union if union > 0 else 0.0
+
+
+def test_corners_axis_aligned():
+    c = np.asarray(bev_corners(jnp.asarray([0.0, 0.0, 4.0, 2.0, 0.0])))
+    assert {tuple(p) for p in c.round(5)} == {
+        (2.0, 1.0), (-2.0, 1.0), (-2.0, -1.0), (2.0, -1.0)
+    }
+
+
+def test_identical_boxes_iou_one():
+    b = jnp.asarray([[1.0, 2.0, 4.0, 2.0, 0.7]])
+    iou = float(rotated_iou_bev(b, b)[0, 0])
+    assert abs(iou - 1.0) < 1e-4
+
+
+def test_disjoint_boxes_iou_zero():
+    a = jnp.asarray([[0.0, 0.0, 2.0, 2.0, 0.3]])
+    b = jnp.asarray([[10.0, 10.0, 2.0, 2.0, 1.0]])
+    assert float(rotated_iou_bev(a, b)[0, 0]) == 0.0
+
+
+def test_axis_aligned_matches_exact():
+    # overlap region 1x1 of two 2x2 squares offset by (1,1)
+    a = jnp.asarray([[0.0, 0.0, 2.0, 2.0, 0.0]])
+    b = jnp.asarray([[1.0, 1.0, 2.0, 2.0, 0.0]])
+    iou = float(rotated_iou_bev(a, b)[0, 0])
+    assert abs(iou - 1.0 / 7.0) < 1e-4
+
+
+def test_cross_45_degrees_exact():
+    # Unit square at origin vs same square rotated 45 deg: intersection
+    # is a regular octagon, area = 8*(sqrt(2)-1)/2 ... known value:
+    # A = 2*(sqrt(2)-1) for unit squares. IoU = A / (2 - A).
+    a = jnp.asarray([[0.0, 0.0, 1.0, 1.0, 0.0]])
+    b = jnp.asarray([[0.0, 0.0, 1.0, 1.0, np.pi / 4]])
+    inter = 2 * (np.sqrt(2) - 1)
+    want = inter / (2 - inter)
+    got = float(rotated_iou_bev(a, b)[0, 0])
+    assert abs(got - want) < 1e-4
+
+
+def test_random_vs_monte_carlo(rng):
+    for seed in range(6):
+        r = np.random.default_rng(seed)
+        a = np.array([r.uniform(-2, 2), r.uniform(-2, 2),
+                      r.uniform(1, 4), r.uniform(1, 4), r.uniform(0, np.pi)])
+        b = np.array([r.uniform(-2, 2), r.uniform(-2, 2),
+                      r.uniform(1, 4), r.uniform(1, 4), r.uniform(0, np.pi)])
+        got = float(rotated_iou_bev(jnp.asarray(a[None]), jnp.asarray(b[None]))[0, 0])
+        want = _mc_iou(a, b)
+        assert abs(got - want) < 2e-2, (seed, got, want)
+
+
+def test_containment():
+    # small box fully inside big box: IoU = small/big area
+    a = jnp.asarray([[0.0, 0.0, 6.0, 6.0, 0.5]])
+    b = jnp.asarray([[0.0, 0.0, 1.0, 1.0, 1.2]])
+    got = float(rotated_iou_bev(a, b)[0, 0])
+    assert abs(got - 1.0 / 36.0) < 1e-4
+
+
+def test_nms_bev_suppresses_rotated_duplicates():
+    boxes = jnp.asarray([
+        [0.0, 0.0, 0.0, 4.0, 2.0, 1.5, 0.3],
+        [0.1, 0.0, 0.0, 4.0, 2.0, 1.5, 0.32],   # near-duplicate
+        [10.0, 0.0, 0.0, 4.0, 2.0, 1.5, 2.0],   # far away
+    ])
+    scores = jnp.asarray([0.9, 0.8, 0.7])
+    idx, valid = nms_bev(boxes, scores, iou_thresh=0.1, max_det=8)
+    kept = np.asarray(idx)[np.asarray(valid)]
+    np.testing.assert_array_equal(kept, [0, 2])
+
+
+def test_boxes7_to_bev_layout():
+    b7 = jnp.asarray([[1, 2, 3, 4, 5, 6, 7]], jnp.float32)
+    np.testing.assert_allclose(np.asarray(boxes7_to_bev(b7))[0], [1, 2, 4, 5, 7])

@@ -80,3 +80,17 @@ def test_yolov5_pipeline_through_channel():
     dets = resp.outputs["detections"][0][resp.outputs["valid"][0]]
     if dets.size:
         assert dets[:, :4].min() >= -96 and dets[:, :4].max() <= 192
+
+
+def test_channel_rejects_missing_input(repo_with_toy_model):
+    chan = TPUChannel(repo_with_toy_model)
+    with pytest.raises(ValueError, match="requires input 'x'"):
+        chan.do_inference(InferRequest("double", {}))
+
+
+def test_channel_casts_wire_dtype(repo_with_toy_model):
+    chan = TPUChannel(repo_with_toy_model)
+    resp = chan.do_inference(
+        InferRequest("double", {"x": np.ones((2, 4), np.float64)})
+    )
+    assert resp.outputs["y"].dtype == np.float32

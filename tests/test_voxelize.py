@@ -1,0 +1,105 @@
+"""Voxelizer vs a python-dict oracle."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from triton_client_tpu.ops.voxelize import VoxelConfig, pad_points, voxelize
+
+CFG = VoxelConfig(
+    point_cloud_range=(0.0, -4.0, -2.0, 8.0, 4.0, 2.0),
+    voxel_size=(0.5, 0.5, 4.0),
+    max_voxels=256,
+    max_points_per_voxel=4,
+)
+
+
+def _oracle(points, cfg):
+    """Group points into voxels with python dicts (insertion order =
+    first-point order, matching the sort-based first-occurrence rule
+    only up to voxel ordering; compare as sets keyed by coords)."""
+    r, v = cfg.point_cloud_range, cfg.voxel_size
+    nx, ny, nz = cfg.grid_size
+    groups = {}
+    for p in points:
+        i = int(np.floor((p[0] - r[0]) / v[0]))
+        j = int(np.floor((p[1] - r[1]) / v[1]))
+        k = int(np.floor((p[2] - r[2]) / v[2]))
+        if not (0 <= i < nx and 0 <= j < ny and 0 <= k < nz):
+            continue
+        groups.setdefault((k, j, i), []).append(p)
+    return groups
+
+
+def test_voxelize_matches_oracle(rng):
+    pts = rng.uniform(-1, 9, size=(200, 4)).astype(np.float32)
+    pts[:, 1] = rng.uniform(-5, 5, size=200)
+    pts[:, 2] = rng.uniform(-3, 3, size=200)
+    padded, m = pad_points(pts, 256)
+    out = voxelize(jnp.asarray(padded), jnp.asarray(m), CFG)
+    groups = _oracle(pts, CFG)
+
+    valid = np.asarray(out["voxel_valid"])
+    coords = np.asarray(out["coords"])[valid]
+    counts = np.asarray(out["num_points_per_voxel"])[valid]
+    voxels = np.asarray(out["voxels"])[valid]
+
+    assert len(coords) == len(groups)
+    for c, cnt, vox in zip(coords, counts, voxels):
+        key = tuple(int(x) for x in c)
+        assert key in groups
+        want = groups[key][: CFG.max_points_per_voxel]
+        assert cnt == len(want)
+        got_rows = {tuple(np.round(row, 4)) for row in vox[:cnt]}
+        want_rows = {tuple(np.round(row, 4)) for row in want}
+        assert got_rows == want_rows
+        # padding rows are zero
+        assert np.all(vox[cnt:] == 0)
+
+
+def test_voxelize_point_overflow_capped(rng):
+    # 10 points in one voxel, K=4 -> count capped at 4
+    pts = np.tile(np.array([[0.25, 0.25, 0.0, 1.0]], np.float32), (10, 1))
+    pts += rng.uniform(0, 0.1, size=pts.shape).astype(np.float32) * 0.01
+    padded, m = pad_points(pts, 16)
+    out = voxelize(jnp.asarray(padded), jnp.asarray(m), CFG)
+    counts = np.asarray(out["num_points_per_voxel"])
+    assert counts.max() == CFG.max_points_per_voxel
+    assert np.asarray(out["voxel_valid"]).sum() == 1
+
+
+def test_voxelize_voxel_overflow_capped(rng):
+    cfg = VoxelConfig(
+        point_cloud_range=CFG.point_cloud_range,
+        voxel_size=CFG.voxel_size,
+        max_voxels=4,
+        max_points_per_voxel=4,
+    )
+    # 20 distinct voxels but budget 4
+    pts = np.zeros((20, 4), np.float32)
+    pts[:, 0] = np.arange(20) * 0.4 % 8.0
+    pts[:, 1] = (np.arange(20) // 16) * 0.6 - 3.0
+    padded, m = pad_points(pts, 32)
+    out = voxelize(jnp.asarray(padded), jnp.asarray(m), cfg)
+    assert np.asarray(out["voxel_valid"]).sum() == 4
+
+
+def test_voxelize_all_out_of_range():
+    pts = np.full((8, 4), 100.0, np.float32)
+    padded, m = pad_points(pts, 16)
+    out = voxelize(jnp.asarray(padded), jnp.asarray(m), CFG)
+    assert not np.asarray(out["voxel_valid"]).any()
+    assert np.all(np.asarray(out["coords"]) == -1)
+
+
+def test_voxelize_respects_num_points():
+    pts = np.zeros((16, 4), np.float32)
+    pts[:, 0] = 0.25  # all would be valid...
+    out = voxelize(jnp.asarray(pts), jnp.asarray(0), CFG)  # ...but count=0
+    assert not np.asarray(out["voxel_valid"]).any()
+
+
+def test_grid_size_kitti_reference():
+    # data/pointpillar.yaml: range [0,-39.68,-3,69.12,39.68,1], vox 0.16
+    cfg = VoxelConfig()
+    assert cfg.grid_size == (432, 496, 1)

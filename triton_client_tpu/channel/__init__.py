@@ -4,8 +4,7 @@ The reference's BaseChannel (communicator/channel/base_channel.py:12-34)
 is the boundary this framework swings on: where the reference's only
 implementation crosses a network to a remote Triton server
 (grpc_channel.py), the primary implementation here is an in-process
-dispatch to jit-compiled functions on the local TPU mesh. A
-KServe-v2-compatible gRPC facade lives in runtime/ for drop-in ROS use.
+dispatch to jit-compiled functions on the local TPU mesh.
 """
 
 from triton_client_tpu.channel.base import (

@@ -16,7 +16,6 @@ from __future__ import annotations
 
 import abc
 import dataclasses
-import time
 from typing import Mapping
 
 import numpy as np
@@ -58,15 +57,3 @@ class BaseChannel(abc.ABC):
     @abc.abstractmethod
     def do_inference(self, request: InferRequest) -> InferResponse:
         """Run one inference round-trip."""
-
-
-class TimedInference:
-    """Small mixin: wraps do_inference with wall-clock timing."""
-
-    def timed_inference(
-        self: BaseChannel, request: InferRequest
-    ) -> InferResponse:
-        t0 = time.perf_counter()
-        resp = self.do_inference(request)
-        resp.latency_s = time.perf_counter() - t0
-        return resp

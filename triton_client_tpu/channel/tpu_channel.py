@@ -58,14 +58,28 @@ class TPUChannel(BaseChannel):
         model = self._repository.get(request.model_name, request.model_version)
         if self._validate:
             for tensor_spec in model.spec.inputs:
-                if tensor_spec.name in request.inputs:
-                    tensor_spec.validate(np.asarray(request.inputs[tensor_spec.name]))
+                if tensor_spec.name not in request.inputs:
+                    raise ValueError(
+                        f"model '{model.spec.name}' requires input "
+                        f"'{tensor_spec.name}'; request has "
+                        f"{sorted(request.inputs)}"
+                    )
+                tensor_spec.validate(np.asarray(request.inputs[tensor_spec.name]))
         sharding = batch_sharding(self._mesh)
         device_inputs = {}
         for name, arr in request.inputs.items():
             # Shard batch-leading arrays over the data axis when the
             # batch divides; otherwise replicate (single-frame path).
             arr = np.asarray(arr)
+            if self._validate:
+                # Cast to the declared wire dtype: a stray float64/int64
+                # would otherwise silently trigger one retrace per dtype.
+                try:
+                    want = model.spec.input_by_name(name).np_dtype()
+                    if arr.dtype != want:
+                        arr = arr.astype(want)
+                except (KeyError, ValueError):
+                    pass  # undeclared/BF16 inputs pass through as-is
             use = (
                 sharding
                 if arr.ndim > 0 and arr.shape[0] % self._mesh.shape["data"] == 0

@@ -1,0 +1,58 @@
+"""3D detection postprocess: per-anchor predictions -> packed detections.
+
+Parity target: the server-side OpenPCDet post_processing the reference
+invokes inside TritonPythonModel.execute (examples/pointpillar_kitti/
+1/model.py:163) and the client's extract_boxes contract
+(clients/postprocess/detector_3d_postprocess.py: pred_boxes (N, 7),
+pred_scores, pred_labels with 1-indexed labels). Fixed shapes
+throughout: score gate + top-k prefilter + rotated-BEV NMS.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from triton_client_tpu.ops.boxes3d import nms_bev
+
+
+@functools.partial(jax.jit, static_argnames=("max_det", "pre_max"))
+def extract_boxes_3d(
+    boxes: jnp.ndarray,
+    scores: jnp.ndarray,
+    score_thresh: float = 0.1,
+    iou_thresh: float = 0.01,
+    max_det: int = 128,
+    pre_max: int = 512,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """boxes (B, N, 7), scores (B, N, nc) -> packed per-image detections.
+
+    Returns (detections (B, max_det, 9), valid (B, max_det)) with rows
+    [x, y, z, dx, dy, dz, heading, score, label]; label is 1-indexed
+    (0 reserved for background, the OpenPCDet convention the reference's
+    pedestrian filter indexes against, communicator/ros_inference3d.py:156).
+    """
+
+    def one_image(b: jnp.ndarray, s: jnp.ndarray):
+        cls_score = s.max(axis=-1)
+        label = s.argmax(axis=-1) + 1
+        gated = jnp.where(cls_score > score_thresh, cls_score, -jnp.inf)
+        k = min(pre_max, gated.shape[0])
+        top_scores, top_idx = jax.lax.top_k(gated, k)
+        cand_boxes = b[top_idx]
+        idx, keep = nms_bev(
+            cand_boxes, top_scores, iou_thresh=iou_thresh, max_det=max_det
+        )
+        out = jnp.concatenate(
+            [
+                cand_boxes[idx],
+                jnp.where(keep, top_scores[idx], 0.0)[:, None],
+                (label[top_idx][idx]).astype(b.dtype)[:, None],
+            ],
+            axis=-1,
+        )
+        return jnp.where(keep[:, None], out, 0.0), keep
+
+    return jax.vmap(one_image)(boxes, scores)

@@ -1,0 +1,160 @@
+"""3D detection pipeline: raw point cloud in, packed 3D boxes out.
+
+The reference's 3D path spans three processes (client voxelizes on CPU
+via OpenPCDet, ships dynamic-shaped tensors over gRPC, the server runs
+the network; SURVEY.md section 3.2/3.3). Here voxelize -> VFE -> scatter
+-> backbone -> head -> rotated NMS is ONE jitted program on static
+budgets: the host only pads the raw cloud to the point budget
+(pad_points) and reads back (max_det, 9) rows.
+
+Bucketed padding: ``point_buckets`` trades recompiles for wasted
+compute — clouds are padded up to the smallest bucket that fits, so
+the jit caches one executable per bucket instead of one per frame
+(the reference instead rewrites request shapes every frame,
+communicator/ros_inference3d.py:131-139).
+"""
+
+from __future__ import annotations
+
+import bisect
+import dataclasses
+import logging
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+from triton_client_tpu.config import ModelSpec, TensorSpec
+from triton_client_tpu.models.pointpillars import (
+    PointPillars,
+    PointPillarsConfig,
+    init_pointpillars,
+)
+from triton_client_tpu.ops.detect3d_postprocess import extract_boxes_3d
+from triton_client_tpu.ops.voxelize import pad_points, voxelize
+
+
+@dataclasses.dataclass(frozen=True)
+class Detect3DConfig:
+    model_name: str = "pointpillars"
+    score_thresh: float = 0.1
+    iou_thresh: float = 0.01
+    max_det: int = 128
+    pre_max: int = 512
+    point_buckets: tuple[int, ...] = (32768, 65536, 131072)
+    # Sensor-height z correction added to incoming points before
+    # voxelization (reference driver parity: ros_inference3d.py:126-128
+    # adds 1.5 m for its lidar mount)
+    z_offset: float = 0.0
+    class_names: tuple[str, ...] = ("Car", "Pedestrian", "Cyclist")
+
+
+class Detect3DPipeline:
+    def __init__(
+        self,
+        config: Detect3DConfig,
+        model: PointPillars,
+        variables,
+    ) -> None:
+        self.config = config
+        self.model = model
+        self.variables = variables
+        self._jit = jax.jit(self._pipeline)
+
+    def _pipeline(self, points: jnp.ndarray, count: jnp.ndarray):
+        cfg = self.config
+        vox = voxelize(points, count, self.model.cfg.voxel)
+        heads = self.model.apply(
+            self.variables,
+            vox["voxels"][None],
+            vox["num_points_per_voxel"][None],
+            vox["coords"][None],
+            train=False,
+        )
+        pred = self.model.decode(heads)
+        dets, valid = extract_boxes_3d(
+            pred["boxes"],
+            pred["scores"],
+            score_thresh=cfg.score_thresh,
+            iou_thresh=cfg.iou_thresh,
+            max_det=cfg.max_det,
+            pre_max=cfg.pre_max,
+        )
+        return dets[0], valid[0]
+
+    def infer(self, points: np.ndarray) -> dict[str, np.ndarray]:
+        """points: (M, 4+) raw cloud [x, y, z, intensity, ...]. Returns
+        the reference 3D client contract: pred_boxes (n, 7), pred_scores
+        (n,), pred_labels (n,) — n = live detections."""
+        buckets = self.config.point_buckets
+        i = bisect.bisect_left(buckets, points.shape[0])
+        budget = buckets[min(i, len(buckets) - 1)]
+        if points.shape[0] > budget:
+            logger.warning(
+                "point cloud (%d pts) exceeds largest bucket (%d); tail "
+                "points dropped — raise Detect3DConfig.point_buckets",
+                points.shape[0],
+                budget,
+            )
+        points = points[:, :4].astype(np.float32)
+        if self.config.z_offset:
+            points = points.copy()
+            points[:, 2] += self.config.z_offset
+        padded, m = pad_points(points, budget)
+        dets, valid = self._jit(jnp.asarray(padded), jnp.asarray(m))
+        dets, valid = np.asarray(dets), np.asarray(valid)
+        live = dets[valid]
+        return {
+            "pred_boxes": live[:, :7],
+            "pred_scores": live[:, 7],
+            "pred_labels": live[:, 8].astype(np.int32),
+        }
+
+    def infer_fn(self):
+        """Repository-facing adapter over the padded static contract."""
+
+        def fn(inputs):
+            dets, valid = self._jit(inputs["points"], inputs["num_points"])
+            return {"detections": dets, "valid": valid}
+
+        return fn
+
+
+def build_pointpillars_pipeline(
+    rng: jax.Array | None = None,
+    model_cfg: PointPillarsConfig | None = None,
+    config: Detect3DConfig | None = None,
+    variables=None,
+    dtype: jnp.dtype = jnp.float32,
+) -> tuple[Detect3DPipeline, ModelSpec, dict]:
+    model_cfg = model_cfg or PointPillarsConfig()
+    if variables is None:
+        model, variables = init_pointpillars(
+            rng if rng is not None else jax.random.PRNGKey(0), model_cfg, dtype
+        )
+    else:
+        model = PointPillars(model_cfg, dtype=dtype)
+    cfg = config or Detect3DConfig()
+    pipeline = Detect3DPipeline(cfg, model, variables)
+    spec = ModelSpec(
+        name=cfg.model_name,
+        version="1",
+        platform="jax",
+        inputs=(
+            TensorSpec("points", (-1, 4), "FP32"),
+            TensorSpec("num_points", (), "INT32"),
+        ),
+        outputs=(
+            TensorSpec("detections", (cfg.max_det, 9), "FP32"),
+            TensorSpec("valid", (cfg.max_det,), "BOOL"),
+        ),
+        extra={
+            "score_thresh": cfg.score_thresh,
+            "iou_thresh": cfg.iou_thresh,
+            "class_names": list(cfg.class_names),
+            "max_voxels": model_cfg.voxel.max_voxels,
+        },
+    )
+    return pipeline, spec, variables

@@ -1,9 +1,10 @@
-"""Serving runtime: model repository, request queue, micro-batcher.
+"""Serving runtime.
 
 The in-tree replacement for the Triton Inference Server runtime the
-reference deploys in docker (docker/server/Dockerfile:23-27): model
-versioning + registry, dispatch to pjit-compiled functions, optional
-micro-batching, and the KServe v2 gRPC facade for ROS interop.
+reference deploys in docker (docker/server/Dockerfile:23-27).
+Currently implemented: the versioned model repository (registry +
+dispatch target). Request queue / micro-batcher / KServe v2 gRPC
+facade land in this package as they are built.
 """
 
 from triton_client_tpu.runtime.repository import ModelRepository, RegisteredModel
