@@ -70,7 +70,7 @@ def test_cross_45_degrees_exact():
     assert abs(got - want) < 1e-4
 
 
-def test_random_vs_monte_carlo(rng):
+def test_random_vs_monte_carlo():
     for seed in range(6):
         r = np.random.default_rng(seed)
         a = np.array([r.uniform(-2, 2), r.uniform(-2, 2),

@@ -71,15 +71,15 @@ class TPUChannel(BaseChannel):
             # Shard batch-leading arrays over the data axis when the
             # batch divides; otherwise replicate (single-frame path).
             arr = np.asarray(arr)
-            if self._validate:
-                # Cast to the declared wire dtype: a stray float64/int64
-                # would otherwise silently trigger one retrace per dtype.
-                try:
-                    want = model.spec.input_by_name(name).np_dtype()
-                    if arr.dtype != want:
-                        arr = arr.astype(want)
-                except (KeyError, ValueError):
-                    pass  # undeclared/BF16 inputs pass through as-is
+            # Cast to the declared wire dtype unconditionally (not gated
+            # on validate): a stray float64/int64 would otherwise
+            # silently trigger one retrace per dtype.
+            try:
+                want = model.spec.input_by_name(name).np_dtype()
+                if arr.dtype != want:
+                    arr = arr.astype(want)
+            except (KeyError, ValueError):
+                pass  # undeclared/BF16 inputs pass through as-is
             use = (
                 sharding
                 if arr.ndim > 0 and arr.shape[0] % self._mesh.shape["data"] == 0

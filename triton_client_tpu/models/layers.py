@@ -20,15 +20,30 @@ def autopad(kernel: int, padding: int | None = None) -> int:
     return kernel // 2 if padding is None else padding
 
 
+def mish(x: jnp.ndarray) -> jnp.ndarray:
+    """Mish activation (YOLOv4 backbone)."""
+    return x * jnp.tanh(nn.softplus(x))
+
+
+# Activation registry: ConvBnAct.act accepts True (silu, the YOLOv5
+# default), False (linear), or a name. YOLOv4 uses mish in the backbone
+# and leaky(0.1) in the neck/head.
+_ACTS = {
+    "silu": nn.silu,
+    "mish": mish,
+    "leaky": lambda x: nn.leaky_relu(x, 0.1),
+}
+
+
 class ConvBnAct(nn.Module):
-    """Conv2D + BatchNorm + SiLU — the universal YOLO block."""
+    """Conv2D + BatchNorm + activation — the universal YOLO block."""
 
     features: int
     kernel: int = 1
     stride: int = 1
     padding: int | None = None
     groups: int = 1
-    act: bool = True
+    act: bool | str = True
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -52,7 +67,7 @@ class ConvBnAct(nn.Module):
             name="bn",
         )(x)
         if self.act:
-            x = nn.silu(x)
+            x = _ACTS["silu" if self.act is True else self.act](x)
         return x
 
 

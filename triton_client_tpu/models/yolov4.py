@@ -1,0 +1,219 @@
+"""YOLOv4 in flax (NHWC, TPU-first).
+
+The reference serves YOLOv4 as a server-side ONNX artifact with a
+two-output contract — ``confs [1, N, nc]`` (obj*cls) and ``boxes
+[1, N, 1, 4]`` normalized corner boxes (examples/YOLOv4/config.pbtxt) —
+and decodes raw feature maps client-side when the served model emits
+them (tools/yolo_layer.py:148-288). Here the network is first-party:
+CSPDarknet53 (mish) + SPP + PANet (leaky) + anchor heads at strides
+8/16/32, with the decode fused into the jit.
+
+``decode_wire`` reproduces the reference wire contract exactly
+(normalized x1y1x2y2 + obj*cls confs, tools/yolo_layer.py:259-288);
+``decode_flat`` emits the framework-uniform (B, N, 5+nc) pixel-unit
+tensor so YOLOv4 drops into the same Detect2DPipeline as YOLOv5.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from triton_client_tpu.models.layers import ConvBnAct, make_divisible
+from triton_client_tpu.ops.yolo_decode import decode_yolo_grid
+
+# Upstream YOLOv4 anchors (pixels at 512 input), masks [0:3, 3:6, 6:9]
+# per stride 8/16/32 (reference tools/utils.py:168-171 comment block).
+YOLOV4_ANCHORS: tuple[tuple[tuple[int, int], ...], ...] = (
+    ((12, 16), (19, 36), (40, 28)),
+    ((36, 75), (76, 55), (72, 146)),
+    ((142, 110), (192, 243), (459, 401)),
+)
+STRIDES = (8, 16, 32)
+
+
+class CSPStage(nn.Module):
+    """Darknet CSP downsample stage: stride-2 conv, then a split-residual
+    stack merged by 1x1 convs. ``first`` keeps full-width hidden channels
+    (the darknet53 first-stage quirk)."""
+
+    features: int
+    depth: int
+    first: bool = False
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        dt = self.dtype
+        hidden = self.features if self.first else self.features // 2
+        x = ConvBnAct(self.features, 3, 2, act="mish", dtype=dt, name="down")(x, train)
+        main = ConvBnAct(hidden, 1, act="mish", dtype=dt, name="split_main")(x, train)
+        short = ConvBnAct(hidden, 1, act="mish", dtype=dt, name="split_short")(x, train)
+        for i in range(self.depth):
+            y = ConvBnAct(
+                self.features // 2, 1, act="mish", dtype=dt, name=f"res{i}_cv1"
+            )(main, train)
+            y = ConvBnAct(hidden, 3, act="mish", dtype=dt, name=f"res{i}_cv2")(y, train)
+            main = main + y
+        main = ConvBnAct(hidden, 1, act="mish", dtype=dt, name="post")(main, train)
+        merged = jnp.concatenate([main, short], axis=-1)
+        return ConvBnAct(self.features, 1, act="mish", dtype=dt, name="merge")(
+            merged, train
+        )
+
+
+class SPP(nn.Module):
+    """YOLOv4 spatial pyramid pooling: parallel 5/9/13 maxpools."""
+
+    features: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        pools = [x]
+        for k in (5, 9, 13):
+            p = k // 2
+            pools.append(
+                nn.max_pool(x, (k, k), strides=(1, 1), padding=((p, p), (p, p)))
+            )
+        return ConvBnAct(
+            self.features, 1, act="leaky", dtype=self.dtype, name="merge"
+        )(jnp.concatenate(pools, axis=-1), train)
+
+
+def _conv5(x, features: int, dtype, name: str, train: bool) -> jnp.ndarray:
+    """The neck's 1-3-1-3-1 conv block (leaky)."""
+    for i, (k, f) in enumerate(
+        ((1, features), (3, features * 2), (1, features), (3, features * 2), (1, features))
+    ):
+        x = ConvBnAct(f, k, act="leaky", dtype=dtype, name=f"{name}_cv{i}")(x, train)
+    return x
+
+
+def _upsample2x(x: jnp.ndarray) -> jnp.ndarray:
+    b, h, w, c = x.shape
+    x = jnp.broadcast_to(x[:, :, None, :, None, :], (b, h, 2, w, 2, c))
+    return x.reshape(b, h * 2, w * 2, c)
+
+
+class YoloV4(nn.Module):
+    """YOLOv4 detector. ``__call__`` returns raw per-scale head tensors;
+    ``decode_wire``/``decode_flat`` map them to served outputs.
+
+    ``width`` scales channel counts (1.0 = full CSPDarknet53); tests use
+    small widths to keep CPU compile time sane.
+    """
+
+    num_classes: int = 80
+    anchors: Sequence[Sequence[tuple[int, int]]] = YOLOV4_ANCHORS
+    width: float = 1.0
+    dtype: jnp.dtype = jnp.float32
+
+    def _c(self, ch: int) -> int:
+        return make_divisible(ch * self.width)
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> list[jnp.ndarray]:
+        """x: (B, H, W, 3) float in [0, 1]. Returns raw head outputs
+        [(B, H/8, W/8, a, 5+nc), (B, H/16, ...), (B, H/32, ...)]."""
+        c, dt = self._c, self.dtype
+        na = len(self.anchors[0])
+        no = 5 + self.num_classes
+
+        x = x.astype(dt)
+        # CSPDarknet53 backbone (depths 1,2,8,8,4).
+        x = ConvBnAct(c(32), 3, act="mish", dtype=dt, name="stem")(x, train)
+        x = CSPStage(c(64), 1, first=True, dtype=dt, name="stage1")(x, train)
+        x = CSPStage(c(128), 2, dtype=dt, name="stage2")(x, train)
+        p3 = CSPStage(c(256), 8, dtype=dt, name="stage3")(x, train)
+        p4 = CSPStage(c(512), 8, dtype=dt, name="stage4")(p3, train)
+        p5 = CSPStage(c(1024), 4, dtype=dt, name="stage5")(p4, train)
+
+        # SPP block between two 1-3-1 conv groups.
+        x = ConvBnAct(c(512), 1, act="leaky", dtype=dt, name="pre_spp0")(p5, train)
+        x = ConvBnAct(c(1024), 3, act="leaky", dtype=dt, name="pre_spp1")(x, train)
+        x = ConvBnAct(c(512), 1, act="leaky", dtype=dt, name="pre_spp2")(x, train)
+        x = SPP(c(512), dtype=dt, name="spp")(x, train)
+        x = ConvBnAct(c(1024), 3, act="leaky", dtype=dt, name="post_spp0")(x, train)
+        n5 = ConvBnAct(c(512), 1, act="leaky", dtype=dt, name="post_spp1")(x, train)
+
+        # PANet: top-down (with lateral 1x1s), then bottom-up.
+        t4 = ConvBnAct(c(256), 1, act="leaky", dtype=dt, name="td4_lat")(p4, train)
+        u5 = ConvBnAct(c(256), 1, act="leaky", dtype=dt, name="td4_up")(n5, train)
+        n4 = _conv5(
+            jnp.concatenate([t4, _upsample2x(u5)], axis=-1), c(256), dt, "td4", train
+        )
+        t3 = ConvBnAct(c(128), 1, act="leaky", dtype=dt, name="td3_lat")(p3, train)
+        u4 = ConvBnAct(c(128), 1, act="leaky", dtype=dt, name="td3_up")(n4, train)
+        n3 = _conv5(
+            jnp.concatenate([t3, _upsample2x(u4)], axis=-1), c(128), dt, "td3", train
+        )
+        d3 = ConvBnAct(c(256), 3, 2, act="leaky", dtype=dt, name="bu4_down")(n3, train)
+        n4 = _conv5(jnp.concatenate([d3, n4], axis=-1), c(256), dt, "bu4", train)
+        d4 = ConvBnAct(c(512), 3, 2, act="leaky", dtype=dt, name="bu5_down")(n4, train)
+        n5 = _conv5(jnp.concatenate([d4, n5], axis=-1), c(512), dt, "bu5", train)
+
+        # Heads: 3x3 leaky conv then linear 1x1 (f32 outputs).
+        heads = []
+        for i, (feat, ch) in enumerate(((n3, c(256)), (n4, c(512)), (n5, c(1024)))):
+            h = ConvBnAct(ch, 3, act="leaky", dtype=dt, name=f"head{i}_cv")(feat, train)
+            h = nn.Conv(na * no, (1, 1), dtype=jnp.float32, name=f"detect{i}")(
+                h.astype(jnp.float32)
+            )
+            b, hh, ww, _ = h.shape
+            heads.append(h.reshape(b, hh, ww, na, no))
+        return heads
+
+    def decode_flat(
+        self, heads: list[jnp.ndarray], normalize_hw: tuple[int, int] | None = None
+    ) -> jnp.ndarray:
+        """Raw heads -> (B, sum(h*w*a), 5+nc) [cx, cy, w, h, obj, cls...]
+        in input pixels (or [0, 1] when normalize_hw is given)."""
+        decoded = [
+            decode_yolo_grid(
+                head,
+                np.asarray(self.anchors[i], np.float32),
+                STRIDES[i],
+                "v4",
+                normalize_hw=normalize_hw,
+            )
+            for i, head in enumerate(heads)
+        ]
+        return jnp.concatenate(decoded, axis=1)
+
+    def decode_wire(
+        self, heads: list[jnp.ndarray], input_hw: tuple[int, int]
+    ) -> tuple[jnp.ndarray, jnp.ndarray]:
+        """Raw heads -> the reference served contract
+        (examples/YOLOv4/config.pbtxt): ``boxes (B, N, 1, 4)`` normalized
+        [x1, y1, x2, y2] and ``confs (B, N, nc)`` = obj * cls."""
+        flat = self.decode_flat(heads, normalize_hw=input_hw)
+        xy, wh = flat[..., :2], flat[..., 2:4]
+        x1y1 = xy - wh * 0.5
+        boxes = jnp.concatenate([x1y1, x1y1 + wh], axis=-1)[:, :, None, :]
+        confs = flat[..., 5:] * flat[..., 4:5]
+        return boxes, confs
+
+
+def num_predictions(input_hw: tuple[int, int], num_anchors: int = 3) -> int:
+    """Total prediction slots for an input size (512 -> 16128, the
+    reference's served N)."""
+    h, w = input_hw
+    return sum((h // s) * (w // s) * num_anchors for s in STRIDES)
+
+
+def init_yolov4(
+    rng: Any,
+    num_classes: int = 80,
+    width: float = 1.0,
+    input_hw: tuple[int, int] = (512, 512),
+    dtype: jnp.dtype = jnp.float32,
+):
+    """Build module + init variables. Returns (module, variables)."""
+    model = YoloV4(num_classes=num_classes, width=width, dtype=dtype)
+    dummy = jnp.zeros((1, input_hw[0], input_hw[1], 3), jnp.float32)
+    variables = model.init(rng, dummy, train=False)
+    return model, variables

@@ -24,6 +24,35 @@ from triton_client_tpu.ops.boxes import xywh2xyxy
 from triton_client_tpu.ops.nms import nms_padded
 
 
+def _gate_topk_nms(
+    boxes: jnp.ndarray,
+    scores: jnp.ndarray,
+    classes: jnp.ndarray,
+    conf_thresh: float,
+    iou_thresh: float,
+    max_det: int,
+    max_nms: int,
+    class_agnostic: bool = False,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Shared single-image tail: confidence gate -> top-k prefilter ->
+    class-aware NMS -> packed (max_det, 6) rows. Invalid top-k slots
+    carry the gate's -inf in ``gated`` but 0.0 in the packed output so
+    confs stay clean."""
+    gated = jnp.where(scores > conf_thresh, scores, -jnp.inf)
+    k = min(max_nms, gated.shape[0])
+    top_scores, top_idx = jax.lax.top_k(gated, k)
+    top_valid = top_scores > -jnp.inf
+    return nms_padded(
+        boxes[top_idx],
+        jnp.where(top_valid, top_scores, 0.0),
+        classes[top_idx],
+        top_valid,
+        iou_thresh=iou_thresh,
+        max_det=max_det,
+        class_agnostic=class_agnostic,
+    )
+
+
 @functools.partial(
     jax.jit, static_argnames=("max_det", "max_nms", "class_agnostic", "multi_label")
 )
@@ -65,34 +94,77 @@ def extract_boxes(
             # One candidate per (box, class) pair over the threshold.
             # Top-k runs on the flat (N*nc,) scores; boxes/classes are
             # derived from the surviving indices (idx // nc, idx % nc)
-            # so the (N*nc, 4) box expansion is never materialized.
+            # so the (N*nc, 4) box expansion is never materialized —
+            # this branch can't use _gate_topk_nms, which gathers boxes
+            # only after its own top-k.
             flat_conf = cls_conf.reshape(-1)
             gated = jnp.where(flat_conf > conf_thresh, flat_conf, -jnp.inf)
             k = min(max_nms, gated.shape[0])
             top_scores, top_idx = jax.lax.top_k(gated, k)
-            cand_boxes = boxes[top_idx // nc]
-            cand_classes = top_idx % nc
-        else:
-            classes = jnp.argmax(cls_conf, axis=-1)
-            scores = jnp.max(cls_conf, axis=-1)
-            gated = jnp.where(scores > conf_thresh, scores, -jnp.inf)
-            k = min(max_nms, gated.shape[0])
-            top_scores, top_idx = jax.lax.top_k(gated, k)
-            cand_boxes = boxes[top_idx]
-            cand_classes = classes[top_idx]
-
-        top_valid = top_scores > -jnp.inf
-        return nms_padded(
-            cand_boxes,
-            # scores carry the gate's -inf in invalid slots; nms_padded
-            # re-masks by top_valid, and packed rows are zeroed anyway —
-            # but pass the ungated values so output confs are clean.
-            jnp.where(top_valid, top_scores, 0.0),
-            cand_classes,
-            top_valid,
-            iou_thresh=iou_thresh,
-            max_det=max_det,
-            class_agnostic=class_agnostic,
+            top_valid = top_scores > -jnp.inf
+            return nms_padded(
+                boxes[top_idx // nc],
+                jnp.where(top_valid, top_scores, 0.0),
+                top_idx % nc,
+                top_valid,
+                iou_thresh=iou_thresh,
+                max_det=max_det,
+                class_agnostic=class_agnostic,
+            )
+        return _gate_topk_nms(
+            boxes,
+            jnp.max(cls_conf, axis=-1),
+            jnp.argmax(cls_conf, axis=-1),
+            conf_thresh,
+            iou_thresh,
+            max_det,
+            max_nms,
+            class_agnostic,
         )
 
     return jax.vmap(one_image)(prediction)
+
+
+@functools.partial(jax.jit, static_argnames=("max_det", "max_nms"))
+def extract_boxes_yolov4(
+    boxes: jnp.ndarray,
+    confs: jnp.ndarray,
+    conf_thresh: float = 0.4,
+    iou_thresh: float = 0.6,
+    max_det: int = 300,
+    max_nms: int = 1024,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """YOLOv4 two-output wire contract -> packed per-image detections.
+
+    Behavioral parity with the reference's post_processing
+    (tools/utils.py:166-233): best-class selection over pre-multiplied
+    confs, confidence gate, per-class greedy NMS (realized here with the
+    class-offset trick instead of a python per-class loop). The
+    reference emits 7-element rows duplicating the confidence
+    (tools/utils.py:219); here rows are the framework-uniform
+    [x1, y1, x2, y2, conf, class].
+
+    Args:
+      boxes: (B, N, 1, 4) or (B, N, 4) normalized [x1, y1, x2, y2]
+        (examples/YOLOv4/config.pbtxt "boxes").
+      confs: (B, N, nc) obj*cls scores (config.pbtxt "confs").
+
+    Returns:
+      (detections, valid): (B, max_det, 6) rows in the boxes' coordinate
+      units and (B, max_det) bool mask.
+    """
+    if boxes.ndim == 4:
+        boxes = boxes[:, :, 0, :]
+
+    def one_image(b: jnp.ndarray, c: jnp.ndarray):
+        return _gate_topk_nms(
+            b,
+            jnp.max(c, axis=-1),
+            jnp.argmax(c, axis=-1),
+            conf_thresh,
+            iou_thresh,
+            max_det,
+            max_nms,
+        )
+
+    return jax.vmap(one_image)(boxes, confs)

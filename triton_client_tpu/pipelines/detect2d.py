@@ -144,7 +144,52 @@ def build_yolov5_pipeline(
         model_name=f"yolov5{variant}", input_hw=input_hw, num_classes=num_classes
     )
     pipeline = Detect2DPipeline(cfg, forward)
-    spec = ModelSpec(
+    spec = _detect2d_spec(cfg, num_predictions(cfg.input_hw))
+    return pipeline, spec, variables
+
+
+def build_yolov4_pipeline(
+    rng: jax.Array | None = None,
+    num_classes: int = 80,
+    width: float = 1.0,
+    input_hw: tuple[int, int] = (512, 512),
+    variables=None,
+    dtype: jnp.dtype = jnp.float32,
+    config: Detect2DConfig | None = None,
+) -> tuple[Detect2DPipeline, ModelSpec, dict]:
+    """YOLOv4 variant of the fused pipeline (reference contract:
+    examples/YOLOv4/config.pbtxt confs+boxes; decode parity with
+    tools/yolo_layer.py). The flat pixel-unit decode drops into the same
+    Detect2DPipeline as YOLOv5."""
+    from triton_client_tpu.models.yolov4 import YoloV4
+    from triton_client_tpu.models.yolov4 import num_predictions as v4_num_predictions
+
+    model = YoloV4(num_classes=num_classes, width=width, dtype=dtype)
+    if variables is None:
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        dummy = jnp.zeros((1, input_hw[0], input_hw[1], 3), jnp.float32)
+        variables = model.init(rng, dummy, train=False)
+
+    def forward(x: jnp.ndarray) -> jnp.ndarray:
+        return model.decode_flat(model.apply(variables, x, train=False))
+
+    cfg = config or Detect2DConfig(
+        model_name="yolov4",
+        input_hw=input_hw,
+        num_classes=num_classes,
+        conf_thresh=0.4,
+        iou_thresh=0.6,
+    )
+    pipeline = Detect2DPipeline(cfg, forward)
+    spec = _detect2d_spec(cfg, v4_num_predictions(cfg.input_hw))
+    return pipeline, spec, variables
+
+
+def _detect2d_spec(cfg: Detect2DConfig, n_predictions: int) -> ModelSpec:
+    """Serving spec shared by the 2D detector pipelines (the analogue of
+    examples/YOLOv5/config.pbtxt + examples/YOLOv4/config.pbtxt)."""
+    return ModelSpec(
         name=cfg.model_name,
         version="1",
         platform="jax",
@@ -158,9 +203,8 @@ def build_yolov5_pipeline(
         extra={
             "conf_thresh": cfg.conf_thresh,
             "iou_thresh": cfg.iou_thresh,
-            "model_input_hw": list(input_hw),
-            "num_predictions": num_predictions(input_hw),
-            "num_classes": num_classes,
+            "model_input_hw": list(cfg.input_hw),
+            "num_predictions": n_predictions,
+            "num_classes": cfg.num_classes,
         },
     )
-    return pipeline, spec, variables

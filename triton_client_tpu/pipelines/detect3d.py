@@ -98,9 +98,10 @@ class Detect3DPipeline:
                 points.shape[0],
                 budget,
             )
+        # astype(copy=True default) always returns a fresh array, so the
+        # in-place z shift below never aliases caller memory.
         points = points[:, :4].astype(np.float32)
         if self.config.z_offset:
-            points = points.copy()
             points[:, 2] += self.config.z_offset
         padded, m = pad_points(points, budget)
         dets, valid = self._jit(jnp.asarray(padded), jnp.asarray(m))
