@@ -1,0 +1,153 @@
+"""Evaluator: AP math, greedy matching, aggregation (parity targets:
+communicator/evaluate_inference.py:131-218,400-446)."""
+
+import numpy as np
+import pytest
+
+from triton_client_tpu.eval import (
+    DetectionEvaluator,
+    ap_per_class,
+    compute_ap,
+    match_predictions,
+)
+from triton_client_tpu.eval.detection_map import IOU_THRESHOLDS, box_iou_np
+
+
+def test_box_iou_np():
+    a = np.array([[0, 0, 10, 10]], np.float64)
+    b = np.array([[0, 0, 10, 10], [5, 5, 15, 15], [20, 20, 30, 30]], np.float64)
+    iou = box_iou_np(a, b)
+    np.testing.assert_allclose(iou[0], [1.0, 25 / 175, 0.0], atol=1e-9)
+
+
+def test_compute_ap_perfect_detector():
+    # One TP covering all GT: recall hits 1.0 at precision 1.0. The
+    # 101-pt trapz with the closing (1.0 -> precision 0) sentinel gives
+    # 1 - 0.005 (half of the last 0.01 bin), the COCO-interp ceiling.
+    ap = compute_ap(np.array([1.0]), np.array([1.0]))
+    assert ap == pytest.approx(0.995, abs=1e-6)
+
+
+def test_compute_ap_monotone_envelope():
+    # Precision dips are flattened by the running-max envelope.
+    recall = np.array([0.2, 0.4, 0.6, 0.8, 1.0])
+    precision = np.array([1.0, 0.4, 0.9, 0.4, 0.9])
+    ap = compute_ap(recall, precision)
+    # Envelope makes precision >= 0.9 up to recall 1.0.
+    assert 0.89 < ap < 0.96
+
+
+def test_match_predictions_basic():
+    gt = np.array([[0, 0, 10, 10]], np.float64)
+    gt_cls = np.array([1.0])
+    preds = np.array([[0, 0, 10, 10], [0.5, 0, 10.5, 10], [20, 20, 30, 30]])
+    pred_cls = np.array([1.0, 1.0, 1.0])
+    correct = match_predictions(preds, pred_cls, gt, gt_cls)
+    assert correct.shape == (3, 10)
+    # Only the best-IoU detection matches the single gt.
+    assert correct[0].all()
+    assert not correct[1].any()
+    assert not correct[2].any()
+
+
+def test_match_predictions_class_gate():
+    gt = np.array([[0, 0, 10, 10]], np.float64)
+    preds = np.array([[0, 0, 10, 10]])
+    correct = match_predictions(preds, np.array([2.0]), gt, np.array([1.0]))
+    assert not correct.any()
+
+
+def test_match_predictions_iou_ladder():
+    # IoU ~0.667 clears thresholds 0.5-0.65 only.
+    gt = np.array([[0, 0, 10, 10]], np.float64)
+    preds = np.array([[0, 2, 10, 12]])  # inter 80, union 120
+    correct = match_predictions(preds, np.array([0.0]), gt, np.array([0.0]))
+    want = (80 / 120) >= IOU_THRESHOLDS
+    np.testing.assert_array_equal(correct[0], want)
+
+
+def test_ap_per_class_perfect():
+    tp = np.ones((4, 10), bool)
+    conf = np.array([0.9, 0.8, 0.7, 0.6])
+    cls = np.array([0.0, 0.0, 1.0, 1.0])
+    p, r, ap, f1, classes = ap_per_class(tp, conf, cls, cls)
+    np.testing.assert_array_equal(classes, [0, 1])
+    assert ap[:, 0] == pytest.approx([0.995, 0.995], abs=1e-6)
+    assert p == pytest.approx([1.0, 1.0])
+    assert r == pytest.approx([1.0, 1.0])
+    assert f1 == pytest.approx([1.0, 1.0], abs=1e-3)
+
+
+def test_ap_per_class_all_false_positives():
+    tp = np.zeros((3, 10), bool)
+    conf = np.array([0.9, 0.8, 0.7])
+    pred_cls = np.zeros(3)
+    target_cls = np.zeros(5)
+    p, r, ap, f1, classes = ap_per_class(tp, conf, pred_cls, target_cls)
+    assert ap[0, 0] == pytest.approx(0.0, abs=1e-6)
+    assert r[0] == pytest.approx(0.0)
+
+
+def test_evaluator_end_to_end_perfect():
+    ev = DetectionEvaluator()
+    rng = np.random.default_rng(0)
+    for _ in range(5):
+        n = rng.integers(1, 6)
+        xy = rng.uniform(0, 400, (n, 2))
+        wh = rng.uniform(20, 80, (n, 2))
+        cls = rng.integers(0, 3, n).astype(np.float64)
+        gts = np.concatenate([xy, xy + wh, cls[:, None]], axis=1)
+        dets = np.concatenate(
+            [xy, xy + wh, np.full((n, 1), 0.9), cls[:, None]], axis=1
+        )
+        ev.add_frame(dets, None, gts)
+    s = ev.summary()
+    assert s["frames"] == 5
+    assert s["map50"] == pytest.approx(0.995, abs=1e-3)
+    assert s["map"] == pytest.approx(0.995, abs=1e-3)
+    assert s["precision"] == pytest.approx(1.0, abs=1e-6)
+
+
+def test_evaluator_mixed_quality():
+    ev = DetectionEvaluator()
+    gts = np.array([[0, 0, 100, 100, 0], [200, 200, 300, 300, 0]], np.float64)
+    # one perfect, one badly offset (IoU < 0.5), one false positive
+    dets = np.array(
+        [
+            [0, 0, 100, 100, 0.9, 0],
+            [260, 260, 360, 360, 0.8, 0],
+            [400, 400, 450, 450, 0.7, 0],
+        ]
+    )
+    ev.add_frame(dets, None, gts)
+    s = ev.summary()
+    assert 0.2 < s["map50"] < 0.6  # 1 of 2 gts found
+    assert s["recall"] == pytest.approx(0.5, abs=0.01)
+
+
+def test_evaluator_valid_mask_and_empty_frames():
+    ev = DetectionEvaluator()
+    gts = np.array([[0, 0, 10, 10, 1]], np.float64)
+    dets = np.array([[0, 0, 10, 10, 0.9, 1], [0, 0, 0, 0, 0.0, 0]])
+    valid = np.array([True, False])
+    ev.add_frame(dets, valid, gts)
+    ev.add_frame(np.zeros((0, 6)), None, np.zeros((0, 5)))
+    s = ev.summary()
+    assert s["map50"] == pytest.approx(0.995, abs=1e-3)
+
+
+def test_prometheus_exporter_gated():
+    from triton_client_tpu.eval import prometheus_export
+
+    if not prometheus_export.available():
+        pytest.skip("prometheus_client not installed")
+    ex = prometheus_export.EvalPrometheusExporter(start_server=False)
+    ev = DetectionEvaluator()
+    gts = np.array([[0, 0, 10, 10, 0]], np.float64)
+    dets = np.array([[0, 0, 10, 10, 0.9, 0]])
+    ev.add_frame(dets, None, gts)
+    for frame_stats in ev.per_frame_summaries():
+        ex.observe(*frame_stats)
+    collected = {m.name for m in ex.registry.collect()}
+    assert "model_precision" in collected
+    assert "model_f1" in collected

@@ -1,0 +1,15 @@
+"""Evaluation: online detection mAP (COCO 101-pt) + metric export."""
+
+from triton_client_tpu.eval.detection_map import (
+    DetectionEvaluator,
+    ap_per_class,
+    compute_ap,
+    match_predictions,
+)
+
+__all__ = [
+    "DetectionEvaluator",
+    "ap_per_class",
+    "compute_ap",
+    "match_predictions",
+]

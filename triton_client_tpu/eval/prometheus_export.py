@@ -1,0 +1,63 @@
+"""Prometheus export for the online evaluator.
+
+Parity with the reference's client-side exporter: five Summary metrics
+served from an HTTP endpoint on port 7658
+(communicator/evaluate_inference.py:52-61), observed per evaluated
+frame (:437-444). Import of prometheus_client is gated the same way the
+reference gates its optional deps (communicator/__init__.py:5-8):
+constructing the exporter without the package raises, and
+``available()`` lets drivers degrade gracefully.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+try:
+    import prometheus_client
+
+    _HAVE_PROMETHEUS = True
+except ImportError:  # pragma: no cover - environment without the dep
+    prometheus_client = None
+    _HAVE_PROMETHEUS = False
+
+DEFAULT_PORT = 7658
+
+
+def available() -> bool:
+    return _HAVE_PROMETHEUS
+
+
+class EvalPrometheusExporter:
+    """Five Summaries (precision/recall/ap/f1/ap_class), one HTTP port."""
+
+    def __init__(self, port: int = DEFAULT_PORT, start_server: bool = True) -> None:
+        if not _HAVE_PROMETHEUS:
+            raise ImportError("prometheus_client is not installed")
+        registry = prometheus_client.CollectorRegistry()
+        self.registry = registry
+        s = prometheus_client.Summary
+        self.p_summary = s("model_precision", "per-class precision", registry=registry)
+        self.r_summary = s("model_recall", "per-class recall", registry=registry)
+        self.ap_summary = s("model_ap", "per-class AP@0.5", registry=registry)
+        self.f1_summary = s("model_f1", "per-class F1", registry=registry)
+        self.ap_class_summary = s(
+            "model_ap_class", "class ids contributing AP", registry=registry
+        )
+        if start_server:
+            prometheus_client.start_http_server(port, registry=registry)
+
+    def observe(self, p, r, ap, f1, classes) -> None:
+        """Observe one ap_per_class result, value-by-value as the
+        reference does (evaluate_inference.py:440-444)."""
+        for v in np.atleast_1d(p):
+            self.p_summary.observe(float(v))
+        for v in np.atleast_1d(r):
+            self.r_summary.observe(float(v))
+        ap = np.atleast_2d(ap)
+        for v in ap[:, 0] if ap.size else ():
+            self.ap_summary.observe(float(v))
+        for v in np.atleast_1d(f1):
+            self.f1_summary.observe(float(v))
+        for v in np.atleast_1d(classes):
+            self.ap_class_summary.observe(float(v))
