@@ -1,0 +1,223 @@
+"""Sources, sinks, drawing, driver loop, CLI smoke (SURVEY.md section 4:
+golden replay + fake-channel strategy)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from triton_client_tpu.drivers.driver import DriverStats, InferenceDriver
+from triton_client_tpu.io.draw import draw_boxes
+from triton_client_tpu.io.sinks import DetectionLogSink, ImageFileSink
+from triton_client_tpu.io.sources import (
+    ImageDirSource,
+    NpyPointCloudSource,
+    SyntheticImageSource,
+    SyntheticPointCloudSource,
+    open_source,
+)
+
+
+def _write_images(tmp_path, n=3, hw=(32, 48)):
+    from PIL import Image
+
+    rng = np.random.default_rng(0)
+    for i in range(n):
+        arr = rng.integers(0, 255, (*hw, 3), dtype=np.uint8)
+        Image.fromarray(arr).save(tmp_path / f"{i:03d}.png")
+    return tmp_path
+
+
+def test_image_dir_source(tmp_path):
+    _write_images(tmp_path, 3)
+    src = ImageDirSource(str(tmp_path))
+    frames = list(src)
+    assert len(src) == 3 and len(frames) == 3
+    assert frames[0].data.shape == (32, 48, 3)
+    assert frames[0].data.dtype == np.uint8
+    assert [f.frame_id for f in frames] == [0, 1, 2]
+
+
+def test_image_dir_source_empty(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        ImageDirSource(str(tmp_path))
+
+
+def test_synthetic_sources_deterministic():
+    a = [f.data for f in SyntheticImageSource(2, (16, 16), seed=7)]
+    b = [f.data for f in SyntheticImageSource(2, (16, 16), seed=7)]
+    np.testing.assert_array_equal(a[0], b[0])
+    pc = list(SyntheticPointCloudSource(1, points=100))
+    assert pc[0].data.shape == (100, 4)
+
+
+def test_npy_source(tmp_path):
+    for i in range(2):
+        np.save(tmp_path / f"{i}.npy", np.zeros((10, 4), np.float32))
+    src = NpyPointCloudSource(str(tmp_path))
+    assert len(src) == 2
+    assert next(iter(src)).data.shape == (10, 4)
+
+
+def test_open_source_dispatch(tmp_path):
+    _write_images(tmp_path, 1)
+    assert isinstance(open_source(str(tmp_path)), ImageDirSource)
+    assert isinstance(open_source("synthetic:4"), SyntheticImageSource)
+    s = open_source("synthetic:4:32x64")
+    assert s.hw == (32, 64)
+    assert isinstance(
+        open_source("synthetic:2", kind="pointcloud"), SyntheticPointCloudSource
+    )
+
+
+def test_draw_boxes_marks_pixels():
+    img = np.zeros((64, 64, 3), np.uint8)
+    dets = np.array([[8, 8, 40, 40, 0.9, 1]])
+    out = draw_boxes(img, dets, np.array([True]))
+    assert out.shape == img.shape
+    assert out.sum() > 0
+    assert img.sum() == 0  # input untouched
+
+
+def test_sinks(tmp_path):
+    from triton_client_tpu.io.sources import Frame
+
+    frame = Frame(np.zeros((16, 16, 3), np.uint8), 0, 0.0)
+    result = {
+        "detections": np.array([[1, 1, 8, 8, 0.5, 0]]),
+        "valid": np.array([True]),
+    }
+    img_sink = ImageFileSink(str(tmp_path / "imgs"))
+    img_sink.write(frame, result)
+    assert os.path.exists(tmp_path / "imgs" / "0000.png")
+
+    log_path = tmp_path / "out" / "d.jsonl"
+    log_sink = DetectionLogSink(str(log_path))
+    log_sink.write(frame, result)
+    log_sink.close()
+    row = json.loads(log_path.read_text().splitlines()[0])
+    assert row["frame_id"] == 0
+    assert row["detections"][0][4] == 0.5
+
+
+def test_driver_loop_with_eval():
+    from triton_client_tpu.eval import DetectionEvaluator
+
+    calls = []
+
+    def fake_infer(img):
+        calls.append(img.shape)
+        return {
+            "detections": np.array([[0, 0, 10, 10, 0.9, 0]]),
+            "valid": np.array([True]),
+        }
+
+    gts = np.array([[0, 0, 10, 10, 0]], np.float64)
+    ev = DetectionEvaluator()
+    driver = InferenceDriver(
+        fake_infer,
+        SyntheticImageSource(5, (16, 16)),
+        evaluator=ev,
+        gt_lookup=lambda frame: gts,
+        warmup=1,
+    )
+    stats = driver.run()
+    assert stats.frames == 5
+    assert len(calls) == 6  # 5 + 1 warmup
+    assert stats.fps > 0
+    assert ev.summary()["map50"] == pytest.approx(0.995, abs=1e-3)
+
+
+def test_driver_propagates_source_error():
+    class BadSource:
+        def __len__(self):
+            return 1
+
+        def __iter__(self):
+            raise RuntimeError("boom")
+            yield
+
+    driver = InferenceDriver(lambda x: {}, BadSource())
+    with pytest.raises(RuntimeError, match="boom"):
+        driver.run()
+
+
+def test_driver_empty_source():
+    driver = InferenceDriver(lambda x: {}, SyntheticImageSource(0))
+    assert driver.run() == DriverStats()
+
+
+def test_driver_max_frames():
+    driver = InferenceDriver(
+        lambda x: {"n": 1}, SyntheticImageSource(100, (8, 8)), warmup=0
+    )
+    stats = driver.run(max_frames=3)
+    assert stats.frames == 3
+
+
+@pytest.mark.slow
+def test_cli_detect2d_smoke(tmp_path, capsys):
+    from triton_client_tpu.cli.detect2d import main
+
+    main(
+        [
+            "-m",
+            "yolov5n",
+            "-c",
+            "2",
+            "--input-size",
+            "64",
+            "-i",
+            "synthetic:3:64x64",
+            "--sink",
+            "jsonl",
+            "-o",
+            str(tmp_path),
+            "--warmup",
+            "1",
+        ]
+    )
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert report["driver"]["frames"] == 3
+    assert report["model"] == "yolov5n"
+    assert os.path.exists(tmp_path / "detections.jsonl")
+
+
+@pytest.mark.slow
+def test_cli_detect3d_smoke(capsys):
+    from triton_client_tpu.cli.detect3d import main
+
+    main(["-i", "synthetic:2", "--limit", "2"])
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert report["driver"]["frames"] == 2
+    assert report["model"] == "pointpillars"
+
+
+@pytest.mark.slow
+def test_cli_evaluate_smoke(tmp_path, capsys):
+    from triton_client_tpu.cli.evaluate import main
+
+    gt_path = tmp_path / "gt.jsonl"
+    with open(gt_path, "w") as f:
+        for i in range(2):
+            f.write(json.dumps({"frame_id": i, "boxes": [[0, 0, 10, 10, 0]]}) + "\n")
+    main(
+        [
+            "-m",
+            "yolov5n",
+            "-c",
+            "2",
+            "--input-size",
+            "64",
+            "-i",
+            "synthetic:2:64x64",
+            "--gt",
+            str(gt_path),
+            "--prometheus-port",
+            "-1",  # negative: keep the exporter (a real server) off in tests
+        ]
+    )
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert "eval" in report
+    assert report["eval"]["frames"] == 2

@@ -152,3 +152,21 @@ def test_batched_nms_bf16_boxes():
     _, valid = batched_nms(boxes, scores, classes, 0.5, max_det=10)
     # boxes 0/1 same class overlap -> one survives; box 2 other class survives
     assert np.asarray(valid).sum() == 2
+
+
+def test_batched_nms_normalized_boxes_high_class_id():
+    # Normalized [0,1] boxes with a high class id (YOLOv4's wire format
+    # + COCO class 79): the class offset stride must adapt to the data
+    # range — a fixed 4096 offset quantizes f32 coords to 1/32-image
+    # steps at class ~80, so the near-duplicate below would escape
+    # suppression and the distinct box could be wrongly merged.
+    boxes = jnp.asarray(
+        [[0.200, 0.400, 0.250, 0.450],
+         [0.201, 0.400, 0.251, 0.450],   # near-duplicate of 0
+         [0.300, 0.400, 0.350, 0.450]],  # distinct, same class
+        jnp.float32,
+    )
+    scores = jnp.asarray([0.9, 0.8, 0.7])
+    classes = jnp.asarray([79, 79, 79])
+    _, valid = batched_nms(boxes, scores, classes, 0.5, max_det=10)
+    assert np.asarray(valid).sum() == 2

@@ -1,0 +1,35 @@
+"""``python -m triton_client_tpu <command>`` dispatch.
+
+Commands map 1:1 onto the reference's entry scripts:
+  detect2d  — main.py / bag2d.py (live vs replay chosen by --input)
+  detect3d  — main3d.py / bag3d.py
+  evaluate  — evaluate.py
+"""
+
+from __future__ import annotations
+
+import sys
+
+COMMANDS = ("detect2d", "detect3d", "evaluate")
+
+
+def main() -> None:
+    if len(sys.argv) < 2 or sys.argv[1] in ("-h", "--help"):
+        print(__doc__)
+        print(f"commands: {', '.join(COMMANDS)}")
+        raise SystemExit(0 if len(sys.argv) >= 2 else 2)
+    cmd, argv = sys.argv[1], sys.argv[2:]
+    if cmd == "detect2d":
+        from triton_client_tpu.cli.detect2d import main as run
+    elif cmd == "detect3d":
+        from triton_client_tpu.cli.detect3d import main as run
+    elif cmd == "evaluate":
+        from triton_client_tpu.cli.evaluate import main as run
+    else:
+        print(f"unknown command '{cmd}'; commands: {', '.join(COMMANDS)}")
+        raise SystemExit(2)
+    run(argv)
+
+
+if __name__ == "__main__":
+    main()

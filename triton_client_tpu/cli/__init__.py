@@ -1,0 +1,5 @@
+"""CLI entry points (L5): ``python -m triton_client_tpu.cli.detect2d``
+etc., mirroring the reference's six entry scripts (main.py, main3d.py,
+bag2d.py, bag3d.py, evaluate.py, yolo_onnx_test.py — SURVEY.md section 2
+#1-3). One flag set serves live/replay: the input source string picks
+the mode (directory, video, synthetic, or ros:<topic>)."""

@@ -1,0 +1,109 @@
+"""Shared CLI flags and wiring helpers.
+
+Flag parity with the reference argparse surface (main.py:51-113):
+``-m`` model, ``-x`` version, ``-b`` batch size, ``-c`` class count,
+``-s`` scaling mode, ``-i`` input, ``--async``/``--streaming`` retained
+(accepted and recorded; the reference defines but never exercises them
+— main.py:59-70). TPU-first additions: --variant/--width, --limit,
+--sink, --gt, --prometheus-port.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from typing import Callable
+
+import numpy as np
+
+
+def add_common_flags(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("-m", "--model-name", default="", help="served model name")
+    parser.add_argument("-x", "--model-version", default="", help="model version")
+    parser.add_argument("-b", "--batch-size", type=int, default=1)
+    parser.add_argument(
+        "-c", "--classes", type=int, default=80, help="number of classes"
+    )
+    parser.add_argument(
+        "-s",
+        "--scaling",
+        default="yolo",
+        choices=("yolo", "none", "inception", "vgg", "coco"),
+        help="input scaling mode (reference utils/preprocess.py:147-157)",
+    )
+    parser.add_argument(
+        "-i",
+        "--input",
+        default="synthetic:32",
+        help="source: image dir | video file | synthetic[:N[:HxW]] | "
+        "npy dir (3D)",
+    )
+    parser.add_argument("--limit", type=int, default=0, help="max frames")
+    parser.add_argument(
+        "--sink",
+        default="null",
+        choices=("null", "images", "jsonl"),
+        help="where detections go (images parity: bag_inference2d.py:136)",
+    )
+    parser.add_argument("-o", "--output", default="./output_data")
+    parser.add_argument("--names", default="", help="class-names file")
+    parser.add_argument("--gt", default="", help="ground-truth JSONL for eval")
+    parser.add_argument("--prometheus-port", type=int, default=0)
+    parser.add_argument(
+        "--async",
+        dest="async_set",
+        action="store_true",
+        help="accepted for flag parity (unused in the reference too)",
+    )
+    parser.add_argument("--streaming", action="store_true", help="flag parity")
+    parser.add_argument("--prefetch", type=int, default=4)
+    parser.add_argument("--warmup", type=int, default=1)
+
+
+def make_sink(args, class_names: tuple[str, ...] = ()):
+    from triton_client_tpu.io.sinks import DetectionLogSink, ImageFileSink, NullSink
+
+    if args.sink == "images":
+        return ImageFileSink(args.output, class_names)
+    if args.sink == "jsonl":
+        import os
+
+        return DetectionLogSink(os.path.join(args.output, "detections.jsonl"))
+    return NullSink()
+
+
+def load_gt_lookup(path: str) -> Callable:
+    """GT JSONL: one {"frame_id": int, "boxes": [[x1,y1,x2,y2,cls],...]}
+    per line — the replay-mode stand-in for the reference's live GT
+    topic (evaluate_inference.py:113-115)."""
+    table: dict[int, np.ndarray] = {}
+    with open(path) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            row = json.loads(line)
+            table[int(row["frame_id"])] = np.asarray(row["boxes"], np.float64).reshape(
+                -1, 5
+            )
+
+    def lookup(frame):
+        return table.get(frame.frame_id)
+
+    return lookup
+
+
+def load_names(path: str) -> tuple[str, ...]:
+    if not path:
+        return ()
+    from triton_client_tpu.pipelines.detect2d import load_class_names
+
+    return load_class_names(path)
+
+
+def print_report(stats, summary=None, extra=None) -> None:
+    out = {"driver": stats.to_dict()}
+    if summary is not None:
+        out["eval"] = summary
+    if extra:
+        out.update(extra)
+    print(json.dumps(out))

@@ -1,0 +1,158 @@
+"""2D detection entry point.
+
+The composition is the reference's main.py:116-139 triple — client
+(model pipeline) + channel + inference driver — with the remote Triton
+hop replaced by the in-process TPU channel. ``--input ros:<topic>``
+selects the live ROS adapter when rospy is available; anything else is
+pull-driven replay (bag2d.py semantics).
+
+Usage:
+  python -m triton_client_tpu.cli.detect2d -m yolov5n -i ./frames --sink images
+  python -m triton_client_tpu.cli.detect2d -m yolov4 -i synthetic:64 --gt gt.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+
+from triton_client_tpu.cli.common import (
+    add_common_flags,
+    load_gt_lookup,
+    load_names,
+    make_sink,
+    print_report,
+)
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    parser = argparse.ArgumentParser(description=__doc__)
+    add_common_flags(parser)
+    parser.add_argument(
+        "--input-size", type=int, default=512, help="model input H=W (reference 512)"
+    )
+    # None -> per-model reference defaults (yolov5: 0.3/0.45
+    # ros_inference.py:148; yolov4: 0.4/0.6 tools/utils.py post_processing)
+    parser.add_argument("--conf", type=float, default=None)
+    parser.add_argument("--iou", type=float, default=None)
+    parser.add_argument(
+        "--width", type=float, default=1.0, help="YOLOv4 width multiple"
+    )
+    return parser.parse_args(argv)
+
+
+def build(args):
+    """Model name -> (pipeline, spec). yolov5{n,s,m,l,x} or yolov4."""
+    from triton_client_tpu.pipelines.detect2d import (
+        Detect2DConfig,
+        build_yolov4_pipeline,
+        build_yolov5_pipeline,
+    )
+
+    name = args.model_name or "yolov5n"
+    hw = (args.input_size, args.input_size)
+    is_v4 = name == "yolov4"
+    cfg = Detect2DConfig(
+        model_name=name,
+        input_hw=hw,
+        num_classes=args.classes,
+        conf_thresh=args.conf if args.conf is not None else (0.4 if is_v4 else 0.3),
+        iou_thresh=args.iou if args.iou is not None else (0.6 if is_v4 else 0.45),
+        scaling=args.scaling,
+    )
+    if name.startswith("yolov5"):
+        variant = name[len("yolov5") :] or "n"
+        pipe, spec, _ = build_yolov5_pipeline(
+            jax.random.PRNGKey(0),
+            variant=variant,
+            num_classes=args.classes,
+            input_hw=hw,
+            config=cfg,
+        )
+    elif name == "yolov4":
+        pipe, spec, _ = build_yolov4_pipeline(
+            jax.random.PRNGKey(0),
+            num_classes=args.classes,
+            width=args.width,
+            input_hw=hw,
+            config=cfg,
+        )
+    else:
+        raise SystemExit(f"unknown 2D model '{name}' (yolov5[nsmlx] | yolov4)")
+    return pipe, spec
+
+
+def main(argv=None) -> None:
+    args = parse_args(argv)
+    pipe, spec = build(args)
+    class_names = load_names(args.names)
+
+    from triton_client_tpu.channel.tpu_channel import TPUChannel
+    from triton_client_tpu.drivers.driver import InferenceDriver, channel_infer
+    from triton_client_tpu.runtime.repository import ModelRepository
+
+    repo = ModelRepository()
+    repo.register(spec, pipe.infer_fn())
+    channel = TPUChannel(repo)
+    infer = channel_infer(channel, spec.name)
+
+    if args.input.startswith("ros:"):
+        from triton_client_tpu.drivers import ros
+
+        node = ros.RosDetect2D(
+            infer,
+            sub_topic=args.input[len("ros:") :],
+            pub_topic="/tpu_detections/image",
+            class_names=class_names,
+        )
+        node.spin()
+        return
+
+    from triton_client_tpu.io.sources import open_source
+
+    source = open_source(args.input, args.limit)
+    evaluator = gt_lookup = None
+    if args.gt:
+        from triton_client_tpu.eval import DetectionEvaluator
+
+        evaluator = DetectionEvaluator()
+        gt_lookup = load_gt_lookup(args.gt)
+
+    driver = InferenceDriver(
+        infer,
+        source,
+        sink=make_sink(args, class_names),
+        prefetch=args.prefetch,
+        warmup=args.warmup,
+        evaluator=evaluator,
+        gt_lookup=gt_lookup,
+    )
+    stats = driver.run(max_frames=args.limit)
+    summary = evaluator.summary() if evaluator is not None else None
+    print_report(stats, summary, {"model": spec.name})
+    if summary is not None and args.prometheus_port > 0:
+        # Keep the process (and the metrics HTTP server) alive so a
+        # Prometheus scrape can actually happen — the reference exporter
+        # lives inside a long-running ROS node (evaluate_inference.py:52).
+        import sys
+        import time as _time
+
+        from triton_client_tpu.eval.prometheus_export import EvalPrometheusExporter
+
+        exporter = EvalPrometheusExporter(args.prometheus_port)
+        for frame_stats in evaluator.per_frame_summaries():
+            exporter.observe(*frame_stats)
+        print(
+            f"serving eval metrics on :{args.prometheus_port}; Ctrl-C to exit",
+            file=sys.stderr,
+        )
+        try:
+            while True:
+                _time.sleep(3600)
+        except KeyboardInterrupt:
+            pass
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,82 @@
+"""3D detection entry point (main3d.py / bag3d.py parity).
+
+Runs PointPillars over recorded .npy point clouds (the reference's
+tools/pc_extractor.py output format), a synthetic stream, or a live
+PointCloud2 topic (``ros:<topic>``, gated).
+
+Usage:
+  python -m triton_client_tpu.cli.detect3d -i ./clouds --sink jsonl
+  python -m triton_client_tpu.cli.detect3d -i synthetic:16
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+
+from triton_client_tpu.cli.common import add_common_flags, make_sink, print_report
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    parser = argparse.ArgumentParser(description=__doc__)
+    add_common_flags(parser)
+    parser.add_argument("--score", type=float, default=0.1)
+    parser.add_argument(
+        "--z-offset",
+        type=float,
+        default=0.0,
+        help="sensor z correction (reference adds 1.5, ros_inference3d.py:128)",
+    )
+    return parser.parse_args(argv)
+
+
+def main(argv=None) -> None:
+    args = parse_args(argv)
+    if args.sink == "images":
+        raise SystemExit(
+            "--sink images is 2D-only (3D results are box arrays, not "
+            "annotated frames); use --sink jsonl"
+        )
+
+    from triton_client_tpu.drivers.driver import InferenceDriver, detect3d_infer
+    from triton_client_tpu.pipelines.detect3d import (
+        Detect3DConfig,
+        build_pointpillars_pipeline,
+    )
+
+    cfg = Detect3DConfig(
+        model_name=args.model_name or "pointpillars",
+        score_thresh=args.score,
+        z_offset=args.z_offset,
+    )
+    pipe, spec, _ = build_pointpillars_pipeline(jax.random.PRNGKey(0), config=cfg)
+    infer = detect3d_infer(pipe)
+
+    if args.input.startswith("ros:"):
+        from triton_client_tpu.drivers import ros
+
+        node = ros.RosDetect3D(
+            infer,
+            sub_topic=args.input[len("ros:") :],
+            pub_topic="/tpu_detections/boxes3d",
+        )
+        node.spin()
+        return
+
+    from triton_client_tpu.io.sources import open_source
+
+    source = open_source(args.input, args.limit, kind="pointcloud")
+    driver = InferenceDriver(
+        infer,
+        source,
+        sink=make_sink(args),
+        prefetch=args.prefetch,
+        warmup=args.warmup,
+    )
+    stats = driver.run(max_frames=args.limit)
+    print_report(stats, None, {"model": spec.name})
+
+
+if __name__ == "__main__":
+    main()

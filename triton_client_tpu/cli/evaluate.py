@@ -1,0 +1,50 @@
+"""Accuracy evaluation entry point (evaluate.py / EvaluateInference
+parity, communicator/evaluate_inference.py).
+
+Replays an image source against a ground-truth JSONL, computes COCO
+101-pt mAP at IoU 0.5:0.05:0.95 with per-class P/R/F1, and (optionally)
+serves the reference's five Prometheus Summaries on --prometheus-port
+(default 7658 when enabled; evaluate_inference.py:52-61).
+
+The reference needed a 20 s sleep barrier to sync its image and GT
+topics (evaluate_inference.py:117); replay mode joins on frame_id, so
+there is nothing to race.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from triton_client_tpu.cli import detect2d
+from triton_client_tpu.cli.common import add_common_flags
+
+
+def main(argv=None) -> None:
+    # evaluate == detect2d with --gt required and eval defaults on.
+    parser = argparse.ArgumentParser(description=__doc__)
+    add_common_flags(parser)
+    parser.add_argument("--input-size", type=int, default=512)
+    parser.add_argument("--conf", type=float, default=None)
+    parser.add_argument("--iou", type=float, default=None)
+    parser.add_argument("--width", type=float, default=1.0)
+    args = parser.parse_args(argv)
+    if not args.gt:
+        parser.error("--gt <file.jsonl> is required for evaluation")
+    if args.prometheus_port == 0:
+        args.prometheus_port = 7658
+
+    forwarded = []
+    for key, val in vars(args).items():
+        flag = "--" + key.replace("_", "-")
+        if key == "async_set":
+            flag = "--async"
+        if isinstance(val, bool):
+            if val:
+                forwarded.append(flag)
+        elif val != "" and val is not None:
+            forwarded.extend([flag, str(val)])
+    detect2d.main(forwarded)
+
+
+if __name__ == "__main__":
+    main()

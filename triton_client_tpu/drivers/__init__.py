@@ -1,0 +1,18 @@
+"""Inference drivers (L4): the event loops that pump sources through
+channels/pipelines into sinks."""
+
+from triton_client_tpu.drivers.driver import (
+    DriverStats,
+    InferenceDriver,
+    channel_infer,
+    detect2d_infer,
+    detect3d_infer,
+)
+
+__all__ = [
+    "DriverStats",
+    "InferenceDriver",
+    "channel_infer",
+    "detect2d_infer",
+    "detect3d_infer",
+]

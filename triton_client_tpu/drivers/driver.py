@@ -1,0 +1,176 @@
+"""The inference event loop (L4).
+
+The reference's drivers are ROS-callback-shaped: preprocessing of frame
+N+1 can't start until frame N's blocking RPC returns
+(communicator/ros_inference.py:117-175, SURVEY.md section 2.10). Here the
+loop is pull-driven with a bounded prefetch queue: a producer thread
+reads + decodes upcoming frames while the accelerator runs the current
+one, so host IO and device compute overlap — the driver-level half of
+SURVEY.md hard part (d).
+
+The driver is model-agnostic: it pumps ``Frame``s through an
+``infer(data) -> {name: array}`` callable (adapters below wrap the 2D/3D
+pipelines and the channel seam), optionally scores against ground truth,
+and reports throughput + latency percentiles.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from typing import Any, Callable, Mapping
+
+import numpy as np
+
+from triton_client_tpu.io.sinks import Sink
+from triton_client_tpu.io.sources import Frame, FrameSource
+
+InferFn = Callable[[np.ndarray], Mapping[str, Any]]
+
+_SENTINEL = object()
+
+
+@dataclasses.dataclass
+class DriverStats:
+    frames: int = 0
+    wall_s: float = 0.0
+    fps: float = 0.0
+    p50_ms: float = 0.0
+    p99_ms: float = 0.0
+    mean_ms: float = 0.0
+
+    def to_dict(self) -> dict[str, float]:
+        return dataclasses.asdict(self)
+
+
+class InferenceDriver:
+    """Prefetching pull loop: source -> infer -> sink (+ eval)."""
+
+    def __init__(
+        self,
+        infer: InferFn,
+        source: FrameSource,
+        sink: Sink | None = None,
+        prefetch: int = 4,
+        warmup: int = 1,
+        evaluator=None,
+        gt_lookup: Callable[[Frame], np.ndarray | None] | None = None,
+    ) -> None:
+        """``evaluator``: DetectionEvaluator scored via ``gt_lookup``,
+        which maps a frame to (n_gt, 5) [x1, y1, x2, y2, cls] or None."""
+        self.infer = infer
+        self.source = source
+        self.sink = sink
+        self.prefetch = prefetch
+        self.warmup = warmup
+        self.evaluator = evaluator
+        self.gt_lookup = gt_lookup
+
+    def run(self, max_frames: int = 0) -> DriverStats:
+        q: queue.Queue = queue.Queue(maxsize=self.prefetch)
+        error: list[BaseException] = []
+
+        def produce() -> None:
+            try:
+                for i, frame in enumerate(self.source):
+                    if max_frames and i >= max_frames:
+                        break
+                    q.put(frame)
+            except BaseException as e:  # propagate into the consumer
+                error.append(e)
+            finally:
+                q.put(_SENTINEL)
+
+        producer = threading.Thread(target=produce, daemon=True)
+        producer.start()
+
+        latencies: list[float] = []
+        n = 0
+        first = q.get()
+        if first is _SENTINEL:
+            if error:
+                raise error[0]
+            return DriverStats()
+        # Warmup compiles outside the timed window (first jit trace is
+        # tens of seconds on TPU; the reference has no analogue because
+        # its compile cost sits server-side).
+        frame = first
+        for _ in range(self.warmup):
+            self.infer(frame.data)
+
+        t_start = time.perf_counter()
+        while frame is not _SENTINEL:
+            t0 = time.perf_counter()
+            result = self.infer(frame.data)
+            latencies.append(time.perf_counter() - t0)
+            n += 1
+            if self.sink is not None:
+                self.sink.write(frame, result)
+            if self.evaluator is not None and self.gt_lookup is not None:
+                gts = self.gt_lookup(frame)
+                if gts is not None:
+                    self.evaluator.add_frame(
+                        np.asarray(result["detections"]),
+                        np.asarray(result["valid"]) if "valid" in result else None,
+                        gts,
+                    )
+            frame = q.get()
+        wall = time.perf_counter() - t_start
+        if self.sink is not None:
+            self.sink.close()
+        if error:
+            raise error[0]
+
+        lat_ms = np.asarray(latencies) * 1e3
+        return DriverStats(
+            frames=n,
+            wall_s=wall,
+            fps=n / wall if wall > 0 else 0.0,
+            p50_ms=float(np.percentile(lat_ms, 50)) if n else 0.0,
+            p99_ms=float(np.percentile(lat_ms, 99)) if n else 0.0,
+            mean_ms=float(lat_ms.mean()) if n else 0.0,
+        )
+
+
+def detect2d_infer(pipeline) -> InferFn:
+    """Adapter over Detect2DPipeline.infer's (dets, valid) pair."""
+
+    def fn(image: np.ndarray) -> Mapping[str, Any]:
+        dets, valid = pipeline.infer(image)
+        return {"detections": dets, "valid": valid}
+
+    return fn
+
+
+def detect3d_infer(pipeline) -> InferFn:
+    """Adapter over Detect3DPipeline.infer's dict (already packed as the
+    reference 3D client contract pred_boxes/scores/labels)."""
+
+    def fn(points: np.ndarray) -> Mapping[str, Any]:
+        return pipeline.infer(points)
+
+    return fn
+
+
+def channel_infer(channel, model_name: str, input_name: str = "images") -> InferFn:
+    """Adapter that round-trips through a BaseChannel (TPUChannel for
+    in-process, GRPCChannel for the KServe facade) — the composition the
+    reference wires in main.py:131-139."""
+    from triton_client_tpu.channel.base import InferRequest
+
+    def fn(data: np.ndarray) -> Mapping[str, Any]:
+        if input_name == "images" and data.ndim == 3:
+            data = data[None]
+        resp = channel.do_inference(
+            InferRequest(model_name=model_name, inputs={input_name: data})
+        )
+        out = dict(resp.outputs)
+        if input_name == "images" and "detections" in out:
+            # un-batch single-frame results for sink/eval uniformity
+            if out["detections"].ndim == 3 and out["detections"].shape[0] == 1:
+                out = {k: v[0] for k, v in out.items()}
+        return out
+
+    return fn

@@ -1,0 +1,33 @@
+"""Input sources and output sinks for the inference drivers."""
+
+from triton_client_tpu.io.sources import (
+    Frame,
+    FrameSource,
+    ImageDirSource,
+    NpyPointCloudSource,
+    SyntheticImageSource,
+    SyntheticPointCloudSource,
+    VideoSource,
+    open_source,
+)
+from triton_client_tpu.io.sinks import (
+    DetectionLogSink,
+    ImageFileSink,
+    NullSink,
+    Sink,
+)
+
+__all__ = [
+    "Frame",
+    "FrameSource",
+    "ImageDirSource",
+    "NpyPointCloudSource",
+    "SyntheticImageSource",
+    "SyntheticPointCloudSource",
+    "VideoSource",
+    "open_source",
+    "DetectionLogSink",
+    "ImageFileSink",
+    "NullSink",
+    "Sink",
+]

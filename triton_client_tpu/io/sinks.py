@@ -1,0 +1,89 @@
+"""Output sinks for the drivers.
+
+The reference publishes annotated images to a ROS topic live
+(communicator/ros_inference.py:158-175) and writes numbered PNGs in
+replay mode (communicator/bag_inference2d.py:136, pattern
+``./output_data/{:04d}.png``); 3D replay writes detections into an
+output bag (bag_inference3d.py:182-183). Here sinks implement one
+``write(frame, result)`` protocol; the ROS publisher lives behind the
+same protocol in the gated ROS adapter.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Mapping, Protocol
+
+import numpy as np
+
+from triton_client_tpu.io.draw import draw_boxes
+from triton_client_tpu.io.sources import Frame
+
+
+class Sink(Protocol):
+    def write(self, frame: Frame, result: Mapping[str, Any]) -> None: ...
+
+    def close(self) -> None: ...
+
+
+class NullSink:
+    """Discard results (benchmark mode)."""
+
+    def write(self, frame: Frame, result: Mapping[str, Any]) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+class ImageFileSink:
+    """Numbered annotated PNGs, parity with bag_inference2d.py:136."""
+
+    def __init__(
+        self, out_dir: str = "./output_data", class_names: tuple[str, ...] = ()
+    ) -> None:
+        self.out_dir = out_dir
+        self.class_names = class_names
+        os.makedirs(out_dir, exist_ok=True)
+
+    def write(self, frame: Frame, result: Mapping[str, Any]) -> None:
+        img = draw_boxes(
+            frame.data,
+            result["detections"],
+            result.get("valid"),
+            self.class_names,
+        )
+        path = os.path.join(self.out_dir, f"{frame.frame_id:04d}.png")
+        try:
+            import cv2
+
+            cv2.imwrite(path, img[..., ::-1])
+        except ImportError:  # pragma: no cover
+            from PIL import Image
+
+            Image.fromarray(img).save(path)
+
+    def close(self) -> None:
+        pass
+
+
+class DetectionLogSink:
+    """Detections as JSON lines — the machine-readable record (the
+    replacement for the reference's output bag, bag_inference3d.py:63)."""
+
+    def __init__(self, path: str) -> None:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._f = open(path, "w")
+
+    def write(self, frame: Frame, result: Mapping[str, Any]) -> None:
+        row: dict[str, Any] = {"frame_id": frame.frame_id, "ts": frame.timestamp}
+        for key, val in result.items():
+            if isinstance(val, np.ndarray):
+                row[key] = val.tolist()
+            elif isinstance(val, (int, float, str, list, bool)):
+                row[key] = val
+        self._f.write(json.dumps(row) + "\n")
+
+    def close(self) -> None:
+        self._f.close()

@@ -1,0 +1,199 @@
+"""Frame sources: the pull-driven input seam of the drivers.
+
+The reference has two input modes — live ROS topics
+(communicator/ros_inference.py:91-96 subscriber push) and rosbag replay
+(communicator/bag_inference2d.py:92 pull loop) — hard-wired into each
+driver. Here the seam is one iterator protocol, so the same driver runs
+a directory of images, a video file, recorded .npy point clouds, a
+synthetic generator (benchmarks), or a live ROS adapter (drivers/ros.py,
+import-gated) without knowing which.
+
+cv2 is used when present (JPEG decode parity with the reference's
+cv2.imdecode, ros_inference.py:119-131) and PIL is the fallback.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import os
+import time
+from typing import Iterator, Protocol
+
+import numpy as np
+
+try:
+    import cv2
+
+    _HAVE_CV2 = True
+except ImportError:  # pragma: no cover
+    cv2 = None
+    _HAVE_CV2 = False
+
+IMAGE_EXTENSIONS = (".jpg", ".jpeg", ".png", ".bmp")
+
+
+@dataclasses.dataclass
+class Frame:
+    """One unit of input: an RGB image (H, W, 3) uint8 or a point cloud
+    (N, >=4) float32, plus identity/timing for eval + sinks."""
+
+    data: np.ndarray
+    frame_id: int
+    timestamp: float
+    path: str = ""
+
+
+class FrameSource(Protocol):
+    def __iter__(self) -> Iterator[Frame]: ...
+
+    def __len__(self) -> int: ...
+
+
+class ImageDirSource:
+    """Sorted directory of images -> RGB frames (the reference's
+    filesystem requestGenerator, utils/preprocess.py:185-263)."""
+
+    def __init__(self, path: str, limit: int = 0) -> None:
+        self.paths = sorted(
+            p
+            for p in glob.glob(os.path.join(path, "*"))
+            if os.path.splitext(p)[1].lower() in IMAGE_EXTENSIONS
+        )
+        if limit:
+            self.paths = self.paths[:limit]
+        if not self.paths:
+            raise FileNotFoundError(f"no images under {path}")
+
+    def __len__(self) -> int:
+        return len(self.paths)
+
+    def __iter__(self) -> Iterator[Frame]:
+        for i, p in enumerate(self.paths):
+            yield Frame(_read_image_rgb(p), i, time.time(), p)
+
+
+class VideoSource:
+    """Video file -> RGB frames (the reference's local baseline input,
+    yolo_onnx_test.py:154-198)."""
+
+    def __init__(self, path: str, limit: int = 0) -> None:
+        if not _HAVE_CV2:
+            raise ImportError("VideoSource requires cv2")
+        if not os.path.exists(path):
+            raise FileNotFoundError(path)
+        self.path = path
+        self.limit = limit
+        cap = cv2.VideoCapture(path)
+        self._length = int(cap.get(cv2.CAP_PROP_FRAME_COUNT)) or 0
+        cap.release()
+        if limit:
+            self._length = min(self._length, limit)
+
+    def __len__(self) -> int:
+        return self._length
+
+    def __iter__(self) -> Iterator[Frame]:
+        cap = cv2.VideoCapture(self.path)
+        i = 0
+        try:
+            while True:
+                if self.limit and i >= self.limit:
+                    break
+                ok, bgr = cap.read()
+                if not ok:
+                    break
+                yield Frame(bgr[..., ::-1].copy(), i, time.time(), self.path)
+                i += 1
+        finally:
+            cap.release()
+
+
+class SyntheticImageSource:
+    """Deterministic random frames — the benchmark input (no-IO mode)."""
+
+    def __init__(self, n: int, hw: tuple[int, int] = (480, 640), seed: int = 0):
+        self.n, self.hw, self.seed = n, hw, seed
+
+    def __len__(self) -> int:
+        return self.n
+
+    def __iter__(self) -> Iterator[Frame]:
+        rng = np.random.default_rng(self.seed)
+        for i in range(self.n):
+            img = rng.integers(0, 255, (*self.hw, 3), dtype=np.uint8)
+            yield Frame(img, i, time.time())
+
+
+class NpyPointCloudSource:
+    """Directory of .npy point clouds (the reference extracts these from
+    bags with tools/pc_extractor.py:17-45 for its 3D demo path)."""
+
+    def __init__(self, path: str, limit: int = 0) -> None:
+        self.paths = sorted(glob.glob(os.path.join(path, "*.npy")))
+        if limit:
+            self.paths = self.paths[:limit]
+        if not self.paths:
+            raise FileNotFoundError(f"no .npy point clouds under {path}")
+
+    def __len__(self) -> int:
+        return len(self.paths)
+
+    def __iter__(self) -> Iterator[Frame]:
+        for i, p in enumerate(self.paths):
+            yield Frame(np.load(p).astype(np.float32), i, time.time(), p)
+
+
+class SyntheticPointCloudSource:
+    """Random KITTI-like point clouds for 3D benchmarks/tests."""
+
+    def __init__(self, n: int, points: int = 20000, seed: int = 0) -> None:
+        self.n, self.points, self.seed = n, points, seed
+
+    def __len__(self) -> int:
+        return self.n
+
+    def __iter__(self) -> Iterator[Frame]:
+        rng = np.random.default_rng(self.seed)
+        for i in range(self.n):
+            pc = np.stack(
+                [
+                    rng.uniform(0, 70, self.points),  # x forward
+                    rng.uniform(-40, 40, self.points),  # y left
+                    rng.uniform(-3, 1, self.points),  # z up
+                    rng.uniform(0, 1, self.points),  # intensity
+                ],
+                axis=1,
+            ).astype(np.float32)
+            yield Frame(pc, i, time.time())
+
+
+def open_source(spec: str, limit: int = 0, kind: str = "image") -> FrameSource:
+    """CLI string -> source. ``synthetic[:N[:HxW]]``, a directory, or a
+    video file (2D); ``synthetic`` or a .npy directory (3D)."""
+    if spec.startswith("synthetic"):
+        parts = spec.split(":")
+        n = int(parts[1]) if len(parts) > 1 else (limit or 100)
+        if kind == "pointcloud":
+            return SyntheticPointCloudSource(n)
+        hw = (480, 640)
+        if len(parts) > 2:
+            h, w = parts[2].split("x")
+            hw = (int(h), int(w))
+        return SyntheticImageSource(n, hw)
+    if kind == "pointcloud":
+        return NpyPointCloudSource(spec, limit)
+    if os.path.isdir(spec):
+        return ImageDirSource(spec, limit)
+    return VideoSource(spec, limit)
+
+
+def _read_image_rgb(path: str) -> np.ndarray:
+    if _HAVE_CV2:
+        bgr = cv2.imread(path, cv2.IMREAD_COLOR)
+        if bgr is None:
+            raise IOError(f"cannot decode {path}")
+        return bgr[..., ::-1].copy()
+    from PIL import Image
+
+    return np.asarray(Image.open(path).convert("RGB"))

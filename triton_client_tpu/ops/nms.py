@@ -29,10 +29,6 @@ import jax.numpy as jnp
 
 from triton_client_tpu.ops.boxes import box_area
 
-# Same spirit as the reference's max_wh=4096 pixel offset
-# (yolov5_postprocess.py:49): separates classes into disjoint coordinate
-# ranges so one class-agnostic NMS pass is class-aware.
-MAX_WH = 4096.0
 
 
 def _iou_row(
@@ -93,14 +89,19 @@ def batched_nms(
     class_agnostic: bool = False,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Class-aware NMS via the per-class coordinate offset trick."""
-    # Offsets are computed in f32 regardless of input dtype: at bf16,
-    # coords shifted by class*4096 lose all sub-32px structure and the
-    # suppression becomes garbage for classes >= 1.
+    # Same spirit as the reference's fixed max_wh=4096 pixel offset
+    # (yolov5_postprocess.py:49), but the stride adapts to the data
+    # range and the math runs in f32 regardless of input dtype: a fixed
+    # 4096 offset in f32 quantizes normalized [0,1] boxes to ~1/32-image
+    # steps by class ~80 (corrupting IoU) and cannot separate classes at
+    # all for coordinates above 4096; bf16 offsets lose all sub-32px
+    # structure from class 1 on.
     boxes32 = boxes.astype(jnp.float32)
     if class_agnostic:
         offset_boxes = boxes32
     else:
-        offset_boxes = boxes32 + (classes.astype(jnp.float32) * MAX_WH)[:, None]
+        stride = jnp.max(jnp.abs(boxes32)) * 2.0 + 1.0
+        offset_boxes = boxes32 + (classes.astype(jnp.float32) * stride)[:, None]
     return nms(offset_boxes, scores, iou_thresh=iou_thresh, max_det=max_det)
 
 
