@@ -14,4 +14,20 @@ from triton_client_tpu.channel.base import (
 )
 from triton_client_tpu.channel.tpu_channel import TPUChannel
 
-__all__ = ["BaseChannel", "InferRequest", "InferResponse", "TPUChannel"]
+__all__ = [
+    "BaseChannel",
+    "GRPCChannel",
+    "InferRequest",
+    "InferResponse",
+    "TPUChannel",
+]
+
+
+def __getattr__(name):
+    # Lazy: the remote path needs grpcio/protobuf (optional extra); the
+    # in-process TPUChannel path must import without them.
+    if name == "GRPCChannel":
+        from triton_client_tpu.channel.grpc_channel import GRPCChannel
+
+        return GRPCChannel
+    raise AttributeError(name)

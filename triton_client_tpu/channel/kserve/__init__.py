@@ -1,0 +1,10 @@
+"""KServe v2 wire protocol: proto messages, gRPC stubs, tensor codec.
+
+``kserve_v2_pb2.py`` is generated from ``kserve_v2.proto`` by
+``protoc --python_out=.`` (regenerate with ``make -C . proto`` or the
+command in the proto header comment). The gRPC service stubs are
+hand-written in ``service.py`` against the generic grpc API (the image
+has grpcio but not grpcio-tools).
+"""
+
+from triton_client_tpu.channel.kserve import kserve_v2_pb2 as pb  # noqa: F401

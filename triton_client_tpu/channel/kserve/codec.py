@@ -1,0 +1,135 @@
+"""numpy <-> KServe v2 raw tensor codec, zero-copy where possible.
+
+The reference deserializes ``raw_output_contents`` with a per-scalar
+``struct.unpack_from`` python loop (clients/postprocess/
+base_postprocess.py:15-37) — O(N) interpreter round-trips per tensor.
+Here both directions are single buffer views: ``np.frombuffer`` on
+receive (no copy; the protobuf bytes own the memory) and
+``ndarray.tobytes()`` / memoryview on send.
+
+Datatype strings follow the KServe v2 table; BF16 travels as uint16
+words (the standard Triton convention) and is viewed back at the jax
+boundary.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from triton_client_tpu.channel.kserve import pb
+
+# KServe v2 datatype string <-> numpy dtype (little-endian wire order,
+# matching the reference's struct '<' formats, base_postprocess.py:20).
+_TO_NP: dict[str, np.dtype] = {
+    "BOOL": np.dtype(np.bool_),
+    "UINT8": np.dtype(np.uint8),
+    "UINT16": np.dtype(np.uint16),
+    "UINT32": np.dtype(np.uint32),
+    "UINT64": np.dtype(np.uint64),
+    "INT8": np.dtype(np.int8),
+    "INT16": np.dtype(np.int16),
+    "INT32": np.dtype(np.int32),
+    "INT64": np.dtype(np.int64),
+    "FP16": np.dtype(np.float16),
+    "FP32": np.dtype(np.float32),
+    "FP64": np.dtype(np.float64),
+    "BF16": np.dtype(np.uint16),  # raw 16-bit words
+}
+_FROM_NP = {v: k for k, v in _TO_NP.items() if k != "BF16"}
+
+_CONFIG_DTYPE = {
+    "BOOL": pb.TYPE_BOOL,
+    "UINT8": pb.TYPE_UINT8,
+    "UINT16": pb.TYPE_UINT16,
+    "UINT32": pb.TYPE_UINT32,
+    "UINT64": pb.TYPE_UINT64,
+    "INT8": pb.TYPE_INT8,
+    "INT16": pb.TYPE_INT16,
+    "INT32": pb.TYPE_INT32,
+    "INT64": pb.TYPE_INT64,
+    "FP16": pb.TYPE_FP16,
+    "FP32": pb.TYPE_FP32,
+    "FP64": pb.TYPE_FP64,
+    "BF16": pb.TYPE_BF16,
+}
+
+
+def datatype_of(arr: np.ndarray) -> str:
+    dtype = arr.dtype.newbyteorder("=")
+    if dtype not in _FROM_NP:
+        raise ValueError(f"unsupported wire dtype {arr.dtype}")
+    return _FROM_NP[dtype]
+
+
+def config_datatype(datatype: str) -> int:
+    return _CONFIG_DTYPE.get(datatype, pb.TYPE_INVALID)
+
+
+def serialize_tensor(arr: np.ndarray) -> bytes:
+    """Array -> little-endian raw bytes (C order). A no-copy memoryview
+    when the array is already contiguous little-endian."""
+    arr = np.ascontiguousarray(arr)
+    if arr.dtype.byteorder == ">":
+        arr = arr.astype(arr.dtype.newbyteorder("<"))
+    return arr.tobytes()
+
+
+def deserialize_tensor(raw: bytes, datatype: str, shape) -> np.ndarray:
+    """Raw bytes -> array view over the buffer (zero copy)."""
+    if datatype not in _TO_NP:
+        raise ValueError(f"unsupported wire datatype '{datatype}'")
+    arr = np.frombuffer(raw, dtype=_TO_NP[datatype])
+    return arr.reshape(tuple(int(d) for d in shape))
+
+
+def build_infer_request(
+    model_name: str,
+    inputs: dict[str, np.ndarray],
+    model_version: str = "",
+    request_id: str = "",
+) -> pb.ModelInferRequest:
+    req = pb.ModelInferRequest(
+        model_name=model_name, model_version=model_version, id=request_id
+    )
+    # Sorted for a deterministic input<->raw_input_contents pairing
+    # (the wire pairs them by position).
+    for name in sorted(inputs):
+        arr = np.asarray(inputs[name])
+        req.inputs.add(name=name, datatype=datatype_of(arr), shape=arr.shape)
+        req.raw_input_contents.append(serialize_tensor(arr))
+    return req
+
+
+def parse_infer_request(req: pb.ModelInferRequest) -> dict[str, np.ndarray]:
+    if len(req.raw_input_contents) != len(req.inputs):
+        raise ValueError(
+            f"{len(req.inputs)} input tensors but "
+            f"{len(req.raw_input_contents)} raw buffers"
+        )
+    return {
+        t.name: deserialize_tensor(raw, t.datatype, t.shape)
+        for t, raw in zip(req.inputs, req.raw_input_contents)
+    }
+
+
+def build_infer_response(
+    model_name: str,
+    outputs: dict[str, np.ndarray],
+    model_version: str = "",
+    request_id: str = "",
+) -> pb.ModelInferResponse:
+    resp = pb.ModelInferResponse(
+        model_name=model_name, model_version=model_version, id=request_id
+    )
+    for name in sorted(outputs):
+        arr = np.asarray(outputs[name])
+        resp.outputs.add(name=name, datatype=datatype_of(arr), shape=arr.shape)
+        resp.raw_output_contents.append(serialize_tensor(arr))
+    return resp
+
+
+def parse_infer_response(resp: pb.ModelInferResponse) -> dict[str, np.ndarray]:
+    return {
+        t.name: deserialize_tensor(raw, t.datatype, t.shape)
+        for t, raw in zip(resp.outputs, resp.raw_output_contents)
+    }

@@ -1,0 +1,105 @@
+"""Hand-written gRPC stubs for inference.GRPCInferenceService.
+
+grpcio is in the image but grpcio-tools is not, so instead of generated
+``_pb2_grpc.py`` these stubs are built on grpc's generic API: the client
+side creates ``unary_unary``/``stream_stream`` multicallables and the
+server side registers a ``method_handlers_generic_handler``. Method
+paths and serialization match what grpcio-tools would generate, so the
+wire is indistinguishable from a stock tritonclient/Triton pairing.
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from triton_client_tpu.channel.kserve import pb
+
+_SERVICE = "inference.GRPCInferenceService"
+
+# method name -> (request type, response type, is_streaming)
+_METHODS = {
+    "ServerLive": (pb.ServerLiveRequest, pb.ServerLiveResponse, False),
+    "ServerReady": (pb.ServerReadyRequest, pb.ServerReadyResponse, False),
+    "ModelReady": (pb.ModelReadyRequest, pb.ModelReadyResponse, False),
+    "ServerMetadata": (pb.ServerMetadataRequest, pb.ServerMetadataResponse, False),
+    "ModelMetadata": (pb.ModelMetadataRequest, pb.ModelMetadataResponse, False),
+    "ModelInfer": (pb.ModelInferRequest, pb.ModelInferResponse, False),
+    "ModelStreamInfer": (pb.ModelInferRequest, pb.ModelStreamInferResponse, True),
+    "ModelConfig": (pb.ModelConfigRequest, pb.ModelConfigResponse, False),
+    "RepositoryIndex": (pb.RepositoryIndexRequest, pb.RepositoryIndexResponse, False),
+}
+
+
+class GRPCInferenceServiceStub:
+    """Client stub; same surface as a generated ``*_pb2_grpc`` stub."""
+
+    def __init__(self, channel: grpc.Channel) -> None:
+        for name, (req_t, resp_t, streaming) in _METHODS.items():
+            path = f"/{_SERVICE}/{name}"
+            if streaming:
+                call = channel.stream_stream(
+                    path,
+                    request_serializer=req_t.SerializeToString,
+                    response_deserializer=resp_t.FromString,
+                )
+            else:
+                call = channel.unary_unary(
+                    path,
+                    request_serializer=req_t.SerializeToString,
+                    response_deserializer=resp_t.FromString,
+                )
+            setattr(self, name, call)
+
+
+class GRPCInferenceServiceServicer:
+    """Base servicer: override the methods the server implements."""
+
+    def _unimplemented(self, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "method not implemented")
+
+    def ServerLive(self, request, context):
+        self._unimplemented(context)
+
+    def ServerReady(self, request, context):
+        self._unimplemented(context)
+
+    def ModelReady(self, request, context):
+        self._unimplemented(context)
+
+    def ServerMetadata(self, request, context):
+        self._unimplemented(context)
+
+    def ModelMetadata(self, request, context):
+        self._unimplemented(context)
+
+    def ModelInfer(self, request, context):
+        self._unimplemented(context)
+
+    def ModelStreamInfer(self, request_iterator, context):
+        self._unimplemented(context)
+
+    def ModelConfig(self, request, context):
+        self._unimplemented(context)
+
+    def RepositoryIndex(self, request, context):
+        self._unimplemented(context)
+
+
+def add_servicer_to_server(
+    servicer: GRPCInferenceServiceServicer, server: grpc.Server
+) -> None:
+    handlers = {}
+    for name, (req_t, resp_t, streaming) in _METHODS.items():
+        make = (
+            grpc.stream_stream_rpc_method_handler
+            if streaming
+            else grpc.unary_unary_rpc_method_handler
+        )
+        handlers[name] = make(
+            getattr(servicer, name),
+            request_deserializer=req_t.FromString,
+            response_serializer=resp_t.SerializeToString,
+        )
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(_SERVICE, handlers),)
+    )

@@ -23,15 +23,27 @@ import numpy as np
 # KServe v2 dtype strings <-> numpy, per the wire contract the reference
 # asserts against (communicator/ros_inference3d.py:141-144).
 _DTYPES = {
+    "FP64": np.float64,
     "FP32": np.float32,
     "FP16": np.float16,
     "BF16": None,  # no numpy bf16; handled at the jax boundary
-    "INT32": np.int32,
     "INT64": np.int64,
-    "UINT8": np.uint8,
+    "INT32": np.int32,
+    "INT16": np.int16,
     "INT8": np.int8,
+    "UINT64": np.uint64,
+    "UINT32": np.uint32,
+    "UINT16": np.uint16,
+    "UINT8": np.uint8,
     "BOOL": np.bool_,
 }
+
+# Wire width in bytes per dtype string (BF16 travels as 16-bit words).
+_ITEMSIZE = {k: (2 if v is None else np.dtype(v).itemsize) for k, v in _DTYPES.items()}
+
+# Headroom for protobuf framing + tensor name/shape metadata on top of
+# raw payloads when sizing gRPC message caps from wire_bytes().
+FRAMING_BYTES = 1 << 20
 
 
 @dataclasses.dataclass(frozen=True)
@@ -83,6 +95,21 @@ class ModelSpec:
             if t.name == name:
                 return t
         raise KeyError(f"model '{self.name}' has no input '{name}'")
+
+    def wire_bytes(self) -> int:
+        """Max raw-tensor payload of one full-batch request/response, or
+        0 if any dim is dynamic (callers fall back to a floor). This is
+        the dynamic replacement for the reference's hardcoded
+        ``batch_size * 8568044`` message budget (grpc_channel.py:26-29,
+        README.md:118 'make dynamic' TODO)."""
+        total = 0
+        for t in tuple(self.inputs) + tuple(self.outputs):
+            if any(d < 0 for d in t.shape):
+                return 0
+            total += int(np.prod(t.shape, dtype=np.int64)) * _ITEMSIZE.get(
+                t.dtype, 8
+            )
+        return total * max(1, self.max_batch_size)
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), indent=2)

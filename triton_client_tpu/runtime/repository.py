@@ -20,6 +20,12 @@ from triton_client_tpu.config import ModelSpec
 InferFn = Callable[[Mapping[str, object]], dict[str, object]]
 
 
+def _version_key(v: str):
+    """Single source of the 'latest version' ordering used by get() and
+    versions(): numeric-style compare ('10' > '9') with lexical tiebreak."""
+    return (len(v), v)
+
+
 @dataclasses.dataclass
 class RegisteredModel:
     spec: ModelSpec
@@ -62,7 +68,7 @@ class ModelRepository:
                 if version not in versions:
                     raise KeyError(f"model '{name}' has no version '{version}'")
                 return versions[version]
-            latest = max(versions, key=lambda v: (len(v), v))
+            latest = max(versions, key=_version_key)
             return versions[latest]
 
     def metadata(self, name: str, version: str = "") -> ModelSpec:
@@ -71,3 +77,11 @@ class ModelRepository:
     def list_models(self) -> list[tuple[str, str]]:
         with self._lock:
             return [(n, v) for n, vs in self._models.items() for v in vs]
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._models)
+
+    def versions(self, name: str) -> list[str]:
+        with self._lock:
+            return sorted(self._models.get(name, {}), key=_version_key)

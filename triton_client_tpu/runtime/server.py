@@ -1,0 +1,209 @@
+"""KServe v2 gRPC serving façade over the model repository.
+
+This is the in-tree replacement for the Triton Inference Server binary
+the reference deploys in docker (SURVEY.md §2.9 row 1): a gRPC server
+speaking the same KServe v2 protocol (so the reference's ROS tooling
+and any tritonclient-based caller work unchanged), dispatching to
+jit-compiled JAX functions through a BaseChannel (normally TPUChannel
+on a device mesh) instead of CUDA backends.
+
+Differences from the reference's serving story, by design:
+  * message size limits are computed from the registered model specs
+    (the reference hardcodes batch_size * 8568044 bytes with a "make
+    dynamic" TODO, grpc_channel.py:26-29 / README.md:118);
+  * ModelStreamInfer is implemented, not a dangling flag
+    (main.py:59-70 exposes --streaming but the refactored client never
+    exercises it);
+  * errors surface as rich gRPC status codes rather than a returned
+    exception object (yolov5_postprocess.py:124-125).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import logging
+
+import grpc
+
+from triton_client_tpu import __version__
+from triton_client_tpu.channel.base import BaseChannel, InferRequest
+from triton_client_tpu.channel.kserve import codec, pb, service
+from triton_client_tpu.config import FRAMING_BYTES
+from triton_client_tpu.runtime.repository import ModelRepository
+
+log = logging.getLogger(__name__)
+
+# Floor for the gRPC message cap; specs with dynamic (-1) dims fall back
+# to this. 64 MiB covers the reference's largest contract (batch 8
+# images, grpc_channel.py:26-29) with headroom.
+_MIN_MSG_BYTES = 64 << 20
+
+
+def message_limit(repository: ModelRepository) -> int:
+    """Dynamic per-repository message cap (README.md:118's TODO).
+
+    Computed from the specs registered *now*; InferenceServer reads it
+    once at construction (gRPC server options are bind-time fixed), so
+    register large models before constructing the server or pass an
+    explicit ``max_message_bytes``.
+    """
+    best = _MIN_MSG_BYTES
+    for name in repository.names():
+        for version in repository.versions(name):
+            spec = repository.metadata(name, version)
+            best = max(best, 2 * spec.wire_bytes() + FRAMING_BYTES)
+    return best
+
+
+class _Servicer(service.GRPCInferenceServiceServicer):
+    def __init__(self, repository: ModelRepository, channel: BaseChannel) -> None:
+        self._repo = repository
+        self._channel = channel
+
+    # -- health ---------------------------------------------------------------
+
+    def ServerLive(self, request, context):
+        return pb.ServerLiveResponse(live=True)
+
+    def ServerReady(self, request, context):
+        return pb.ServerReadyResponse(ready=True)
+
+    def ModelReady(self, request, context):
+        try:
+            self._repo.get(request.name, request.version)
+            ready = True
+        except KeyError:
+            ready = False
+        return pb.ModelReadyResponse(ready=ready)
+
+    # -- metadata -------------------------------------------------------------
+
+    def ServerMetadata(self, request, context):
+        return pb.ServerMetadataResponse(
+            name="triton_client_tpu",
+            version=__version__,
+            extensions=["model_repository", "binary_tensor_data"],
+        )
+
+    def _spec_or_abort(self, name, version, context):
+        try:
+            return self._repo.metadata(name, version)
+        except KeyError as e:
+            context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+
+    def ModelMetadata(self, request, context):
+        spec = self._spec_or_abort(request.name, request.version, context)
+        resp = pb.ModelMetadataResponse(
+            name=spec.name,
+            versions=list(self._repo.versions(spec.name)),
+            platform=spec.platform,
+        )
+        for t in spec.inputs:
+            resp.inputs.add(name=t.name, datatype=t.dtype, shape=t.shape)
+        for t in spec.outputs:
+            resp.outputs.add(name=t.name, datatype=t.dtype, shape=t.shape)
+        return resp
+
+    def ModelConfig(self, request, context):
+        spec = self._spec_or_abort(request.name, request.version, context)
+        config = pb.ModelConfig(
+            name=spec.name,
+            platform=spec.platform,
+            max_batch_size=spec.max_batch_size,
+        )
+        for t in spec.inputs:
+            config.input.add(
+                name=t.name,
+                data_type=codec.config_datatype(t.dtype),
+                dims=t.shape,
+            )
+        for t in spec.outputs:
+            config.output.add(
+                name=t.name,
+                data_type=codec.config_datatype(t.dtype),
+                dims=t.shape,
+            )
+        return pb.ModelConfigResponse(config=config)
+
+    def RepositoryIndex(self, request, context):
+        resp = pb.RepositoryIndexResponse()
+        for name in self._repo.names():
+            for version in self._repo.versions(name):
+                resp.models.add(name=name, version=version, state="READY")
+        return resp
+
+    # -- inference ------------------------------------------------------------
+
+    def _infer(self, request):
+        inputs = codec.parse_infer_request(request)
+        result = self._channel.do_inference(
+            InferRequest(
+                model_name=request.model_name,
+                model_version=request.model_version,
+                inputs=inputs,
+                request_id=request.id,
+            )
+        )
+        return codec.build_infer_response(
+            model_name=result.model_name,
+            model_version=result.model_version,
+            outputs=result.outputs,
+            request_id=result.request_id,
+        )
+
+    def ModelInfer(self, request, context):
+        try:
+            return self._infer(request)
+        except KeyError as e:
+            context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+        except ValueError as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+
+    def ModelStreamInfer(self, request_iterator, context):
+        for request in request_iterator:
+            try:
+                yield pb.ModelStreamInferResponse(
+                    infer_response=self._infer(request)
+                )
+            except (KeyError, ValueError) as e:
+                yield pb.ModelStreamInferResponse(error_message=str(e))
+
+
+class InferenceServer:
+    """Owns the grpc.Server; serve(), then shutdown()."""
+
+    def __init__(
+        self,
+        repository: ModelRepository,
+        channel: BaseChannel,
+        address: str = "0.0.0.0:8001",
+        max_workers: int = 8,
+        max_message_bytes: int | None = None,
+    ) -> None:
+        limit = max_message_bytes or message_limit(repository)
+        self._server = grpc.server(
+            concurrent.futures.ThreadPoolExecutor(max_workers=max_workers),
+            options=[
+                ("grpc.max_send_message_length", limit),
+                ("grpc.max_receive_message_length", limit),
+            ],
+        )
+        service.add_servicer_to_server(_Servicer(repository, channel), self._server)
+        self._port = self._server.add_insecure_port(address)
+        if self._port == 0:
+            raise RuntimeError(f"could not bind {address}")
+        self._address = address
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+    def start(self) -> None:
+        self._server.start()
+        log.info("KServe v2 server listening on %s", self._address)
+
+    def wait(self) -> None:
+        self._server.wait_for_termination()
+
+    def stop(self, grace: float = 1.0) -> None:
+        self._server.stop(grace).wait()
