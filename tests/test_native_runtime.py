@@ -1,0 +1,228 @@
+"""Native C++ runtime: queue/batcher/arena semantics + BatchingChannel.
+
+The reference outsources these to the Triton server binary (SURVEY.md
+§2.9); here they are in-tree, so they get the unit coverage Triton's
+dynamic batcher gets upstream: size-triggered closes, timeout-triggered
+closes, admission control, priority ordering, and end-to-end coalescing
+through the channel seam.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from triton_client_tpu.channel.base import BaseChannel, InferRequest, InferResponse
+from triton_client_tpu.runtime.batching import BatchingChannel
+
+try:
+    from triton_client_tpu.native import Arena, NativeBatchServer
+
+    NATIVE = True
+except Exception:  # pragma: no cover - toolchain-less environments
+    NATIVE = False
+
+needs_native = pytest.mark.skipif(not NATIVE, reason="native toolchain unavailable")
+
+
+@needs_native
+class TestNativeBatchServer:
+    def test_size_triggered_close(self):
+        got = []
+        done = threading.Event()
+
+        def on_batch(ids):
+            got.append(list(ids))
+            if sum(len(b) for b in got) >= 8:
+                done.set()
+
+        srv = NativeBatchServer(on_batch, max_batch=4, timeout_us=500_000)
+        with srv:
+            for i in range(8):
+                assert srv.enqueue(i)
+            assert done.wait(5.0)
+        assert [len(b) for b in got] == [4, 4]
+        stats_sizes = sorted(x for b in got for x in b)
+        assert stats_sizes == list(range(8))
+
+    def test_timeout_triggered_close(self):
+        got = []
+        done = threading.Event()
+
+        def on_batch(ids):
+            got.append(list(ids))
+            done.set()
+
+        srv = NativeBatchServer(on_batch, max_batch=64, timeout_us=10_000)
+        with srv:
+            srv.enqueue(42)
+            t0 = time.perf_counter()
+            assert done.wait(5.0)
+            waited = time.perf_counter() - t0
+            stats = srv.stats()
+        assert got == [[42]]
+        assert waited < 1.0  # closed by the 10ms window, not the 5s guard
+        assert stats["timeout_closes"] >= 1
+
+    def test_priority_order(self):
+        got = []
+        done = threading.Event()
+        release = threading.Event()
+
+        def on_batch(ids):
+            release.wait(5.0)  # hold the first batch until all enqueued
+            got.append(list(ids))
+            if len(got) >= 2:
+                done.set()
+
+        srv = NativeBatchServer(on_batch, max_batch=2, timeout_us=1_000)
+        with srv:
+            srv.enqueue(1, priority=0)
+            srv.enqueue(2, priority=0)
+            time.sleep(0.05)  # let batch 1 form and block in the callback
+            srv.enqueue(3, priority=0)
+            srv.enqueue(4, priority=1)  # high priority jumps the line
+            release.set()
+            assert done.wait(5.0)
+        assert got[1][0] == 4
+
+    def test_admission_control(self):
+        blocked = threading.Event()
+
+        def on_batch(ids):
+            blocked.wait(2.0)
+
+        srv = NativeBatchServer(on_batch, max_batch=1, timeout_us=100, capacity=2)
+        with srv:
+            time.sleep(0.02)
+            results = [srv.enqueue(i) for i in range(8)]
+            blocked.set()
+            stats = srv.stats()
+        # Capacity 2: at least one admitted, several rejected.
+        assert any(results) and not all(results)
+        assert stats["rejected_full"] >= 1
+
+    def test_drain_on_stop(self):
+        got = []
+
+        def on_batch(ids):
+            got.extend(ids)
+
+        srv = NativeBatchServer(on_batch, max_batch=4, timeout_us=1_000_000)
+        srv.start()
+        for i in range(3):
+            srv.enqueue(i)
+        srv.stop()  # must dispatch the partial batch, not drop it
+        assert sorted(got) == [0, 1, 2]
+        srv.close()
+
+
+@needs_native
+class TestArena:
+    def test_acquire_release_cycle(self):
+        arena = Arena(slot_bytes=1024, n_slots=2)
+        a = arena.acquire((16, 16), np.float32)
+        b = arena.acquire((256,), np.float32)
+        assert arena.free_slots() == 0
+        assert arena.acquire((4,), np.float32) is None  # exhausted
+        a[:] = 7.0
+        np.testing.assert_array_equal(np.asarray(a), np.full((16, 16), 7.0))
+        arena.release(a)
+        assert arena.free_slots() == 1
+        c = arena.acquire((8,), np.uint8)
+        assert c is not None
+        arena.release(b)
+        arena.release(c)
+        arena.close()
+
+    def test_oversized_request_rejected(self):
+        arena = Arena(slot_bytes=64, n_slots=1)
+        with pytest.raises(ValueError):
+            arena.acquire((1024,), np.float32)
+        arena.close()
+
+    def test_foreign_array_rejected(self):
+        arena = Arena(slot_bytes=64, n_slots=1)
+        with pytest.raises(ValueError):
+            arena.release(np.zeros(4, np.float32))
+        arena.close()
+
+
+class _EchoChannel(BaseChannel):
+    """Records the batch sizes it sees; output = input + 1."""
+
+    def __init__(self):
+        self.batch_sizes = []
+
+    def register_channel(self):
+        pass
+
+    def fetch_channel(self):
+        return None
+
+    def get_metadata(self, model_name, model_version=""):
+        raise KeyError(model_name)
+
+    def do_inference(self, request: InferRequest) -> InferResponse:
+        x = np.asarray(request.inputs["x"])
+        self.batch_sizes.append(x.shape[0])
+        return InferResponse(
+            model_name=request.model_name,
+            outputs={"y": x + 1.0},
+            request_id=request.request_id,
+        )
+
+
+@pytest.mark.parametrize("use_native", [True, False])
+def test_batching_channel_coalesces(use_native):
+    inner = _EchoChannel()
+    channel = BatchingChannel(
+        inner, max_batch=8, timeout_us=20_000, use_native=use_native
+    )
+    frames = [np.full((1, 4), float(i), np.float32) for i in range(8)]
+
+    results = [None] * len(frames)
+
+    def call(i):
+        results[i] = channel.do_inference(
+            InferRequest(model_name="m", inputs={"x": frames[i]}, request_id=str(i))
+        )
+
+    threads = [threading.Thread(target=call, args=(i,)) for i in range(len(frames))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10.0)
+    channel.close()
+
+    for i, r in enumerate(results):
+        assert r is not None
+        np.testing.assert_array_equal(r.outputs["y"], frames[i] + 1.0)
+        assert r.request_id == str(i)
+    # Coalescing happened: fewer inner calls than requests.
+    assert len(inner.batch_sizes) < len(frames)
+    assert sum(inner.batch_sizes) == len(frames)
+
+
+def test_batching_channel_mixed_shapes_not_merged():
+    inner = _EchoChannel()
+    channel = BatchingChannel(inner, max_batch=8, timeout_us=20_000, use_native=False)
+    a = np.zeros((1, 4), np.float32)
+    b = np.zeros((1, 6), np.float32)
+    out = {}
+
+    def call(name, arr):
+        out[name] = channel.do_inference(InferRequest(model_name="m", inputs={"x": arr}))
+
+    threads = [
+        threading.Thread(target=call, args=("a", a)),
+        threading.Thread(target=call, args=("b", b)),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10.0)
+    channel.close()
+    assert out["a"].outputs["y"].shape == (1, 4)
+    assert out["b"].outputs["y"].shape == (1, 6)

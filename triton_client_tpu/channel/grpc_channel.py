@@ -54,6 +54,7 @@ class GRPCChannel(BaseChannel):
         self._backoff_s = backoff_s
         self._channel: grpc.Channel | None = None
         self._stub: service.GRPCInferenceServiceStub | None = None
+        self._retired: list[grpc.Channel] = []
         self.register_channel()
 
     # -- BaseChannel protocol -------------------------------------------------
@@ -94,8 +95,12 @@ class GRPCChannel(BaseChannel):
         )
         needed = 2 * spec.wire_bytes() + FRAMING_BYTES
         if needed > self._max_message_bytes:
+            # Re-dial with the larger cap. The old channel is retired,
+            # not closed: closing would cancel RPCs other threads have
+            # in flight on it; it is drained and closed in close().
             self._max_message_bytes = needed
-            self.close()
+            if self._channel is not None:
+                self._retired.append(self._channel)
             self.register_channel()
         return spec
 
@@ -154,6 +159,9 @@ class GRPCChannel(BaseChannel):
     def close(self) -> None:
         if self._channel is not None:
             self._channel.close()
+        for ch in self._retired:
+            ch.close()
+        self._retired.clear()
 
     # -- internals ------------------------------------------------------------
 

@@ -14,28 +14,21 @@ boundary.
 
 from __future__ import annotations
 
+import ml_dtypes  # ships with jax
 import numpy as np
 
 from triton_client_tpu.channel.kserve import pb
+from triton_client_tpu.config import config_dtypes
 
 # KServe v2 datatype string <-> numpy dtype (little-endian wire order,
 # matching the reference's struct '<' formats, base_postprocess.py:20).
+# Derived from the single table in config._DTYPES; BF16 is the one
+# special case (no stock-numpy dtype) and maps to ml_dtypes.bfloat16.
+_BF16 = np.dtype(ml_dtypes.bfloat16)
 _TO_NP: dict[str, np.dtype] = {
-    "BOOL": np.dtype(np.bool_),
-    "UINT8": np.dtype(np.uint8),
-    "UINT16": np.dtype(np.uint16),
-    "UINT32": np.dtype(np.uint32),
-    "UINT64": np.dtype(np.uint64),
-    "INT8": np.dtype(np.int8),
-    "INT16": np.dtype(np.int16),
-    "INT32": np.dtype(np.int32),
-    "INT64": np.dtype(np.int64),
-    "FP16": np.dtype(np.float16),
-    "FP32": np.dtype(np.float32),
-    "FP64": np.dtype(np.float64),
-    "BF16": np.dtype(np.uint16),  # raw 16-bit words
+    k: (_BF16 if v is None else np.dtype(v)) for k, v in config_dtypes().items()
 }
-_FROM_NP = {v: k for k, v in _TO_NP.items() if k != "BF16"}
+_FROM_NP = {v: k for k, v in _TO_NP.items()}
 
 _CONFIG_DTYPE = {
     "BOOL": pb.TYPE_BOOL,

@@ -46,6 +46,13 @@ _ITEMSIZE = {k: (2 if v is None else np.dtype(v).itemsize) for k, v in _DTYPES.i
 FRAMING_BYTES = 1 << 20
 
 
+def config_dtypes() -> dict:
+    """The canonical KServe dtype table (BF16 maps to None — resolved
+    to ml_dtypes.bfloat16 at the codec layer). Single source for spec
+    validation, wire sizing, and the gRPC codec."""
+    return dict(_DTYPES)
+
+
 @dataclasses.dataclass(frozen=True)
 class TensorSpec:
     """One input/output tensor contract. -1 dims are dynamic (bucketed

@@ -1,0 +1,205 @@
+"""ctypes bindings over libtcr_runtime.so (native queue/batcher/arena).
+
+The C++ side owns admission + batch formation timing (off the GIL);
+tensor payloads never cross the boundary — Python keeps them keyed by
+request id and the batch callback receives only the id list. ctypes
+re-acquires the GIL for the callback, so it can run JAX directly.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+
+import numpy as np
+
+from triton_client_tpu.native.build import NativeUnavailable, ensure_built
+
+__all__ = ["Arena", "NativeBatchServer", "NativeUnavailable", "load"]
+
+_BATCH_CB = ctypes.CFUNCTYPE(
+    None, ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64), ctypes.c_int32
+)
+
+
+class Stats(ctypes.Structure):
+    _fields_ = [
+        ("enqueued", ctypes.c_uint64),
+        ("rejected_full", ctypes.c_uint64),
+        ("batches", ctypes.c_uint64),
+        ("batched_requests", ctypes.c_uint64),
+        ("timeout_closes", ctypes.c_uint64),
+        ("size_closes", ctypes.c_uint64),
+        ("queue_depth", ctypes.c_int32),
+        ("mean_batch", ctypes.c_double),
+        ("mean_queue_us", ctypes.c_double),
+    ]
+
+    def as_dict(self) -> dict:
+        return {name: getattr(self, name) for name, _ in self._fields_}
+
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def load() -> ctypes.CDLL:
+    """Build (if needed) and dlopen the native library, once."""
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        lib = ctypes.CDLL(str(ensure_built()))
+
+        lib.tcr_server_create.restype = ctypes.c_void_p
+        lib.tcr_server_create.argtypes = [
+            ctypes.c_int32,
+            ctypes.c_int64,
+            ctypes.c_int32,
+        ]
+        lib.tcr_server_set_callback.argtypes = [
+            ctypes.c_void_p,
+            _BATCH_CB,
+            ctypes.c_void_p,
+        ]
+        lib.tcr_server_start.restype = ctypes.c_int32
+        lib.tcr_server_start.argtypes = [ctypes.c_void_p]
+        lib.tcr_server_enqueue.restype = ctypes.c_int32
+        lib.tcr_server_enqueue.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_uint64,
+            ctypes.c_int32,
+        ]
+        lib.tcr_server_stop.argtypes = [ctypes.c_void_p]
+        lib.tcr_server_stats.argtypes = [ctypes.c_void_p, ctypes.POINTER(Stats)]
+        lib.tcr_server_destroy.argtypes = [ctypes.c_void_p]
+
+        lib.tcr_arena_create.restype = ctypes.c_void_p
+        lib.tcr_arena_create.argtypes = [ctypes.c_size_t, ctypes.c_int32]
+        lib.tcr_arena_acquire.restype = ctypes.c_void_p
+        lib.tcr_arena_acquire.argtypes = [ctypes.c_void_p]
+        lib.tcr_arena_release.restype = ctypes.c_int32
+        lib.tcr_arena_release.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+        lib.tcr_arena_slot_bytes.restype = ctypes.c_size_t
+        lib.tcr_arena_slot_bytes.argtypes = [ctypes.c_void_p]
+        lib.tcr_arena_free_slots.restype = ctypes.c_int32
+        lib.tcr_arena_free_slots.argtypes = [ctypes.c_void_p]
+        lib.tcr_arena_destroy.argtypes = [ctypes.c_void_p]
+
+        _lib = lib
+        return lib
+
+
+class NativeBatchServer:
+    """Queue + micro-batcher. ``on_batch(ids: list[int])`` runs on the
+    native batcher thread (with the GIL, via ctypes)."""
+
+    def __init__(
+        self,
+        on_batch,
+        max_batch: int = 8,
+        timeout_us: int = 2000,
+        capacity: int = 256,
+    ) -> None:
+        self._lib = load()
+        self._handle = self._lib.tcr_server_create(max_batch, timeout_us, capacity)
+        if not self._handle:
+            raise NativeUnavailable("tcr_server_create failed")
+        self._on_batch = on_batch
+
+        def trampoline(_user, ids_ptr, count):
+            try:
+                self._on_batch([ids_ptr[i] for i in range(count)])
+            except Exception:  # never let an exception cross the C boundary
+                import logging
+
+                logging.getLogger(__name__).exception("batch callback failed")
+
+        # Keep a reference: the C side holds a raw function pointer.
+        self._cb = _BATCH_CB(trampoline)
+        self._lib.tcr_server_set_callback(self._handle, self._cb, None)
+
+    def _require_handle(self):
+        if not self._handle:
+            raise RuntimeError("server is closed")
+        return self._handle
+
+    def start(self) -> None:
+        rc = self._lib.tcr_server_start(self._require_handle())
+        if rc != 0:
+            raise RuntimeError(f"tcr_server_start -> {rc}")
+
+    def enqueue(self, request_id: int, priority: int = 0) -> bool:
+        """False when the queue is full (admission control)."""
+        rc = self._lib.tcr_server_enqueue(
+            self._require_handle(), request_id, priority
+        )
+        if rc == -2:
+            raise RuntimeError("server not running")
+        return rc == 0
+
+    def stats(self) -> dict:
+        out = Stats()
+        self._lib.tcr_server_stats(self._require_handle(), ctypes.byref(out))
+        return out.as_dict()
+
+    def stop(self) -> None:
+        self._lib.tcr_server_stop(self._require_handle())
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.tcr_server_destroy(self._handle)
+            self._handle = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class Arena:
+    """Fixed-slot 64B-aligned host buffer pool; slots surface as numpy
+    arrays viewing native memory (no per-frame allocation in the IO
+    path)."""
+
+    def __init__(self, slot_bytes: int, n_slots: int) -> None:
+        self._lib = load()
+        self._handle = self._lib.tcr_arena_create(slot_bytes, n_slots)
+        if not self._handle:
+            raise NativeUnavailable("tcr_arena_create failed")
+        self._stride = self._lib.tcr_arena_slot_bytes(self._handle)
+        self._ptrs: dict[int, int] = {}
+
+    def acquire(self, shape, dtype) -> np.ndarray | None:
+        """An ndarray view over a free slot, or None when exhausted."""
+        dtype = np.dtype(dtype)
+        if not self._handle:
+            raise RuntimeError("arena is closed")
+        nbytes = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+        if nbytes > self._stride:
+            raise ValueError(f"slot is {self._stride} B; need {nbytes} B")
+        ptr = self._lib.tcr_arena_acquire(self._handle)
+        if not ptr:
+            return None
+        buf = (ctypes.c_char * self._stride).from_address(ptr)
+        arr = np.frombuffer(buf, dtype=dtype, count=nbytes // dtype.itemsize)
+        arr = arr.reshape(shape)
+        self._ptrs[id(arr)] = ptr
+        return arr
+
+    def release(self, arr: np.ndarray) -> None:
+        ptr = self._ptrs.pop(id(arr), None)
+        if ptr is None:
+            raise ValueError("array does not belong to this arena")
+        if self._lib.tcr_arena_release(self._handle, ptr) != 0:
+            raise ValueError("native release rejected pointer")
+
+    def free_slots(self) -> int:
+        return self._lib.tcr_arena_free_slots(self._handle)
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.tcr_arena_destroy(self._handle)
+            self._handle = None

@@ -1,0 +1,50 @@
+"""Build libtcr_runtime.so on demand (g++ direct; CMakeLists.txt is the
+equivalent recipe for packaging builds).
+
+The .so is compiled into ``_lib/`` next to this file the first time the
+native runtime is imported, and recompiled whenever the source is newer
+— the toolchain (g++) is part of the supported environment. Import-time
+failures are surfaced as NativeUnavailable so pure-Python fallbacks can
+take over (mirrors the reference's optional-dependency degradation
+pattern, communicator/__init__.py:5-8).
+"""
+
+from __future__ import annotations
+
+import pathlib
+import subprocess
+
+_HERE = pathlib.Path(__file__).resolve().parent
+SRC = _HERE / "src" / "tcr_runtime.cc"
+LIB = _HERE / "_lib" / "libtcr_runtime.so"
+
+
+class NativeUnavailable(RuntimeError):
+    pass
+
+
+def ensure_built() -> pathlib.Path:
+    if LIB.exists() and LIB.stat().st_mtime >= SRC.stat().st_mtime:
+        return LIB
+    LIB.parent.mkdir(parents=True, exist_ok=True)
+    cmd = [
+        "g++",
+        "-std=c++17",
+        "-O2",
+        "-Wall",
+        "-fPIC",
+        "-shared",
+        "-pthread",
+        str(SRC),
+        "-o",
+        str(LIB),
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+    except FileNotFoundError as e:
+        raise NativeUnavailable("g++ not found; native runtime disabled") from e
+    except subprocess.CalledProcessError as e:
+        raise NativeUnavailable(
+            f"native build failed:\n{e.stderr[-2000:]}"
+        ) from e
+    return LIB

@@ -1,0 +1,272 @@
+// tcr_runtime: native serving runtime core (C ABI for ctypes).
+//
+// The reference delegates its serving runtime — request queue,
+// dynamic batcher, scheduler — to the Triton Inference Server C++
+// binary (SURVEY.md §2.9 row 1; docker/server/Dockerfile:23-27). This
+// is the in-tree TPU-native equivalent: C++ owns admission, batch
+// formation and timing; tensor payloads never enter C++ (they stay as
+// numpy arrays keyed by request id on the Python side), so the hot
+// data path has zero extra copies while batching policy runs off the
+// GIL.
+//
+//   * tcr_server: bounded two-priority MPMC queue + batcher thread.
+//     Batches close when (a) max_batch requests are pending, or
+//     (b) timeout_us elapsed since the oldest admitted request, or
+//     (c) shutdown drains. Formed batches are handed to a registered
+//     callback (Python: ctypes CFUNCTYPE — ctypes re-acquires the GIL
+//     for the call, so the callback may run JAX directly).
+//   * tcr_arena: fixed-slot aligned buffer pool for frame staging
+//     (the allocator piece; 64-byte aligned for vectorized host ops).
+//
+// Build: g++ -O2 -fPIC -shared -pthread (driven by ../build.py).
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+typedef void (*tcr_batch_cb)(void* user, const uint64_t* ids, int32_t count);
+
+typedef struct {
+  uint64_t enqueued;
+  uint64_t rejected_full;
+  uint64_t batches;
+  uint64_t batched_requests;
+  uint64_t timeout_closes;   // batches closed by deadline
+  uint64_t size_closes;      // batches closed by reaching max_batch
+  int32_t queue_depth;
+  double mean_batch;
+  double mean_queue_us;      // mean admission->dispatch latency
+} tcr_stats;
+
+}  // extern "C"
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+struct Pending {
+  uint64_t id;
+  Clock::time_point admitted;
+};
+
+struct Server {
+  int32_t max_batch;
+  int64_t timeout_us;
+  int32_t capacity;
+
+  tcr_batch_cb cb = nullptr;
+  void* user = nullptr;
+
+  std::mutex mu;
+  std::condition_variable cv;
+  std::deque<Pending> high, normal;  // two-priority admission
+  bool running = false;
+  bool stopping = false;
+  std::thread worker;
+
+  // stats (written under mu except the atomics)
+  std::atomic<uint64_t> enqueued{0}, rejected{0};
+  uint64_t batches = 0, batched_requests = 0;
+  uint64_t timeout_closes = 0, size_closes = 0;
+  double queue_us_sum = 0.0;
+
+  int32_t depth_locked() const {
+    return static_cast<int32_t>(high.size() + normal.size());
+  }
+
+  // Pop up to max_batch ids, oldest-admitted deadline already expired
+  // or batch full. Returns ids + whether the close was size-triggered.
+  void run() {
+    std::vector<uint64_t> ids;
+    ids.reserve(max_batch);
+    std::unique_lock<std::mutex> lk(mu);
+    while (true) {
+      cv.wait(lk, [&] { return stopping || depth_locked() > 0; });
+      if (stopping && depth_locked() == 0) return;
+
+      // Batch window: wait until max_batch ready or the oldest
+      // request's deadline passes.
+      Clock::time_point oldest;
+      if (high.empty())
+        oldest = normal.front().admitted;
+      else if (normal.empty())
+        oldest = high.front().admitted;
+      else
+        oldest = std::min(high.front().admitted, normal.front().admitted);
+      const auto deadline = oldest + std::chrono::microseconds(timeout_us);
+      bool full = cv.wait_until(lk, deadline, [&] {
+        return stopping || depth_locked() >= max_batch;
+      });
+
+      ids.clear();
+      const auto now = Clock::now();
+      while (depth_locked() > 0 &&
+             static_cast<int32_t>(ids.size()) < max_batch) {
+        auto& q = high.empty() ? normal : high;
+        queue_us_sum +=
+            std::chrono::duration<double, std::micro>(now - q.front().admitted)
+                .count();
+        ids.push_back(q.front().id);
+        q.pop_front();
+      }
+      if (ids.empty()) continue;
+      batches++;
+      batched_requests += ids.size();
+      if (full && static_cast<int32_t>(ids.size()) >= max_batch)
+        size_closes++;
+      else
+        timeout_closes++;
+
+      // Dispatch outside the lock: the callback re-enters Python.
+      lk.unlock();
+      cb(user, ids.data(), static_cast<int32_t>(ids.size()));
+      lk.lock();
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+Server* tcr_server_create(int32_t max_batch, int64_t timeout_us,
+                          int32_t capacity) {
+  if (max_batch < 1 || capacity < 1) return nullptr;
+  auto* s = new Server();
+  s->max_batch = max_batch;
+  s->timeout_us = timeout_us;
+  s->capacity = capacity;
+  return s;
+}
+
+void tcr_server_set_callback(Server* s, tcr_batch_cb cb, void* user) {
+  s->cb = cb;
+  s->user = user;
+}
+
+int32_t tcr_server_start(Server* s) {
+  if (!s->cb) return -1;
+  std::lock_guard<std::mutex> lk(s->mu);
+  if (s->running) return -2;
+  s->running = true;
+  s->stopping = false;
+  s->worker = std::thread([s] { s->run(); });
+  return 0;
+}
+
+// 0 = admitted; -1 = queue full; -2 = not running. Never blocks.
+int32_t tcr_server_enqueue(Server* s, uint64_t id, int32_t priority) {
+  std::lock_guard<std::mutex> lk(s->mu);
+  if (!s->running || s->stopping) return -2;
+  if (s->depth_locked() >= s->capacity) {
+    s->rejected.fetch_add(1, std::memory_order_relaxed);
+    return -1;
+  }
+  (priority > 0 ? s->high : s->normal).push_back({id, Clock::now()});
+  s->enqueued.fetch_add(1, std::memory_order_relaxed);
+  s->cv.notify_all();
+  return 0;
+}
+
+// Drains pending requests (they are dispatched, not dropped), then
+// joins the batcher thread.
+void tcr_server_stop(Server* s) {
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    if (!s->running) return;
+    s->stopping = true;
+    s->cv.notify_all();
+  }
+  s->worker.join();
+  std::lock_guard<std::mutex> lk(s->mu);
+  s->running = false;
+}
+
+void tcr_server_stats(Server* s, tcr_stats* out) {
+  std::lock_guard<std::mutex> lk(s->mu);
+  out->enqueued = s->enqueued.load(std::memory_order_relaxed);
+  out->rejected_full = s->rejected.load(std::memory_order_relaxed);
+  out->batches = s->batches;
+  out->batched_requests = s->batched_requests;
+  out->timeout_closes = s->timeout_closes;
+  out->size_closes = s->size_closes;
+  out->queue_depth = s->depth_locked();
+  out->mean_batch =
+      s->batches ? static_cast<double>(s->batched_requests) / s->batches : 0.0;
+  out->mean_queue_us =
+      s->batched_requests ? s->queue_us_sum / s->batched_requests : 0.0;
+}
+
+void tcr_server_destroy(Server* s) {
+  tcr_server_stop(s);
+  delete s;
+}
+
+// ---- tcr_arena: fixed-slot aligned host buffer pool ------------------
+
+struct Arena {
+  size_t slot_bytes;
+  int32_t n_slots;
+  char* base;
+  std::mutex mu;
+  std::vector<int32_t> freelist;
+};
+
+Arena* tcr_arena_create(size_t slot_bytes, int32_t n_slots) {
+  if (slot_bytes == 0 || n_slots < 1) return nullptr;
+  // Round slots to 64B so every slot starts cache-line aligned.
+  const size_t stride = (slot_bytes + 63) & ~size_t{63};
+  void* base = nullptr;
+  if (posix_memalign(&base, 64, stride * n_slots) != 0) return nullptr;
+  auto* a = new Arena();
+  a->slot_bytes = stride;
+  a->n_slots = n_slots;
+  a->base = static_cast<char*>(base);
+  a->freelist.reserve(n_slots);
+  for (int32_t i = n_slots - 1; i >= 0; --i) a->freelist.push_back(i);
+  return a;
+}
+
+// Returns a slot pointer or NULL when exhausted (caller falls back to
+// regular allocation — admission control, not a hard failure).
+void* tcr_arena_acquire(Arena* a) {
+  std::lock_guard<std::mutex> lk(a->mu);
+  if (a->freelist.empty()) return nullptr;
+  int32_t slot = a->freelist.back();
+  a->freelist.pop_back();
+  return a->base + static_cast<size_t>(slot) * a->slot_bytes;
+}
+
+int32_t tcr_arena_release(Arena* a, void* p) {
+  auto off = static_cast<char*>(p) - a->base;
+  if (off < 0 || off % static_cast<ptrdiff_t>(a->slot_bytes) != 0) return -1;
+  auto slot = static_cast<int32_t>(off / a->slot_bytes);
+  if (slot >= a->n_slots) return -1;
+  std::lock_guard<std::mutex> lk(a->mu);
+  a->freelist.push_back(slot);
+  return 0;
+}
+
+size_t tcr_arena_slot_bytes(Arena* a) { return a->slot_bytes; }
+
+int32_t tcr_arena_free_slots(Arena* a) {
+  std::lock_guard<std::mutex> lk(a->mu);
+  return static_cast<int32_t>(a->freelist.size());
+}
+
+void tcr_arena_destroy(Arena* a) {
+  free(a->base);
+  delete a;
+}
+
+}  // extern "C"

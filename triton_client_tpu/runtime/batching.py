@@ -1,0 +1,250 @@
+"""Micro-batching channel: coalesce concurrent requests into one TPU call.
+
+Triton's dynamic batcher is a core piece of the serving runtime the
+reference leans on (config.pbtxt max_batch_size; SURVEY.md §2.9 row 1).
+Here the same policy runs in-tree: admission + batch-window timing live
+in the native C++ runtime (triton_client_tpu/native), and the formed
+batch is executed as ONE inference over the wrapped channel with the
+per-request arrays concatenated on the batch axis — bigger batches keep
+the MXU busy and amortize dispatch overhead.
+
+BatchingChannel is itself a BaseChannel, so it stacks under the gRPC
+façade or above TPUChannel unchanged. Requests are only merged when
+model, version and non-batch input shapes match; mismatches run solo.
+A pure-Python batcher (same semantics, queue.Queue + thread) backstops
+environments without the native toolchain.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import itertools
+import logging
+import queue
+import threading
+import time
+
+import numpy as np
+
+from triton_client_tpu.channel.base import BaseChannel, InferRequest, InferResponse
+
+log = logging.getLogger(__name__)
+
+
+def _merge_key(request: InferRequest):
+    return (
+        request.model_name,
+        request.model_version,
+        tuple(
+            (name, np.asarray(a).shape[1:], np.asarray(a).dtype.str)
+            for name, a in sorted(request.inputs.items())
+        ),
+    )
+
+
+class BatchingChannel(BaseChannel):
+    def __init__(
+        self,
+        inner: BaseChannel,
+        max_batch: int = 8,
+        timeout_us: int = 2000,
+        capacity: int = 256,
+        use_native: bool = True,
+    ) -> None:
+        self._inner = inner
+        self._pending: dict[int, tuple[InferRequest, concurrent.futures.Future]] = {}
+        self._lock = threading.Lock()
+        self._ids = itertools.count(1)
+        self._impl = None
+        self._py = None
+        if use_native:
+            try:
+                from triton_client_tpu.native import NativeBatchServer
+
+                self._impl = NativeBatchServer(
+                    self._on_batch,
+                    max_batch=max_batch,
+                    timeout_us=timeout_us,
+                    capacity=capacity,
+                )
+                self._impl.start()
+            except Exception as e:  # NativeUnavailable or load errors
+                self._impl = None
+                log.warning("native batcher unavailable (%s); python fallback", e)
+        if self._impl is None:
+            self._py = _PyBatcher(self._on_batch, max_batch, timeout_us, capacity)
+            self._py.start()
+
+    # -- BaseChannel ----------------------------------------------------------
+
+    def register_channel(self) -> None:
+        self._inner.register_channel()
+
+    def fetch_channel(self):
+        return self._inner.fetch_channel()
+
+    def get_metadata(self, model_name: str, model_version: str = ""):
+        return self._inner.get_metadata(model_name, model_version)
+
+    def do_inference(self, request: InferRequest) -> InferResponse:
+        future: concurrent.futures.Future = concurrent.futures.Future()
+        rid = next(self._ids)
+        with self._lock:
+            self._pending[rid] = (request, future)
+        admitted = (
+            self._impl.enqueue(rid) if self._impl is not None else self._py.enqueue(rid)
+        )
+        if not admitted:
+            with self._lock:
+                self._pending.pop(rid, None)
+            raise RuntimeError("inference queue full")
+        return future.result()
+
+    # -- batch execution (runs on the batcher thread) -------------------------
+
+    def _on_batch(self, ids) -> None:
+        with self._lock:
+            work = [(rid, *self._pending.pop(rid)) for rid in ids if rid in self._pending]
+        groups: dict = {}
+        for rid, request, future in work:
+            try:
+                key = _merge_key(request)
+            except Exception:
+                key = ("__solo__", rid)
+            groups.setdefault(key, []).append((rid, request, future))
+        for group in groups.values():
+            try:
+                self._run_group(group)
+            except Exception as e:
+                # No exception may escape: an unresolved future hangs its
+                # caller forever, and on the _PyBatcher path it would also
+                # kill the batcher thread.
+                for _, _, future in group:
+                    if not future.done():
+                        future.set_exception(e)
+
+    def _run_group(self, group) -> None:
+        if len(group) == 1:
+            _, request, future = group[0]
+            self._run_solo(request, future)
+            return
+        requests = [g[1] for g in group]
+        futures = [g[2] for g in group]
+        try:
+            sizes = [
+                next(iter(np.asarray(a).shape[0] for a in r.inputs.values()))
+                for r in requests
+            ]
+            merged = {
+                name: np.concatenate([np.asarray(r.inputs[name]) for r in requests])
+                for name in requests[0].inputs
+            }
+            resp = self._inner.do_inference(
+                InferRequest(
+                    model_name=requests[0].model_name,
+                    model_version=requests[0].model_version,
+                    inputs=merged,
+                )
+            )
+        except Exception:
+            # A merged failure must not take down unrelated requests:
+            # fall back to per-request execution.
+            for request, future in zip(requests, futures):
+                self._run_solo(request, future)
+            return
+        total = sum(sizes)
+        splits = np.cumsum(sizes)[:-1]
+        per_output = {}
+        for name, arr in resp.outputs.items():
+            arr = np.asarray(arr)
+            if arr.ndim >= 1 and arr.shape[0] == total:
+                per_output[name] = np.split(arr, splits)
+            else:  # non-batched output — replicate
+                per_output[name] = [arr] * len(requests)
+        for i, (request, future) in enumerate(zip(requests, futures)):
+            future.set_result(
+                InferResponse(
+                    model_name=resp.model_name,
+                    model_version=resp.model_version,
+                    outputs={k: v[i] for k, v in per_output.items()},
+                    request_id=request.request_id,
+                    latency_s=resp.latency_s,
+                )
+            )
+
+    def _run_solo(self, request: InferRequest, future) -> None:
+        try:
+            future.set_result(self._inner.do_inference(request))
+        except Exception as e:
+            future.set_exception(e)
+
+    # -- stats / lifecycle ----------------------------------------------------
+
+    def stats(self) -> dict:
+        if self._impl is not None:
+            return self._impl.stats()
+        return self._py.stats()
+
+    def close(self) -> None:
+        if self._impl is not None:
+            self._impl.close()
+        if self._py is not None:
+            self._py.close()
+
+
+class _PyBatcher:
+    """queue.Queue + thread fallback with the same close semantics."""
+
+    def __init__(self, on_batch, max_batch, timeout_us, capacity) -> None:
+        self._on_batch = on_batch
+        self._max_batch = max_batch
+        self._timeout_s = timeout_us / 1e6
+        self._q: queue.Queue = queue.Queue(maxsize=capacity)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._n_batches = 0
+        self._n_requests = 0
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def enqueue(self, rid: int) -> bool:
+        try:
+            self._q.put_nowait(rid)
+            return True
+        except queue.Full:
+            return False
+
+    def _run(self) -> None:
+        while not self._stop.is_set() or not self._q.empty():
+            try:
+                first = self._q.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            ids = [first]
+            deadline = time.perf_counter() + self._timeout_s
+            while len(ids) < self._max_batch:
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                try:
+                    ids.append(self._q.get(timeout=remaining))
+                except queue.Empty:
+                    break
+            self._n_batches += 1
+            self._n_requests += len(ids)
+            self._on_batch(ids)
+
+    def stats(self) -> dict:
+        return {
+            "batches": self._n_batches,
+            "batched_requests": self._n_requests,
+            "mean_batch": self._n_requests / self._n_batches
+            if self._n_batches
+            else 0.0,
+            "queue_depth": self._q.qsize(),
+        }
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5.0)
