@@ -1,0 +1,214 @@
+"""RetinaNet/FCOS (detectron family): anchors, decode, model contracts.
+
+Reference parity targets: examples/RetinaNet_detectron/config.pbtxt
+(640x480, boxes/classes/scores/dims) and the FCOS_client/detectron
+postprocess semantics (clients/postprocess/detectron_postprocess.py).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from triton_client_tpu.ops.anchor_decode import (
+    RETINA_STRIDES,
+    cell_anchors,
+    decode_deltas,
+    fcos_decode,
+    fcos_locations,
+    pyramid_anchors,
+)
+from triton_client_tpu.ops.detect_postprocess import extract_boxes_scored
+
+INPUT_HW = (96, 128)  # small, non-square: catches H/W transposes
+
+
+class TestAnchors:
+    def test_cell_anchor_geometry(self):
+        a = cell_anchors(32.0)
+        assert a.shape == (9, 4)
+        # All centered at origin.
+        centers = (a[:, :2] + a[:, 2:]) / 2
+        np.testing.assert_allclose(centers, 0.0, atol=1e-4)
+        # The 1:1 anchor at octave 0 is exactly 32x32.
+        w = a[:, 2] - a[:, 0]
+        h = a[:, 3] - a[:, 1]
+        assert any(abs(wi - 32) < 1e-3 and abs(hi - 32) < 1e-3 for wi, hi in zip(w, h))
+        # Aspect ratios h/w cover {0.5, 1, 2}.
+        ratios = sorted(set(np.round(h / w, 3)))
+        np.testing.assert_allclose(ratios, [0.5, 1.0, 2.0], rtol=1e-3)
+
+    def test_pyramid_count_and_coverage(self):
+        anchors = pyramid_anchors(INPUT_HW)
+        n = sum(
+            -(-INPUT_HW[0] // s) * -(-INPUT_HW[1] // s) * 9 for s in RETINA_STRIDES
+        )
+        assert anchors.shape == (n, 4)
+        # First-level anchors are centered on the stride-8 grid.
+        first = anchors[:9]
+        centers = (first[:, :2] + first[:, 2:]) / 2
+        np.testing.assert_allclose(centers, 4.0, atol=1e-4)
+
+    def test_decode_zero_deltas_identity(self):
+        anchors = pyramid_anchors(INPUT_HW)
+        out = decode_deltas(jnp.asarray(anchors), jnp.zeros((anchors.shape[0], 4)))
+        np.testing.assert_allclose(np.asarray(out), anchors, rtol=1e-5, atol=1e-3)
+
+    def test_decode_shift_and_scale(self):
+        anchors = jnp.asarray([[0.0, 0.0, 10.0, 10.0]])
+        # dx=0.5 anchor-widths right, dw=log(2) doubles width.
+        deltas = jnp.asarray([[[0.5, 0.0, np.log(2.0), 0.0]]])
+        out = np.asarray(decode_deltas(anchors, deltas))[0, 0]
+        np.testing.assert_allclose(out, [0.0, 0.0, 20.0, 10.0], atol=1e-4)
+
+    def test_fcos_decode(self):
+        locs = jnp.asarray(fcos_locations((16, 16), strides=(8,)))
+        assert locs.shape == (4, 2)
+        ltrb = jnp.full((1, 4, 4), 2.0)
+        boxes = np.asarray(fcos_decode(locs, ltrb))
+        # First location is (4, 4): box = [2, 2, 6, 6].
+        np.testing.assert_allclose(boxes[0, 0], [2.0, 2.0, 6.0, 6.0], atol=1e-5)
+
+
+class TestExtractScored:
+    def test_planted_box_survives(self):
+        n, nc = 64, 3
+        boxes = np.tile(np.array([0.0, 0.0, 8.0, 8.0], np.float32), (n, 1))
+        boxes += np.arange(n, dtype=np.float32)[:, None] * 10  # disjoint
+        scores = np.full((n, nc), 0.01, np.float32)
+        scores[5, 1] = 0.9
+        scores[17, 2] = 0.8
+        dets, valid = extract_boxes_scored(
+            jnp.asarray(boxes)[None], jnp.asarray(scores)[None], conf_thresh=0.05
+        )
+        dets, valid = np.asarray(dets)[0], np.asarray(valid)[0]
+        assert valid.sum() == 2
+        assert dets[0, 4] == pytest.approx(0.9, rel=1e-5)
+        assert int(dets[0, 5]) == 1
+        np.testing.assert_allclose(dets[0, :4], boxes[5], rtol=1e-5)
+        assert dets[1, 4] == pytest.approx(0.8, rel=1e-5)
+
+    def test_multilabel_emits_both_classes(self):
+        boxes = np.array([[0.0, 0.0, 10.0, 10.0]], np.float32)
+        scores = np.array([[0.7, 0.6]], np.float32)
+        dets, valid = extract_boxes_scored(
+            jnp.asarray(boxes)[None],
+            jnp.asarray(scores)[None],
+            conf_thresh=0.05,
+            multi_label=True,
+        )
+        # Same box, two classes: class-aware NMS keeps both.
+        assert np.asarray(valid)[0].sum() == 2
+        classes = sorted(np.asarray(dets)[0, :2, 5].astype(int))
+        assert classes == [0, 1]
+
+    def test_same_class_overlap_suppressed(self):
+        boxes = np.array(
+            [[0.0, 0.0, 10.0, 10.0], [1.0, 1.0, 11.0, 11.0]], np.float32
+        )
+        scores = np.array([[0.9], [0.8]], np.float32)
+        dets, valid = extract_boxes_scored(
+            jnp.asarray(boxes)[None], jnp.asarray(scores)[None], iou_thresh=0.5
+        )
+        assert np.asarray(valid)[0].sum() == 1
+
+
+@pytest.fixture(scope="module")
+def tiny_retinanet():
+    from triton_client_tpu.models.retinanet import init_retinanet
+
+    return init_retinanet(
+        jax.random.PRNGKey(0), num_classes=3, depth="tiny", input_hw=INPUT_HW
+    )
+
+
+@pytest.fixture(scope="module")
+def tiny_fcos():
+    from triton_client_tpu.models.retinanet import init_fcos
+
+    return init_fcos(
+        jax.random.PRNGKey(0), num_classes=3, depth="tiny", input_hw=INPUT_HW
+    )
+
+
+@pytest.mark.slow
+class TestRetinaNetModel:
+    def test_head_and_decode_shapes(self, tiny_retinanet):
+        from triton_client_tpu.models.retinanet import num_locations
+
+        model, variables = tiny_retinanet
+        x = jnp.zeros((2, *INPUT_HW, 3))
+        logits, deltas = model.apply(variables, x, train=False)
+        n = num_locations(INPUT_HW, per_cell=9)
+        assert logits.shape == (2, n, 3)
+        assert deltas.shape == (2, n, 4)
+        boxes, scores = model.decode((logits, deltas))
+        assert boxes.shape == (2, n, 4)
+        assert scores.shape == (2, n, 3)
+        s = np.asarray(scores)
+        assert (s > 0).all() and (s < 1).all()
+        # Prior-prob bias: initial scores should sit near 0.01, the
+        # focal-loss stability condition.
+        assert 0.001 < s.mean() < 0.2
+
+    def test_boxes_match_anchor_scale(self, tiny_retinanet):
+        model, variables = tiny_retinanet
+        x = jnp.zeros((1, *INPUT_HW, 3))
+        boxes, _ = model.decode(model.apply(variables, x, train=False))
+        b = np.asarray(boxes)[0]
+        assert np.isfinite(b).all()
+        # Near-zero deltas at init: boxes stay within ~2x the image.
+        assert b.min() > -600 and b.max() < 1200
+
+
+@pytest.mark.slow
+class TestFCOSModel:
+    def test_shapes_and_ranges(self, tiny_fcos):
+        from triton_client_tpu.models.retinanet import num_locations
+
+        model, variables = tiny_fcos
+        x = jnp.zeros((1, *INPUT_HW, 3))
+        logits, ltrb, ctr = model.apply(variables, x, train=False)
+        n = num_locations(INPUT_HW)
+        assert logits.shape == (1, n, 3)
+        assert ltrb.shape == (1, n, 4)
+        assert ctr.shape == (1, n)
+        assert (np.asarray(ltrb) >= 0).all()  # distances are relu'd
+        boxes, scores = model.decode((logits, ltrb, ctr))
+        assert boxes.shape == (1, n, 4)
+        s = np.asarray(scores)
+        assert (s >= 0).all() and (s <= 1).all()
+
+    def test_fcos_boxes_contain_locations(self, tiny_fcos):
+        from triton_client_tpu.ops.anchor_decode import fcos_locations
+
+        model, variables = tiny_fcos
+        x = jnp.ones((1, *INPUT_HW, 3))
+        boxes, _ = model.decode(model.apply(variables, x, train=False))
+        locs = fcos_locations(INPUT_HW)
+        b = np.asarray(boxes)[0]
+        assert (b[:, 0] <= locs[:, 0] + 1e-3).all()
+        assert (b[:, 2] >= locs[:, 0] - 1e-3).all()
+
+
+@pytest.mark.slow
+def test_retinanet_pipeline_end_to_end():
+    from triton_client_tpu.pipelines.detect2d import (
+        build_retinanet_pipeline,
+        detectron_infer_fn,
+    )
+
+    pipeline, spec, _ = build_retinanet_pipeline(
+        jax.random.PRNGKey(0), num_classes=3, depth="tiny", input_hw=INPUT_HW
+    )
+    assert [t.name for t in spec.outputs] == ["boxes", "scores", "classes", "dims"]
+    frame = np.random.default_rng(0).integers(0, 255, (60, 80, 3)).astype(np.float32)
+    dets, valid = pipeline.infer(frame)
+    assert dets.shape == (100, 6)
+    assert valid.shape == (100,)
+    # Detectron wire contract adapter.
+    out = detectron_infer_fn(pipeline)({"images": frame[None]})
+    assert out["boxes"].shape == (1, 100, 4)
+    assert out["classes"].dtype == np.int64
+    assert out["dims"].shape == (1,)
+    assert out["dims"][0] == np.asarray(valid).sum()

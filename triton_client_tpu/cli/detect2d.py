@@ -14,6 +14,7 @@ Usage:
 from __future__ import annotations
 
 import argparse
+import dataclasses
 
 import jax
 
@@ -43,9 +44,12 @@ def parse_args(argv=None) -> argparse.Namespace:
 
 
 def build(args):
-    """Model name -> (pipeline, spec). yolov5{n,s,m,l,x} or yolov4."""
+    """Model name -> (pipeline, spec). yolov5{n,s,m,l,x}, yolov4,
+    retinanet[_<depth>] or fcos[_<depth>] (depth: tiny|resnet18|34|50)."""
     from triton_client_tpu.pipelines.detect2d import (
         Detect2DConfig,
+        build_fcos_pipeline,
+        build_retinanet_pipeline,
         build_yolov4_pipeline,
         build_yolov5_pipeline,
     )
@@ -78,8 +82,39 @@ def build(args):
             input_hw=hw,
             config=cfg,
         )
+    elif name.partition("_")[0] in ("retinanet", "fcos"):
+        from triton_client_tpu.models.retinanet import RESNET_DEPTHS
+
+        base, _, depth = name.partition("_")
+        depth = depth or "resnet50"
+        if depth not in RESNET_DEPTHS:
+            raise SystemExit(
+                f"unknown backbone depth '{depth}' (choose from {sorted(RESNET_DEPTHS)})"
+            )
+        builder = build_retinanet_pipeline if base == "retinanet" else build_fcos_pipeline
+        # Detectron family: no /255 scaling, detectron2 test thresholds,
+        # reference input 640x480 (RetinaNet_detectron/config.pbtxt:3-8).
+        cfg = dataclasses.replace(
+            cfg,
+            conf_thresh=args.conf if args.conf is not None else 0.05,
+            iou_thresh=args.iou if args.iou is not None else 0.5,
+            max_det=100,
+            scaling="none",
+            multi_label=True,
+            head_style="scored",
+        )
+        pipe, spec, _ = builder(
+            jax.random.PRNGKey(0),
+            num_classes=args.classes,
+            depth=depth,
+            input_hw=hw,
+            config=cfg,
+        )
     else:
-        raise SystemExit(f"unknown 2D model '{name}' (yolov5[nsmlx] | yolov4)")
+        raise SystemExit(
+            f"unknown 2D model '{name}' "
+            "(yolov5[nsmlx] | yolov4 | retinanet[_depth] | fcos[_depth])"
+        )
     return pipe, spec
 
 

@@ -1,0 +1,364 @@
+"""RetinaNet / FCOS (the reference's "detectron" family) in flax, NHWC.
+
+The reference serves these models as Detectron2 exports behind Triton
+(examples/RetinaNet_detectron/config.pbtxt: libtorch backend, 640x480
+input, 4 outputs boxes/classes/scores/dims) and its client does no
+decoding at all (clients/detectron_client.py:4-21,
+clients/postprocess/detectron_postprocess.py:26-38). Here the whole
+model lives in-tree, TPU-first:
+
+  * ResNet backbone: NHWC convs so XLA tiles the MXU, bf16-capable,
+    basic blocks (resnet18-style) or bottlenecks (resnet50-style);
+  * FPN P3-P7 with the RetinaNet extra P6/P7 convs;
+  * two heads over the shared pyramid:
+      - RetinaNetHead: anchor-based, A=9, class subnet + box subnet,
+        prior-prob bias init so training starts stable;
+      - FCOSHead: anchor-free, ltrb + centerness (the reference's
+        FCOS_client model);
+  * decode folds the anchor table (trace-time constant) into the jit;
+    NMS comes from ops.nms downstream.
+
+Heads emit (B, N, ...) flattened over levels in pyramid order, matching
+ops.anchor_decode's tables.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from triton_client_tpu.ops.anchor_decode import (
+    RETINA_OCTAVES,
+    RETINA_RATIOS,
+    RETINA_STRIDES,
+    decode_deltas,
+    fcos_decode,
+    fcos_locations,
+    pyramid_anchors,
+)
+
+
+class _ConvBnRelu(nn.Module):
+    features: int
+    kernel: int = 3
+    stride: int = 1
+    act: bool = True
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        p = self.kernel // 2
+        x = nn.Conv(
+            self.features,
+            (self.kernel, self.kernel),
+            strides=(self.stride, self.stride),
+            padding=((p, p), (p, p)),
+            use_bias=False,
+            dtype=self.dtype,
+            name="conv",
+        )(x)
+        x = nn.BatchNorm(
+            use_running_average=not train, momentum=0.9, dtype=self.dtype, name="bn"
+        )(x)
+        return nn.relu(x) if self.act else x
+
+
+class BasicBlock(nn.Module):
+    features: int
+    stride: int = 1
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        identity = x
+        y = _ConvBnRelu(self.features, 3, self.stride, dtype=self.dtype, name="c1")(
+            x, train
+        )
+        y = _ConvBnRelu(self.features, 3, 1, act=False, dtype=self.dtype, name="c2")(
+            y, train
+        )
+        if identity.shape != y.shape:
+            identity = _ConvBnRelu(
+                self.features, 1, self.stride, act=False, dtype=self.dtype, name="down"
+            )(x, train)
+        return nn.relu(identity + y)
+
+
+class Bottleneck(nn.Module):
+    features: int  # output width (4x the inner width)
+    stride: int = 1
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        inner = self.features // 4
+        identity = x
+        y = _ConvBnRelu(inner, 1, 1, dtype=self.dtype, name="c1")(x, train)
+        y = _ConvBnRelu(inner, 3, self.stride, dtype=self.dtype, name="c2")(y, train)
+        y = _ConvBnRelu(self.features, 1, 1, act=False, dtype=self.dtype, name="c3")(
+            y, train
+        )
+        if identity.shape != y.shape:
+            identity = _ConvBnRelu(
+                self.features, 1, self.stride, act=False, dtype=self.dtype, name="down"
+            )(x, train)
+        return nn.relu(identity + y)
+
+
+# depth preset -> (block, blocks-per-stage, stage widths)
+_RESNETS = {
+    # "tiny" keeps unit tests fast: one block per stage, narrow.
+    "tiny": (BasicBlock, (1, 1, 1, 1), (16, 32, 64, 128)),
+    "resnet18": (BasicBlock, (2, 2, 2, 2), (64, 128, 256, 512)),
+    "resnet34": (BasicBlock, (3, 4, 6, 3), (64, 128, 256, 512)),
+    "resnet50": (Bottleneck, (3, 4, 6, 3), (256, 512, 1024, 2048)),
+}
+RESNET_DEPTHS = tuple(_RESNETS)
+
+
+class ResNetFPN(nn.Module):
+    """ResNet C2-C5 -> FPN P3-P7 feature pyramid."""
+
+    depth: str = "resnet50"
+    fpn_width: int = 256
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False) -> list[jnp.ndarray]:
+        block, stages, widths = _RESNETS[self.depth]
+        stem = widths[0] // 4 if block is Bottleneck else widths[0]
+        x = _ConvBnRelu(stem, 7, 2, dtype=self.dtype, name="stem")(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+
+        feats = []
+        for si, (n, w) in enumerate(zip(stages, widths)):
+            for bi in range(n):
+                stride = 2 if (bi == 0 and si > 0) else 1
+                x = block(w, stride, dtype=self.dtype, name=f"s{si}b{bi}")(x, train)
+            feats.append(x)
+        _, c3, c4, c5 = feats
+
+        # FPN lateral + top-down (P3-P5), plus RetinaNet's P6/P7.
+        fw = self.fpn_width
+        p5 = nn.Conv(fw, (1, 1), dtype=self.dtype, name="lat5")(c5)
+        p4 = nn.Conv(fw, (1, 1), dtype=self.dtype, name="lat4")(c4) + _upsample2(p5, c4)
+        p3 = nn.Conv(fw, (1, 1), dtype=self.dtype, name="lat3")(c3) + _upsample2(p4, c3)
+        p3 = nn.Conv(fw, (3, 3), padding=((1, 1), (1, 1)), dtype=self.dtype, name="out3")(p3)
+        p4 = nn.Conv(fw, (3, 3), padding=((1, 1), (1, 1)), dtype=self.dtype, name="out4")(p4)
+        p5 = nn.Conv(fw, (3, 3), padding=((1, 1), (1, 1)), dtype=self.dtype, name="out5")(p5)
+        p6 = nn.Conv(
+            fw, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)), dtype=self.dtype, name="p6"
+        )(c5)
+        p7 = nn.Conv(
+            fw, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)), dtype=self.dtype, name="p7"
+        )(nn.relu(p6))
+        return [p3, p4, p5, p6, p7]
+
+
+def _upsample2(x: jnp.ndarray, like: jnp.ndarray) -> jnp.ndarray:
+    """Nearest 2x upsample to `like`'s spatial shape (handles odd sizes)."""
+    b, h, w, c = like.shape
+    return jax.image.resize(x, (x.shape[0], h, w, x.shape[3]), method="nearest")
+
+
+def _prior_bias(prior: float = 0.01) -> float:
+    """Focal-loss prior bias for classification convs."""
+    return -math.log((1 - prior) / prior)
+
+
+class RetinaNetHead(nn.Module):
+    """Shared class/box subnets applied to every pyramid level."""
+
+    num_classes: int
+    num_anchors: int = len(RETINA_RATIOS) * len(RETINA_OCTAVES)
+    width: int = 256
+    depth: int = 4
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, pyramid: Sequence[jnp.ndarray]) -> tuple[jnp.ndarray, jnp.ndarray]:
+        """-> (B, N, num_classes) logits, (B, N, 4) deltas; N flattened
+        over levels in pyramid order (matches pyramid_anchors)."""
+        cls_convs = [
+            nn.Conv(self.width, (3, 3), padding=((1, 1), (1, 1)), dtype=self.dtype,
+                    name=f"cls{i}")
+            for i in range(self.depth)
+        ]
+        box_convs = [
+            nn.Conv(self.width, (3, 3), padding=((1, 1), (1, 1)), dtype=self.dtype,
+                    name=f"box{i}")
+            for i in range(self.depth)
+        ]
+        cls_out = nn.Conv(
+            self.num_anchors * self.num_classes,
+            (3, 3),
+            padding=((1, 1), (1, 1)),
+            bias_init=nn.initializers.constant(_prior_bias()),
+            dtype=jnp.float32,
+            name="cls_out",
+        )
+        box_out = nn.Conv(
+            self.num_anchors * 4,
+            (3, 3),
+            padding=((1, 1), (1, 1)),
+            dtype=jnp.float32,
+            name="box_out",
+        )
+
+        logits, deltas = [], []
+        for feat in pyramid:
+            c = feat
+            for conv in cls_convs:
+                c = nn.relu(conv(c))
+            c = cls_out(c.astype(jnp.float32))
+            b, h, w, _ = c.shape
+            logits.append(c.reshape(b, h * w * self.num_anchors, self.num_classes))
+
+            d = feat
+            for conv in box_convs:
+                d = nn.relu(conv(d))
+            d = box_out(d.astype(jnp.float32))
+            deltas.append(d.reshape(b, h * w * self.num_anchors, 4))
+        return jnp.concatenate(logits, axis=1), jnp.concatenate(deltas, axis=1)
+
+
+class FCOSHead(nn.Module):
+    """Anchor-free head: class logits + ltrb distances + centerness."""
+
+    num_classes: int
+    width: int = 256
+    depth: int = 4
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self, pyramid: Sequence[jnp.ndarray]
+    ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+        """-> (B, N, nc) logits, (B, N, 4) ltrb >= 0, (B, N) centerness."""
+        cls_convs = [
+            nn.Conv(self.width, (3, 3), padding=((1, 1), (1, 1)), dtype=self.dtype,
+                    name=f"cls{i}")
+            for i in range(self.depth)
+        ]
+        reg_convs = [
+            nn.Conv(self.width, (3, 3), padding=((1, 1), (1, 1)), dtype=self.dtype,
+                    name=f"reg{i}")
+            for i in range(self.depth)
+        ]
+        cls_out = nn.Conv(
+            self.num_classes,
+            (3, 3),
+            padding=((1, 1), (1, 1)),
+            bias_init=nn.initializers.constant(_prior_bias()),
+            dtype=jnp.float32,
+            name="cls_out",
+        )
+        reg_out = nn.Conv(4, (3, 3), padding=((1, 1), (1, 1)), dtype=jnp.float32,
+                          name="reg_out")
+        ctr_out = nn.Conv(1, (3, 3), padding=((1, 1), (1, 1)), dtype=jnp.float32,
+                          name="ctr_out")
+
+        logits, ltrb, ctr = [], [], []
+        for li, feat in enumerate(pyramid):
+            # Per-level learnable scale on the distance regression
+            # (FCOS's trainable scalar per level).
+            scale = self.param(f"scale{li}", nn.initializers.ones, (1,), jnp.float32)
+            c = feat
+            for conv in cls_convs:
+                c = nn.relu(conv(c))
+            r = feat
+            for conv in reg_convs:
+                r = nn.relu(conv(r))
+            cl = cls_out(c.astype(jnp.float32))
+            b, h, w, _ = cl.shape
+            logits.append(cl.reshape(b, h * w, self.num_classes))
+            dist = nn.relu(reg_out(r.astype(jnp.float32)) * scale) * RETINA_STRIDES[li]
+            ltrb.append(dist.reshape(b, h * w, 4))
+            ctr.append(ctr_out(r.astype(jnp.float32)).reshape(b, h * w))
+        return (
+            jnp.concatenate(logits, axis=1),
+            jnp.concatenate(ltrb, axis=1),
+            jnp.concatenate(ctr, axis=1),
+        )
+
+
+class RetinaNet(nn.Module):
+    """Backbone + FPN + RetinaNet head, with in-jit decode."""
+
+    num_classes: int = 80
+    depth: str = "resnet50"
+    input_hw: tuple[int, int] = (480, 640)
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        pyramid = ResNetFPN(self.depth, dtype=self.dtype, name="backbone")(x, train)
+        return RetinaNetHead(self.num_classes, dtype=self.dtype, name="head")(pyramid)
+
+    def decode(self, outputs) -> tuple[jnp.ndarray, jnp.ndarray]:
+        """(logits, deltas) -> ((B, N, 4) xyxy boxes, (B, N, nc) scores)."""
+        logits, deltas = outputs
+        anchors = jnp.asarray(pyramid_anchors(self.input_hw))
+        return decode_deltas(anchors, deltas), jax.nn.sigmoid(logits)
+
+
+class FCOS(nn.Module):
+    """Backbone + FPN + FCOS head, with in-jit decode."""
+
+    num_classes: int = 80
+    depth: str = "resnet50"
+    input_hw: tuple[int, int] = (480, 640)
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        pyramid = ResNetFPN(self.depth, dtype=self.dtype, name="backbone")(x, train)
+        return FCOSHead(self.num_classes, dtype=self.dtype, name="head")(pyramid)
+
+    def decode(self, outputs) -> tuple[jnp.ndarray, jnp.ndarray]:
+        """-> ((B, N, 4) boxes, (B, N, nc) scores); scores are
+        sqrt(cls * centerness), FCOS's test-time scoring."""
+        logits, ltrb, ctr = outputs
+        locations = jnp.asarray(fcos_locations(self.input_hw))
+        boxes = fcos_decode(locations, ltrb)
+        scores = jnp.sqrt(
+            jax.nn.sigmoid(logits) * jax.nn.sigmoid(ctr)[..., None]
+        )
+        return boxes, scores
+
+
+def num_locations(input_hw: tuple[int, int], per_cell: int = 1) -> int:
+    return sum(
+        (-(-input_hw[0] // s)) * (-(-input_hw[1] // s)) * per_cell
+        for s in RETINA_STRIDES
+    )
+
+
+def init_retinanet(
+    rng: Any,
+    num_classes: int = 80,
+    depth: str = "resnet50",
+    input_hw: tuple[int, int] = (480, 640),
+    dtype: jnp.dtype = jnp.float32,
+):
+    model = RetinaNet(num_classes=num_classes, depth=depth, input_hw=input_hw,
+                      dtype=dtype)
+    dummy = jnp.zeros((1, *input_hw, 3), jnp.float32)
+    return model, model.init(rng, dummy, train=False)
+
+
+def init_fcos(
+    rng: Any,
+    num_classes: int = 80,
+    depth: str = "resnet50",
+    input_hw: tuple[int, int] = (480, 640),
+    dtype: jnp.dtype = jnp.float32,
+):
+    model = FCOS(num_classes=num_classes, depth=depth, input_hw=input_hw, dtype=dtype)
+    dummy = jnp.zeros((1, *input_hw, 3), jnp.float32)
+    return model, model.init(rng, dummy, train=False)

@@ -190,6 +190,8 @@ class Arena:
         return arr
 
     def release(self, arr: np.ndarray) -> None:
+        if not self._handle:
+            raise RuntimeError("arena is closed")
         ptr = self._ptrs.pop(id(arr), None)
         if ptr is None:
             raise ValueError("array does not belong to this arena")
@@ -197,6 +199,8 @@ class Arena:
             raise ValueError("native release rejected pointer")
 
     def free_slots(self) -> int:
+        if not self._handle:
+            raise RuntimeError("arena is closed")
         return self._lib.tcr_arena_free_slots(self._handle)
 
     def close(self) -> None:

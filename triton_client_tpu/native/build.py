@@ -11,6 +11,7 @@ pattern, communicator/__init__.py:5-8).
 
 from __future__ import annotations
 
+import os
 import pathlib
 import subprocess
 
@@ -27,6 +28,10 @@ def ensure_built() -> pathlib.Path:
     if LIB.exists() and LIB.stat().st_mtime >= SRC.stat().st_mtime:
         return LIB
     LIB.parent.mkdir(parents=True, exist_ok=True)
+    # Compile to a unique temp name and os.replace() into place so an
+    # interrupted or concurrent build can never leave a corrupt .so
+    # that passes the mtime check.
+    tmp = LIB.with_suffix(f".so.tmp{os.getpid()}")
     cmd = [
         "g++",
         "-std=c++17",
@@ -37,14 +42,17 @@ def ensure_built() -> pathlib.Path:
         "-pthread",
         str(SRC),
         "-o",
-        str(LIB),
+        str(tmp),
     ]
     try:
         subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.replace(tmp, LIB)
     except FileNotFoundError as e:
         raise NativeUnavailable("g++ not found; native runtime disabled") from e
     except subprocess.CalledProcessError as e:
         raise NativeUnavailable(
             f"native build failed:\n{e.stderr[-2000:]}"
         ) from e
+    finally:
+        tmp.unlink(missing_ok=True)
     return LIB

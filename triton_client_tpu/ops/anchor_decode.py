@@ -1,0 +1,131 @@
+"""Anchor generation + box-delta decoding for the detectron family.
+
+The reference serves RetinaNet/FCOS behind Triton with decoding and NMS
+already applied server-side (clients/detectron_client.py:4-21 consumes
+finished boxes/classes/scores). In this framework that server side is
+in-tree, so the decode must exist here — implemented as fixed-shape
+jnp ops that fuse into the model's jit:
+
+  * dense per-level anchor grids (RetinaNet: 3 scales x 3 ratios per
+    cell, strides 8..128 for FPN P3-P7);
+  * Faster-RCNN delta decode (dx,dy,dw,dh vs anchor, clamped dw/dh);
+  * FCOS location + ltrb distance decode (anchor-free).
+
+Everything is computed from static shapes at trace time — anchors are
+constants folded into the compiled program, not a host-side table.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+# Detectron2 RetinaNet defaults: sizes 32..512 on P3..P7, 3 octave
+# scales, aspect ratios 1:2 / 1:1 / 2:1.
+RETINA_STRIDES = (8, 16, 32, 64, 128)
+RETINA_SIZES = (32, 64, 128, 256, 512)
+RETINA_RATIOS = (0.5, 1.0, 2.0)
+RETINA_OCTAVES = (1.0, 2 ** (1 / 3), 2 ** (2 / 3))
+
+# Delta clamp: log(max scale factor), detectron2's SCALE_CLAMP.
+_SCALE_CLAMP = math.log(1000.0 / 16)
+
+
+def cell_anchors(
+    size: float,
+    ratios: Sequence[float] = RETINA_RATIOS,
+    octaves: Sequence[float] = RETINA_OCTAVES,
+) -> np.ndarray:
+    """(A, 4) xyxy anchors centered at the origin for one level."""
+    out = []
+    for octave in octaves:
+        area = (size * octave) ** 2
+        for ratio in ratios:
+            w = math.sqrt(area / ratio)
+            h = w * ratio
+            out.append([-w / 2, -h / 2, w / 2, h / 2])
+    return np.asarray(out, np.float32)
+
+
+def level_anchors(
+    feat_hw: tuple[int, int], stride: int, base: np.ndarray
+) -> np.ndarray:
+    """(H*W*A, 4) anchors for one pyramid level (host-side constant)."""
+    h, w = feat_hw
+    shift_x = (np.arange(w, dtype=np.float32) + 0.5) * stride
+    shift_y = (np.arange(h, dtype=np.float32) + 0.5) * stride
+    sx, sy = np.meshgrid(shift_x, shift_y)
+    shifts = np.stack([sx, sy, sx, sy], axis=-1).reshape(-1, 1, 4)
+    return (shifts + base[None]).reshape(-1, 4)
+
+
+def pyramid_anchors(
+    input_hw: tuple[int, int],
+    strides: Sequence[int] = RETINA_STRIDES,
+    sizes: Sequence[float] = RETINA_SIZES,
+    ratios: Sequence[float] = RETINA_RATIOS,
+    octaves: Sequence[float] = RETINA_OCTAVES,
+) -> np.ndarray:
+    """All-level (N, 4) anchor table for an input resolution. Feature
+    sizes follow ceil-division like the conv stack's SAME padding."""
+    out = []
+    for stride, size in zip(strides, sizes):
+        feat_hw = (
+            -(-input_hw[0] // stride),
+            -(-input_hw[1] // stride),
+        )
+        out.append(level_anchors(feat_hw, stride, cell_anchors(size, ratios, octaves)))
+    return np.concatenate(out, axis=0)
+
+
+def decode_deltas(anchors: jnp.ndarray, deltas: jnp.ndarray) -> jnp.ndarray:
+    """Faster-RCNN parameterization: anchors (N, 4) xyxy + deltas
+    (..., N, 4) [dx, dy, dw, dh] -> (..., N, 4) xyxy boxes."""
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    ax = anchors[:, 0] + 0.5 * aw
+    ay = anchors[:, 1] + 0.5 * ah
+
+    dx, dy, dw, dh = (deltas[..., i] for i in range(4))
+    dw = jnp.clip(dw, None, _SCALE_CLAMP)
+    dh = jnp.clip(dh, None, _SCALE_CLAMP)
+
+    cx = dx * aw + ax
+    cy = dy * ah + ay
+    w = jnp.exp(dw) * aw
+    h = jnp.exp(dh) * ah
+    return jnp.stack(
+        [cx - 0.5 * w, cy - 0.5 * h, cx + 0.5 * w, cy + 0.5 * h], axis=-1
+    )
+
+
+def fcos_locations(
+    input_hw: tuple[int, int], strides: Sequence[int] = RETINA_STRIDES
+) -> np.ndarray:
+    """(N, 2) FCOS per-cell center locations across the pyramid."""
+    out = []
+    for stride in strides:
+        h = -(-input_hw[0] // stride)
+        w = -(-input_hw[1] // stride)
+        xs = (np.arange(w, dtype=np.float32) + 0.5) * stride
+        ys = (np.arange(h, dtype=np.float32) + 0.5) * stride
+        gx, gy = np.meshgrid(xs, ys)
+        out.append(np.stack([gx, gy], axis=-1).reshape(-1, 2))
+    return np.concatenate(out, axis=0)
+
+
+def fcos_decode(locations: jnp.ndarray, ltrb: jnp.ndarray) -> jnp.ndarray:
+    """locations (N, 2) + ltrb distances (..., N, 4) -> xyxy boxes."""
+    x, y = locations[:, 0], locations[:, 1]
+    return jnp.stack(
+        [
+            x - ltrb[..., 0],
+            y - ltrb[..., 1],
+            x + ltrb[..., 2],
+            y + ltrb[..., 3],
+        ],
+        axis=-1,
+    )

@@ -53,6 +53,36 @@ def _gate_topk_nms(
     )
 
 
+def _multilabel_topk_nms(
+    boxes: jnp.ndarray,
+    per_class_scores: jnp.ndarray,
+    conf_thresh: float,
+    iou_thresh: float,
+    max_det: int,
+    max_nms: int,
+    class_agnostic: bool = False,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Single-image multi-label tail: every (box, class) pair over the
+    threshold is a candidate. Top-k runs on the flat (N*nc,) scores;
+    boxes/classes are derived from surviving indices (idx // nc,
+    idx % nc) so the (N*nc, 4) box expansion is never materialized."""
+    nc = per_class_scores.shape[-1]
+    flat = per_class_scores.reshape(-1)
+    gated = jnp.where(flat > conf_thresh, flat, -jnp.inf)
+    k = min(max_nms, gated.shape[0])
+    top_scores, top_idx = jax.lax.top_k(gated, k)
+    top_valid = top_scores > -jnp.inf
+    return nms_padded(
+        boxes[top_idx // nc],
+        jnp.where(top_valid, top_scores, 0.0),
+        top_idx % nc,
+        top_valid,
+        iou_thresh=iou_thresh,
+        max_det=max_det,
+        class_agnostic=class_agnostic,
+    )
+
+
 @functools.partial(
     jax.jit, static_argnames=("max_det", "max_nms", "class_agnostic", "multi_label")
 )
@@ -91,25 +121,14 @@ def extract_boxes(
         cls_conf = pred[:, 5:] * obj[:, None]  # conf = obj * cls
 
         if multi_label and nc > 1:
-            # One candidate per (box, class) pair over the threshold.
-            # Top-k runs on the flat (N*nc,) scores; boxes/classes are
-            # derived from the surviving indices (idx // nc, idx % nc)
-            # so the (N*nc, 4) box expansion is never materialized —
-            # this branch can't use _gate_topk_nms, which gathers boxes
-            # only after its own top-k.
-            flat_conf = cls_conf.reshape(-1)
-            gated = jnp.where(flat_conf > conf_thresh, flat_conf, -jnp.inf)
-            k = min(max_nms, gated.shape[0])
-            top_scores, top_idx = jax.lax.top_k(gated, k)
-            top_valid = top_scores > -jnp.inf
-            return nms_padded(
-                boxes[top_idx // nc],
-                jnp.where(top_valid, top_scores, 0.0),
-                top_idx % nc,
-                top_valid,
-                iou_thresh=iou_thresh,
-                max_det=max_det,
-                class_agnostic=class_agnostic,
+            return _multilabel_topk_nms(
+                boxes,
+                cls_conf,
+                conf_thresh,
+                iou_thresh,
+                max_det,
+                max_nms,
+                class_agnostic,
             )
         return _gate_topk_nms(
             boxes,
@@ -168,3 +187,60 @@ def extract_boxes_yolov4(
         )
 
     return jax.vmap(one_image)(boxes, confs)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("max_det", "max_nms", "class_agnostic", "multi_label")
+)
+def extract_boxes_scored(
+    boxes: jnp.ndarray,
+    scores: jnp.ndarray,
+    conf_thresh: float = 0.05,
+    iou_thresh: float = 0.5,
+    max_det: int = 100,
+    max_nms: int = 1024,
+    class_agnostic: bool = False,
+    multi_label: bool = True,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Decoded-box detectors (RetinaNet/FCOS) -> packed detections.
+
+    The reference's detectron family has NMS server-side and its client
+    consumes finished boxes (clients/postprocess/detectron_postprocess.py:
+    26-38); this op IS that server side, in-jit. Defaults follow
+    detectron2's test-time config (score 0.05, NMS 0.5, 100 dets).
+
+    Args:
+      boxes: (B, N, 4) xyxy in input pixels (already decoded).
+      scores: (B, N, nc) per-class probabilities.
+      multi_label: detectron semantics — every (box, class) over the
+        threshold is a candidate (default), vs best-class-only.
+
+    Returns:
+      (detections, valid): (B, max_det, 6) [x1, y1, x2, y2, score,
+      class] + (B, max_det) mask.
+    """
+    nc = scores.shape[-1]
+
+    def one_image(b: jnp.ndarray, s: jnp.ndarray):
+        if multi_label and nc > 1:
+            return _multilabel_topk_nms(
+                b,
+                s,
+                conf_thresh,
+                iou_thresh,
+                max_det,
+                max_nms,
+                class_agnostic,
+            )
+        return _gate_topk_nms(
+            b,
+            jnp.max(s, axis=-1),
+            jnp.argmax(s, axis=-1),
+            conf_thresh,
+            iou_thresh,
+            max_det,
+            max_nms,
+            class_agnostic,
+        )
+
+    return jax.vmap(one_image)(boxes, scores)

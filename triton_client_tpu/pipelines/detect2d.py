@@ -25,7 +25,10 @@ import numpy as np
 from triton_client_tpu.config import ModelSpec, TensorSpec
 from triton_client_tpu.models.yolov5 import YoloV5, num_predictions
 from triton_client_tpu.ops.boxes import scale_boxes
-from triton_client_tpu.ops.detect_postprocess import extract_boxes
+from triton_client_tpu.ops.detect_postprocess import (
+    extract_boxes,
+    extract_boxes_scored,
+)
 from triton_client_tpu.ops.preprocess import normalize_image
 
 
@@ -44,6 +47,10 @@ class Detect2DConfig:
     scaling: str = "yolo"
     multi_label: bool = False
     class_names: tuple[str, ...] = ()
+    # "yolo": forward returns (B, N, 5+nc) obj/cls predictions.
+    # "scored": forward returns ((B, N, 4) boxes, (B, N, nc) scores) —
+    # the detectron family, where decode happens in the model.
+    head_style: str = "yolo"
 
 
 class Detect2DPipeline:
@@ -72,14 +79,25 @@ class Detect2DPipeline:
             )
         x = normalize_image(x, cfg.scaling)
         pred = self._forward(x)
-        dets, valid = extract_boxes(
-            pred,
-            conf_thresh=cfg.conf_thresh,
-            iou_thresh=cfg.iou_thresh,
-            max_det=cfg.max_det,
-            max_nms=cfg.max_nms,
-            multi_label=cfg.multi_label,
-        )
+        if cfg.head_style == "scored":
+            boxes_scores = pred
+            dets, valid = extract_boxes_scored(
+                *boxes_scores,
+                conf_thresh=cfg.conf_thresh,
+                iou_thresh=cfg.iou_thresh,
+                max_det=cfg.max_det,
+                max_nms=cfg.max_nms,
+                multi_label=cfg.multi_label,
+            )
+        else:
+            dets, valid = extract_boxes(
+                pred,
+                conf_thresh=cfg.conf_thresh,
+                iou_thresh=cfg.iou_thresh,
+                max_det=cfg.max_det,
+                max_nms=cfg.max_nms,
+                multi_label=cfg.multi_label,
+            )
         boxes = scale_boxes(dets[..., :4], cfg.input_hw, orig_hw)
         dets = jnp.concatenate([boxes, dets[..., 4:]], axis=-1)
         dets = jnp.where(valid[..., None], dets, 0.0)
@@ -97,13 +115,27 @@ class Detect2DPipeline:
         return (dets[0], valid[0]) if squeeze else (dets, valid)
 
     def infer_fn(self):
-        """Repository-facing dict->dict adapter."""
+        """Repository-facing dict->dict adapter. Emits the wire contract
+        of the spec its builder registers: packed detections/valid for
+        the YOLO family, the reference's detectron 4-output contract
+        (boxes/scores/classes/dims, RetinaNet_detectron/config.pbtxt)
+        for scored heads."""
+        if self.config.head_style == "scored":
 
-        def fn(inputs):
-            frames = inputs["images"]
-            orig_hw = (frames.shape[1], frames.shape[2])
-            dets, valid = self._jit(frames, orig_hw)
-            return {"detections": dets, "valid": valid}
+            def fn(inputs):
+                dets, valid = self.infer(np.asarray(inputs["images"]))
+                return {
+                    "boxes": dets[..., :4],
+                    "scores": dets[..., 4],
+                    "classes": dets[..., 5].astype(np.int64),
+                    "dims": valid.sum(axis=-1).astype(np.int32),
+                }
+
+        else:
+
+            def fn(inputs):
+                dets, valid = self.infer(np.asarray(inputs["images"]))
+                return {"detections": dets, "valid": valid}
 
         return fn
 
@@ -206,5 +238,116 @@ def _detect2d_spec(cfg: Detect2DConfig, n_predictions: int) -> ModelSpec:
             "model_input_hw": list(cfg.input_hw),
             "num_predictions": n_predictions,
             "num_classes": cfg.num_classes,
+        },
+    )
+
+
+def build_retinanet_pipeline(
+    rng: jax.Array | None = None,
+    num_classes: int = 80,
+    depth: str = "resnet50",
+    input_hw: tuple[int, int] = (480, 640),
+    variables=None,
+    dtype: jnp.dtype = jnp.float32,
+    config: Detect2DConfig | None = None,
+) -> tuple[Detect2DPipeline, ModelSpec, dict]:
+    """RetinaNet (detectron family) fused pipeline.
+
+    Contract parity: examples/RetinaNet_detectron/config.pbtxt (3x640x480
+    input; boxes/classes/scores/dims outputs — served via
+    detectron_infer_fn). Unlike the YOLO paths there is no /255 scaling
+    (clients/preprocess/detectron_preprocess.py:12-24 feeds raw pixels).
+    """
+    from triton_client_tpu.models.retinanet import RetinaNet
+
+    model = RetinaNet(
+        num_classes=num_classes, depth=depth, input_hw=input_hw, dtype=dtype
+    )
+    if variables is None:
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        dummy = jnp.zeros((1, *input_hw, 3), jnp.float32)
+        variables = model.init(rng, dummy, train=False)
+
+    def forward(x: jnp.ndarray):
+        return model.decode(model.apply(variables, x, train=False))
+
+    cfg = config or Detect2DConfig(
+        model_name="retinanet",
+        input_hw=input_hw,
+        num_classes=num_classes,
+        conf_thresh=0.05,
+        iou_thresh=0.5,
+        max_det=100,
+        scaling="none",
+        multi_label=True,
+        head_style="scored",
+    )
+    pipeline = Detect2DPipeline(cfg, forward)
+    return pipeline, _detectron_spec(cfg), variables
+
+
+def build_fcos_pipeline(
+    rng: jax.Array | None = None,
+    num_classes: int = 80,
+    depth: str = "resnet50",
+    input_hw: tuple[int, int] = (480, 640),
+    variables=None,
+    dtype: jnp.dtype = jnp.float32,
+    config: Detect2DConfig | None = None,
+) -> tuple[Detect2DPipeline, ModelSpec, dict]:
+    """FCOS (anchor-free detectron family; the reference's FCOS_client)."""
+    from triton_client_tpu.models.retinanet import FCOS
+
+    model = FCOS(
+        num_classes=num_classes, depth=depth, input_hw=input_hw, dtype=dtype
+    )
+    if variables is None:
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        dummy = jnp.zeros((1, *input_hw, 3), jnp.float32)
+        variables = model.init(rng, dummy, train=False)
+
+    def forward(x: jnp.ndarray):
+        return model.decode(model.apply(variables, x, train=False))
+
+    cfg = config or Detect2DConfig(
+        model_name="fcos",
+        input_hw=input_hw,
+        num_classes=num_classes,
+        conf_thresh=0.05,
+        iou_thresh=0.6,
+        max_det=100,
+        scaling="none",
+        multi_label=True,
+        head_style="scored",
+    )
+    pipeline = Detect2DPipeline(cfg, forward)
+    return pipeline, _detectron_spec(cfg), variables
+
+
+def detectron_infer_fn(pipeline: Detect2DPipeline):
+    """Back-compat alias: scored pipelines' infer_fn() already emits the
+    detectron contract (boxes/scores/classes/dims)."""
+    return pipeline.infer_fn()
+
+
+def _detectron_spec(cfg: Detect2DConfig) -> ModelSpec:
+    return ModelSpec(
+        name=cfg.model_name,
+        version="1",
+        platform="jax",
+        inputs=(TensorSpec("images", (-1, -1, -1, 3), "FP32", "NHWC"),),
+        outputs=(
+            TensorSpec("boxes", (-1, cfg.max_det, 4), "FP32"),
+            TensorSpec("scores", (-1, cfg.max_det), "FP32"),
+            TensorSpec("classes", (-1, cfg.max_det), "INT64"),
+            TensorSpec("dims", (-1,), "INT32"),
+        ),
+        max_batch_size=8,
+        extra={
+            "conf_thresh": cfg.conf_thresh,
+            "iou_thresh": cfg.iou_thresh,
+            "scaling": cfg.scaling,
         },
     )

@@ -209,6 +209,10 @@ class _PyBatcher:
         self._thread.start()
 
     def enqueue(self, rid: int) -> bool:
+        if self._stop.is_set():
+            # Match the native path: enqueue after close raises rather
+            # than accepting work no thread will ever drain.
+            raise RuntimeError("server not running")
         try:
             self._q.put_nowait(rid)
             return True
