@@ -97,7 +97,10 @@ def build(args):
         cfg = dataclasses.replace(
             cfg,
             conf_thresh=args.conf if args.conf is not None else 0.05,
-            iou_thresh=args.iou if args.iou is not None else 0.5,
+            # Per-model detectron2 test-time NMS: 0.5 retinanet, 0.6 fcos.
+            iou_thresh=args.iou
+            if args.iou is not None
+            else (0.5 if base == "retinanet" else 0.6),
             max_det=100,
             scaling="none",
             multi_label=True,

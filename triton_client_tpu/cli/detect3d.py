@@ -12,6 +12,7 @@ Usage:
 from __future__ import annotations
 
 import argparse
+import dataclasses
 
 import jax
 
@@ -42,15 +43,29 @@ def main(argv=None) -> None:
     from triton_client_tpu.drivers.driver import InferenceDriver, detect3d_infer
     from triton_client_tpu.pipelines.detect3d import (
         Detect3DConfig,
+        build_centerpoint_pipeline,
         build_pointpillars_pipeline,
+        build_second_pipeline,
     )
 
+    name = args.model_name or "pointpillars"
+    builders = {
+        "pointpillars": build_pointpillars_pipeline,
+        "second_iou": build_second_pipeline,
+        "centerpoint": build_centerpoint_pipeline,
+    }
+    if name not in builders:
+        raise SystemExit(f"unknown 3D model '{name}' (choose from {sorted(builders)})")
     cfg = Detect3DConfig(
-        model_name=args.model_name or "pointpillars",
+        model_name=name,
         score_thresh=args.score,
         z_offset=args.z_offset,
     )
-    pipe, spec, _ = build_pointpillars_pipeline(jax.random.PRNGKey(0), config=cfg)
+    if name == "centerpoint":
+        from triton_client_tpu.models.centerpoint import NUSC_CLASSES
+
+        cfg = dataclasses.replace(cfg, class_names=NUSC_CLASSES, iou_thresh=0.2)
+    pipe, spec, _ = builders[name](jax.random.PRNGKey(0), config=cfg)
     infer = detect3d_infer(pipe)
 
     if args.input.startswith("ros:"):

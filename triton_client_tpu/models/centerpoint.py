@@ -1,0 +1,217 @@
+"""CenterPoint (pillar variant) — center-heatmap 3D detector, nuScenes.
+
+The reference's CenterPoint path is the det3d/nuScenes branch of its 3D
+client (clients/preprocess/voxelize.py:11-47 feeds a served CenterPoint
+with the nusc_centerpoint_pp_02voxel_two_pfn_10sweep config). Here the
+whole detector is in-tree and TPU-shaped:
+
+  * reuses the PointPillars VFE + scatter + BEV backbone (the pillar
+    variant of CenterPoint shares that trunk);
+  * CenterHead: class heatmap + regression maps (offset, height, size,
+    sin/cos rotation, velocity);
+  * decode is fixed-shape: 3x3 max-pool peak NMS on the sigmoid
+    heatmap (the center-NMS trick replacing box NMS) + top-K gather —
+    no data-dependent shapes anywhere, so the whole thing jits.
+
+Anchor-free means no anchor table and no direction bins; headings come
+from atan2(sin, cos) directly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from triton_client_tpu.models.pointpillars import (
+    BEVBackbone,
+    PillarVFE,
+    scatter_to_bev,
+)
+from triton_client_tpu.ops.voxelize import VoxelConfig
+
+# nuScenes detection classes (data/nuscenes.names, nusc_centerpoint
+# config class_names).
+NUSC_CLASSES = (
+    "car",
+    "truck",
+    "construction_vehicle",
+    "bus",
+    "trailer",
+    "barrier",
+    "motorcycle",
+    "bicycle",
+    "pedestrian",
+    "traffic_cone",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class CenterPointConfig:
+    # nuScenes grid (nusc_centerpoint_pp_02voxel...: 0.2 m pillars over
+    # a +/-51.2 m square -> 512x512 canvas).
+    voxel: VoxelConfig = VoxelConfig(
+        point_cloud_range=(-51.2, -51.2, -5.0, 51.2, 51.2, 3.0),
+        voxel_size=(0.2, 0.2, 8.0),
+        max_voxels=30000,
+        max_points_per_voxel=20,
+    )
+    vfe_filters: int = 64
+    backbone_layers: tuple[int, ...] = (3, 5, 5)
+    backbone_strides: tuple[int, ...] = (2, 2, 2)
+    backbone_filters: tuple[int, ...] = (64, 128, 256)
+    upsample_strides: tuple[int, ...] = (1, 2, 4)
+    upsample_filters: tuple[int, ...] = (128, 128, 128)
+    class_names: tuple[str, ...] = NUSC_CLASSES
+    head_width: int = 64
+    max_objects: int = 128  # top-K centers kept per frame
+    with_velocity: bool = True
+
+    @property
+    def num_classes(self) -> int:
+        return len(self.class_names)
+
+    @property
+    def head_stride(self) -> int:
+        return self.backbone_strides[0] // self.upsample_strides[0]
+
+    @property
+    def head_hw(self) -> tuple[int, int]:
+        nx, ny, _ = self.voxel.grid_size
+        s = self.head_stride
+        return ny // s, nx // s
+
+
+class CenterHead(nn.Module):
+    """Shared 3x3 conv + per-branch 1x1 heads over the BEV features."""
+
+    cfg: CenterPointConfig
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> dict[str, jnp.ndarray]:
+        cfg = self.cfg
+        shared = nn.Conv(
+            cfg.head_width, (3, 3), padding=1, use_bias=False, dtype=self.dtype,
+            name="shared",
+        )(x)
+        shared = nn.BatchNorm(
+            use_running_average=not train, momentum=0.99, epsilon=1e-3,
+            dtype=self.dtype, name="shared_bn",
+        )(shared)
+        shared = nn.relu(shared).astype(jnp.float32)
+
+        def branch(features: int, name: str, bias_init=0.0):
+            return nn.Conv(
+                features,
+                (1, 1),
+                dtype=jnp.float32,
+                bias_init=nn.initializers.constant(bias_init),
+                name=name,
+            )(shared)
+
+        out = {
+            # -2.19 = -log((1-0.1)/0.1), CenterNet's heatmap prior.
+            "heatmap": branch(cfg.num_classes, "heatmap", bias_init=-2.19),
+            "offset": branch(2, "offset"),
+            "height": branch(1, "height"),
+            "size": branch(3, "size"),
+            "rot": branch(2, "rot"),  # (sin, cos)
+        }
+        if cfg.with_velocity:
+            out["vel"] = branch(2, "vel")
+        return out
+
+
+class CenterPoint(nn.Module):
+    cfg: CenterPointConfig = CenterPointConfig()
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self,
+        voxels: jnp.ndarray,      # (B, V, K, F)
+        num_points: jnp.ndarray,  # (B, V)
+        coords: jnp.ndarray,      # (B, V, 3) [z, y, x]
+        train: bool = False,
+    ) -> dict[str, jnp.ndarray]:
+        cfg, dt = self.cfg, self.dtype
+        nx, ny, _ = cfg.voxel.grid_size
+
+        vfe = PillarVFE(cfg.vfe_filters, cfg.voxel, dtype=dt, name="vfe")
+        feats = jax.vmap(lambda v, n, c: vfe(v, n, c, train))(
+            voxels, num_points, coords
+        )
+        canvas = jax.vmap(lambda f, c: scatter_to_bev(f, c, (ny, nx)))(feats, coords)
+        spatial = BEVBackbone(cfg, dtype=dt, name="backbone")(canvas, train)
+        return CenterHead(cfg, dtype=dt, name="head")(spatial, train)
+
+    def decode(self, heads: dict[str, jnp.ndarray]) -> dict[str, jnp.ndarray]:
+        """Center decode -> flat predictions shaped like the anchor
+        models' contract so extract_boxes_3d / nms_bev apply unchanged:
+        boxes (B, K, 7[+2 vel folded out]), scores (B, K, nc) one-hot at
+        the peak's class.
+
+        Peak picking: sigmoid heatmap, 3x3 max-pool equality mask
+        (CenterNet's local-maximum NMS), flat top-K over (class, y, x).
+        """
+        cfg = self.cfg
+        heat = jax.nn.sigmoid(heads["heatmap"])  # (B, H, W, nc)
+        pooled = nn.max_pool(heat, (3, 3), strides=(1, 1), padding=((1, 1), (1, 1)))
+        heat = jnp.where(jnp.abs(heat - pooled) < 1e-6, heat, 0.0)
+
+        b, h, w, nc = heat.shape
+        k = cfg.max_objects
+        flat = heat.reshape(b, -1)  # (B, H*W*nc)
+        scores, idx = jax.lax.top_k(flat, k)  # (B, K)
+        cls = idx % nc
+        cell = idx // nc
+        ys = (cell // w).astype(jnp.float32)
+        xs = (cell % w).astype(jnp.float32)
+
+        def gather(name: str, feats: int):
+            m = heads[name].reshape(b, h * w, feats)
+            return jnp.take_along_axis(m, cell[..., None], axis=1)
+
+        offset = gather("offset", 2)
+        height = gather("height", 1)[..., 0]
+        size = gather("size", 3)
+        rot = gather("rot", 2)
+
+        stride = cfg.head_stride
+        vs = cfg.voxel.voxel_size
+        r = cfg.voxel.point_cloud_range
+        x_world = (xs + offset[..., 0]) * stride * vs[0] + r[0]
+        y_world = (ys + offset[..., 1]) * stride * vs[1] + r[1]
+        dims = jnp.exp(jnp.clip(size, -10, 10))
+        heading = jnp.arctan2(rot[..., 0], rot[..., 1])
+
+        boxes = jnp.stack(
+            [x_world, y_world, height, dims[..., 0], dims[..., 1], dims[..., 2],
+             heading],
+            axis=-1,
+        )  # (B, K, 7)
+        # One-hot class scores so downstream max/argmax recovers
+        # (score, label) — the anchor-family contract.
+        score_map = jax.nn.one_hot(cls, nc) * scores[..., None]
+        out = {"boxes": boxes, "scores": score_map}
+        if cfg.with_velocity:
+            out["velocity"] = gather("vel", 2)
+        return out
+
+
+def init_centerpoint(rng, cfg: CenterPointConfig | None = None, dtype=jnp.float32):
+    cfg = cfg or CenterPointConfig()
+    model = CenterPoint(cfg, dtype=dtype)
+    v, k = cfg.voxel.max_voxels, cfg.voxel.max_points_per_voxel
+    variables = model.init(
+        rng,
+        jnp.zeros((1, v, k, 4)),
+        jnp.zeros((1, v), jnp.int32),
+        jnp.full((1, v, 3), -1, jnp.int32),
+        train=False,
+    )
+    return model, variables

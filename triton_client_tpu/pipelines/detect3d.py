@@ -159,3 +159,103 @@ def build_pointpillars_pipeline(
         },
     )
     return pipeline, spec, variables
+
+
+def build_second_pipeline(
+    rng: jax.Array | None = None,
+    model_cfg=None,
+    config: Detect3DConfig | None = None,
+    variables=None,
+    dtype: jnp.dtype = jnp.float32,
+) -> tuple[Detect3DPipeline, ModelSpec, dict]:
+    """SECOND-IoU over the same seam as PointPillars (the reference
+    serves both from the same Triton python backend shape,
+    examples/second_iou/*). Duck-typed into Detect3DPipeline: identical
+    apply/decode surfaces."""
+    from triton_client_tpu.models.second import SECONDConfig, SECONDIoU, init_second
+
+    model_cfg = model_cfg or SECONDConfig()
+    if variables is None:
+        model, variables = init_second(
+            rng if rng is not None else jax.random.PRNGKey(0), model_cfg, dtype
+        )
+    else:
+        model = SECONDIoU(model_cfg, dtype=dtype)
+    cfg = config or Detect3DConfig(model_name="second_iou")
+    pipeline = Detect3DPipeline(cfg, model, variables)
+    spec = ModelSpec(
+        name=cfg.model_name,
+        version="1",
+        platform="jax",
+        inputs=(
+            TensorSpec("points", (-1, 4), "FP32"),
+            TensorSpec("num_points", (), "INT32"),
+        ),
+        outputs=(
+            TensorSpec("detections", (cfg.max_det, 9), "FP32"),
+            TensorSpec("valid", (cfg.max_det,), "BOOL"),
+        ),
+        extra={
+            "score_thresh": cfg.score_thresh,
+            "iou_thresh": cfg.iou_thresh,
+            "class_names": list(cfg.class_names),
+            "iou_alpha": model_cfg.iou_alpha,
+        },
+    )
+    return pipeline, spec, variables
+
+
+def build_centerpoint_pipeline(
+    rng: jax.Array | None = None,
+    model_cfg=None,
+    config: Detect3DConfig | None = None,
+    variables=None,
+    dtype: jnp.dtype = jnp.float32,
+) -> tuple[Detect3DPipeline, ModelSpec, dict]:
+    """CenterPoint-pillar, nuScenes config (the reference's det3d path,
+    clients/preprocess/voxelize.py + data/nusc_centerpoint_pp...py).
+    decode emits one-hot class scores so the shared rotated-NMS
+    postprocess applies unchanged; with_velocity is dropped at the
+    packed-detection boundary (the reference's 3D wire contract carries
+    boxes/scores/labels only, clients/detector_3d_client.py:29-34)."""
+    from triton_client_tpu.models.centerpoint import (
+        CenterPointConfig,
+        CenterPoint,
+        init_centerpoint,
+    )
+
+    model_cfg = model_cfg or CenterPointConfig()
+    if variables is None:
+        model, variables = init_centerpoint(
+            rng if rng is not None else jax.random.PRNGKey(0), model_cfg, dtype
+        )
+    else:
+        model = CenterPoint(model_cfg, dtype=dtype)
+    cfg = config or Detect3DConfig(
+        model_name="centerpoint",
+        class_names=model_cfg.class_names,
+        # Center-heatmap models pre-NMS via local peaks; box NMS only
+        # needs to kill duplicate peaks, so a higher IoU gate is right.
+        iou_thresh=0.2,
+    )
+    pipeline = Detect3DPipeline(cfg, model, variables)
+    spec = ModelSpec(
+        name=cfg.model_name,
+        version="1",
+        platform="jax",
+        inputs=(
+            TensorSpec("points", (-1, 4), "FP32"),
+            TensorSpec("num_points", (), "INT32"),
+        ),
+        outputs=(
+            TensorSpec("detections", (cfg.max_det, 9), "FP32"),
+            TensorSpec("valid", (cfg.max_det,), "BOOL"),
+        ),
+        extra={
+            "score_thresh": cfg.score_thresh,
+            "iou_thresh": cfg.iou_thresh,
+            "class_names": list(cfg.class_names),
+            "with_velocity": model_cfg.with_velocity,
+        },
+    )
+    return pipeline, spec, variables

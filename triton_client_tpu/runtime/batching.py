@@ -91,9 +91,16 @@ class BatchingChannel(BaseChannel):
         rid = next(self._ids)
         with self._lock:
             self._pending[rid] = (request, future)
-        admitted = (
-            self._impl.enqueue(rid) if self._impl is not None else self._py.enqueue(rid)
-        )
+        try:
+            admitted = (
+                self._impl.enqueue(rid)
+                if self._impl is not None
+                else self._py.enqueue(rid)
+            )
+        except Exception:
+            with self._lock:
+                self._pending.pop(rid, None)
+            raise
         if not admitted:
             with self._lock:
                 self._pending.pop(rid, None)
