@@ -62,9 +62,9 @@ def main(argv=None) -> None:
         z_offset=args.z_offset,
     )
     if name == "centerpoint":
-        from triton_client_tpu.models.centerpoint import NUSC_CLASSES
-
-        cfg = dataclasses.replace(cfg, class_names=NUSC_CLASSES, iou_thresh=0.2)
+        # class_names are reconciled from the model config inside the
+        # builder; only the peak-NMS-appropriate IoU gate is set here.
+        cfg = dataclasses.replace(cfg, iou_thresh=0.2)
     pipe, spec, _ = builders[name](jax.random.PRNGKey(0), config=cfg)
     infer = detect3d_infer(pipe)
 

@@ -159,9 +159,14 @@ class CenterPoint(nn.Module):
         (CenterNet's local-maximum NMS), flat top-K over (class, y, x).
         """
         cfg = self.cfg
-        heat = jax.nn.sigmoid(heads["heatmap"])  # (B, H, W, nc)
-        pooled = nn.max_pool(heat, (3, 3), strides=(1, 1), padding=((1, 1), (1, 1)))
-        heat = jnp.where(jnp.abs(heat - pooled) < 1e-6, heat, 0.0)
+        # Peak test runs in LOGIT space: sigmoid saturates (neighbors of
+        # a confident peak become float-equal to it after sigmoid, which
+        # would pass the whole 3x3 patch as peaks); logits don't.
+        logits = heads["heatmap"]  # (B, H, W, nc)
+        pooled = nn.max_pool(
+            logits, (3, 3), strides=(1, 1), padding=((1, 1), (1, 1))
+        )
+        heat = jnp.where(logits >= pooled, jax.nn.sigmoid(logits), 0.0)
 
         b, h, w, nc = heat.shape
         k = cfg.max_objects

@@ -117,6 +117,21 @@ def decode_boxes(deltas: jnp.ndarray, anchors: jnp.ndarray) -> jnp.ndarray:
     return jnp.stack([x, y, z, dx, dy, dz, r], axis=-1)
 
 
+def rectify_direction(
+    rot: jnp.ndarray,
+    dir_bin: jnp.ndarray,
+    num_dir_bins: int,
+    dir_offset: float,
+) -> jnp.ndarray:
+    """OpenPCDet direction-bin heading rectification (shared by every
+    anchor-head decode): fold the regressed angle into one period,
+    then add the classified bin."""
+    period = 2 * jnp.pi / num_dir_bins
+    out = rot - dir_offset
+    out = out - jnp.floor(out / period) * period + dir_offset
+    return out + period * dir_bin.astype(jnp.float32)
+
+
 def encode_boxes(boxes: jnp.ndarray, anchors: jnp.ndarray) -> jnp.ndarray:
     """Inverse of decode_boxes, for the training target assignment."""
     diag = jnp.sqrt(anchors[..., 3] ** 2 + anchors[..., 4] ** 2)
@@ -302,12 +317,10 @@ class PointPillars(nn.Module):
         cfg = self.cfg
         anchors = generate_anchors(cfg)[None]  # (1, h, w, A, 7)
         boxes = decode_boxes(heads["box"], anchors)
-        # heading correction by direction bin
         dir_bin = jnp.argmax(heads["dir"], axis=-1)  # (B, h, w, A)
-        period = 2 * jnp.pi / cfg.num_dir_bins
-        rot = boxes[..., 6] - cfg.dir_offset
-        rot = rot - jnp.floor(rot / period) * period + cfg.dir_offset
-        rot = rot + period * dir_bin.astype(jnp.float32)
+        rot = rectify_direction(
+            boxes[..., 6], dir_bin, cfg.num_dir_bins, cfg.dir_offset
+        )
         boxes = jnp.concatenate([boxes[..., :6], rot[..., None]], axis=-1)
         scores = jax.nn.sigmoid(heads["cls"])
         b = boxes.shape[0]

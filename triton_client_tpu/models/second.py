@@ -37,6 +37,7 @@ from triton_client_tpu.models.pointpillars import (
     BEVBackbone,
     decode_boxes,
     generate_anchors,
+    rectify_direction,
 )
 from triton_client_tpu.ops.voxelize import VoxelConfig
 
@@ -202,10 +203,9 @@ class SECONDIoU(nn.Module):
         anchors = generate_anchors(cfg)[None]
         boxes = decode_boxes(heads["box"], anchors)
         dir_bin = jnp.argmax(heads["dir"], axis=-1)
-        period = 2 * jnp.pi / cfg.num_dir_bins
-        rot = boxes[..., 6] - cfg.dir_offset
-        rot = rot - jnp.floor(rot / period) * period + cfg.dir_offset
-        rot = rot + period * dir_bin.astype(jnp.float32)
+        rot = rectify_direction(
+            boxes[..., 6], dir_bin, cfg.num_dir_bins, cfg.dir_offset
+        )
         boxes = jnp.concatenate([boxes[..., :6], rot[..., None]], axis=-1)
 
         cls_score = jax.nn.sigmoid(heads["cls"])

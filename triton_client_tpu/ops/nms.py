@@ -23,12 +23,35 @@ as the reference (yolov5_postprocess.py:106-107).
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
 
 from triton_client_tpu.ops.boxes import box_area
 
+
+def _use_pallas(n: int, max_det: int) -> bool:
+    """Route to the Pallas kernel on TPU (env override:
+    TRITON_CLIENT_TPU_NMS=pallas|xla). Decided at trace time — shapes
+    are static under jit, so the choice is baked into the executable."""
+    mode = os.environ.get("TRITON_CLIENT_TPU_NMS", "auto")
+    if mode == "xla":
+        return False
+    from triton_client_tpu.ops.pallas_nms import vmem_fits
+
+    fits = vmem_fits(n, max_det)
+    if mode == "pallas":
+        if not fits:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "TRITON_CLIENT_TPU_NMS=pallas but n=%d exceeds the VMEM "
+                "budget; falling back to the XLA loop",
+                n,
+            )
+        return fits
+    return jax.default_backend() == "tpu" and fits
 
 
 def _iou_row(
@@ -44,7 +67,6 @@ def _iou_row(
     return inter / jnp.maximum(box_a + areas - inter, 1e-9)
 
 
-@functools.partial(jax.jit, static_argnames=("max_det",))
 def nms(
     boxes: jnp.ndarray,
     scores: jnp.ndarray,
@@ -56,7 +78,33 @@ def nms(
     Returns ``(indices, valid)``: (max_det,) int32 indices into the input
     (arbitrary where invalid) and a (max_det,) bool mask. Slots whose
     input score is -inf (padding) are never selected.
+
+    Backend routing (XLA loop vs Pallas kernel) happens at TRACE time:
+    callers jitted around this see the choice baked into their
+    executable until retrace (TRITON_CLIENT_TPU_NMS env override).
     """
+    n = boxes.shape[0]
+    if _use_pallas(n, max_det):
+        from triton_client_tpu.ops.pallas_nms import nms_pallas
+
+        return nms_pallas(
+            boxes,
+            scores,
+            iou_thresh=iou_thresh,
+            max_det=max_det,
+            # Off-TPU (forced via env) the kernel runs interpreted.
+            interpret=jax.default_backend() != "tpu",
+        )
+    return _nms_xla(boxes, scores, iou_thresh, max_det=max_det)
+
+
+@functools.partial(jax.jit, static_argnames=("max_det",))
+def _nms_xla(
+    boxes: jnp.ndarray,
+    scores: jnp.ndarray,
+    iou_thresh: float = 0.45,
+    max_det: int = 300,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
     n = boxes.shape[0]
     neg_inf = jnp.asarray(-jnp.inf, scores.dtype)
     areas = box_area(boxes)

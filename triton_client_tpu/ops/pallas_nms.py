@@ -1,0 +1,144 @@
+"""Pallas TPU kernel for greedy NMS (VMEM-resident suppression loop).
+
+The reference offloads NMS to torchvision's C++/CUDA op
+(clients/postprocess/yolov5_postprocess.py:108). The XLA fallback here
+(ops/nms.py) expresses the greedy loop as a ``lax.fori_loop`` over HLO;
+this kernel instead runs the whole loop inside ONE Pallas program with
+every operand pinned in VMEM:
+
+  * boxes live as a transposed (8, N) struct-of-arrays block so each
+    IoU row is pure lane-parallel VPU work (x1/y1/x2/y2/area rows, N
+    lanes, padded to a 128 multiple);
+  * the max_det-iteration argmax -> gather -> IoU -> mask loop never
+    leaves the core: no per-iteration kernel launches, no HBM traffic
+    between iterations;
+  * outputs are (1, max_det) index/valid rows (lane-tiled), squeezed at
+    the wrapper.
+
+The wrapper pads N up to a lane multiple and exposes the same
+``(indices, valid)`` contract as ops.nms.nms, so ops.nms can route to
+it transparently on TPU (interpret mode keeps CPU tests honest).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_LANES = 128
+_NEG_INF = float("-inf")
+
+
+def _nms_kernel(boxes_ref, scores_ref, idx_ref, valid_ref, live_ref, *, max_det, iou_thresh):
+    """boxes_ref: (8, N) rows [x1, y1, x2, y2, area, 0, 0, 0];
+    scores_ref: (1, N); outputs (1, max_det) int32 / bool;
+    live_ref: (1, N) f32 scratch holding still-live scores.
+
+    No dynamic indexing anywhere: the selected box's coordinates are
+    extracted with masked lane reductions (Mosaic has no dynamic_slice
+    on values), and per-iteration outputs accumulate via iota==i masked
+    writes — everything stays lane-parallel VPU work.
+    """
+    n = scores_ref.shape[1]
+    live_ref[:] = scores_ref[:]
+
+    x1 = boxes_ref[0:1, :]
+    y1 = boxes_ref[1:2, :]
+    x2 = boxes_ref[2:3, :]
+    y2 = boxes_ref[3:4, :]
+    area = boxes_ref[4:5, :]
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, n), 1)
+    out_lane = jax.lax.broadcasted_iota(jnp.int32, idx_ref.shape, 1)
+
+    def body(i, _):
+        live = live_ref[:]
+        best_score = jnp.max(live)
+        best = jnp.argmax(live[0, :]).astype(jnp.int32)
+        is_valid = best_score > _NEG_INF
+        sel = lane == best  # one-hot over lanes
+
+        idx_ref[:] = jnp.where(out_lane == i, best, idx_ref[:])
+        # valid is carried as i32 (i1 vector selects don't lower).
+        valid_ref[:] = jnp.where(
+            out_lane == i, is_valid.astype(jnp.int32), valid_ref[:]
+        )
+
+        def pick(row):  # masked lane reduction replaces a gather
+            return jnp.sum(jnp.where(sel, row, 0.0))
+
+        bx1, by1, bx2, by2, barea = pick(x1), pick(y1), pick(x2), pick(y2), pick(area)
+
+        iw = jnp.clip(jnp.minimum(x2, bx2) - jnp.maximum(x1, bx1), 0.0, None)
+        ih = jnp.clip(jnp.minimum(y2, by2) - jnp.maximum(y1, by1), 0.0, None)
+        inter = iw * ih
+        iou = inter / jnp.maximum(area + barea - inter, 1e-9)
+
+        suppress = (iou > iou_thresh) | sel
+        live_ref[:] = jnp.where(suppress & is_valid, _NEG_INF, live)
+        return 0
+
+    idx_ref[:] = jnp.zeros(idx_ref.shape, jnp.int32)
+    valid_ref[:] = jnp.zeros(valid_ref.shape, jnp.int32)
+    jax.lax.fori_loop(0, max_det, body, 0)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("max_det", "iou_thresh", "interpret")
+)
+def nms_pallas(
+    boxes: jnp.ndarray,
+    scores: jnp.ndarray,
+    iou_thresh: float = 0.45,
+    max_det: int = 300,
+    interpret: bool = False,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Greedy NMS over (N, 4) xyxy boxes + (N,) scores on the TPU core.
+
+    Same contract as ops.nms.nms: (max_det,) int32 indices into the
+    input + (max_det,) bool validity; -inf scores are padding and never
+    selected.
+    """
+    n = boxes.shape[0]
+    n_pad = max(_LANES, ((n + _LANES - 1) // _LANES) * _LANES)
+    md_pad = max(_LANES, ((max_det + _LANES - 1) // _LANES) * _LANES)
+
+    boxes32 = boxes.astype(jnp.float32)
+    area = (boxes32[:, 2] - boxes32[:, 0]) * (boxes32[:, 3] - boxes32[:, 1])
+    # (8, N) struct-of-arrays block (8 sublanes = f32 tile height).
+    packed = jnp.zeros((8, n_pad), jnp.float32)
+    packed = packed.at[0:4, :n].set(boxes32.T)
+    packed = packed.at[4, :n].set(area)
+    padded_scores = jnp.full((1, n_pad), _NEG_INF, jnp.float32)
+    padded_scores = padded_scores.at[0, :n].set(scores.astype(jnp.float32))
+
+    idx, valid = pl.pallas_call(
+        functools.partial(_nms_kernel, max_det=max_det, iou_thresh=iou_thresh),
+        out_shape=(
+            jax.ShapeDtypeStruct((1, md_pad), jnp.int32),
+            jax.ShapeDtypeStruct((1, md_pad), jnp.int32),
+        ),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ),
+        scratch_shapes=[pltpu.VMEM((1, n_pad), jnp.float32)],
+        interpret=interpret,
+    )(packed, padded_scores)
+    return idx[0, :max_det], valid[0, :max_det].astype(jnp.bool_)
+
+
+def vmem_fits(n: int, max_det: int = 300, budget_bytes: int = 12 << 20) -> bool:
+    """Whether the kernel's VMEM working set fits comfortably."""
+    n_pad = max(_LANES, ((n + _LANES - 1) // _LANES) * _LANES)
+    md_pad = max(_LANES, ((max_det + _LANES - 1) // _LANES) * _LANES)
+    working = 8 * n_pad * 4 + 2 * n_pad * 4 + md_pad * 8
+    return working < budget_bytes

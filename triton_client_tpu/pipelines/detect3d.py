@@ -123,23 +123,12 @@ class Detect3DPipeline:
         return fn
 
 
-def build_pointpillars_pipeline(
-    rng: jax.Array | None = None,
-    model_cfg: PointPillarsConfig | None = None,
-    config: Detect3DConfig | None = None,
-    variables=None,
-    dtype: jnp.dtype = jnp.float32,
-) -> tuple[Detect3DPipeline, ModelSpec, dict]:
-    model_cfg = model_cfg or PointPillarsConfig()
-    if variables is None:
-        model, variables = init_pointpillars(
-            rng if rng is not None else jax.random.PRNGKey(0), model_cfg, dtype
-        )
-    else:
-        model = PointPillars(model_cfg, dtype=dtype)
-    cfg = config or Detect3DConfig()
-    pipeline = Detect3DPipeline(cfg, model, variables)
-    spec = ModelSpec(
+def _detect3d_spec(
+    cfg: Detect3DConfig, model_cfg, extra: dict | None = None
+) -> ModelSpec:
+    """Serving spec shared by every 3D pipeline (the analogue of
+    examples/pointpillar_kitti/config.pbtxt + examples/second_iou)."""
+    return ModelSpec(
         name=cfg.model_name,
         version="1",
         platform="jax",
@@ -156,9 +145,28 @@ def build_pointpillars_pipeline(
             "iou_thresh": cfg.iou_thresh,
             "class_names": list(cfg.class_names),
             "max_voxels": model_cfg.voxel.max_voxels,
+            **(extra or {}),
         },
     )
-    return pipeline, spec, variables
+
+
+def build_pointpillars_pipeline(
+    rng: jax.Array | None = None,
+    model_cfg: PointPillarsConfig | None = None,
+    config: Detect3DConfig | None = None,
+    variables=None,
+    dtype: jnp.dtype = jnp.float32,
+) -> tuple[Detect3DPipeline, ModelSpec, dict]:
+    model_cfg = model_cfg or PointPillarsConfig()
+    if variables is None:
+        model, variables = init_pointpillars(
+            rng if rng is not None else jax.random.PRNGKey(0), model_cfg, dtype
+        )
+    else:
+        model = PointPillars(model_cfg, dtype=dtype)
+    cfg = config or Detect3DConfig()
+    pipeline = Detect3DPipeline(cfg, model, variables)
+    return pipeline, _detect3d_spec(cfg, model_cfg), variables
 
 
 def build_second_pipeline(
@@ -183,25 +191,7 @@ def build_second_pipeline(
         model = SECONDIoU(model_cfg, dtype=dtype)
     cfg = config or Detect3DConfig(model_name="second_iou")
     pipeline = Detect3DPipeline(cfg, model, variables)
-    spec = ModelSpec(
-        name=cfg.model_name,
-        version="1",
-        platform="jax",
-        inputs=(
-            TensorSpec("points", (-1, 4), "FP32"),
-            TensorSpec("num_points", (), "INT32"),
-        ),
-        outputs=(
-            TensorSpec("detections", (cfg.max_det, 9), "FP32"),
-            TensorSpec("valid", (cfg.max_det,), "BOOL"),
-        ),
-        extra={
-            "score_thresh": cfg.score_thresh,
-            "iou_thresh": cfg.iou_thresh,
-            "class_names": list(cfg.class_names),
-            "iou_alpha": model_cfg.iou_alpha,
-        },
-    )
+    spec = _detect3d_spec(cfg, model_cfg, {"iou_alpha": model_cfg.iou_alpha})
     return pipeline, spec, variables
 
 
@@ -231,31 +221,17 @@ def build_centerpoint_pipeline(
         )
     else:
         model = CenterPoint(model_cfg, dtype=dtype)
-    cfg = config or Detect3DConfig(
-        model_name="centerpoint",
-        class_names=model_cfg.class_names,
+    if config is None:
         # Center-heatmap models pre-NMS via local peaks; box NMS only
         # needs to kill duplicate peaks, so a higher IoU gate is right.
-        iou_thresh=0.2,
-    )
+        cfg = Detect3DConfig(model_name="centerpoint", iou_thresh=0.2)
+    else:
+        cfg = config
+    # class_names derive from the MODEL config — reconcile so a caller
+    # config built with the KITTI defaults can't mislabel nuScenes
+    # predictions (pred_labels range over model_cfg.class_names).
+    if tuple(cfg.class_names) != tuple(model_cfg.class_names):
+        cfg = dataclasses.replace(cfg, class_names=tuple(model_cfg.class_names))
     pipeline = Detect3DPipeline(cfg, model, variables)
-    spec = ModelSpec(
-        name=cfg.model_name,
-        version="1",
-        platform="jax",
-        inputs=(
-            TensorSpec("points", (-1, 4), "FP32"),
-            TensorSpec("num_points", (), "INT32"),
-        ),
-        outputs=(
-            TensorSpec("detections", (cfg.max_det, 9), "FP32"),
-            TensorSpec("valid", (cfg.max_det,), "BOOL"),
-        ),
-        extra={
-            "score_thresh": cfg.score_thresh,
-            "iou_thresh": cfg.iou_thresh,
-            "class_names": list(cfg.class_names),
-            "with_velocity": model_cfg.with_velocity,
-        },
-    )
+    spec = _detect3d_spec(cfg, model_cfg, {"with_velocity": model_cfg.with_velocity})
     return pipeline, spec, variables
