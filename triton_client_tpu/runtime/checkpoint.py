@@ -1,0 +1,188 @@
+"""Checkpoint / resume + external weight conversion.
+
+The reference's "checkpointing" is server-side weight loading at model
+init from fixed paths (examples/pointpillar_kitti/1/model.py:93-112
+loads yaml + .pth; ONNX/libtorch artifacts named by config.pbtxt),
+provisioned by scp (deploy.sh:56-65) or S3/Keycloak
+(docker/server/Dockerfile:9-18). The TPU equivalents here:
+
+  * orbax-backed save/restore of model variables and full train states
+    (resume-at-step), with versioned step directories and retention —
+    the framework's answer to both "load weights to serve" and
+    "resume training";
+  * torch .pth state_dict -> flax variables conversion utilities so
+    models trained elsewhere can be served (weight provisioning parity
+    with deploy.sh's pth->ONNX->server flow, minus the ONNX hop).
+
+Conversion is explicit-mapping-based: convert_state_dict walks the
+flax variable tree, looks up each leaf through a caller-supplied
+name-mapping function, and transposes torch's OIHW conv / (out, in)
+linear layouts into flax's HWIO / (in, out).
+"""
+
+from __future__ import annotations
+
+import logging
+import pathlib
+from typing import Any, Callable, Mapping
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+log = logging.getLogger(__name__)
+
+
+class CheckpointManager:
+    """Versioned checkpoints under ``directory/<step>/`` with retention.
+
+    Works for bare variable pytrees (serving weights) and TrainState
+    pytrees (resume) alike — anything jax.tree-mappable.
+    """
+
+    def __init__(self, directory: str | pathlib.Path, keep: int = 3) -> None:
+        self._dir = pathlib.Path(directory).resolve()
+        self._dir.mkdir(parents=True, exist_ok=True)
+        self._manager = ocp.CheckpointManager(
+            self._dir,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=keep, create=True, enable_async_checkpointing=False
+            ),
+        )
+
+    def save(self, step: int, tree: Any) -> None:
+        self._manager.save(step, args=ocp.args.StandardSave(tree))
+        self._manager.wait_until_finished()
+
+    def restore(self, step: int | None = None, like: Any = None) -> Any:
+        """Restore ``step`` (default: latest). ``like`` provides the
+        target pytree structure/shardings; restoring without it returns
+        plain numpy leaves."""
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(f"no checkpoints under {self._dir}")
+        if like is not None:
+            target = jax.tree.map(ocp.utils.to_shape_dtype_struct, like)
+            return self._manager.restore(
+                step, args=ocp.args.StandardRestore(target)
+            )
+        return self._manager.restore(step)
+
+    def latest_step(self) -> int | None:
+        return self._manager.latest_step()
+
+    def all_steps(self) -> list[int]:
+        return sorted(self._manager.all_steps())
+
+    def close(self) -> None:
+        self._manager.close()
+
+
+# ---------------------------------------------------------------------------
+# torch state_dict conversion
+# ---------------------------------------------------------------------------
+
+
+def _to_numpy(t) -> np.ndarray:
+    if hasattr(t, "detach"):  # torch tensor without importing torch
+        return t.detach().cpu().numpy()
+    return np.asarray(t)
+
+
+def torch_to_flax_leaf(name: str, value: np.ndarray, flax_shape) -> np.ndarray:
+    """Layout-convert one torch tensor to a flax leaf shape.
+
+    Rules (checked against the target shape, not guessed from names):
+      * conv kernels: torch OIHW / OIDHW -> flax HWIO / DHWIO;
+      * linear kernels: torch (out, in) -> flax (in, out);
+      * everything else (biases, BN scale/bias/stats): passthrough.
+    """
+    value = _to_numpy(value)
+    flax_shape = tuple(flax_shape)
+    if value.shape == flax_shape:
+        return value
+    if value.ndim == 4 and value.transpose(2, 3, 1, 0).shape == flax_shape:
+        return value.transpose(2, 3, 1, 0)  # OIHW -> HWIO
+    if value.ndim == 5 and value.transpose(2, 3, 4, 1, 0).shape == flax_shape:
+        return value.transpose(2, 3, 4, 1, 0)  # OIDHW -> DHWIO
+    if value.ndim == 2 and value.T.shape == flax_shape:
+        return value.T  # (out, in) -> (in, out)
+    raise ValueError(
+        f"cannot map torch tensor '{name}' {value.shape} onto flax leaf "
+        f"{flax_shape}"
+    )
+
+
+_DEFAULT_LEAF_MAP = {
+    # flax leaf name -> torch suffix (BatchNorm naming differs)
+    "kernel": "weight",
+    "scale": "weight",
+    "bias": "bias",
+    "mean": "running_mean",
+    "var": "running_var",
+}
+
+
+def default_name_map(path: tuple[str, ...]) -> str:
+    """flax variable path -> torch state_dict key.
+
+    ('params', 'backbone', 'conv', 'kernel') -> 'backbone.conv.weight'.
+    Collections ('params'/'batch_stats') are dropped; the leaf name maps
+    through _DEFAULT_LEAF_MAP.
+    """
+    *mods, leaf = [p for p in path if p not in ("params", "batch_stats")]
+    return ".".join([*mods, _DEFAULT_LEAF_MAP.get(leaf, leaf)])
+
+
+def convert_state_dict(
+    state_dict: Mapping[str, Any],
+    variables: Mapping,
+    name_map: Callable[[tuple[str, ...]], str] = default_name_map,
+    strict: bool = True,
+) -> dict:
+    """torch state_dict -> flax variables with the target's structure.
+
+    Walks ``variables`` (the flax init tree used as the shape template),
+    resolves each leaf's torch key via ``name_map``, converts layout,
+    and returns a new tree. With strict=False, missing torch keys keep
+    the template's (random-init) leaf and are logged.
+    """
+    flat = {}
+    missing = []
+
+    def visit(path, leaf):
+        key_path = tuple(str(getattr(p, "key", p)) for p in path)
+        torch_key = name_map(key_path)
+        if torch_key in state_dict:
+            return torch_to_flax_leaf(torch_key, state_dict[torch_key], leaf.shape)
+        missing.append(torch_key)
+        return leaf
+
+    out = jax.tree_util.tree_map_with_path(visit, variables)
+    if missing:
+        msg = f"{len(missing)} torch keys missing (e.g. {missing[:5]})"
+        if strict:
+            raise KeyError(msg)
+        log.warning("%s; kept template init for those leaves", msg)
+    unused = set(state_dict) - {
+        name_map(tuple(str(getattr(p, "key", p)) for p in path))
+        for path, _ in jax.tree_util.tree_flatten_with_path(variables)[0]
+    }
+    if unused:
+        log.info("%d torch keys unused (e.g. %s)", len(unused), sorted(unused)[:5])
+    _ = flat
+    return out
+
+
+def load_torch_checkpoint(path: str | pathlib.Path) -> dict:
+    """Load a .pth file's state_dict (handles the {'state_dict': ...} и
+    {'model_state': ...} wrappers OpenPCDet/ultralytics use)."""
+    import torch
+
+    raw = torch.load(path, map_location="cpu", weights_only=False)
+    for key in ("state_dict", "model_state", "model"):
+        if isinstance(raw, dict) and key in raw and isinstance(raw[key], dict):
+            raw = raw[key]
+            break
+    return {k: _to_numpy(v) for k, v in raw.items() if hasattr(v, "shape")}
