@@ -1,0 +1,86 @@
+"""Checkpoint save/restore + torch state_dict conversion.
+
+The square-Dense case is the regression that motivated leaf-name-aware
+conversion: a torch Linear (n, n) weight is shape-identical to the flax
+kernel, so shape checking alone cannot tell whether to transpose.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from triton_client_tpu.runtime.checkpoint import (
+    CheckpointManager,
+    convert_state_dict,
+    torch_to_flax_leaf,
+)
+
+
+class TinyNet(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Dense(8, name="hidden")(x)  # square 8x8 kernel
+        x = nn.relu(x)
+        return nn.Dense(3, name="head")(x)
+
+
+def test_square_linear_kernel_is_transposed():
+    w = np.arange(16, dtype=np.float32).reshape(4, 4)  # torch (out, in)
+    out = torch_to_flax_leaf("fc.weight", w, (4, 4), leaf_name="kernel")
+    np.testing.assert_array_equal(out, w.T)
+
+
+def test_conv_kernel_oihw_to_hwio():
+    w = np.random.default_rng(0).standard_normal((8, 3, 5, 5)).astype(np.float32)
+    out = torch_to_flax_leaf("conv.weight", w, (5, 5, 3, 8), leaf_name="kernel")
+    np.testing.assert_array_equal(out, w.transpose(2, 3, 1, 0))
+
+
+def test_non_kernel_shape_mismatch_raises():
+    with pytest.raises(ValueError, match="cannot map"):
+        torch_to_flax_leaf("bn.bias", np.zeros(4), (8,), leaf_name="bias")
+
+
+def test_convert_state_dict_square_dense_round_trip(rng):
+    model = TinyNet()
+    x = jnp.asarray(rng.standard_normal((2, 8)), jnp.float32)
+    template = model.init(jax.random.PRNGKey(0), x)
+
+    # Build a "torch" state_dict in (out, in) layout from known values.
+    w_hidden = rng.standard_normal((8, 8)).astype(np.float32)
+    w_head = rng.standard_normal((3, 8)).astype(np.float32)
+    state_dict = {
+        "hidden.weight": w_hidden,
+        "hidden.bias": np.zeros(8, np.float32),
+        "head.weight": w_head,
+        "head.bias": np.zeros(3, np.float32),
+    }
+    converted = convert_state_dict(state_dict, template)
+    got = model.apply(converted, x)
+    want = np.maximum(np.asarray(x) @ w_hidden.T, 0) @ w_head.T
+    np.testing.assert_allclose(np.asarray(got), want, atol=1e-5)
+
+
+def test_convert_state_dict_strict_missing_raises(rng):
+    model = TinyNet()
+    template = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.float32)
+    )
+    with pytest.raises(KeyError, match="missing"):
+        convert_state_dict({"hidden.weight": np.zeros((8, 8))}, template)
+
+
+def test_checkpoint_manager_round_trip(tmp_path, rng):
+    model = TinyNet()
+    x = jnp.asarray(rng.standard_normal((2, 8)), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x)
+
+    mgr = CheckpointManager(tmp_path / "ckpt")
+    mgr.save(5, variables)
+    restored = mgr.restore(5, like=variables)
+    np.testing.assert_allclose(
+        np.asarray(model.apply(restored, x)),
+        np.asarray(model.apply(variables, x)),
+    )

@@ -1,0 +1,84 @@
+"""BEV self-attention neck — the framework's long-context consumer.
+
+The reference's 3D models are pure CNNs (OpenPCDet PointPillars /
+SECOND, examples/pointpillar_kitti/1/model.py:163); their receptive
+field over the BEV canvas is local. This neck adds global context over
+the BEV token grid — and, more importantly for the framework, it is
+the component that exercises sequence/context parallelism end to end:
+a full-resolution KITTI canvas is 432x496 ≈ 214k tokens, far past what
+one chip's VMEM-friendly attention wants, so the token axis shards
+over the ``seq`` mesh axis and attention runs as ring attention
+(parallel/sequence.py) with K/V blocks rotating over ICI.
+
+Design:
+  * tokens = strided patches of the BEV canvas (patch conv), so the
+    sequence length is (H/p)*(W/p) and attention cost is controllable;
+  * attention implementation is injected: dense (single chip) or
+    ring/ulysses (sp>1) — the module's parameters are identical either
+    way, so a checkpoint trained single-chip serves sharded;
+  * pre-norm residual block, then the tokens are scattered back and
+    fused with the input canvas (1x1 conv), preserving the CNN
+    contract of the downstream detection heads.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from triton_client_tpu.parallel.sequence import full_attention
+
+AttentionFn = Callable[[jnp.ndarray, jnp.ndarray, jnp.ndarray], jnp.ndarray]
+
+
+def dense_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray) -> jnp.ndarray:
+    """Single-device full attention (the sp=1 implementation)."""
+    return full_attention(q, k, v, causal=False)
+
+
+class BEVAttentionNeck(nn.Module):
+    """Global-context neck over a BEV canvas (B, H, W, C).
+
+    attention: injected implementation — ``dense_attention`` or a
+    ``lambda q,k,v: ring_attention(q,k,v,mesh)`` closure. Parameters do
+    not depend on the choice.
+    """
+
+    heads: int = 4
+    head_dim: int = 32
+    patch: int = 4
+    attention: Optional[AttentionFn] = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        b, h, w, c = x.shape
+        p = self.patch
+        if h % p or w % p:
+            raise ValueError(f"canvas {h}x{w} not divisible by patch {p}")
+        attn = self.attention or dense_attention
+        inner = self.heads * self.head_dim
+
+        # patchify: (B, H/p, W/p, p*p*C) -> token embed
+        tok = x.reshape(b, h // p, p, w // p, p, c)
+        tok = tok.transpose(0, 1, 3, 2, 4, 5).reshape(
+            b, (h // p) * (w // p), p * p * c
+        )
+        tok = nn.Dense(inner, name="embed")(tok)
+
+        y = nn.LayerNorm(name="ln")(tok)
+        qkv = nn.Dense(3 * inner, name="qkv")(y)
+        s = tok.shape[1]
+        qkv = qkv.reshape(b, s, 3, self.heads, self.head_dim)
+        out = attn(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2])
+        out = out.reshape(b, s, inner)
+        tok = tok + nn.Dense(inner, name="proj")(out)
+
+        # un-patchify to (B, H, W, c_out) and fuse with the input canvas
+        back = nn.Dense(p * p * c, name="unembed")(tok)
+        back = back.reshape(b, h // p, w // p, p, p, c)
+        back = back.transpose(0, 1, 3, 2, 4, 5).reshape(b, h, w, c)
+        return nn.Conv(c, (1, 1), use_bias=True, name="fuse")(
+            jnp.concatenate([x, back], axis=-1)
+        )

@@ -5,6 +5,12 @@ Axes:
            "ensemble multi-camera over v5e-8" config maps cameras here)
   model  — tensor parallelism for wide layers (conv channel sharding,
            voxel-axis sharding for the 3D stack)
+  seq    — sequence/context parallelism: the point/pillar/BEV-token
+           axis for long point clouds (the reference's scale axis is
+           MAX_NUMBER_OF_VOXELS=40000, data/kitti_dataset.yaml:66-70;
+           a full KITTI BEV canvas is 432x496 ≈ 214k tokens). Ring
+           attention and the distributed pillar scatter in
+           parallel/sequence.py ride this axis over ICI.
 
 On a single host this is `jax.devices()` reshaped; on multi-host the
 same code runs under `jax.distributed` with DCN-attached hosts, with
@@ -22,29 +28,36 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+PIPE_AXIS = "pipe"
 
 
 @dataclasses.dataclass(frozen=True)
 class MeshConfig:
     data: int = -1  # -1: all remaining devices
     model: int = 1
+    seq: int = 1
+    pipe: int = 1
 
-    def resolve(self, n_devices: int) -> tuple[int, int]:
+    def resolve(self, n_devices: int) -> tuple[int, int, int, int]:
         model = max(1, self.model)
-        data = self.data if self.data > 0 else n_devices // model
-        if data * model != n_devices:
+        seq = max(1, self.seq)
+        pipe = max(1, self.pipe)
+        rest = model * seq * pipe
+        data = self.data if self.data > 0 else n_devices // rest
+        if data * rest != n_devices:
             raise ValueError(
-                f"mesh {data}x{model} != {n_devices} devices available"
+                f"mesh {data}x{model}x{seq}x{pipe} != {n_devices} devices"
             )
-        return data, model
+        return data, model, seq, pipe
 
 
 def make_mesh(config: MeshConfig | None = None, devices=None) -> Mesh:
     devices = list(devices if devices is not None else jax.devices())
     config = config or MeshConfig()
-    data, model = config.resolve(len(devices))
-    arr = np.asarray(devices).reshape(data, model)
-    return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+    data, model, seq, pipe = config.resolve(len(devices))
+    arr = np.asarray(devices).reshape(data, model, seq, pipe)
+    return Mesh(arr, (DATA_AXIS, MODEL_AXIS, SEQ_AXIS, PIPE_AXIS))
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:

@@ -90,24 +90,57 @@ def _to_numpy(t) -> np.ndarray:
     return np.asarray(t)
 
 
-def torch_to_flax_leaf(name: str, value: np.ndarray, flax_shape) -> np.ndarray:
+def torch_to_flax_leaf(
+    name: str,
+    value: np.ndarray,
+    flax_shape,
+    leaf_name: str | None = None,
+) -> np.ndarray:
     """Layout-convert one torch tensor to a flax leaf shape.
 
-    Rules (checked against the target shape, not guessed from names):
-      * conv kernels: torch OIHW / OIDHW -> flax HWIO / DHWIO;
-      * linear kernels: torch (out, in) -> flax (in, out);
-      * everything else (biases, BN scale/bias/stats): passthrough.
+    Rules:
+      * flax ``kernel`` leaves ALWAYS transpose by rank — torch Linear
+        (out, in) -> (in, out), conv OIHW/OIDHW -> HWIO/DHWIO — even
+        when the tensor is square and the shapes already match (a
+        square Linear weight is shape-ambiguous, so shape checking
+        alone would silently skip the transpose);
+      * everything else (biases, BN scale/bias/stats): passthrough;
+      * without ``leaf_name`` (legacy callers) fall back to
+        shape-directed heuristics.
     """
     value = _to_numpy(value)
     flax_shape = tuple(flax_shape)
+    if leaf_name == "kernel":
+        if value.ndim == 2:
+            out = value.T  # (out, in) -> (in, out)
+        elif value.ndim == 4:
+            out = value.transpose(2, 3, 1, 0)  # OIHW -> HWIO
+        elif value.ndim == 5:
+            out = value.transpose(2, 3, 4, 1, 0)  # OIDHW -> DHWIO
+        else:
+            out = value
+        if out.shape != flax_shape:
+            raise ValueError(
+                f"cannot map torch kernel '{name}' {value.shape} onto "
+                f"flax leaf {flax_shape}"
+            )
+        return out
+    if leaf_name is not None:
+        if value.shape != flax_shape:
+            raise ValueError(
+                f"cannot map torch tensor '{name}' {value.shape} onto "
+                f"flax leaf '{leaf_name}' {flax_shape}"
+            )
+        return value
+    # legacy shape-directed path (no leaf context)
     if value.shape == flax_shape:
         return value
     if value.ndim == 4 and value.transpose(2, 3, 1, 0).shape == flax_shape:
-        return value.transpose(2, 3, 1, 0)  # OIHW -> HWIO
+        return value.transpose(2, 3, 1, 0)
     if value.ndim == 5 and value.transpose(2, 3, 4, 1, 0).shape == flax_shape:
-        return value.transpose(2, 3, 4, 1, 0)  # OIDHW -> DHWIO
+        return value.transpose(2, 3, 4, 1, 0)
     if value.ndim == 2 and value.T.shape == flax_shape:
-        return value.T  # (out, in) -> (in, out)
+        return value.T
     raise ValueError(
         f"cannot map torch tensor '{name}' {value.shape} onto flax leaf "
         f"{flax_shape}"
@@ -148,14 +181,18 @@ def convert_state_dict(
     and returns a new tree. With strict=False, missing torch keys keep
     the template's (random-init) leaf and are logged.
     """
-    flat = {}
     missing = []
+    used = set()
 
     def visit(path, leaf):
         key_path = tuple(str(getattr(p, "key", p)) for p in path)
         torch_key = name_map(key_path)
         if torch_key in state_dict:
-            return torch_to_flax_leaf(torch_key, state_dict[torch_key], leaf.shape)
+            used.add(torch_key)
+            return torch_to_flax_leaf(
+                torch_key, state_dict[torch_key], leaf.shape,
+                leaf_name=key_path[-1],
+            )
         missing.append(torch_key)
         return leaf
 
@@ -165,18 +202,14 @@ def convert_state_dict(
         if strict:
             raise KeyError(msg)
         log.warning("%s; kept template init for those leaves", msg)
-    unused = set(state_dict) - {
-        name_map(tuple(str(getattr(p, "key", p)) for p in path))
-        for path, _ in jax.tree_util.tree_flatten_with_path(variables)[0]
-    }
+    unused = set(state_dict) - used
     if unused:
         log.info("%d torch keys unused (e.g. %s)", len(unused), sorted(unused)[:5])
-    _ = flat
     return out
 
 
 def load_torch_checkpoint(path: str | pathlib.Path) -> dict:
-    """Load a .pth file's state_dict (handles the {'state_dict': ...} и
+    """Load a .pth file's state_dict (handles the {'state_dict': ...} and
     {'model_state': ...} wrappers OpenPCDet/ultralytics use)."""
     import torch
 
