@@ -1,0 +1,113 @@
+"""3D visualization: corner codec oracle + renderer smoke/geometry checks."""
+
+import numpy as np
+
+from triton_client_tpu.io.draw3d import (
+    BEVCanvas,
+    corners_3d,
+    draw_scene_3d,
+    draw_scene_bev,
+    project_pinhole,
+)
+
+
+def test_corners_axis_aligned_oracle():
+    # Box at origin, dims (4, 2, 1), yaw 0: corners at (+-2, +-1, +-0.5).
+    corn = corners_3d(np.array([[0.0, 0.0, 0.0, 4.0, 2.0, 1.0, 0.0]]))[0]
+    assert corn.shape == (8, 3)
+    # Reference ordering: corner 0 = (+x, +y, -z)/2, bottom ring 0-3 CCW-ish.
+    np.testing.assert_allclose(corn[0], [2.0, 1.0, -0.5], atol=1e-6)
+    np.testing.assert_allclose(corn[1], [2.0, -1.0, -0.5], atol=1e-6)
+    np.testing.assert_allclose(corn[2], [-2.0, -1.0, -0.5], atol=1e-6)
+    np.testing.assert_allclose(corn[3], [-2.0, 1.0, -0.5], atol=1e-6)
+    # corner k+4 is vertically above corner k
+    np.testing.assert_allclose(corn[4:, :2], corn[:4, :2], atol=1e-6)
+    np.testing.assert_allclose(corn[4:, 2], np.full(4, 0.5), atol=1e-6)
+
+
+def test_corners_yaw_rotation():
+    # 90 deg yaw swaps dx/dy extents: x rotates toward y.
+    corn = corners_3d(np.array([[0.0, 0.0, 0.0, 4.0, 2.0, 1.0, np.pi / 2]]))[0]
+    np.testing.assert_allclose(corn[:, 0].max(), 1.0, atol=1e-5)
+    np.testing.assert_allclose(corn[:, 1].max(), 2.0, atol=1e-5)
+    # corner 0 (+x,+y in box frame) maps to world (-1, +2)
+    np.testing.assert_allclose(corn[0, :2], [-1.0, 2.0], atol=1e-5)
+
+
+def test_corners_translation():
+    center = np.array([10.0, -5.0, 2.0])
+    corn = corners_3d(np.array([[10.0, -5.0, 2.0, 2.0, 2.0, 2.0, 0.3]]))[0]
+    np.testing.assert_allclose(corn.mean(axis=0), center, atol=1e-5)
+
+
+def test_bev_canvas_world_to_px_orientation():
+    canvas = BEVCanvas(xlim=(0.0, 10.0), ylim=(-5.0, 5.0), px_per_m=10.0)
+    assert canvas.img.shape == (100, 100, 3)
+    # Forward (x=10) maps to top row; left (y=+5) maps to col 0.
+    px = canvas.world_to_px(np.array([10.0, 5.0]))
+    np.testing.assert_allclose(px, [0.0, 0.0], atol=1e-5)
+    px = canvas.world_to_px(np.array([0.0, -5.0]))
+    np.testing.assert_allclose(px, [100.0, 100.0], atol=1e-5)
+
+
+def test_bev_scene_draws_points_and_boxes():
+    rng = np.random.default_rng(0)
+    pts = np.column_stack(
+        [
+            rng.uniform(1, 9, 500),
+            rng.uniform(-4, 4, 500),
+            rng.uniform(-1, 1, 500),
+            rng.uniform(0, 1, 500),
+        ]
+    ).astype(np.float32)
+    boxes = np.array([[5.0, 0.0, 0.0, 3.0, 1.5, 1.5, 0.4]], np.float32)
+    img = draw_scene_bev(
+        pts, boxes, labels=np.array([1]), scores=np.array([0.9]),
+        xlim=(0, 10), ylim=(-5, 5), px_per_m=10.0,
+    )
+    assert img.shape == (100, 100, 3)
+    assert img.any(), "points must be splatted"
+    # Box color (label 1 -> green channel) must appear near the box center.
+    region = img[40:60, 40:60]
+    assert (region[..., 1] > 200).any(), "green box lines expected near center"
+
+
+def test_bev_gt_boxes_colored_distinctly():
+    boxes = np.array([[5.0, 0.0, 0.0, 3.0, 1.5, 1.5, 0.0]], np.float32)
+    img = draw_scene_bev(
+        None, gt_boxes7=boxes, xlim=(0, 10), ylim=(-5, 5), px_per_m=10.0
+    )
+    # GT palette is blue-ish (64, 128, 255)
+    assert (img[..., 2] == 255).any()
+
+
+def test_pinhole_projection_center():
+    # A point straight ahead of the camera projects to the image center.
+    px, depth = project_pinhole(
+        np.array([[10.0, 0.0, 0.0]]),
+        eye=np.array([0.0, 0.0, 0.0]),
+        look_at=np.array([1.0, 0.0, 0.0]),
+        size=(400, 300),
+    )
+    np.testing.assert_allclose(px[0], [200.0, 150.0], atol=1e-4)
+    np.testing.assert_allclose(depth[0], 10.0, atol=1e-5)
+
+
+def test_pinhole_left_point_maps_left():
+    # World +y is to the camera's left when looking down +x with z up.
+    px, _ = project_pinhole(
+        np.array([[10.0, 2.0, 0.0]]),
+        eye=np.array([0.0, 0.0, 0.0]),
+        look_at=np.array([1.0, 0.0, 0.0]),
+        size=(400, 300),
+    )
+    assert px[0, 0] < 200.0
+
+
+def test_scene_3d_smoke():
+    rng = np.random.default_rng(1)
+    pts = rng.uniform(-5, 30, size=(300, 4)).astype(np.float32)
+    boxes = np.array([[15.0, 0.0, 0.0, 4.0, 2.0, 1.6, 0.7]], np.float32)
+    img = draw_scene_3d(pts, boxes, labels=np.array([2]), size=(320, 240))
+    assert img.shape == (240, 320, 3)
+    assert img.any()

@@ -1,16 +1,26 @@
 """``python -m triton_client_tpu <command>`` dispatch.
 
 Commands map 1:1 onto the reference's entry scripts:
-  detect2d  — main.py / bag2d.py (live vs replay chosen by --input)
-  detect3d  — main3d.py / bag3d.py
-  evaluate  — evaluate.py
+  detect2d   — main.py / bag2d.py (live vs replay chosen by --input)
+  detect3d   — main3d.py / bag3d.py
+  evaluate   — evaluate.py
+  pc-extract — tools/pc_extractor.py (bag -> .npy point clouds)
+  bag-stitch — tools/bag_stitch.py (truncate a bag)
+  bag-info   — rosbag info equivalent
 """
 
 from __future__ import annotations
 
 import sys
 
-COMMANDS = ("detect2d", "detect3d", "evaluate")
+COMMANDS = (
+    "detect2d",
+    "detect3d",
+    "evaluate",
+    "pc-extract",
+    "bag-stitch",
+    "bag-info",
+)
 
 
 def main() -> None:
@@ -25,6 +35,12 @@ def main() -> None:
         from triton_client_tpu.cli.detect3d import main as run
     elif cmd == "evaluate":
         from triton_client_tpu.cli.evaluate import main as run
+    elif cmd == "pc-extract":
+        from triton_client_tpu.cli.tools import pc_extract as run
+    elif cmd == "bag-stitch":
+        from triton_client_tpu.cli.tools import bag_stitch as run
+    elif cmd == "bag-info":
+        from triton_client_tpu.cli.tools import bag_info as run
     else:
         print(f"unknown command '{cmd}'; commands: {', '.join(COMMANDS)}")
         raise SystemExit(2)

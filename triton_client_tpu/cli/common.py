@@ -35,15 +35,16 @@ def add_common_flags(parser: argparse.ArgumentParser) -> None:
         "-i",
         "--input",
         default="synthetic:32",
-        help="source: image dir | video file | synthetic[:N[:HxW]] | "
-        "npy dir (3D)",
+        help="source: image dir | video file | rosbag (*.bag) | "
+        "synthetic[:N[:HxW]] | npy dir (3D)",
     )
     parser.add_argument("--limit", type=int, default=0, help="max frames")
     parser.add_argument(
         "--sink",
         default="null",
-        choices=("null", "images", "jsonl"),
-        help="where detections go (images parity: bag_inference2d.py:136)",
+        choices=("null", "images", "jsonl", "bag"),
+        help="where detections go (images parity: bag_inference2d.py:136; "
+        "bag parity: bag_inference3d.py:182-183)",
     )
     parser.add_argument("-o", "--output", default="./output_data")
     parser.add_argument("--names", default="", help="class-names file")
@@ -69,6 +70,18 @@ def make_sink(args, class_names: tuple[str, ...] = ()):
         import os
 
         return DetectionLogSink(os.path.join(args.output, "detections.jsonl"))
+    if args.sink == "bag":
+        import os
+
+        from triton_client_tpu.io.bag_io import OutputBagSink, default_output_bag
+
+        name = (
+            default_output_bag(args.input)
+            if args.input.endswith(".bag")
+            else "output.bag"
+        )
+        os.makedirs(args.output, exist_ok=True)
+        return OutputBagSink(os.path.join(args.output, name))
     return NullSink()
 
 

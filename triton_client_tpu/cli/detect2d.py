@@ -123,6 +123,12 @@ def build(args):
 
 def main(argv=None) -> None:
     args = parse_args(argv)
+    if args.sink == "bag":
+        raise SystemExit(
+            "--sink bag is 3D-only (the output bag carries point clouds + "
+            "jsk box arrays, bag_inference3d.py:182-183); use --sink "
+            "images or jsonl"
+        )
     pipe, spec = build(args)
     class_names = load_names(args.names)
 

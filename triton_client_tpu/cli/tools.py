@@ -1,0 +1,87 @@
+"""Dataset utility subcommands (the reference's tools/ scripts).
+
+  pc-extract  — PointCloud2 topic of a bag -> numbered .npy point clouds
+                (tools/pc_extractor.py:17-45; output feeds the 3D
+                NpyPointCloudSource demo path).
+  bag-stitch  — copy the first N messages (optionally per-topic) of a
+                bag into a new bag: truncated fixture bags for tests
+                (tools/bag_stitch.py:1-8).
+  bag-info    — topics/types/counts of a bag (rosbag info equivalent,
+                handy since TPU hosts have no ROS tooling).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import numpy as np
+
+
+def pc_extract(argv=None) -> None:
+    p = argparse.ArgumentParser(description="bag -> .npy point clouds")
+    p.add_argument("bag_file")
+    p.add_argument("--pc-topic", default=None, help="default: first PointCloud2 topic")
+    p.add_argument("-o", "--output", default="./extracted_clouds")
+    p.add_argument("--limit", type=int, default=0)
+    p.add_argument(
+        "--intensity-scale",
+        type=float,
+        default=1.0,
+        help="divide intensity by this (pc_extractor.py normalizes /255)",
+    )
+    args = p.parse_args(argv)
+
+    from triton_client_tpu.io.bag_io import BagPointCloudSource
+
+    os.makedirs(args.output, exist_ok=True)
+    src = BagPointCloudSource(args.bag_file, topic=args.pc_topic, limit=args.limit)
+    n = 0
+    for i, frame in enumerate(src):
+        pts = frame.data.copy()
+        if args.intensity_scale != 1.0:
+            pts[:, 3] /= args.intensity_scale
+        np.save(os.path.join(args.output, f"{i:06d}.npy"), pts)
+        n += 1
+    print(f"extracted {n} point clouds from {src.topic} -> {args.output}")
+
+
+def bag_stitch(argv=None) -> None:
+    p = argparse.ArgumentParser(description="truncate/copy a bag")
+    p.add_argument("in_bag")
+    p.add_argument("out_bag")
+    p.add_argument("-n", "--count", type=int, default=100, help="max messages")
+    p.add_argument("--topics", nargs="*", default=None)
+    args = p.parse_args(argv)
+
+    from triton_client_tpu.io import rosbag as rb
+
+    n = 0
+    with rb.BagReader(args.in_bag) as r, rb.BagWriter(args.out_bag) as w:
+        for topic, bm, t in r.read_messages(topics=args.topics, raw=True):
+            if n >= args.count:
+                break
+            w.write(topic, bm, t=t)
+            n += 1
+    print(f"wrote {n} messages -> {args.out_bag}")
+
+
+def bag_info(argv=None) -> None:
+    p = argparse.ArgumentParser(description="bag topic/type/count summary")
+    p.add_argument("bag_file")
+    args = p.parse_args(argv)
+
+    from triton_client_tpu.io import rosbag as rb
+
+    counts: dict[str, int] = {}
+    t0, t1 = None, None
+    with rb.BagReader(args.bag_file) as r:
+        for topic, _, t in r.read_messages(raw=True):
+            counts[topic] = counts.get(topic, 0) + 1
+            t0 = t if t0 is None else min(t0, t)
+            t1 = t if t1 is None else max(t1, t)
+        types = {c.topic: c.datatype for c in r.connections.values()}
+    if t0 is not None:
+        print(f"duration: {t1 - t0:.3f}s  messages: {sum(counts.values())}")
+    for topic in sorted(counts):
+        print(f"  {topic}  {types.get(topic, '?')}  {counts[topic]} msgs")

@@ -42,6 +42,10 @@ class Frame:
     frame_id: int
     timestamp: float
     path: str = ""
+    # carrier for source-specific context (e.g. the raw BagMessage so a
+    # bag sink can copy the input message through unchanged, the way
+    # bag_inference3d.py:182 re-writes the input cloud)
+    meta: object = None
 
 
 class FrameSource(Protocol):
@@ -181,6 +185,12 @@ def open_source(spec: str, limit: int = 0, kind: str = "image") -> FrameSource:
             h, w = parts[2].split("x")
             hw = (int(h), int(w))
         return SyntheticImageSource(n, hw)
+    if spec.endswith(".bag"):
+        from triton_client_tpu.io.bag_io import BagImageSource, BagPointCloudSource
+
+        if kind == "pointcloud":
+            return BagPointCloudSource(spec, limit=limit)
+        return BagImageSource(spec, limit=limit)
     if kind == "pointcloud":
         return NpyPointCloudSource(spec, limit)
     if os.path.isdir(spec):

@@ -74,8 +74,7 @@ def _ring_attention_kernel(
 
     perm = [(j, (j + 1) % sp) for j in range(sp)]
 
-    def body(i, carry):
-        m, l, acc, k_blk, v_blk = carry
+    def accumulate(i, m, l, acc, k_blk, v_blk):
         # Block currently held started at device (idx - i) mod sp.
         src = (idx - i) % sp
         k_pos = src * s_blk + jnp.arange(s_blk)
@@ -95,12 +94,19 @@ def _ring_attention_kernel(
             "bhqk,bkhd->bqhd", p, v_blk.astype(jnp.float32)
         )
         acc = acc * corr.transpose(0, 2, 1)[..., None] + pv
+        return m_new, l, acc
 
+    def body(i, carry):
+        # Rotate at the top so the final iteration's blocks are consumed,
+        # not discarded — exactly sp-1 ppermute rounds in total.
+        m, l, acc, k_blk, v_blk = carry
         k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
         v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
-        return m_new, l, acc, k_blk, v_blk
+        m, l, acc = accumulate(i, m, l, acc, k_blk, v_blk)
+        return m, l, acc, k_blk, v_blk
 
-    m, l, acc, _, _ = jax.lax.fori_loop(0, sp, body, (m, l, acc, k, v))
+    m, l, acc = accumulate(0, m, l, acc, k, v)  # local block, no transfer
+    m, l, acc, _, _ = jax.lax.fori_loop(1, sp, body, (m, l, acc, k, v))
     out = acc / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
     return out.astype(q.dtype)
 
