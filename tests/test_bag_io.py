@@ -109,3 +109,33 @@ def test_output_bag_sink_packed_result(tmp_path):
 
 def test_default_output_bag_name():
     assert default_output_bag("/data/run_1.bag") == "run_1.bag_output.bag"
+
+
+def test_driver_closes_sink_on_infer_error(mixed_bag, tmp_path):
+    """A mid-run inference crash must still flush the output bag
+    (index + final chunk), or all processed frames are lost."""
+    from triton_client_tpu.drivers.driver import InferenceDriver
+
+    out = str(tmp_path / "crash.bag")
+    sink = OutputBagSink(out, pub_topic="/det/boxes")
+    calls = {"n": 0}
+
+    def flaky_infer(points):
+        calls["n"] += 1
+        if calls["n"] > 2:
+            raise RuntimeError("boom")
+        return {
+            "pred_boxes": np.zeros((1, 7), np.float32),
+            "pred_scores": np.ones(1, np.float32),
+            "pred_labels": np.ones(1, np.int64),
+        }
+
+    driver = InferenceDriver(
+        flaky_infer, BagPointCloudSource(mixed_bag), sink=sink, warmup=0
+    )
+    with pytest.raises(RuntimeError, match="boom"):
+        driver.run()
+    with rb.BagReader(out) as r:
+        msgs = list(r.read_messages())
+    # two frames fully recorded before the crash (cloud + boxes each)
+    assert len(msgs) == 4

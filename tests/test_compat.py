@@ -1,0 +1,160 @@
+"""Function-style v1 compat API: scaling modes, batch generator, codecs."""
+
+import numpy as np
+import pytest
+
+from triton_client_tpu import compat
+from triton_client_tpu.config import ModelSpec, TensorSpec
+
+
+def test_model_dtype_to_np():
+    assert compat.model_dtype_to_np("FP32") == np.float32
+    assert compat.model_dtype_to_np("INT64") == np.int64
+    with pytest.raises(ValueError):
+        compat.model_dtype_to_np("BF16")  # no numpy bf16 in the v1 API
+
+
+def test_parse_model_nchw():
+    spec = ModelSpec(
+        name="yolo",
+        inputs=(TensorSpec("images", (1, 3, 512, 512), "FP32", "NCHW"),),
+        outputs=(TensorSpec("output", (1, 16128, 7), "FP32"),),
+    )
+    name, outs, c, h, w, fmt, dt = compat.parse_model(spec)
+    assert (name, outs) == ("images", ["output"])
+    assert (c, h, w, fmt, dt) == (3, 512, 512, "NCHW", "FP32")
+
+
+def test_parse_model_nhwc_inferred():
+    spec = ModelSpec(
+        name="m",
+        inputs=(TensorSpec("x", (640, 480, 3), "UINT8"),),
+        outputs=(),
+    )
+    _, _, c, h, w, fmt, _ = compat.parse_model(spec)
+    assert (c, h, w, fmt) == (3, 640, 480, "NHWC")
+
+
+def test_parse_model_rejects_multi_input():
+    spec = ModelSpec(
+        name="pp",
+        inputs=(
+            TensorSpec("a", (1, 2, 3)),
+            TensorSpec("b", (1, 2, 3)),
+        ),
+    )
+    with pytest.raises(ValueError, match="1 input"):
+        compat.parse_model(spec)
+
+
+@pytest.mark.parametrize(
+    "scaling,probe",
+    [
+        ("NONE", 200.0),
+        ("INCEPTION", 200.0 / 127.5 - 1),
+        ("VGG", 200.0 - 123.0),
+        ("COCO", 200.0 / 255.0),
+    ],
+)
+def test_image_adjust_scaling_modes(scaling, probe):
+    img = np.full((8, 8, 3), 200, np.uint8)
+    out = compat.image_adjust(img, "NCHW", "FP32", 3, 8, 8, scaling)
+    assert out.shape == (3, 8, 8)
+    np.testing.assert_allclose(out[0], probe, rtol=1e-6)
+
+
+def test_image_adjust_resize_and_hwc():
+    img = np.random.default_rng(0).integers(0, 255, (32, 48, 3), np.uint8)
+    out = compat.image_adjust(img, "NHWC", "FP32", 3, 16, 16, "COCO")
+    assert out.shape == (16, 16, 3)
+    assert 0.0 <= out.min() and out.max() <= 1.0
+
+
+def test_image_adjust_mono():
+    img = np.full((8, 8, 3), 100, np.uint8)
+    out = compat.image_adjust(img, "NCHW", "FP32", 1, 8, 8, "VGG")
+    assert out.shape == (1, 8, 8)
+    np.testing.assert_allclose(out, 100.0 - 128.0, rtol=1e-6)
+
+
+def test_request_generator_batches_and_padding(tmp_path):
+    from PIL import Image
+
+    for i in range(5):
+        Image.fromarray(
+            np.full((10, 10, 3), 10 * i, np.uint8)
+        ).save(tmp_path / f"{i}.png")
+    batches = list(
+        compat.request_generator(
+            str(tmp_path), batch_size=2, c=3, h=10, w=10, scaling="NONE"
+        )
+    )
+    assert len(batches) == 3
+    assert all(b.shape == (2, 3, 10, 10) for b, _ in batches)
+    # final batch pads by repeating the last image (reference wraparound)
+    last, names = batches[-1]
+    np.testing.assert_array_equal(last[0], last[1])
+    assert names[0] == names[1]
+
+
+def test_deserialize_bytes_roundtrip():
+    f = np.arange(7, dtype="<f4")
+    np.testing.assert_array_equal(compat.deserialize_bytes_float(f.tobytes()), f)
+    i = np.arange(5, dtype="<i8")
+    np.testing.assert_array_equal(compat.deserialize_bytes_int(i.tobytes()), i)
+
+
+def test_xywh2xyxy_and_iou():
+    xywh = np.array([[10.0, 10.0, 4.0, 6.0]])
+    xyxy = compat.xywh2xyxy(xywh)
+    np.testing.assert_allclose(xyxy, [[8, 7, 12, 13]])
+    self_iou = compat.box_iou(xyxy, xyxy)
+    np.testing.assert_allclose(self_iou, [[1.0]], atol=1e-6)
+
+
+def test_nms_cpu_suppresses_overlaps():
+    boxes = np.array(
+        [[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]], np.float32
+    )
+    confs = np.array([0.9, 0.8, 0.7])
+    keep = compat.nms_cpu(boxes, confs, nms_thresh=0.5)
+    assert list(keep) == [0, 2]
+
+
+def test_extract_boxes_yolov5_planted_detection():
+    # One strong prediction among noise; raw head rows are
+    # [cx, cy, w, h, obj, cls...].
+    n, nc = 64, 3
+    pred = np.zeros((1, n, 5 + nc), np.float32)
+    pred[0, :, :4] = [5, 5, 2, 2]
+    pred[0, 0] = [100, 100, 20, 10, 0.95, 0.05, 0.9, 0.05]
+    out = compat.extract_boxes_yolov5(pred, conf_thres=0.5, iou_thres=0.45)
+    assert len(out) == 1 and out[0].shape[0] == 1
+    x1, y1, x2, y2, conf, cls = out[0][0]
+    np.testing.assert_allclose([x1, y1, x2, y2], [90, 95, 110, 105], atol=1e-3)
+    assert cls == 1
+    assert abs(conf - 0.95 * 0.9) < 1e-3
+
+
+def test_extract_boxes_detectron_gate_no_nms():
+    outputs = {
+        "pred_boxes": np.array([[0, 0, 5, 5], [1, 1, 6, 6], [9, 9, 12, 12]]),
+        "scores": np.array([0.9, 0.85, 0.2]),
+        "pred_classes": np.array([0, 0, 1]),
+    }
+    dets = compat.extract_boxes_detectron(outputs, conf_thres=0.6)
+    # overlapping boxes both survive: NMS already happened server-side
+    assert dets.shape == (2, 6)
+    np.testing.assert_allclose(dets[:, 4], [0.9, 0.85])
+
+
+def test_plot_boxes_writes_file(tmp_path):
+    img = np.zeros((32, 32, 3), np.uint8)
+    boxes = np.array([[4, 4, 20, 20, 0.9, 0]], np.float32)
+    out_path = str(tmp_path / "out.png")
+    out = compat.plot_boxes(img, boxes, savename=out_path, class_names=["crop"])
+    assert out.shape == (32, 32, 3)
+    assert out.any()
+    import os
+
+    assert os.path.exists(out_path)

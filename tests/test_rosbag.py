@@ -233,3 +233,53 @@ def test_real_rosbag_can_read_ours(tmp_path):
     path = _write_sample_bag(str(tmp_path / "x.bag"))
     with rosbag_pkg.Bag(path) as b:
         assert b.get_message_count() == 12
+
+
+def test_topics_scan_survives_unregistered_types(tmp_path):
+    """Metadata scan must not decode payloads: bags full of types we have
+    no spec for (tf2_msgs etc.) are the normal case in the wild."""
+    path = str(tmp_path / "alien.bag")
+    with rb.BagWriter(path) as w:
+        w.write(
+            "/tf", b"\x00\x01\x02", t=1.0, datatype="tf2_msgs/TFMessage"
+        )
+        w.write("/camera", rb.numpy_to_image(np.zeros((2, 2, 3), np.uint8)), t=1.0)
+    with rb.BagReader(path) as r:
+        topics = r.topics()
+    assert topics == {
+        "/tf": "tf2_msgs/TFMessage",
+        "/camera": "sensor_msgs/Image",
+    }
+    # and filtered reads skip the alien topic without decoding it
+    with rb.BagReader(path) as r:
+        msgs = list(r.read_messages(topics=["/camera"]))
+    assert len(msgs) == 1
+
+
+def test_pointcloud2_odd_point_step():
+    """Velodyne-style 22-byte points (float32 x4 + uint16 ring) — the
+    step is not a multiple of 4, and any point count must work."""
+    for n in (4, 5):
+        step = 22
+        buf = np.zeros((n, step), np.uint8)
+        xyzi = np.arange(4 * n, dtype=np.float32).reshape(n, 4)
+        buf[:, :16] = xyzi.view(np.uint8).reshape(n, 16)
+        fields = [
+            rb.make("sensor_msgs/PointField", name=nm, offset=4 * i, datatype=7, count=1)
+            for i, nm in enumerate(("x", "y", "z", "intensity"))
+        ]
+        fields.append(
+            rb.make("sensor_msgs/PointField", name="ring", offset=16, datatype=4, count=1)
+        )
+        msg = rb.make(
+            "sensor_msgs/PointCloud2",
+            header=rb.make("std_msgs/Header"),
+            height=1,
+            width=n,
+            fields=fields,
+            point_step=step,
+            row_step=step * n,
+            data=buf.reshape(-1),
+            is_dense=1,
+        )
+        np.testing.assert_allclose(rb.pointcloud2_to_xyzi(msg), xyzi)

@@ -22,12 +22,21 @@ from triton_client_tpu.cli.common import add_common_flags, make_sink, print_repo
 def parse_args(argv=None) -> argparse.Namespace:
     parser = argparse.ArgumentParser(description=__doc__)
     add_common_flags(parser)
-    parser.add_argument("--score", type=float, default=0.1)
+    # None sentinels: "not passed" must be distinguishable from the
+    # default so YAML --config values aren't silently clobbered.
+    parser.add_argument("--score", type=float, default=None, help="default 0.1")
     parser.add_argument(
         "--z-offset",
         type=float,
-        default=0.0,
-        help="sensor z correction (reference adds 1.5, ros_inference3d.py:128)",
+        default=None,
+        help="sensor z correction, default 0 (reference adds 1.5, "
+        "ros_inference3d.py:128)",
+    )
+    parser.add_argument(
+        "--config",
+        default="",
+        help="dataset/model YAML (data/kitti_pointpillars.yaml etc.; the "
+        "reference's data/pointpillar.yaml role) — overrides -m",
     )
     return parser.parse_args(argv)
 
@@ -48,24 +57,33 @@ def main(argv=None) -> None:
         build_second_pipeline,
     )
 
-    name = args.model_name or "pointpillars"
     builders = {
         "pointpillars": build_pointpillars_pipeline,
         "second_iou": build_second_pipeline,
         "centerpoint": build_centerpoint_pipeline,
     }
+    model_cfg = None
+    if args.config:
+        from triton_client_tpu.dataset_config import detect3d_from_yaml
+
+        name, model_cfg, cfg = detect3d_from_yaml(args.config)
+    else:
+        name = args.model_name or "pointpillars"
+        cfg = Detect3DConfig(model_name=name)
+        if name == "centerpoint":
+            # class_names are reconciled from the model config inside the
+            # builder; only the peak-NMS-appropriate IoU gate is set here.
+            cfg = dataclasses.replace(cfg, iou_thresh=0.2)
+    # explicitly-passed CLI flags win over config-file/default values
+    if args.score is not None:
+        cfg = dataclasses.replace(cfg, score_thresh=args.score)
+    if args.z_offset is not None:
+        cfg = dataclasses.replace(cfg, z_offset=args.z_offset)
     if name not in builders:
         raise SystemExit(f"unknown 3D model '{name}' (choose from {sorted(builders)})")
-    cfg = Detect3DConfig(
-        model_name=name,
-        score_thresh=args.score,
-        z_offset=args.z_offset,
+    pipe, spec, _ = builders[name](
+        jax.random.PRNGKey(0), model_cfg=model_cfg, config=cfg
     )
-    if name == "centerpoint":
-        # class_names are reconciled from the model config inside the
-        # builder; only the peak-NMS-appropriate IoU gate is set here.
-        cfg = dataclasses.replace(cfg, iou_thresh=0.2)
-    pipe, spec, _ = builders[name](jax.random.PRNGKey(0), config=cfg)
     infer = detect3d_infer(pipe)
 
     if args.input.startswith("ros:"):

@@ -1,0 +1,17 @@
+"""Function-style v1 compatibility API (the reference's legacy stack)."""
+
+from triton_client_tpu.compat.functional import (  # noqa: F401
+    box_iou,
+    deserialize_bytes_float,
+    deserialize_bytes_int,
+    extract_boxes_detectron,
+    extract_boxes_yolov5,
+    image_adjust,
+    load_class_names,
+    model_dtype_to_np,
+    nms_cpu,
+    parse_model,
+    plot_boxes,
+    request_generator,
+    xywh2xyxy,
+)

@@ -1,0 +1,308 @@
+"""Function-style API: parity with the reference's pre-refactor v1 stack.
+
+The reference keeps an older, non-OO copy of its client layer alive for
+evaluate.py (utils/preprocess.py, utils/postprocess.py — SURVEY.md
+section 2 #9): free functions for model parsing, image scaling modes,
+filesystem batch generation, byte deserialization, and per-model box
+extraction. This module is the same surface expressed over the new
+framework's primitives, so scripts written against the v1 function
+names port by changing an import. Numeric semantics:
+
+- scaling modes NONE/INCEPTION/VGG/COCO match utils/preprocess.py:147-157
+- deserialize_bytes_* replaces the per-scalar struct.unpack_from loop
+  (utils/postprocess.py:12-34) with one numpy frombuffer — the loop was
+  a documented hot spot (SURVEY.md section 2 #14)
+- extract_boxes_yolov5 keeps the (n, 6) [x1,y1,x2,y2,conf,cls] contract
+  of utils/postprocess.py:105-199
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterator, Sequence
+
+import numpy as np
+
+from triton_client_tpu.config import ModelSpec, config_dtypes
+
+_NP_DTYPES = {k: v for k, v in config_dtypes().items() if v is not None}
+
+
+def model_dtype_to_np(model_dtype: str) -> np.dtype:
+    """KServe/Triton dtype string -> numpy (utils/preprocess.py:17-40)."""
+    if model_dtype not in _NP_DTYPES:
+        raise ValueError(f"unsupported model dtype {model_dtype!r}")
+    return np.dtype(_NP_DTYPES[model_dtype])
+
+
+def load_class_names(namesfile: str) -> list[str]:
+    """*.names file -> class list (utils/preprocess.py:42-49)."""
+    with open(namesfile) as f:
+        return [line.strip() for line in f if line.strip()]
+
+
+def parse_model(spec: ModelSpec) -> tuple:
+    """ModelSpec -> (input_name, output_names, c, h, w, format, dtype)
+    — the v1 tuple contract (utils/preprocess.py:51-126). The format
+    element is 'NHWC'/'NCHW' (inferred from the input layout/shape)
+    instead of the protobuf enum."""
+    if len(spec.inputs) != 1:
+        raise ValueError(f"expecting 1 input, got {len(spec.inputs)}")
+    inp = spec.inputs[0]
+    if len(inp.shape) == 4:  # batch dim present
+        shape = list(inp.shape[1:])
+    elif len(inp.shape) == 3:
+        shape = list(inp.shape)
+    else:
+        raise ValueError(
+            f"expecting a 3-dim image input (+batch), got {inp.shape}"
+        )
+    layout = inp.layout or ("NCHW" if shape[0] in (1, 3) else "NHWC")
+    if layout.endswith("NCHW") or layout == "CHW":
+        c, h, w = shape
+        fmt = "NCHW"
+    else:
+        h, w, c = shape
+        fmt = "NHWC"
+    return (
+        inp.name,
+        [o.name for o in spec.outputs],
+        c,
+        h,
+        w,
+        fmt,
+        inp.dtype,
+    )
+
+
+def image_adjust(
+    img,
+    format: str = "NCHW",
+    dtype: str = "FP32",
+    c: int = 3,
+    h: int = 512,
+    w: int = 512,
+    scaling: str = "NONE",
+) -> np.ndarray:
+    """Path or HWC uint8 array -> scaled (c, h, w) / (h, w, c) tensor.
+
+    Scaling modes per utils/preprocess.py:147-157: INCEPTION
+    ``x/127.5 - 1``; VGG ``x - (123,117,104)`` (128 for mono); COCO
+    ``x/255``; anything else passes through.
+    """
+    if isinstance(img, (str, os.PathLike)):
+        from triton_client_tpu.io.sources import _read_image_rgb
+
+        img = _read_image_rgb(os.fspath(img))
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    if c == 1 and arr.shape[2] == 3:
+        # ITU-R 601 luma, same intent as PIL convert('L')
+        arr = (arr @ np.array([0.299, 0.587, 0.114]))[..., None]
+    if arr.shape[:2] != (h, w):
+        try:
+            import cv2
+
+            arr = cv2.resize(
+                arr.astype(np.uint8), (w, h), interpolation=cv2.INTER_LINEAR
+            )
+            if arr.ndim == 2:
+                arr = arr[:, :, None]
+        except ImportError:
+            from triton_client_tpu.ops.preprocess import resize_bilinear
+
+            arr = np.asarray(resize_bilinear(arr.astype(np.float32), (h, w)))
+    typed = arr.astype(model_dtype_to_np(dtype))
+    if scaling == "INCEPTION":
+        scaled = (typed / 127.5) - 1
+    elif scaling == "VGG":
+        mean = (128,) if c == 1 else (123, 117, 104)
+        scaled = typed - np.asarray(mean, typed.dtype)
+    elif scaling == "COCO":
+        scaled = typed / 255.0
+    else:
+        scaled = typed
+    if format == "NCHW":
+        scaled = np.transpose(scaled, (2, 0, 1))
+    return np.ascontiguousarray(scaled)
+
+
+def request_generator(
+    image_filename: str,
+    batch_size: int = 1,
+    *,
+    c: int = 3,
+    h: int = 512,
+    w: int = 512,
+    format: str = "NCHW",
+    dtype: str = "FP32",
+    scaling: str = "NONE",
+    limit: int = 0,
+) -> Iterator[tuple[np.ndarray, list[str]]]:
+    """Directory (jpg/png) or single file -> (batched tensor, filenames)
+    pairs — the filesystem batch path of utils/preprocess.py:185-263,
+    minus the protobuf plumbing (the channel codec adds that when the
+    batch is dispatched). The last batch repeats its final image to
+    stay full-shape, matching the reference's wraparound behavior."""
+    if os.path.isdir(image_filename):
+        filenames = sorted(
+            os.path.join(image_filename, f)
+            for f in os.listdir(image_filename)
+            if f.lower().endswith((".jpg", ".jpeg", ".png"))
+        )
+    elif os.path.isfile(image_filename):
+        filenames = [image_filename]
+    else:
+        raise FileNotFoundError(image_filename)
+    if limit:
+        filenames = filenames[:limit]
+    if not filenames:
+        raise FileNotFoundError(f"no jpg/png under {image_filename}")
+
+    batch, names = [], []
+    for fn in filenames:
+        batch.append(image_adjust(fn, format, dtype, c, h, w, scaling))
+        names.append(fn)
+        if len(batch) == batch_size:
+            yield np.stack(batch), names
+            batch, names = [], []
+    if batch:
+        while len(batch) < batch_size:  # pad final partial batch
+            batch.append(batch[-1])
+            names.append(names[-1])
+        yield np.stack(batch), names
+
+
+# --- wire codec (vectorized replacement for the v1 scalar loops) ---------
+
+
+def deserialize_bytes_float(encoded: bytes | np.ndarray) -> np.ndarray:
+    """raw little-endian FP32 bytes -> float32 array. One frombuffer vs
+    the reference's per-scalar struct.unpack_from loop
+    (utils/postprocess.py:12-22, clients/postprocess/base_postprocess.py:15-25)."""
+    buf = encoded.tobytes() if isinstance(encoded, np.ndarray) else bytes(encoded)
+    return np.frombuffer(buf, dtype="<f4").copy()
+
+
+def deserialize_bytes_int(encoded: bytes | np.ndarray) -> np.ndarray:
+    """raw little-endian INT64 bytes -> int64 array
+    (utils/postprocess.py:24-34 semantics)."""
+    buf = encoded.tobytes() if isinstance(encoded, np.ndarray) else bytes(encoded)
+    return np.frombuffer(buf, dtype="<i8").copy()
+
+
+# --- box math (numpy, v1 signatures: utils/postprocess.py:36-103) --------
+
+
+def xywh2xyxy(x: np.ndarray) -> np.ndarray:
+    y = np.array(x, dtype=np.float32, copy=True)
+    y[..., 0] = x[..., 0] - x[..., 2] / 2
+    y[..., 1] = x[..., 1] - x[..., 3] / 2
+    y[..., 2] = x[..., 0] + x[..., 2] / 2
+    y[..., 3] = x[..., 1] + x[..., 3] / 2
+    return y
+
+
+def box_iou(box1: np.ndarray, box2: np.ndarray) -> np.ndarray:
+    """(N, 4) x (M, 4) xyxy -> (N, M) IoU (utils/postprocess.py:45-67)."""
+    a1 = np.maximum(box1[:, None, :2], box2[None, :, :2])
+    a2 = np.minimum(box1[:, None, 2:4], box2[None, :, 2:4])
+    inter = np.prod(np.clip(a2 - a1, 0, None), axis=2)
+    area1 = np.prod(box1[:, 2:4] - box1[:, :2], axis=1)
+    area2 = np.prod(box2[:, 2:4] - box2[:, :2], axis=1)
+    return inter / np.maximum(area1[:, None] + area2[None, :] - inter, 1e-9)
+
+
+def nms_cpu(
+    boxes: np.ndarray, confs: np.ndarray, nms_thresh: float = 0.5
+) -> np.ndarray:
+    """Greedy CPU NMS returning kept indices (utils/postprocess.py:69-103
+    semantics — host-side fallback; the TPU path uses ops.nms)."""
+    order = np.argsort(-np.asarray(confs))
+    boxes = np.asarray(boxes, np.float32)
+    keep = []
+    alive = np.ones(len(order), bool)
+    for oi, idx in enumerate(order):
+        if not alive[oi]:
+            continue
+        keep.append(int(idx))
+        rest = order[oi + 1 :]
+        mask = alive[oi + 1 :]
+        if not rest.size:
+            break
+        ious = box_iou(boxes[idx : idx + 1], boxes[rest]).reshape(-1)
+        alive[oi + 1 :] = mask & (ious <= nms_thresh)
+    return np.asarray(keep, np.int64)
+
+
+# --- per-model extraction (v1 contracts) ---------------------------------
+
+
+def extract_boxes_yolov5(
+    prediction: np.ndarray,
+    conf_thres: float = 0.6,
+    iou_thres: float = 0.45,
+    max_det: int = 300,
+) -> list[np.ndarray]:
+    """(B, N, 5+nc) raw YOLOv5 head -> per-image (n, 6)
+    [x1,y1,x2,y2,conf,cls] float32 (utils/postprocess.py:105-199 /
+    clients/postprocess/yolov5_postprocess.py:28-125). Runs the jitted
+    fixed-shape TPU postprocess and strips padding on the way out."""
+    from triton_client_tpu.ops.detect_postprocess import extract_boxes
+
+    pred = np.asarray(prediction, np.float32)
+    if pred.ndim == 2:
+        pred = pred[None]
+    dets, valid = extract_boxes(
+        pred, conf_thresh=conf_thres, iou_thresh=iou_thres, max_det=max_det
+    )
+    dets, valid = np.asarray(dets), np.asarray(valid)
+    return [dets[i][valid[i].astype(bool)] for i in range(dets.shape[0])]
+
+
+def extract_boxes_detectron(
+    outputs: dict[str, np.ndarray] | Sequence[np.ndarray],
+    conf_thres: float = 0.6,
+) -> np.ndarray:
+    """Server-side-NMS family (FCOS/RetinaNet): boxes/scores/classes in,
+    (n, 6) out with a confidence gate — no client NMS, matching
+    clients/postprocess/detectron_postprocess.py:26-38. Accepts the
+    3-output dict (pred_boxes/scores/pred_classes) or a sequence in
+    that order; the 4th reference output (dims) is unused there too."""
+    if isinstance(outputs, dict):
+        boxes = np.asarray(outputs["pred_boxes"], np.float32)
+        scores = np.asarray(outputs["scores"], np.float32)
+        classes = np.asarray(outputs["pred_classes"], np.float32)
+    else:
+        boxes, scores, classes = (np.asarray(o, np.float32) for o in outputs[:3])
+    boxes = boxes.reshape(-1, 4)
+    scores = scores.reshape(-1)
+    classes = classes.reshape(-1)
+    keep = scores >= conf_thres
+    return np.concatenate(
+        [boxes[keep], scores[keep, None], classes[keep, None]], axis=1
+    )
+
+
+def plot_boxes(
+    img: np.ndarray,
+    boxes: np.ndarray,
+    savename: str | None = None,
+    class_names: Sequence[str] = (),
+) -> np.ndarray:
+    """Draw (n, 6) detections on an RGB image; optionally save
+    (utils/postprocess.py:324-366 role, via the new draw module)."""
+    from triton_client_tpu.io.draw import draw_boxes
+
+    out = draw_boxes(img, np.asarray(boxes, np.float32), None, tuple(class_names))
+    if savename:
+        try:
+            import cv2
+
+            cv2.imwrite(savename, out[..., ::-1])
+        except ImportError:
+            from PIL import Image
+
+            Image.fromarray(out).save(savename)
+    return out

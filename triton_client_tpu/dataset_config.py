@@ -1,0 +1,174 @@
+"""YAML dataset/model hyperparameter files -> typed configs.
+
+The reference drives its 3D stack from YAML/py config files —
+data/kitti_dataset.yaml (voxelization + point range),
+data/pointpillar.yaml:110-142 (anchors + heads), and
+data/nusc_centerpoint_pp_02voxel_two_pfn_10sweep.py (nuScenes grid) —
+parsed by OpenPCDet/det3d at client startup
+(clients/preprocess/preprocess_3d.py:13-25, voxelize.py:13-24). Here the
+same hyperparameters live in data/*.yaml files that map 1:1 onto the
+frozen config dataclasses, so a deployment can retune grids/anchors
+without touching code, and the in-code defaults remain the source of
+truth for anything the file omits.
+
+Also loads the client parameter file (endpoint + topic wiring,
+data/client_parameter.yaml — main.py:119-121 parity).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Mapping
+
+import yaml
+
+from triton_client_tpu.ops.voxelize import VoxelConfig
+
+
+def load_yaml(path: str) -> dict:
+    with open(path) as f:
+        doc = yaml.safe_load(f)
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: expected a YAML mapping at top level")
+    return doc
+
+
+def _tup(v: Any) -> tuple:
+    return tuple(v) if isinstance(v, (list, tuple)) else (v,)
+
+
+def voxel_from_dict(d: Mapping[str, Any], base: VoxelConfig | None = None) -> VoxelConfig:
+    base = base or VoxelConfig()
+    return dataclasses.replace(
+        base,
+        **{
+            k: (_tup(d[k]) if k in ("point_cloud_range", "voxel_size") else int(d[k]))
+            for k in ("point_cloud_range", "voxel_size", "max_voxels", "max_points_per_voxel")
+            if k in d
+        },
+    )
+
+
+def _anchor_classes(rows: list[Mapping[str, Any]]):
+    from triton_client_tpu.models.pointpillars import AnchorClassConfig
+
+    out = []
+    for r in rows:
+        out.append(
+            AnchorClassConfig(
+                name=r["name"],
+                size=_tup(r["size"]),
+                bottom_z=float(r["bottom_z"]),
+                matched_thresh=float(r.get("matched_thresh", 0.6)),
+                unmatched_thresh=float(r.get("unmatched_thresh", 0.45)),
+            )
+        )
+    return tuple(out)
+
+
+def _apply_overrides(cfg, d: Mapping[str, Any], tuple_keys: set[str]):
+    """Overlay YAML keys onto a frozen dataclass; unknown keys error so
+    typos fail loudly instead of silently keeping defaults."""
+    known = {f.name for f in dataclasses.fields(cfg)}
+    updates = {}
+    for k, v in d.items():
+        if k not in known:
+            raise KeyError(
+                f"unknown {type(cfg).__name__} key {k!r} (valid: {sorted(known)})"
+            )
+        updates[k] = _tup(v) if k in tuple_keys and isinstance(v, list) else v
+    return dataclasses.replace(cfg, **updates)
+
+
+_SEQ_KEYS = {
+    "backbone_layers",
+    "backbone_strides",
+    "backbone_filters",
+    "upsample_strides",
+    "upsample_filters",
+    "middle_filters",
+    "class_names",
+    "point_buckets",
+}
+
+
+def model_config_from_dict(model: str, d: Mapping[str, Any]):
+    """'pointpillars' | 'second_iou' | 'centerpoint' + mapping -> config
+    dataclass. Recognized sections: ``voxel`` (grid), ``anchors`` (list
+    of per-class anchor rows), everything else = direct field override."""
+    d = dict(d)
+    voxel = d.pop("voxel", None)
+    anchors = d.pop("anchors", None)
+    if model == "pointpillars":
+        from triton_client_tpu.models.pointpillars import PointPillarsConfig
+
+        cfg = PointPillarsConfig()
+    elif model == "second_iou":
+        from triton_client_tpu.models.second import SECONDConfig
+
+        cfg = SECONDConfig()
+    elif model == "centerpoint":
+        from triton_client_tpu.models.centerpoint import CenterPointConfig
+
+        cfg = CenterPointConfig()
+    else:
+        raise ValueError(f"unknown 3D model {model!r}")
+    if voxel is not None:
+        cfg = dataclasses.replace(cfg, voxel=voxel_from_dict(voxel, cfg.voxel))
+    if anchors is not None:
+        if not hasattr(cfg, "anchor_classes"):
+            raise ValueError(f"{model} is anchor-free; remove the anchors section")
+        cfg = dataclasses.replace(cfg, anchor_classes=_anchor_classes(anchors))
+    return _apply_overrides(cfg, d, _SEQ_KEYS)
+
+
+def detect3d_from_yaml(path: str):
+    """Full 3D stack config file -> (model_name, model_cfg,
+    Detect3DConfig). Layout::
+
+        model: pointpillars
+        voxel: {point_cloud_range: [...], voxel_size: [...], ...}
+        anchors: [{name: Car, size: [...], bottom_z: ...}, ...]
+        pipeline: {score_thresh: ..., z_offset: ..., ...}
+        <field>: <model-config override>
+    """
+    from triton_client_tpu.pipelines.detect3d import Detect3DConfig
+
+    doc = load_yaml(path)
+    model = doc.pop("model", "pointpillars")
+    pipe_d = dict(doc.pop("pipeline", {}))
+    model_cfg = model_config_from_dict(model, doc)
+    # model-appropriate NMS default: heatmap-peak models only need to
+    # kill duplicate peaks (mirrors build_centerpoint_pipeline's default)
+    if model == "centerpoint" and "iou_thresh" not in pipe_d:
+        pipe_d["iou_thresh"] = 0.2
+    pipe_cfg = _apply_overrides(
+        Detect3DConfig(model_name=model), pipe_d, _SEQ_KEYS
+    )
+    # Keep label vocabulary consistent with the model's classes.
+    names = getattr(model_cfg, "class_names", None)
+    if names is None and hasattr(model_cfg, "anchor_classes"):
+        names = tuple(a.name for a in model_cfg.anchor_classes)
+    if names and tuple(pipe_cfg.class_names) != tuple(names):
+        pipe_cfg = dataclasses.replace(pipe_cfg, class_names=tuple(names))
+    return model, model_cfg, pipe_cfg
+
+
+_CLIENT_PARAM_DEFAULTS = {
+    "channel": "tpu",
+    "grpc_channel": "localhost:8001",
+    "sub_topic": "/camera/color/image_raw",
+    "pub_topic": "/tpu_detections/image",
+    "gt_topic": "/camera/color/Detection2DArray",
+    "pointcloud_topic": "/os_cloud_node/points",
+    "mesh": {"data": -1, "model": 1},
+}
+
+
+def client_params(path: str | None = None) -> dict:
+    """Endpoint/topic wiring with defaults (client_parameter.yaml
+    semantics, main.py:119-121)."""
+    params = dict(_CLIENT_PARAM_DEFAULTS)
+    if path:
+        params.update(load_yaml(path))
+    return params

@@ -101,25 +101,30 @@ class InferenceDriver:
             self.infer(frame.data)
 
         t_start = time.perf_counter()
-        while frame is not _SENTINEL:
-            t0 = time.perf_counter()
-            result = self.infer(frame.data)
-            latencies.append(time.perf_counter() - t0)
-            n += 1
+        try:
+            while frame is not _SENTINEL:
+                t0 = time.perf_counter()
+                result = self.infer(frame.data)
+                latencies.append(time.perf_counter() - t0)
+                n += 1
+                if self.sink is not None:
+                    self.sink.write(frame, result)
+                if self.evaluator is not None and self.gt_lookup is not None:
+                    gts = self.gt_lookup(frame)
+                    if gts is not None:
+                        self.evaluator.add_frame(
+                            np.asarray(result["detections"]),
+                            np.asarray(result["valid"]) if "valid" in result else None,
+                            gts,
+                        )
+                frame = q.get()
+            wall = time.perf_counter() - t_start
+        finally:
+            # Close even on infer errors / KeyboardInterrupt: buffered
+            # sinks (the output bag writer) must flush their index or
+            # every frame processed so far is lost.
             if self.sink is not None:
-                self.sink.write(frame, result)
-            if self.evaluator is not None and self.gt_lookup is not None:
-                gts = self.gt_lookup(frame)
-                if gts is not None:
-                    self.evaluator.add_frame(
-                        np.asarray(result["detections"]),
-                        np.asarray(result["valid"]) if "valid" in result else None,
-                        gts,
-                    )
-            frame = q.get()
-        wall = time.perf_counter() - t_start
-        if self.sink is not None:
-            self.sink.close()
+                self.sink.close()
         if error:
             raise error[0]
 

@@ -557,7 +557,8 @@ class BagReader:
         (bag_inference2d.py:92). ``raw=True`` yields the BagMessage
         (undecoded) instead of the deserialized message."""
         self._f.seek(len(MAGIC))
-        want = set(topics) if topics else None
+        # [] means "no topics" (metadata-only scan), None means all.
+        want = set(topics) if topics is not None else None
         while True:
             rec = self._read_record_from_file()
             if rec is None:
@@ -588,8 +589,9 @@ class BagReader:
             # _OP_INDEX / _OP_CHUNK_INFO / _OP_BAG_HEADER: skip
 
     def topics(self) -> dict[str, str]:
-        """topic -> datatype map (forces a header scan)."""
-        for _ in self.read_messages(topics=[]):
+        """topic -> datatype map (raw scan — never decodes payloads, so
+        unregistered message types in the bag are fine)."""
+        for _ in self.read_messages(topics=[], raw=True):
             pass
         return {c.topic: c.datatype for c in self.connections.values()}
 
@@ -667,10 +669,16 @@ class BagWriter:
     ) -> Connection:
         if topic in self._conns:
             return self._conns[topic]
-        if md5sum is None:
-            md5sum = compute_md5(datatype)
-        if definition is None:
-            definition = full_definition(datatype)
+        if datatype in REGISTRY:
+            if md5sum is None:
+                md5sum = compute_md5(datatype)
+            if definition is None:
+                definition = full_definition(datatype)
+        else:
+            # Raw passthrough of a type we have no spec for: '*' is the
+            # ROS wildcard md5 (subscribers that don't type-check accept it).
+            md5sum = md5sum or "*"
+            definition = definition or ""
         conn = Connection(len(self._conns), topic, datatype, md5sum, definition)
         self._conns[topic] = conn
         return conn
@@ -833,25 +841,35 @@ def pointcloud2_to_xyzi(msg: Any) -> np.ndarray:
     """(N, 4) float32 x/y/z/intensity — parity with the driver's
     ``point_cloud2.read_points(msg, ('x','y','z','intensity'))``
     (communicator/ros_inference3d.py:125). Missing intensity -> zeros."""
-    offsets: dict[str, tuple[int, Any]] = {}
-    for f in msg.fields:
-        offsets[f.name] = (f.offset, _PF_DTYPE[int(f.datatype)])
     n = int(msg.width) * int(msg.height)
-    buf = np.asarray(msg.data, np.uint8)
     step = int(msg.point_step)
-    cols = []
-    for name in ("x", "y", "z", "intensity"):
-        if name not in offsets:
-            cols.append(np.zeros(n, np.float32))
-            continue
-        off, dt = offsets[name]
-        dt = np.dtype(dt)
-        view = np.lib.stride_tricks.as_strided(
-            buf[off : off + (n - 1) * step + dt.itemsize].view(dt),
-            shape=(n,),
-            strides=(step,),
-        )
-        cols.append(view.astype(np.float32))
+    buf = np.ascontiguousarray(msg.data, np.uint8)
+    # Structured dtype with explicit offsets + itemsize handles arbitrary
+    # point layouts (padding, extra fields, steps not divisible by 4 —
+    # e.g. velodyne's 22-byte x/y/z/intensity/ring points).
+    present = {
+        f.name: (f.offset, np.dtype(_PF_DTYPE[int(f.datatype)]))
+        for f in msg.fields
+        if f.name in ("x", "y", "z", "intensity")
+    }
+    rec = np.frombuffer(
+        buf.tobytes(),
+        dtype=np.dtype(
+            {
+                "names": list(present),
+                "formats": [dt for _, dt in present.values()],
+                "offsets": [off for off, _ in present.values()],
+                "itemsize": step,
+            }
+        ),
+        count=n,
+    )
+    cols = [
+        rec[name].astype(np.float32)
+        if name in present
+        else np.zeros(n, np.float32)
+        for name in ("x", "y", "z", "intensity")
+    ]
     return np.stack(cols, axis=1)
 
 
