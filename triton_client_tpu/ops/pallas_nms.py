@@ -34,9 +34,11 @@ _LANES = 128
 _NEG_INF = float("-inf")
 
 
-def _nms_kernel(boxes_ref, scores_ref, idx_ref, valid_ref, live_ref, *, max_det, iou_thresh):
+def _nms_kernel(boxes_ref, scores_ref, thresh_ref, idx_ref, valid_ref, live_ref, *, max_det):
     """boxes_ref: (8, N) rows [x1, y1, x2, y2, area, 0, 0, 0];
-    scores_ref: (1, N); outputs (1, max_det) int32 / bool;
+    scores_ref: (1, N); thresh_ref: (1,) SMEM scalar IoU threshold
+    (an input, not a closure constant, so a traced threshold from an
+    enclosing jit works); outputs (1, max_det) int32 / bool;
     live_ref: (1, N) f32 scratch holding still-live scores.
 
     No dynamic indexing anywhere: the selected box's coordinates are
@@ -45,6 +47,7 @@ def _nms_kernel(boxes_ref, scores_ref, idx_ref, valid_ref, live_ref, *, max_det,
     writes — everything stays lane-parallel VPU work.
     """
     n = scores_ref.shape[1]
+    iou_thresh = thresh_ref[0]
     live_ref[:] = scores_ref[:]
 
     x1 = boxes_ref[0:1, :]
@@ -87,13 +90,11 @@ def _nms_kernel(boxes_ref, scores_ref, idx_ref, valid_ref, live_ref, *, max_det,
     jax.lax.fori_loop(0, max_det, body, 0)
 
 
-@functools.partial(
-    jax.jit, static_argnames=("max_det", "iou_thresh", "interpret")
-)
+@functools.partial(jax.jit, static_argnames=("max_det", "interpret"))
 def nms_pallas(
     boxes: jnp.ndarray,
     scores: jnp.ndarray,
-    iou_thresh: float = 0.45,
+    iou_thresh=0.45,
     max_det: int = 300,
     interpret: bool = False,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
@@ -116,8 +117,9 @@ def nms_pallas(
     padded_scores = jnp.full((1, n_pad), _NEG_INF, jnp.float32)
     padded_scores = padded_scores.at[0, :n].set(scores.astype(jnp.float32))
 
+    thresh = jnp.reshape(jnp.asarray(iou_thresh, jnp.float32), (1,))
     idx, valid = pl.pallas_call(
-        functools.partial(_nms_kernel, max_det=max_det, iou_thresh=iou_thresh),
+        functools.partial(_nms_kernel, max_det=max_det),
         out_shape=(
             jax.ShapeDtypeStruct((1, md_pad), jnp.int32),
             jax.ShapeDtypeStruct((1, md_pad), jnp.int32),
@@ -125,6 +127,7 @@ def nms_pallas(
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_specs=(
             pl.BlockSpec(memory_space=pltpu.VMEM),
@@ -132,7 +135,7 @@ def nms_pallas(
         ),
         scratch_shapes=[pltpu.VMEM((1, n_pad), jnp.float32)],
         interpret=interpret,
-    )(packed, padded_scores)
+    )(packed, padded_scores, thresh)
     return idx[0, :max_det], valid[0, :max_det].astype(jnp.bool_)
 
 
