@@ -56,3 +56,13 @@ def test_bag_info_prints_summary(tmp_path, capsys):
     out = capsys.readouterr().out
     assert "messages: 8" in out
     assert "/pc" in out and "sensor_msgs/PointCloud2" in out
+
+
+def test_bag_stitch_bare_topics_flag_copies_all(tmp_path):
+    # `--topics` with zero values must mean "all topics" (rosbag's
+    # falsy-filter semantics), not an empty output bag.
+    bag = _make_bag(str(tmp_path / "in.bag"))
+    out = str(tmp_path / "all.bag")
+    bag_stitch([bag, out, "--topics"])
+    with rb.BagReader(out) as r:
+        assert len(list(r.read_messages())) == 8

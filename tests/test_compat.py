@@ -70,6 +70,18 @@ def test_image_adjust_resize_and_hwc():
     assert 0.0 <= out.min() and out.max() <= 1.0
 
 
+def test_image_adjust_integer_dtype_scales_before_cast():
+    # VGG mean-subtract must happen in float, then cast: 100 - 123 = -23,
+    # not a uint8/int8 wraparound of the pre-cast value.
+    img = np.full((8, 8, 3), 100, np.uint8)
+    out = compat.image_adjust(img, "NCHW", "INT8", 3, 8, 8, "VGG")
+    assert out.dtype == np.int8
+    np.testing.assert_array_equal(out[0], -23)
+    # division modes must come back in the requested dtype, not float64
+    out = compat.image_adjust(img, "NCHW", "FP16", 3, 8, 8, "INCEPTION")
+    assert out.dtype == np.float16
+
+
 def test_image_adjust_mono():
     img = np.full((8, 8, 3), 100, np.uint8)
     out = compat.image_adjust(img, "NCHW", "FP32", 1, 8, 8, "VGG")

@@ -2,10 +2,12 @@
 
 import numpy as np
 import pytest
+import yaml
 
 from triton_client_tpu.dataset_config import (
     client_params,
     detect3d_from_yaml,
+    load_yaml,
     model_config_from_dict,
     voxel_from_dict,
 )
@@ -98,3 +100,17 @@ def test_client_params_defaults_and_file():
     assert params["channel"] == "tpu"
     params = client_params("data/client_parameter.yaml")
     assert "sub_topic" in params and "pub_topic" in params
+
+
+def test_voxel_from_dict_unknown_key_fails():
+    with pytest.raises(KeyError, match="max_voxelz"):
+        voxel_from_dict({"max_voxelz": 99})
+
+
+def test_anchor_class_unknown_key_fails(tmp_path):
+    doc = load_yaml(REPO_KITTI)
+    doc["anchors"][0]["bottomz"] = -1.0
+    p = tmp_path / "bad.yaml"
+    p.write_text(yaml.safe_dump(doc))
+    with pytest.raises(KeyError, match="bottomz"):
+        detect3d_from_yaml(str(p))

@@ -113,16 +113,19 @@ def image_adjust(
             from triton_client_tpu.ops.preprocess import resize_bilinear
 
             arr = np.asarray(resize_bilinear(arr.astype(np.float32), (h, w)))
-    typed = arr.astype(model_dtype_to_np(dtype))
+    # Scale in f32, cast to the model dtype last: casting first wraps
+    # integer dtypes (VGG mean-subtract on uint8) and promotes the
+    # division modes to float64 regardless of the requested dtype.
     if scaling == "INCEPTION":
-        scaled = (typed / 127.5) - 1
+        scaled = (arr.astype(np.float32) / 127.5) - 1
     elif scaling == "VGG":
         mean = (128,) if c == 1 else (123, 117, 104)
-        scaled = typed - np.asarray(mean, typed.dtype)
+        scaled = arr.astype(np.float32) - np.asarray(mean, np.float32)
     elif scaling == "COCO":
-        scaled = typed / 255.0
+        scaled = arr.astype(np.float32) / 255.0
     else:
-        scaled = typed
+        scaled = arr
+    scaled = scaled.astype(model_dtype_to_np(dtype))
     if format == "NCHW":
         scaled = np.transpose(scaled, (2, 0, 1))
     return np.ascontiguousarray(scaled)

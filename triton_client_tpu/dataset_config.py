@@ -37,8 +37,18 @@ def _tup(v: Any) -> tuple:
     return tuple(v) if isinstance(v, (list, tuple)) else (v,)
 
 
+def _check_keys(d: Mapping[str, Any], cls, what: str) -> None:
+    known = {f.name for f in dataclasses.fields(cls)}
+    unknown = set(d) - known
+    if unknown:
+        raise KeyError(
+            f"unknown {what} keys {sorted(unknown)}; known: {sorted(known)}"
+        )
+
+
 def voxel_from_dict(d: Mapping[str, Any], base: VoxelConfig | None = None) -> VoxelConfig:
     base = base or VoxelConfig()
+    _check_keys(d, VoxelConfig, "voxel config")
     return dataclasses.replace(
         base,
         **{
@@ -54,6 +64,7 @@ def _anchor_classes(rows: list[Mapping[str, Any]]):
 
     out = []
     for r in rows:
+        _check_keys(r, AnchorClassConfig, f"anchor class {r.get('name', '?')!r}")
         out.append(
             AnchorClassConfig(
                 name=r["name"],

@@ -554,11 +554,18 @@ class BagReader:
         self, topics: list[str] | None = None, raw: bool = False
     ) -> Iterator[tuple[str, Any, float]]:
         """Yield ``(topic, msg, t)`` — rosbag.Bag.read_messages parity
-        (bag_inference2d.py:92). ``raw=True`` yields the BagMessage
-        (undecoded) instead of the deserialized message."""
+        (bag_inference2d.py:92): a falsy ``topics`` ([] or None) means
+        every topic, matching rosbag's truthiness check. ``raw=True``
+        yields the BagMessage (undecoded) instead of the deserialized
+        message."""
+        yield from self._scan(set(topics) if topics else None, raw)
+
+    def _scan(
+        self, want: set[str] | None, raw: bool = False
+    ) -> Iterator[tuple[str, Any, float]]:
+        """Record walk; ``want`` is the exact topic filter (empty set =
+        yield nothing, i.e. a connection-metadata-only scan)."""
         self._f.seek(len(MAGIC))
-        # [] means "no topics" (metadata-only scan), None means all.
-        want = set(topics) if topics is not None else None
         while True:
             rec = self._read_record_from_file()
             if rec is None:
@@ -591,7 +598,7 @@ class BagReader:
     def topics(self) -> dict[str, str]:
         """topic -> datatype map (raw scan — never decodes payloads, so
         unregistered message types in the bag are fine)."""
-        for _ in self.read_messages(topics=[], raw=True):
+        for _ in self._scan(set()):
             pass
         return {c.topic: c.datatype for c in self.connections.values()}
 
@@ -853,7 +860,7 @@ def pointcloud2_to_xyzi(msg: Any) -> np.ndarray:
         if f.name in ("x", "y", "z", "intensity")
     }
     rec = np.frombuffer(
-        buf.tobytes(),
+        buf,  # zero-copy view; the .astype below does the only copy
         dtype=np.dtype(
             {
                 "names": list(present),
