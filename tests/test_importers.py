@@ -1,0 +1,290 @@
+"""Weight importers: upstream checkpoint naming/layout -> flax trees.
+
+Round-trips synthesize a torch state_dict in the upstream naming from a
+flax init tree (inverse layout), convert back, and require exact
+equality — which proves every leaf is mapped, names don't collide, and
+the layout rules are involutive. Forward-parity tests run real torch
+modules (CPU) against the converted flax modules on the same input.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from triton_client_tpu.runtime import importers
+from triton_client_tpu.runtime.checkpoint import convert_state_dict
+from triton_client_tpu.runtime.onnx_reader import (
+    onnx_to_state_dict,
+    read_onnx_initializers,
+)
+
+
+def _flatten(tree):
+    out = {}
+
+    def visit(path, leaf):
+        out[tuple(str(getattr(p, "key", p)) for p in path)] = np.asarray(leaf)
+
+    jax.tree_util.tree_map_with_path(visit, tree)
+    return out
+
+
+def _inverse_leaf(path, value, transposed=False):
+    """flax leaf -> torch layout (inverse of torch_to_flax_leaf)."""
+    if path[-1] != "kernel":
+        return value
+    if value.ndim == 2:
+        return value.T
+    if value.ndim == 4:
+        if transposed:
+            return np.ascontiguousarray(value[::-1, ::-1]).transpose(2, 3, 0, 1)
+        return value.transpose(3, 2, 0, 1)
+    if value.ndim == 5:
+        return value.transpose(4, 3, 0, 1, 2)
+    return value
+
+
+def test_yolov5_name_map_spot_checks():
+    k = importers.yolov5_torch_key
+    assert k(("params", "stem", "conv", "kernel")) == "model.0.conv.weight"
+    assert (
+        k(("params", "c3_3", "m0", "cv1", "conv", "kernel"))
+        == "model.4.m.0.cv1.conv.weight"
+    )
+    assert k(("batch_stats", "sppf", "cv2", "bn", "mean")) == "model.9.cv2.bn.running_mean"
+    assert k(("params", "detect1", "kernel")) == "model.24.m.1.weight"
+    assert k(("params", "detect2", "bias")) == "model.24.m.2.bias"
+    assert k(("params", "c3_pan5", "cv3", "bn", "scale")) == "model.23.cv3.bn.weight"
+
+
+def test_yolov5_roundtrip_all_leaves():
+    from triton_client_tpu.models.yolov5 import init_yolov5
+
+    _, variables = init_yolov5(
+        jax.random.PRNGKey(0), num_classes=3, variant="n", input_hw=(64, 64)
+    )
+    flat = _flatten(variables)
+    state = {
+        importers.yolov5_torch_key(path): _inverse_leaf(path, leaf)
+        for path, leaf in flat.items()
+    }
+    assert len(state) == len(flat)  # no torch-key collisions
+    restored = importers.load_yolov5(state, variables)
+    for path, leaf in _flatten(restored).items():
+        np.testing.assert_array_equal(leaf, flat[path], err_msg=str(path))
+
+
+def test_yolov5_model_model_prefix_normalized():
+    from triton_client_tpu.models.yolov5 import init_yolov5
+
+    _, variables = init_yolov5(
+        jax.random.PRNGKey(0), num_classes=3, variant="n", input_hw=(64, 64)
+    )
+    flat = _flatten(variables)
+    # ultralytics full-model pickles prefix twice: model.model.0...
+    state = {
+        "model." + importers.yolov5_torch_key(p): _inverse_leaf(p, v)
+        for p, v in flat.items()
+    }
+    restored = importers.load_yolov5(state, variables)
+    np.testing.assert_array_equal(
+        _flatten(restored)[("params", "stem", "conv", "kernel")],
+        flat[("params", "stem", "conv", "kernel")],
+    )
+
+
+def test_pointpillars_name_map_spot_checks():
+    k = importers.pointpillars_torch_key
+    assert k(("params", "vfe", "linear", "kernel")) == "vfe.pfn_layers.0.linear.weight"
+    assert (
+        k(("batch_stats", "vfe", "bn", "var")) == "vfe.pfn_layers.0.norm.running_var"
+    )
+    assert (
+        k(("params", "backbone", "block0_down", "kernel"))
+        == "backbone_2d.blocks.0.1.weight"
+    )
+    assert (
+        k(("params", "backbone", "block1_conv2", "kernel"))
+        == "backbone_2d.blocks.1.10.weight"
+    )
+    assert (
+        k(("batch_stats", "backbone", "block1_bn2", "mean"))
+        == "backbone_2d.blocks.1.11.running_mean"
+    )
+    assert k(("params", "backbone", "up2", "kernel")) == "backbone_2d.deblocks.2.0.weight"
+    assert k(("params", "cls_head", "bias")) == "dense_head.conv_cls.bias"
+
+
+def test_pointpillars_roundtrip_all_leaves():
+    import dataclasses
+
+    from triton_client_tpu.models.pointpillars import (
+        PointPillarsConfig,
+        init_pointpillars,
+    )
+    from triton_client_tpu.ops.voxelize import VoxelConfig
+
+    cfg = PointPillarsConfig(
+        voxel=dataclasses.replace(VoxelConfig(), max_voxels=64)
+    )
+    _, variables = init_pointpillars(jax.random.PRNGKey(0), cfg)
+    flat = _flatten(variables)
+    state = {
+        importers.pointpillars_torch_key(path): _inverse_leaf(
+            path, leaf, transposed=importers._pp_is_transposed_conv(path)
+        )
+        for path, leaf in flat.items()
+    }
+    assert len(state) == len(flat)
+    restored = importers.load_pointpillars(state, variables)
+    for path, leaf in _flatten(restored).items():
+        np.testing.assert_array_equal(leaf, flat[path], err_msg=str(path))
+
+
+def test_conv_bn_act_forward_parity_vs_torch():
+    torch = pytest.importorskip("torch")
+    from triton_client_tpu.models.layers import ConvBnAct
+
+    tmod = torch.nn.Sequential()
+    tmod.add_module("conv", torch.nn.Conv2d(3, 8, 3, stride=1, padding=1, bias=False))
+    tmod.add_module("bn", torch.nn.BatchNorm2d(8, eps=1e-3))
+    tmod.eval()
+    with torch.no_grad():
+        tmod.bn.weight.mul_(1.3)
+        tmod.bn.bias.add_(0.2)
+        tmod.bn.running_mean.add_(0.1)
+        tmod.bn.running_var.mul_(1.7)
+
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((2, 6, 6, 3)).astype(np.float32)
+    with torch.no_grad():
+        ref = torch.nn.functional.silu(
+            tmod(torch.from_numpy(x.transpose(0, 3, 1, 2)))
+        ).numpy().transpose(0, 2, 3, 1)
+
+    fmod = ConvBnAct(8, kernel=3)
+    variables = fmod.init(jax.random.PRNGKey(0), jnp.asarray(x))
+    converted = convert_state_dict(
+        {k: v.detach().numpy() for k, v in tmod.state_dict().items()}, variables
+    )
+    out = fmod.apply(converted, jnp.asarray(x), train=False)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=2e-5)
+
+
+def test_conv_transpose_forward_parity_vs_torch():
+    torch = pytest.importorskip("torch")
+    import flax.linen as nn
+
+    tconv = torch.nn.ConvTranspose2d(3, 5, kernel_size=2, stride=2, bias=False)
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((1, 4, 4, 3)).astype(np.float32)
+    with torch.no_grad():
+        ref = tconv(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy().transpose(
+            0, 2, 3, 1
+        )
+
+    fmod = nn.ConvTranspose(5, (2, 2), strides=(2, 2), use_bias=False)
+    variables = fmod.init(jax.random.PRNGKey(0), jnp.asarray(x))
+    converted = convert_state_dict(
+        {"weight": tconv.weight.detach().numpy()},
+        variables,
+        name_map=lambda path: "weight",
+        transposed_conv=lambda path: True,
+    )
+    out = fmod.apply(converted, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(out), ref, atol=1e-5)
+
+
+# --- minimal ONNX protobuf encoding helpers (test-side writer) ---
+
+
+def _varint(v: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint(field << 3 | wire)
+
+
+def _ld(field: int, payload: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(payload)) + payload
+
+
+def _tensor_raw(name: str, arr: np.ndarray, data_type: int) -> bytes:
+    body = b"".join(_tag(1, 0) + _varint(d) for d in arr.shape)
+    body += _tag(2, 0) + _varint(data_type)
+    body += _ld(8, name.encode())
+    body += _ld(9, arr.tobytes())
+    return body
+
+
+def test_onnx_reader_raw_and_typed_data(tmp_path):
+    w = np.arange(24, dtype=np.float32).reshape(2, 3, 2, 2)
+    b = np.asarray([1.5, -2.5], np.float16)
+    # float_data (packed field 4) variant
+    fd = np.asarray([3.0, 4.0, 5.0], np.float32)
+    t3 = _tag(1, 0) + _varint(3)
+    t3 += _tag(2, 0) + _varint(1)
+    t3 += _ld(8, b"fd_tensor")
+    t3 += _ld(4, fd.tobytes())
+    graph = (
+        _ld(5, _tensor_raw("model.0.conv.weight", w, 1))
+        + _ld(5, _tensor_raw("model.0.conv.bias_fp16", b, 10))
+        + _ld(5, t3)
+    )
+    model = _ld(7, graph)
+    p = tmp_path / "tiny.onnx"
+    p.write_bytes(model)
+
+    tensors = read_onnx_initializers(str(p))
+    np.testing.assert_array_equal(tensors["model.0.conv.weight"], w)
+    np.testing.assert_array_equal(tensors["model.0.conv.bias_fp16"], b)
+    np.testing.assert_array_equal(tensors["fd_tensor"], fd)
+
+    sd = onnx_to_state_dict({"/model.0/conv.weight": w})
+    assert list(sd) == ["model.0/conv.weight"]
+
+
+def test_onnx_reader_int64_dims_and_data():
+    vals = np.asarray([-3, 7, 1 << 40], np.int64)
+    body = _tag(1, 0) + _varint(3)
+    body += _tag(2, 0) + _varint(7)  # INT64
+    body += _ld(8, b"ints")
+    packed = b"".join(_varint(v & ((1 << 64) - 1)) for v in vals.tolist())
+    body += _ld(7, packed)
+    model = _ld(7, _ld(5, body))
+    tensors = read_onnx_initializers(model)
+    np.testing.assert_array_equal(tensors["ints"], vals)
+
+
+def test_onnx_reader_fp16_bit_patterns_in_int32_data():
+    # ONNX stores FLOAT16 typed data as bit patterns in int32_data.
+    vals = np.asarray([1.5, -2.0], np.float16)
+    body = _tag(1, 0) + _varint(2)
+    body += _tag(2, 0) + _varint(10)  # FLOAT16
+    body += _ld(8, b"halfs")
+    packed = b"".join(_varint(int(v)) for v in vals.view(np.uint16))
+    body += _ld(5, packed)
+    tensors = read_onnx_initializers(_ld(7, _ld(5, body)))
+    np.testing.assert_array_equal(tensors["halfs"], vals)
+
+
+def test_onnx_reader_negative_int32_data():
+    vals = np.asarray([-1, -128, 127], np.int32)
+    body = _tag(1, 0) + _varint(3)
+    body += _tag(2, 0) + _varint(6)  # INT32
+    body += _ld(8, b"negs")
+    packed = b"".join(_varint(int(v) & ((1 << 64) - 1)) for v in vals.tolist())
+    body += _ld(5, packed)
+    tensors = read_onnx_initializers(_ld(7, _ld(5, body)))
+    np.testing.assert_array_equal(tensors["negs"], vals)

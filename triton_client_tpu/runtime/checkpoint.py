@@ -95,6 +95,7 @@ def torch_to_flax_leaf(
     value: np.ndarray,
     flax_shape,
     leaf_name: str | None = None,
+    transposed_conv: bool = False,
 ) -> np.ndarray:
     """Layout-convert one torch tensor to a flax leaf shape.
 
@@ -104,6 +105,10 @@ def torch_to_flax_leaf(
         when the tensor is square and the shapes already match (a
         square Linear weight is shape-ambiguous, so shape checking
         alone would silently skip the transpose);
+      * ``transposed_conv`` kernels use torch ConvTranspose's (in, out,
+        kH, kW) layout -> flax's (kH, kW, in, out) — a DIFFERENT axis
+        order than regular convs, and shape-indistinguishable from one
+        when in == out, so callers must flag those paths explicitly;
       * everything else (biases, BN scale/bias/stats): passthrough;
       * without ``leaf_name`` (legacy callers) fall back to
         shape-directed heuristics.
@@ -114,7 +119,13 @@ def torch_to_flax_leaf(
         if value.ndim == 2:
             out = value.T  # (out, in) -> (in, out)
         elif value.ndim == 4:
-            out = value.transpose(2, 3, 1, 0)  # OIHW -> HWIO
+            if transposed_conv:
+                # IOHW -> HWIO plus a spatial flip: torch ConvTranspose
+                # convolves with the flipped kernel (gradient-of-conv),
+                # flax's lax.conv_transpose does not flip.
+                out = np.ascontiguousarray(value.transpose(2, 3, 0, 1)[::-1, ::-1])
+            else:
+                out = value.transpose(2, 3, 1, 0)  # OIHW -> HWIO
         elif value.ndim == 5:
             out = value.transpose(2, 3, 4, 1, 0)  # OIDHW -> DHWIO
         else:
@@ -173,6 +184,7 @@ def convert_state_dict(
     variables: Mapping,
     name_map: Callable[[tuple[str, ...]], str] = default_name_map,
     strict: bool = True,
+    transposed_conv: Callable[[tuple[str, ...]], bool] | None = None,
 ) -> dict:
     """torch state_dict -> flax variables with the target's structure.
 
@@ -180,6 +192,8 @@ def convert_state_dict(
     resolves each leaf's torch key via ``name_map``, converts layout,
     and returns a new tree. With strict=False, missing torch keys keep
     the template's (random-init) leaf and are logged.
+    ``transposed_conv`` marks flax paths whose torch source is a
+    ConvTranspose (different kernel axis order).
     """
     missing = []
     used = set()
@@ -192,6 +206,7 @@ def convert_state_dict(
             return torch_to_flax_leaf(
                 torch_key, state_dict[torch_key], leaf.shape,
                 leaf_name=key_path[-1],
+                transposed_conv=bool(transposed_conv and transposed_conv(key_path)),
             )
         missing.append(torch_key)
         return leaf

@@ -1,0 +1,168 @@
+"""Model-specific weight importers: upstream checkpoints -> flax trees.
+
+The reference never converts weights client-side — the server loads
+.pth (examples/pointpillar_kitti/1/model.py:93-112) or serves .onnx /
+.pt artifacts declared in config.pbtxt (examples/YOLOv5/config.pbtxt:2),
+with deploy.sh doing pth->ONNX conversion offline (deploy.sh:56-65).
+Here the models run in JAX, so importing the SAME upstream artifacts is
+the mAP-parity bridge (SURVEY.md §7 hard part (e)): these functions map
+published checkpoint naming (ultralytics YOLOv5, OpenPCDet PointPillars,
+ONNX initializer graphs) onto our flax module trees via
+checkpoint.convert_state_dict's layout rules.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+from typing import Any, Mapping
+
+from triton_client_tpu.runtime.checkpoint import (
+    convert_state_dict,
+    default_name_map,
+    load_torch_checkpoint,
+)
+
+log = logging.getLogger(__name__)
+
+# Our yolov5 module name -> ultralytics yolov5 layer index ("model.N").
+# The index layout is fixed across ultralytics v5.x n/s/m/l variants
+# (yolov5 models/yolov5n.yaml): backbone 0-9, head 10-23, detect 24;
+# indices 11/15 are Upsample and 12/16/19/22 are Concat (no params).
+_YOLOV5_LAYER_IDX = {
+    "stem": 0,
+    "down2": 1,
+    "c3_2": 2,
+    "down3": 3,
+    "c3_3": 4,
+    "down4": 5,
+    "c3_4": 6,
+    "down5": 7,
+    "c3_5": 8,
+    "sppf": 9,
+    "lat5": 10,
+    "c3_up4": 13,
+    "lat4": 14,
+    "c3_up3": 17,
+    "pan3": 18,
+    "c3_pan4": 20,
+    "pan4": 21,
+    "c3_pan5": 23,
+}
+
+_BOTTLENECK_RE = re.compile(r"^m(\d+)$")
+
+
+def yolov5_torch_key(path: tuple[str, ...]) -> str:
+    """flax yolov5 path -> ultralytics state_dict key.
+
+    ('params','c3_3','m0','cv1','conv','kernel')
+        -> 'model.4.m.0.cv1.conv.weight'
+    ('params','detect1','kernel') -> 'model.24.m.1.weight'
+    """
+    parts = [p for p in path if p not in ("params", "batch_stats")]
+    head, *rest = parts
+    if head.startswith("detect"):
+        scale = head[len("detect"):]
+        leaf = {"kernel": "weight", "bias": "bias"}[parts[-1]]
+        return f"model.24.m.{scale}.{leaf}"
+    idx = _YOLOV5_LAYER_IDX[head]
+    mapped = []
+    for p in rest[:-1]:
+        m = _BOTTLENECK_RE.match(p)
+        mapped.append(f"m.{m.group(1)}" if m else p)
+    return ".".join([f"model.{idx}", *mapped, default_name_map((rest[-1],))])
+
+
+def load_yolov5(path_or_state: Any, variables: Mapping, strict: bool = True) -> dict:
+    """Ultralytics YOLOv5 checkpoint (.pt path or state_dict) -> flax
+    variables shaped like ``variables`` (from init_yolov5)."""
+    state = _as_state_dict(path_or_state)
+    # Ultralytics .pt stores the full pickled model; its state_dict keys
+    # may carry a 'model.' prefix already ('model.model.0...').
+    state = _strip_prefix(state, "model.model.", "model.")
+    return convert_state_dict(state, variables, name_map=yolov5_torch_key, strict=strict)
+
+
+# --- PointPillars (OpenPCDet naming, tools/cfgs/kitti_models/pointpillar.yaml) ---
+
+_PP_BLOCK_DOWN = re.compile(r"^block(\d+)_down(_bn)?$")
+_PP_BLOCK_CONV = re.compile(r"^block(\d+)_(conv|bn)(\d+)$")
+_PP_UP = re.compile(r"^up(\d+)(_bn)?$")
+_PP_HEADS = {
+    "cls_head": "dense_head.conv_cls",
+    "box_head": "dense_head.conv_box",
+    "dir_head": "dense_head.conv_dir_cls",
+}
+
+
+def pointpillars_torch_key(path: tuple[str, ...]) -> str:
+    """flax PointPillars path -> OpenPCDet state_dict key.
+
+    OpenPCDet's BaseBEVBackbone builds each block as
+    Sequential(ZeroPad2d, Conv2d, BN, ReLU, [Conv2d, BN, ReLU] * L)
+    (pcdet/models/backbones_2d/base_bev_backbone.py), so the down conv
+    sits at index 1, its BN at 2, and layer li's conv/BN at 4+3*li /
+    5+3*li. Deblocks are Sequential(ConvTranspose2d, BN, ReLU).
+    """
+    parts = [p for p in path if p not in ("params", "batch_stats")]
+    head, *rest = parts
+    leaf = default_name_map((parts[-1],))
+    if head == "vfe":
+        # PillarVFE keeps one PFNLayer; OpenPCDet names its BN 'norm'.
+        sub = "linear" if rest[0] == "linear" else "norm"
+        return f"vfe.pfn_layers.0.{sub}.{leaf}"
+    if head in _PP_HEADS:
+        return f"{_PP_HEADS[head]}.{leaf}"
+    if head == "backbone":
+        name = rest[0]
+        m = _PP_BLOCK_DOWN.match(name)
+        if m:
+            b, is_bn = m.group(1), bool(m.group(2))
+            return f"backbone_2d.blocks.{b}.{2 if is_bn else 1}.{leaf}"
+        m = _PP_BLOCK_CONV.match(name)
+        if m:
+            b, kind, li = m.group(1), m.group(2), int(m.group(3))
+            idx = 4 + 3 * li if kind == "conv" else 5 + 3 * li
+            return f"backbone_2d.blocks.{b}.{idx}.{leaf}"
+        m = _PP_UP.match(name)
+        if m:
+            b, is_bn = m.group(1), bool(m.group(2))
+            return f"backbone_2d.deblocks.{b}.{1 if is_bn else 0}.{leaf}"
+    raise KeyError(f"unmapped PointPillars path: {path}")
+
+
+def _pp_is_transposed_conv(path: tuple[str, ...]) -> bool:
+    return any(_PP_UP.match(p) and not p.endswith("_bn") for p in path)
+
+
+def load_pointpillars(path_or_state: Any, variables: Mapping, strict: bool = True) -> dict:
+    """OpenPCDet PointPillars checkpoint -> flax variables."""
+    state = _as_state_dict(path_or_state)
+    return convert_state_dict(
+        state,
+        variables,
+        name_map=pointpillars_torch_key,
+        strict=strict,
+        transposed_conv=_pp_is_transposed_conv,
+    )
+
+
+def _as_state_dict(path_or_state: Any) -> Mapping[str, Any]:
+    if isinstance(path_or_state, Mapping):
+        return path_or_state
+    return load_torch_checkpoint(path_or_state)
+
+
+def _strip_prefix(state: Mapping[str, Any], *prefixes: str) -> dict:
+    """Normalize keys to the longest matching prefix removed + re-added
+    canonical 'model.' (ultralytics wraps the Detection model once or
+    twice depending on export path)."""
+    out = dict(state)
+    for prefix in prefixes:
+        if any(k.startswith(prefix) for k in out):
+            return {
+                ("model." + k[len(prefix):] if k.startswith(prefix) else k): v
+                for k, v in out.items()
+            }
+    return out
