@@ -4,6 +4,7 @@ Commands map 1:1 onto the reference's entry scripts:
   detect2d   — main.py / bag2d.py (live vs replay chosen by --input)
   detect3d   — main3d.py / bag3d.py
   evaluate   — evaluate.py
+  serve      — tritonserver --model-repository equivalent (KServe v2)
   pc-extract — tools/pc_extractor.py (bag -> .npy point clouds)
   bag-stitch — tools/bag_stitch.py (truncate a bag)
   bag-info   — rosbag info equivalent
@@ -17,6 +18,7 @@ COMMANDS = (
     "detect2d",
     "detect3d",
     "evaluate",
+    "serve",
     "pc-extract",
     "bag-stitch",
     "bag-info",
@@ -35,6 +37,8 @@ def main() -> None:
         from triton_client_tpu.cli.detect3d import main as run
     elif cmd == "evaluate":
         from triton_client_tpu.cli.evaluate import main as run
+    elif cmd == "serve":
+        from triton_client_tpu.cli.serve import main as run
     elif cmd == "pc-extract":
         from triton_client_tpu.cli.tools import pc_extract as run
     elif cmd == "bag-stitch":

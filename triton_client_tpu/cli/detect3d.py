@@ -51,17 +51,9 @@ def main(argv=None) -> None:
 
     from triton_client_tpu.drivers.driver import InferenceDriver, detect3d_infer
     from triton_client_tpu.pipelines.detect3d import (
-        Detect3DConfig,
-        build_centerpoint_pipeline,
-        build_pointpillars_pipeline,
-        build_second_pipeline,
+        BUILDERS_3D as builders,
+        default_detect3d_config,
     )
-
-    builders = {
-        "pointpillars": build_pointpillars_pipeline,
-        "second_iou": build_second_pipeline,
-        "centerpoint": build_centerpoint_pipeline,
-    }
     model_cfg = None
     if args.config:
         from triton_client_tpu.dataset_config import detect3d_from_yaml
@@ -69,11 +61,7 @@ def main(argv=None) -> None:
         name, model_cfg, cfg = detect3d_from_yaml(args.config)
     else:
         name = args.model_name or "pointpillars"
-        cfg = Detect3DConfig(model_name=name)
-        if name == "centerpoint":
-            # class_names are reconciled from the model config inside the
-            # builder; only the peak-NMS-appropriate IoU gate is set here.
-            cfg = dataclasses.replace(cfg, iou_thresh=0.2)
+        cfg = default_detect3d_config(name)
     # explicitly-passed CLI flags win over config-file/default values
     if args.score is not None:
         cfg = dataclasses.replace(cfg, score_thresh=args.score)

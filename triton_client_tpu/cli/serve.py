@@ -1,0 +1,59 @@
+"""``serve`` entry point: disk model repository -> KServe v2 gRPC server.
+
+The reference's serving process is ``tritonserver
+--model-repository=/opt/model_repo`` inside the server containers
+(docker/server/Dockerfile:131-135, README.md:66). This is that process
+for the TPU runtime: scan the repository layout, jit every model onto
+the mesh, serve the KServe v2 protocol so the reference's ROS tooling
+(and our GRPCChannel) connects unchanged.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description="TPU inference server")
+    p.add_argument(
+        "-r", "--model-repository", required=True,
+        help="model repository root (examples/ layout)",
+    )
+    p.add_argument("-a", "--address", default="0.0.0.0:8001")
+    p.add_argument("--max-workers", type=int, default=8)
+    p.add_argument(
+        "--warmup", action="store_true",
+        help="compile every registered model before accepting requests",
+    )
+    p.add_argument("-v", "--verbose", action="store_true")
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.DEBUG if args.verbose else logging.INFO)
+
+    from triton_client_tpu.channel.tpu_channel import TPUChannel
+    from triton_client_tpu.runtime.disk_repository import scan_disk
+    from triton_client_tpu.runtime.server import InferenceServer
+
+    repo = scan_disk(args.model_repository)
+    for name, version in repo.list_models():
+        model = repo.get(name, version)
+        print(f"loaded {name}:{version} ({model.spec.platform})")
+        if args.warmup and model.warmup is not None:
+            model.warmup()
+
+    server = InferenceServer(
+        repo,
+        TPUChannel(repo),
+        address=args.address,
+        max_workers=args.max_workers,
+    )
+    server.start()
+    print(f"KServe v2 gRPC server listening on port {server.port}")
+    try:
+        server.wait()
+    except KeyboardInterrupt:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -143,19 +143,13 @@ def detect3d_from_yaml(path: str):
         pipeline: {score_thresh: ..., z_offset: ..., ...}
         <field>: <model-config override>
     """
-    from triton_client_tpu.pipelines.detect3d import Detect3DConfig
+    from triton_client_tpu.pipelines.detect3d import default_detect3d_config
 
     doc = load_yaml(path)
     model = doc.pop("model", "pointpillars")
     pipe_d = dict(doc.pop("pipeline", {}))
     model_cfg = model_config_from_dict(model, doc)
-    # model-appropriate NMS default: heatmap-peak models only need to
-    # kill duplicate peaks (mirrors build_centerpoint_pipeline's default)
-    if model == "centerpoint" and "iou_thresh" not in pipe_d:
-        pipe_d["iou_thresh"] = 0.2
-    pipe_cfg = _apply_overrides(
-        Detect3DConfig(model_name=model), pipe_d, _SEQ_KEYS
-    )
+    pipe_cfg = _apply_overrides(default_detect3d_config(model), pipe_d, _SEQ_KEYS)
     # Keep label vocabulary consistent with the model's classes.
     names = getattr(model_cfg, "class_names", None)
     if names is None and hasattr(model_cfg, "anchor_classes"):

@@ -351,3 +351,13 @@ def _detectron_spec(cfg: Detect2DConfig) -> ModelSpec:
             "scaling": cfg.scaling,
         },
     )
+
+
+# family name -> builder; the single dispatch table shared by the CLI
+# entry points and the disk model repository.
+BUILDERS_2D = {
+    "yolov5": build_yolov5_pipeline,
+    "yolov4": build_yolov4_pipeline,
+    "retinanet": build_retinanet_pipeline,
+    "fcos": build_fcos_pipeline,
+}

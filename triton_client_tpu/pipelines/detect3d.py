@@ -221,12 +221,7 @@ def build_centerpoint_pipeline(
         )
     else:
         model = CenterPoint(model_cfg, dtype=dtype)
-    if config is None:
-        # Center-heatmap models pre-NMS via local peaks; box NMS only
-        # needs to kill duplicate peaks, so a higher IoU gate is right.
-        cfg = Detect3DConfig(model_name="centerpoint", iou_thresh=0.2)
-    else:
-        cfg = config
+    cfg = config if config is not None else default_detect3d_config("centerpoint")
     # class_names derive from the MODEL config — reconcile so a caller
     # config built with the KITTI defaults can't mislabel nuScenes
     # predictions (pred_labels range over model_cfg.class_names).
@@ -235,3 +230,21 @@ def build_centerpoint_pipeline(
     pipeline = Detect3DPipeline(cfg, model, variables)
     spec = _detect3d_spec(cfg, model_cfg, {"with_velocity": model_cfg.with_velocity})
     return pipeline, spec, variables
+
+
+def default_detect3d_config(model_name: str) -> Detect3DConfig:
+    """Single source of per-family pipeline defaults. Center-heatmap
+    models pre-NMS via local peaks, so box NMS only needs to kill
+    duplicate peaks (higher IoU gate)."""
+    if model_name == "centerpoint":
+        return Detect3DConfig(model_name=model_name, iou_thresh=0.2)
+    return Detect3DConfig(model_name=model_name)
+
+
+# family name -> builder; the single dispatch table shared by the CLI
+# entry points and the disk model repository.
+BUILDERS_3D = {
+    "pointpillars": build_pointpillars_pipeline,
+    "second_iou": build_second_pipeline,
+    "centerpoint": build_centerpoint_pipeline,
+}
