@@ -58,11 +58,15 @@ def test_versions_latest_wins_and_weights_load(tmp_path):
     rm = dr.build_model(d)  # template for weight synthesis
     from triton_client_tpu.pipelines.detect2d import build_yolov5_pipeline
 
+    _, _, v1_vars = build_yolov5_pipeline(
+        jax.random.PRNGKey(9), variant="n", num_classes=2, input_hw=(64, 64)
+    )
     _, _, variables = build_yolov5_pipeline(
         jax.random.PRNGKey(3), variant="n", num_classes=2, input_hw=(64, 64)
     )
     for v in ("1", "2"):
         (d / v).mkdir()
+    dr.save_flax_weights(d / "1" / "weights.msgpack", v1_vars)
     dr.save_flax_weights(d / "2" / "weights.msgpack", variables)
 
     repo = dr.scan_disk(tmp_path)
@@ -162,6 +166,14 @@ def test_examples_yolov5_builds_and_infers():
     assert rm.spec.max_batch_size == 8
     out = rm.infer_fn({"images": np.zeros((1, 64, 64, 3), np.float32)})
     assert out["detections"].shape[-1] == 6
+
+
+def test_version_dir_without_weights_fails_loudly(tmp_path):
+    d = _write_model(tmp_path, "tiny_yolo", TINY_2D)
+    (d / "1").mkdir()
+    (d / "1" / "yolov5n.pt").write_bytes(b"x")  # unrecognized name
+    with pytest.raises(FileNotFoundError, match="yolov5n.pt"):
+        dr.scan_disk(tmp_path)
 
 
 def test_warmup_compiles_native_shape(tmp_path):

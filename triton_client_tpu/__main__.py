@@ -5,6 +5,8 @@ Commands map 1:1 onto the reference's entry scripts:
   detect3d   — main3d.py / bag3d.py
   evaluate   — evaluate.py
   serve      — tritonserver --model-repository equivalent (KServe v2)
+  deploy     — deploy.sh parity (convert checkpoint -> push repo entry)
+  fetch-model — download_model_s3_keycloak.py parity (OIDC + S3)
   pc-extract — tools/pc_extractor.py (bag -> .npy point clouds)
   bag-stitch — tools/bag_stitch.py (truncate a bag)
   bag-info   — rosbag info equivalent
@@ -19,6 +21,8 @@ COMMANDS = (
     "detect3d",
     "evaluate",
     "serve",
+    "deploy",
+    "fetch-model",
     "pc-extract",
     "bag-stitch",
     "bag-info",
@@ -39,6 +43,10 @@ def main() -> None:
         from triton_client_tpu.cli.evaluate import main as run
     elif cmd == "serve":
         from triton_client_tpu.cli.serve import main as run
+    elif cmd == "deploy":
+        from triton_client_tpu.deploy.push import main as run
+    elif cmd == "fetch-model":
+        from triton_client_tpu.deploy.fetch import main as run
     elif cmd == "pc-extract":
         from triton_client_tpu.cli.tools import pc_extract as run
     elif cmd == "bag-stitch":

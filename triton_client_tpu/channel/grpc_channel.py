@@ -81,6 +81,8 @@ class GRPCChannel(BaseChannel):
             self._stub.ModelConfig,
             pb.ModelConfigRequest(name=model_name, version=model_version),
         ).config
+        import json
+
         spec = ModelSpec(
             name=meta.name,
             version=model_version or (meta.versions[-1] if meta.versions else "1"),
@@ -92,6 +94,7 @@ class GRPCChannel(BaseChannel):
                 TensorSpec(t.name, tuple(t.shape), t.datatype) for t in meta.outputs
             ),
             max_batch_size=config.max_batch_size,
+            extra={k: json.loads(v) for k, v in config.parameters.items()},
         )
         needed = 2 * spec.wire_bytes() + FRAMING_BYTES
         if needed > self._max_message_bytes:

@@ -20,6 +20,12 @@ import numpy as np
 def add_common_flags(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("-m", "--model-name", default="", help="served model name")
     parser.add_argument("-x", "--model-version", default="", help="model version")
+    parser.add_argument(
+        "-u", "--channel", default="tpu", dest="channel",
+        help="inference channel: 'tpu' (in-process jit, default) or "
+        "'grpc:<host:port>' (remote KServe v2 server — the reference's "
+        "-u server URL, main.py:51-113)",
+    )
     parser.add_argument("-b", "--batch-size", type=int, default=1)
     parser.add_argument(
         "-c", "--classes", type=int, default=80, help="number of classes"

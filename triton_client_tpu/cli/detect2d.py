@@ -129,17 +129,34 @@ def main(argv=None) -> None:
             "jsk box arrays, bag_inference3d.py:182-183); use --sink "
             "images or jsonl"
         )
-    pipe, spec = build(args)
-    class_names = load_names(args.names)
-
-    from triton_client_tpu.channel.tpu_channel import TPUChannel
     from triton_client_tpu.drivers.driver import InferenceDriver, channel_infer
-    from triton_client_tpu.runtime.repository import ModelRepository
 
-    repo = ModelRepository()
-    repo.register(spec, pipe.infer_fn())
-    channel = TPUChannel(repo)
-    infer = channel_infer(channel, spec.name)
+    if args.channel.startswith("grpc:"):
+        # Remote mode: the reference's actual topology — model runs in
+        # the serving process, this client only decodes/draws/publishes.
+        if not args.model_name:
+            raise SystemExit("--channel grpc:... requires -m/--model-name")
+        from triton_client_tpu.channel.grpc_channel import GRPCChannel
+
+        channel = GRPCChannel(args.channel[len("grpc:"):])
+        spec = channel.get_metadata(args.model_name, args.model_version)
+        class_names = load_names(args.names) or tuple(
+            spec.extra.get("class_names", ())
+        )
+        infer = channel_infer(
+            channel, args.model_name, model_version=args.model_version
+        )
+    else:
+        pipe, spec = build(args)
+        class_names = load_names(args.names)
+
+        from triton_client_tpu.channel.tpu_channel import TPUChannel
+        from triton_client_tpu.runtime.repository import ModelRepository
+
+        repo = ModelRepository()
+        repo.register(spec, pipe.infer_fn())
+        channel = TPUChannel(repo)
+        infer = channel_infer(channel, spec.name)
 
     if args.input.startswith("ros:"):
         from triton_client_tpu.drivers import ros

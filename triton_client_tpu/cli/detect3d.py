@@ -49,11 +49,39 @@ def main(argv=None) -> None:
             "annotated frames); use --sink jsonl"
         )
 
-    from triton_client_tpu.drivers.driver import InferenceDriver, detect3d_infer
+    from triton_client_tpu.drivers.driver import (
+        InferenceDriver,
+        channel_infer3d,
+        detect3d_infer,
+    )
     from triton_client_tpu.pipelines.detect3d import (
         BUILDERS_3D as builders,
         default_detect3d_config,
     )
+
+    if args.channel.startswith("grpc:"):
+        if not args.model_name:
+            raise SystemExit("--channel grpc:... requires -m/--model-name")
+        if args.config or args.score is not None:
+            # Thresholds/model config are baked into the SERVER's jitted
+            # pipeline (the repo entry's config.yaml) — silently
+            # accepting them here would mislead.
+            raise SystemExit(
+                "--config/--score are server-side in remote mode: set them "
+                "in the model repository entry's config.yaml"
+            )
+        from triton_client_tpu.channel.grpc_channel import GRPCChannel
+
+        channel = GRPCChannel(args.channel[len("grpc:"):])
+        infer = channel_infer3d(
+            channel,
+            args.model_name,
+            model_version=args.model_version,
+            z_offset=args.z_offset,  # None -> served metadata value
+        )
+        _run_3d(args, infer, args.model_name)
+        return
+
     model_cfg = None
     if args.config:
         from triton_client_tpu.dataset_config import detect3d_from_yaml
@@ -73,7 +101,12 @@ def main(argv=None) -> None:
         jax.random.PRNGKey(0), model_cfg=model_cfg, config=cfg
     )
     infer = detect3d_infer(pipe)
+    _run_3d(args, infer, spec.name)
 
+
+def _run_3d(args, infer, model_name: str) -> None:
+    """Shared driver tail for local (TPUChannel) and remote (gRPC)
+    modes: ROS subscriber or pull-driven file/bag source."""
     if args.input.startswith("ros:"):
         from triton_client_tpu.drivers import ros
 
@@ -85,6 +118,7 @@ def main(argv=None) -> None:
         node.spin()
         return
 
+    from triton_client_tpu.drivers.driver import InferenceDriver
     from triton_client_tpu.io.sources import open_source
 
     source = open_source(args.input, args.limit, kind="pointcloud")
@@ -96,7 +130,7 @@ def main(argv=None) -> None:
         warmup=args.warmup,
     )
     stats = driver.run(max_frames=args.limit)
-    print_report(stats, None, {"model": spec.name})
+    print_report(stats, None, {"model": model_name})
 
 
 if __name__ == "__main__":

@@ -1,0 +1,8 @@
+"""Deployment tooling (SURVEY.md §2 #23 parity).
+
+- fetch: model provisioning from S3/MinIO behind Keycloak OIDC
+  (docker/server/utils/download_model_s3_keycloak.py), no boto3 —
+  urllib + hand-rolled AWS SigV4.
+- push: deploy.sh parity — convert a checkpoint, materialize a model
+  repository entry, sync it to a (remote) model repo.
+"""

@@ -1,0 +1,267 @@
+"""Model provisioning: Keycloak OIDC -> MinIO STS -> SigV4 S3 download.
+
+Parity with the reference's server-image fetch tool
+(docker/server/utils/download_model_s3_keycloak.py): authenticate a
+user against Keycloak (OIDC password grant), trade the access token for
+temporary S3 credentials via MinIO's STS AssumeRoleWithWebIdentity, and
+download the model object. The reference uses boto3 + python-keycloak;
+neither is in this image, so the wire protocols are implemented
+directly (urllib + hmac SigV4) — which also drops ~100 MB of
+dependency from the server image.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime
+import hashlib
+import hmac
+import json
+import pathlib
+import urllib.parse
+import urllib.request
+import xml.etree.ElementTree as ET
+
+_EMPTY_SHA256 = hashlib.sha256(b"").hexdigest()
+
+
+def _post_form(url: str, fields: dict[str, str], timeout: float = 30.0) -> bytes:
+    data = urllib.parse.urlencode(fields).encode()
+    req = urllib.request.Request(
+        url, data=data,
+        headers={"Content-Type": "application/x-www-form-urlencoded"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.read()
+
+
+def keycloak_token(
+    server_url: str,
+    realm: str,
+    username: str,
+    password: str,
+    client_id: str = "account",
+    client_secret: str | None = None,
+    timeout: float = 30.0,
+) -> dict[str, str]:
+    """OIDC password grant -> {'access_token', 'refresh_token', ...}.
+
+    ``server_url`` may be the legacy '/auth/' base the reference
+    defaults to (download_model_s3_keycloak.py:41) or a modern root.
+    """
+    base = server_url.rstrip("/")
+    url = f"{base}/realms/{realm}/protocol/openid-connect/token"
+    fields = {
+        "grant_type": "password",
+        "client_id": client_id,
+        "username": username,
+        "password": password,
+    }
+    if client_secret:
+        fields["client_secret"] = client_secret
+    return json.loads(_post_form(url, fields, timeout))
+
+
+@dataclasses.dataclass(frozen=True)
+class S3Credentials:
+    access_key: str
+    secret_key: str
+    session_token: str = ""
+
+
+def sts_assume_role_web_identity(
+    endpoint_url: str,
+    web_identity_token: str,
+    role_arn: str = "arn:aws:iam::123456789",
+    session_name: str = "minios3",
+    duration_s: int = 3600,
+    timeout: float = 30.0,
+) -> S3Credentials:
+    """MinIO STS AssumeRoleWithWebIdentity -> temporary S3 credentials
+    (the reference's boto3 sts.assume_role_with_web_identity,
+    download_model_s3_keycloak.py:128-142)."""
+    body = _post_form(
+        endpoint_url,
+        {
+            "Action": "AssumeRoleWithWebIdentity",
+            "Version": "2011-06-15",
+            "WebIdentityToken": web_identity_token,
+            "RoleArn": role_arn,
+            "RoleSessionName": session_name,
+            "DurationSeconds": str(duration_s),
+        },
+        timeout,
+    )
+    root = ET.fromstring(body)
+    ns = ""
+    if root.tag.startswith("{"):
+        ns = root.tag[: root.tag.index("}") + 1]
+    creds = root.find(f".//{ns}Credentials")
+    if creds is None:
+        raise ValueError(f"STS response has no Credentials element: {body[:200]!r}")
+
+    def field(name: str) -> str:
+        el = creds.find(f"{ns}{name}")
+        return el.text if el is not None and el.text else ""
+
+    return S3Credentials(
+        access_key=field("AccessKeyId"),
+        secret_key=field("SecretAccessKey"),
+        session_token=field("SessionToken"),
+    )
+
+
+def sigv4_headers(
+    method: str,
+    url: str,
+    creds: S3Credentials,
+    region: str = "us-east-1",
+    service: str = "s3",
+    payload_hash: str = _EMPTY_SHA256,
+    now: datetime.datetime | None = None,
+) -> dict[str, str]:
+    """AWS Signature Version 4 headers for one request (the part boto3
+    did for the reference; Config(signature_version='s3v4'))."""
+    parsed = urllib.parse.urlsplit(url)
+    host = parsed.netloc
+    now = now or datetime.datetime.now(datetime.timezone.utc)
+    amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+    datestamp = now.strftime("%Y%m%d")
+
+    headers = {
+        "host": host,
+        "x-amz-content-sha256": payload_hash,
+        "x-amz-date": amz_date,
+    }
+    if creds.session_token:
+        headers["x-amz-security-token"] = creds.session_token
+
+    signed_names = sorted(headers)
+    canonical_headers = "".join(f"{k}:{headers[k]}\n" for k in signed_names)
+    signed_headers = ";".join(signed_names)
+    canonical_query = "&".join(
+        f"{urllib.parse.quote(k, safe='')}={urllib.parse.quote(v, safe='')}"
+        for k, v in sorted(urllib.parse.parse_qsl(parsed.query, keep_blank_values=True))
+    )
+    # The path arrives already percent-encoded (it is what goes on the
+    # wire); re-quoting here would double-encode (%20 -> %2520) and
+    # break the signature for keys with spaces etc. S3-style SigV4
+    # signs the path as sent.
+    canonical_request = "\n".join(
+        [
+            method,
+            parsed.path or "/",
+            canonical_query,
+            canonical_headers,
+            signed_headers,
+            payload_hash,
+        ]
+    )
+    scope = f"{datestamp}/{region}/{service}/aws4_request"
+    string_to_sign = "\n".join(
+        [
+            "AWS4-HMAC-SHA256",
+            amz_date,
+            scope,
+            hashlib.sha256(canonical_request.encode()).hexdigest(),
+        ]
+    )
+
+    def _hmac(key: bytes, msg: str) -> bytes:
+        return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+    k_date = _hmac(b"AWS4" + creds.secret_key.encode(), datestamp)
+    k_region = _hmac(k_date, region)
+    k_service = _hmac(k_region, service)
+    k_signing = _hmac(k_service, "aws4_request")
+    signature = hmac.new(
+        k_signing, string_to_sign.encode(), hashlib.sha256
+    ).hexdigest()
+
+    headers["Authorization"] = (
+        f"AWS4-HMAC-SHA256 Credential={creds.access_key}/{scope}, "
+        f"SignedHeaders={signed_headers}, Signature={signature}"
+    )
+    return {k: v for k, v in headers.items() if k != "host"}
+
+
+def s3_download(
+    endpoint_url: str,
+    bucket: str,
+    key: str,
+    creds: S3Credentials,
+    output_path: str | pathlib.Path,
+    region: str = "us-east-1",
+    timeout: float = 300.0,
+    chunk_bytes: int = 1 << 20,
+) -> pathlib.Path:
+    """SigV4-signed GET (path-style addressing, as MinIO expects)."""
+    url = f"{endpoint_url.rstrip('/')}/{bucket}/{urllib.parse.quote(key)}"
+    req = urllib.request.Request(
+        url, headers=sigv4_headers("GET", url, creds, region=region)
+    )
+    output_path = pathlib.Path(output_path)
+    with urllib.request.urlopen(req, timeout=timeout) as resp, open(
+        output_path, "wb"
+    ) as out:
+        while True:
+            chunk = resp.read(chunk_bytes)
+            if not chunk:
+                break
+            out.write(chunk)
+    return output_path
+
+
+def fetch_model(
+    username: str,
+    password: str,
+    object_path: str,
+    output_path: str,
+    minio_endpoint_url: str,
+    keycloak_endpoint_url: str = "http://localhost:8080/auth/",
+    keycloak_client_id: str = "account",
+    keycloak_realm_name: str = "Agri-Gaia",
+) -> pathlib.Path:
+    """End-to-end fetch, argument-for-argument with the reference CLI
+    (download_model_s3_keycloak.py:10-62). ``object_path`` is
+    '<bucket>/<object_key>'."""
+    bucket, _, key = object_path.partition("/")
+    if not key:  # validate before any authenticated round-trip
+        raise ValueError(
+            f"object path {object_path!r} must be '<bucket>/<object_key>'"
+        )
+    tokens = keycloak_token(
+        keycloak_endpoint_url, keycloak_realm_name, username, password,
+        client_id=keycloak_client_id,
+    )
+    creds = sts_assume_role_web_identity(
+        minio_endpoint_url, tokens["access_token"]
+    )
+    return s3_download(minio_endpoint_url, bucket, key, creds, output_path)
+
+
+def main(argv=None) -> None:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        description="fetch a model artifact from MinIO/S3 behind Keycloak OIDC"
+    )
+    p.add_argument("--username", required=True)
+    p.add_argument("--password", required=True)
+    p.add_argument("--object-path", required=True, help="<bucket>/<object_key>")
+    p.add_argument("--output-path", required=True)
+    p.add_argument("--minio-endpoint-url", required=True)
+    p.add_argument("--keycloak-endpoint-url", default="http://localhost:8080/auth/")
+    p.add_argument("--keycloak-client-id", default="account")
+    p.add_argument("--keycloak-realm-name", default="Agri-Gaia")
+    args = p.parse_args(argv)
+    out = fetch_model(
+        args.username, args.password, args.object_path, args.output_path,
+        args.minio_endpoint_url, args.keycloak_endpoint_url,
+        args.keycloak_client_id, args.keycloak_realm_name,
+    )
+    print(f"downloaded {args.object_path} -> {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,136 @@
+"""deploy.sh parity: convert a checkpoint -> model repo entry -> push.
+
+The reference's deploy.sh (deploy.sh:1-65) hardcodes one flow: run the
+upstream pth->ONNX exporter, scp the artifact into a remote Triton
+model repository, and template a config.pbtxt over ssh. Here the
+conversion target is the flax tree (runtime.importers), the repository
+entry is the disk layout (runtime.disk_repository.export_model), and
+the push is rsync/scp of the finished entry — with a local-path mode so
+the whole flow is testable without a remote.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import subprocess
+import tempfile
+from typing import Any, Mapping
+
+
+def convert_checkpoint(
+    family: str,
+    checkpoint: str,
+    model_kwargs: Mapping[str, Any] | None = None,
+) -> tuple[dict, Mapping]:
+    """Upstream checkpoint (.pt/.pth/.onnx) -> (config_doc, variables).
+
+    Builds the family's pipeline to get the template tree, imports the
+    checkpoint onto it, and returns the repo-entry config + weights.
+    """
+    from triton_client_tpu.runtime import disk_repository
+
+    model_kwargs = dict(model_kwargs or {})
+    doc: dict = {"family": family}
+    if model_kwargs:
+        doc["model"] = dict(model_kwargs)
+    template = disk_repository.conversion_template(family, model_kwargs)
+    variables = disk_repository.load_weights(checkpoint, family, template)
+    return doc, variables
+
+
+def push_entry(
+    entry_dir: str | pathlib.Path,
+    destination: str,
+    dry_run: bool = False,
+) -> list[str]:
+    """Sync a finished model-repo entry to ``destination``.
+
+    destination forms (always the model-repo ROOT; the entry's own
+    directory level is preserved by every transport):
+      * local path            -> copy tree (shutil)
+      * user@host:/path       -> scp -r (deploy.sh:56-65's transport)
+      * rsync://host/module   -> rsync -a
+    Returns the command(s) executed (for logging/dry-run).
+    """
+    entry_dir = pathlib.Path(entry_dir)
+    if ":" in destination and "@" in destination.split(":", 1)[0]:
+        cmd = ["scp", "-r", str(entry_dir), destination]
+        if not dry_run:
+            subprocess.run(cmd, check=True)
+        return [" ".join(cmd)]
+    if destination.startswith("rsync://"):
+        target = f"{destination.rstrip('/')}/{entry_dir.name}/"
+        cmd = ["rsync", "-a", f"{entry_dir}/", target]
+        if not dry_run:
+            subprocess.run(cmd, check=True)
+        return [" ".join(cmd)]
+    # local path
+    if not dry_run:
+        import shutil
+
+        dest = pathlib.Path(destination) / entry_dir.name
+        if dest.exists():
+            shutil.rmtree(dest)
+        shutil.copytree(entry_dir, dest)
+    return [f"copytree {entry_dir} -> {destination}/{entry_dir.name}"]
+
+
+def deploy(
+    family: str,
+    checkpoint: str,
+    model_name: str,
+    destination: str,
+    version: str = "1",
+    model_kwargs: Mapping[str, Any] | None = None,
+    config_extra: Mapping[str, Any] | None = None,
+    dry_run: bool = False,
+) -> list[str]:
+    """Full deploy.sh flow: convert -> materialize entry -> push."""
+    from triton_client_tpu.runtime.disk_repository import export_model
+
+    doc, variables = convert_checkpoint(family, checkpoint, model_kwargs)
+    doc.update(dict(config_extra or {}))
+    with tempfile.TemporaryDirectory() as tmp:
+        entry_dir = export_model(
+            tmp, model_name, doc, variables=variables, version=version
+        )
+        return push_entry(entry_dir, destination, dry_run=dry_run)
+
+
+def main(argv=None) -> None:
+    import argparse
+
+    import yaml
+
+    p = argparse.ArgumentParser(
+        description="convert a checkpoint and push a model-repository entry"
+    )
+    p.add_argument("-f", "--family", required=True,
+                   help="model family (yolov5, pointpillars, ...)")
+    p.add_argument("-c", "--checkpoint", required=True,
+                   help=".pt/.pth/.onnx/.msgpack artifact to convert")
+    p.add_argument("-m", "--model-name", required=True,
+                   help="repository entry name")
+    p.add_argument("-d", "--destination", required=True,
+                   help="model repo root: local path, user@host:/path, rsync://")
+    p.add_argument("--version", default="1")
+    p.add_argument("--model-arg", action="append", default=[],
+                   help="model kwarg as key=value (e.g. num_classes=2); "
+                        "values parse as YAML")
+    p.add_argument("--dry-run", action="store_true")
+    args = p.parse_args(argv)
+
+    model_kwargs = {}
+    for kv in args.model_arg:
+        key, _, value = kv.partition("=")
+        model_kwargs[key] = yaml.safe_load(value)
+
+    for cmd in deploy(
+        args.family, args.checkpoint, args.model_name, args.destination,
+        version=args.version, model_kwargs=model_kwargs, dry_run=args.dry_run,
+    ):
+        print(cmd)
+
+
+if __name__ == "__main__":
+    main()

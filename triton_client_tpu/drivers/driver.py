@@ -159,7 +159,68 @@ def detect3d_infer(pipeline) -> InferFn:
     return fn
 
 
-def channel_infer(channel, model_name: str, input_name: str = "images") -> InferFn:
+def channel_infer3d(
+    channel,
+    model_name: str,
+    model_version: str = "",
+    z_offset: float | None = None,
+) -> InferFn:
+    """Remote 3D adapter: host-side prep (z offset, bucketed padding)
+    configured from the SERVED metadata (override z_offset to force a
+    client-side sensor correction), then the points/num_points padded
+    contract over the channel — the reference's remote 3D client flow
+    (parse_model -> per-frame request mutation,
+    communicator/ros_inference3d.py:120-149) without per-frame dynamic
+    shapes."""
+    import bisect
+    import logging
+
+    from triton_client_tpu.channel.base import InferRequest
+    from triton_client_tpu.ops.voxelize import pad_points
+
+    log = logging.getLogger(__name__)
+    spec = channel.get_metadata(model_name, model_version)
+    buckets = sorted(spec.extra.get("point_buckets", [32768, 65536, 131072]))
+    if z_offset is None:
+        z_offset = float(spec.extra.get("z_offset", 0.0))
+
+    def fn(points: np.ndarray) -> Mapping[str, Any]:
+        points = points[:, :4].astype(np.float32)
+        if z_offset:
+            points[:, 2] += z_offset
+        if len(points) > buckets[-1]:
+            log.warning(
+                "point cloud (%d pts) exceeds largest served bucket (%d); "
+                "tail points dropped — raise the server's point_buckets",
+                len(points), buckets[-1],
+            )
+        budget = buckets[min(bisect.bisect_left(buckets, len(points)), len(buckets) - 1)]
+        padded, m = pad_points(points, budget)
+        resp = channel.do_inference(
+            InferRequest(
+                model_name=model_name,
+                model_version=model_version,
+                inputs={"points": padded, "num_points": np.asarray(m, np.int32)},
+            )
+        )
+        dets = np.asarray(resp.outputs["detections"])
+        valid = np.asarray(resp.outputs["valid"])
+        live = dets[valid]
+        return {
+            "pred_boxes": live[:, :7],
+            "pred_scores": live[:, 7],
+            "pred_labels": live[:, 8].astype(np.int32),
+        }
+
+    return fn
+
+
+def channel_infer(
+    channel,
+    model_name: str,
+    input_name: str = "images",
+    model_version: str = "",
+) -> InferFn:
     """Adapter that round-trips through a BaseChannel (TPUChannel for
     in-process, GRPCChannel for the KServe facade) — the composition the
     reference wires in main.py:131-139."""
@@ -169,7 +230,11 @@ def channel_infer(channel, model_name: str, input_name: str = "images") -> Infer
         if input_name == "images" and data.ndim == 3:
             data = data[None]
         resp = channel.do_inference(
-            InferRequest(model_name=model_name, inputs={input_name: data})
+            InferRequest(
+                model_name=model_name,
+                model_version=model_version,
+                inputs={input_name: data},
+            )
         )
         out = dict(resp.outputs)
         if input_name == "images" and "detections" in out:

@@ -145,6 +145,12 @@ def _detect3d_spec(
             "iou_thresh": cfg.iou_thresh,
             "class_names": list(cfg.class_names),
             "max_voxels": model_cfg.voxel.max_voxels,
+            # Remote clients self-configure host-side prep from the
+            # served metadata (the reference's parse_model pattern,
+            # clients/detector_3d_client.py:28-91): pad buckets + the
+            # sensor z correction applied before the padded contract.
+            "point_buckets": list(cfg.point_buckets),
+            "z_offset": cfg.z_offset,
             **(extra or {}),
         },
     )

@@ -189,9 +189,15 @@ class _Entry:
     """One model dir's parsed config + lazily-shared init template, so
     N version dirs cost ONE random init (the template tree), not N."""
 
-    def __init__(self, model_dir: str | pathlib.Path) -> None:
+    def __init__(
+        self,
+        model_dir: str | pathlib.Path,
+        doc: Mapping[str, Any] | None = None,
+    ) -> None:
         self.model_dir = pathlib.Path(model_dir)
-        doc = load_yaml(str(self.model_dir / "config.yaml"))
+        if doc is None:
+            doc = load_yaml(str(self.model_dir / "config.yaml"))
+        doc = dict(doc)
         unknown = set(doc) - _TOP_KEYS
         if unknown:
             raise KeyError(
@@ -263,6 +269,18 @@ def build_model(
     return _Entry(model_dir).registered(version, weights)
 
 
+def conversion_template(
+    family: str, model_kwargs: Mapping[str, Any] | None = None
+) -> Mapping:
+    """Random-init variables tree for a family — the shape/structure
+    template load_weights converts upstream checkpoints onto. Public
+    entry for deploy tooling (no model dir needed)."""
+    doc: dict[str, Any] = {"family": family}
+    if model_kwargs:
+        doc["model"] = dict(model_kwargs)
+    return _Entry(pathlib.Path.cwd(), doc=doc).template()
+
+
 def _version_dirs(model_dir: pathlib.Path) -> list[pathlib.Path]:
     return sorted(
         (d for d in model_dir.iterdir() if d.is_dir() and d.name.isdigit()),
@@ -270,11 +288,19 @@ def _version_dirs(model_dir: pathlib.Path) -> list[pathlib.Path]:
     )
 
 
-def _find_weights(version_dir: pathlib.Path) -> pathlib.Path | None:
+def _find_weights(version_dir: pathlib.Path) -> pathlib.Path:
+    """A version dir MUST carry a recognized artifact — registering
+    random-init weights for a typo'd filename would serve garbage
+    silently (fail-loudly policy; Triton likewise errors on a version
+    dir its backend can't load)."""
     for name in _WEIGHT_NAMES:
         if (version_dir / name).exists():
             return version_dir / name
-    return None
+    present = sorted(p.name for p in version_dir.iterdir())
+    raise FileNotFoundError(
+        f"{version_dir}: no weight artifact (found {present}; "
+        f"recognized names: {list(_WEIGHT_NAMES)})"
+    )
 
 
 def scan_disk(

@@ -123,6 +123,14 @@ class _Servicer(service.GRPCInferenceServiceServicer):
                 data_type=codec.config_datatype(t.dtype),
                 dims=t.shape,
             )
+        # ModelSpec.extra rides the config parameters map (JSON values)
+        # so remote clients self-configure host-side prep — the role the
+        # reference's client-side parse_model plays over ModelConfig
+        # (base_client.py:32-104).
+        import json
+
+        for key, value in spec.extra.items():
+            config.parameters[key] = json.dumps(value)
         return pb.ModelConfigResponse(config=config)
 
     def RepositoryIndex(self, request, context):
