@@ -1,0 +1,165 @@
+"""StageProfiler, Prometheus export, driver/server integration."""
+
+import urllib.request
+
+import numpy as np
+import pytest
+
+from triton_client_tpu.utils.profiling import StageProfiler
+
+
+def test_summary_quantiles_and_counts():
+    p = StageProfiler()
+    for ms in range(1, 101):
+        p.record("infer", ms / 1e3)
+    s = p.summary()["infer"]
+    assert s["count"] == 100
+    assert abs(s["p50_ms"] - 50.5) < 1.0
+    assert abs(s["p99_ms"] - 99.01) < 1.0
+    assert abs(s["mean_ms"] - 50.5) < 0.1
+
+
+def test_window_bounds_memory_but_counts_all():
+    p = StageProfiler(window=10)
+    for i in range(100):
+        p.record("s", 0.001)
+    assert p.summary()["s"]["count"] == 100
+    assert len(p._stages["s"]) == 10
+
+
+def test_stage_context_and_wrap():
+    p = StageProfiler()
+    with p.stage("a"):
+        pass
+    fn = p.wrap("b", lambda x: x * 2)
+    assert fn(21) == 42
+    assert set(p.summary()) == {"a", "b"}
+
+
+def test_report_renders_table():
+    p = StageProfiler()
+    p.record("source", 0.005)
+    p.record("infer", 0.010)
+    rep = p.report()
+    assert "source" in rep and "infer" in rep and "p99" in rep
+
+
+def test_listener_fires():
+    p = StageProfiler()
+    got = []
+    p.add_listener(lambda stage, s: got.append((stage, s)))
+    p.record("x", 0.5)
+    assert got == [("x", 0.5)]
+
+
+def test_driver_records_stages(tmp_path):
+    from triton_client_tpu.drivers.driver import InferenceDriver
+    from triton_client_tpu.io.sources import open_source
+
+    p = StageProfiler()
+    driver = InferenceDriver(
+        lambda data: {"detections": np.zeros((1, 6))},
+        open_source("synthetic:5:32x32", 5),
+        prefetch=2,
+        warmup=0,
+        profiler=p,
+    )
+    stats = driver.run(max_frames=5)
+    assert stats.frames == 5
+    s = p.summary()
+    assert s["infer"]["count"] == 5
+    assert s["source"]["count"] == 5  # decode timed in the producer
+
+
+def test_prometheus_exporter_serves_histograms():
+    prometheus_client = pytest.importorskip("prometheus_client")
+    import socket
+
+    from triton_client_tpu.utils.profiling import PrometheusStageExporter
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    p = StageProfiler()
+    PrometheusStageExporter(port, namespace="test_ns").attach(p)
+    p.record("infer_yolo", 0.004)
+    p.record("infer_yolo", 0.2)
+    body = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=10
+    ).read().decode()
+    assert "test_ns_infer_yolo_latency_seconds_count 2.0" in body
+    assert 'le="0.005"' in body
+
+
+def test_server_metrics_port_records_model_latency():
+    jax = pytest.importorskip("jax")
+    import socket
+
+    from triton_client_tpu.channel.base import InferRequest
+    from triton_client_tpu.channel.grpc_channel import GRPCChannel
+    from triton_client_tpu.channel.tpu_channel import TPUChannel
+    from triton_client_tpu.config import ModelSpec, TensorSpec
+    from triton_client_tpu.runtime.repository import ModelRepository
+    from triton_client_tpu.runtime.server import InferenceServer
+
+    spec = ModelSpec(
+        name="addone",
+        inputs=(TensorSpec("x", (-1,), "FP32"),),
+        outputs=(TensorSpec("y", (-1,), "FP32"),),
+    )
+    repo = ModelRepository()
+    repo.register(spec, lambda inputs: {"y": np.asarray(inputs["x"]) + 1})
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        mport = s.getsockname()[1]
+    server = InferenceServer(
+        repo, TPUChannel(repo, validate=False), address="127.0.0.1:0",
+        max_workers=2, metrics_port=mport,
+    )
+    server.start()
+    try:
+        channel = GRPCChannel(f"127.0.0.1:{server.port}", timeout_s=10.0)
+        channel.do_inference(
+            InferRequest(model_name="addone", inputs={"x": np.ones(4, np.float32)})
+        )
+        channel.close()
+        assert server.profiler.summary()["infer_addone"]["count"] == 1
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{mport}/metrics", timeout=10
+        ).read().decode()
+        assert "tpu_serving_infer_addone_latency_seconds_count 1.0" in body
+    finally:
+        server.stop()
+
+
+def test_device_trace_writes_profile(tmp_path):
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+
+    from triton_client_tpu.utils.profiling import device_trace
+
+    with device_trace(str(tmp_path)):
+        jnp.ones(8).sum().block_until_ready()
+    # the trace plugin writes under plugins/profile/<run>/
+    assert list(tmp_path.rglob("*.xplane.pb")), "no trace written"
+
+
+def test_exporter_collision_degrades_not_raises():
+    pytest.importorskip("prometheus_client")
+    from triton_client_tpu.utils.profiling import PrometheusStageExporter
+
+    ex = PrometheusStageExporter(0, namespace="collide_ns")
+    ex.observe("yolo-v5", 0.01)
+    ex.observe("yolo.v5", 0.01)  # sanitizes to the same metric name
+    ex.observe("yolo.v5", 0.01)  # and keeps working afterwards
+
+
+def test_listener_exception_does_not_break_record():
+    p = StageProfiler()
+
+    def bad_listener(stage, s):
+        raise RuntimeError("boom")
+
+    p.add_listener(bad_listener)
+    p.record("x", 0.1)  # must not raise
+    assert p.summary()["x"]["count"] == 1

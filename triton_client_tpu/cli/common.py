@@ -26,6 +26,16 @@ def add_common_flags(parser: argparse.ArgumentParser) -> None:
         "'grpc:<host:port>' (remote KServe v2 server — the reference's "
         "-u server URL, main.py:51-113)",
     )
+    parser.add_argument(
+        "--profile", action="store_true",
+        help="print a per-stage latency table (source/infer/sink) after "
+        "the run",
+    )
+    parser.add_argument(
+        "--profile-trace", default="",
+        help="capture a jax.profiler device trace into this directory "
+        "(TensorBoard/Perfetto timeline)",
+    )
     parser.add_argument("-b", "--batch-size", type=int, default=1)
     parser.add_argument(
         "-c", "--classes", type=int, default=80, help="number of classes"
@@ -126,3 +136,24 @@ def print_report(stats, summary=None, extra=None) -> None:
     if extra:
         out.update(extra)
     print(json.dumps(out))
+
+
+def make_profiler(args):
+    """--profile -> StageProfiler (None when off)."""
+    if not getattr(args, "profile", False):
+        return None
+    from triton_client_tpu.utils.profiling import StageProfiler
+
+    return StageProfiler()
+
+
+def maybe_device_trace(args):
+    """--profile-trace <dir> -> jax.profiler trace context (else no-op)."""
+    import contextlib
+
+    log_dir = getattr(args, "profile_trace", "")
+    if not log_dir:
+        return contextlib.nullcontext()
+    from triton_client_tpu.utils.profiling import device_trace
+
+    return device_trace(log_dir)

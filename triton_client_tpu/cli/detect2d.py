@@ -22,7 +22,9 @@ from triton_client_tpu.cli.common import (
     add_common_flags,
     load_gt_lookup,
     load_names,
+    make_profiler,
     make_sink,
+    maybe_device_trace,
     print_report,
 )
 
@@ -136,6 +138,13 @@ def main(argv=None) -> None:
         # the serving process, this client only decodes/draws/publishes.
         if not args.model_name:
             raise SystemExit("--channel grpc:... requires -m/--model-name")
+        if args.conf is not None or args.iou is not None:
+            # Thresholds are baked into the SERVER's jitted pipeline
+            # (repo entry config.yaml) — same guard as detect3d's.
+            raise SystemExit(
+                "--conf/--iou are server-side in remote mode: set them in "
+                "the model repository entry's config.yaml"
+            )
         from triton_client_tpu.channel.grpc_channel import GRPCChannel
 
         channel = GRPCChannel(args.channel[len("grpc:"):])
@@ -180,6 +189,7 @@ def main(argv=None) -> None:
         evaluator = DetectionEvaluator()
         gt_lookup = load_gt_lookup(args.gt)
 
+    profiler = make_profiler(args)
     driver = InferenceDriver(
         infer,
         source,
@@ -188,8 +198,14 @@ def main(argv=None) -> None:
         warmup=args.warmup,
         evaluator=evaluator,
         gt_lookup=gt_lookup,
+        profiler=profiler,
     )
-    stats = driver.run(max_frames=args.limit)
+    with maybe_device_trace(args):
+        stats = driver.run(max_frames=args.limit)
+    if profiler is not None:
+        import sys
+
+        print(profiler.report(), file=sys.stderr)
     summary = evaluator.summary() if evaluator is not None else None
     print_report(stats, summary, {"model": spec.name})
     if summary is not None and args.prometheus_port > 0:

@@ -16,7 +16,13 @@ import dataclasses
 
 import jax
 
-from triton_client_tpu.cli.common import add_common_flags, make_sink, print_report
+from triton_client_tpu.cli.common import (
+    add_common_flags,
+    make_profiler,
+    make_sink,
+    maybe_device_trace,
+    print_report,
+)
 
 
 def parse_args(argv=None) -> argparse.Namespace:
@@ -122,14 +128,21 @@ def _run_3d(args, infer, model_name: str) -> None:
     from triton_client_tpu.io.sources import open_source
 
     source = open_source(args.input, args.limit, kind="pointcloud")
+    profiler = make_profiler(args)
     driver = InferenceDriver(
         infer,
         source,
         sink=make_sink(args),
         prefetch=args.prefetch,
         warmup=args.warmup,
+        profiler=profiler,
     )
-    stats = driver.run(max_frames=args.limit)
+    with maybe_device_trace(args):
+        stats = driver.run(max_frames=args.limit)
+    if profiler is not None:
+        import sys
+
+        print(profiler.report(), file=sys.stderr)
     print_report(stats, None, {"model": model_name})
 
 

@@ -23,6 +23,11 @@ def main(argv=None) -> None:
     p.add_argument("-a", "--address", default="0.0.0.0:8001")
     p.add_argument("--max-workers", type=int, default=8)
     p.add_argument(
+        "--metrics-port", type=int, default=8002,
+        help="Prometheus per-model latency metrics (Triton :8002 parity; "
+        "0 disables)",
+    )
+    p.add_argument(
         "--warmup", action="store_true",
         help="compile every registered model before accepting requests",
     )
@@ -46,9 +51,12 @@ def main(argv=None) -> None:
         TPUChannel(repo),
         address=args.address,
         max_workers=args.max_workers,
+        metrics_port=args.metrics_port,
     )
     server.start()
     print(f"KServe v2 gRPC server listening on port {server.port}")
+    if args.metrics_port:
+        print(f"Prometheus metrics on :{args.metrics_port}")
     try:
         server.wait()
     except KeyboardInterrupt:

@@ -29,11 +29,10 @@ def convert_checkpoint(
     """
     from triton_client_tpu.runtime import disk_repository
 
-    model_kwargs = dict(model_kwargs or {})
     doc: dict = {"family": family}
     if model_kwargs:
         doc["model"] = dict(model_kwargs)
-    template = disk_repository.conversion_template(family, model_kwargs)
+    template = disk_repository.conversion_template(doc=doc)
     variables = disk_repository.load_weights(checkpoint, family, template)
     return doc, variables
 

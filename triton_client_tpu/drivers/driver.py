@@ -57,9 +57,13 @@ class InferenceDriver:
         warmup: int = 1,
         evaluator=None,
         gt_lookup: Callable[[Frame], np.ndarray | None] | None = None,
+        profiler=None,
     ) -> None:
         """``evaluator``: DetectionEvaluator scored via ``gt_lookup``,
-        which maps a frame to (n_gt, 5) [x1, y1, x2, y2, cls] or None."""
+        which maps a frame to (n_gt, 5) [x1, y1, x2, y2, cls] or None.
+        ``profiler``: optional StageProfiler; records source/infer/sink
+        stage latencies (the per-stage view the reference only had as
+        commented-out prints, ros_inference3d.py:209-210)."""
         self.infer = infer
         self.source = source
         self.sink = sink
@@ -67,6 +71,7 @@ class InferenceDriver:
         self.warmup = warmup
         self.evaluator = evaluator
         self.gt_lookup = gt_lookup
+        self.profiler = profiler
 
     def run(self, max_frames: int = 0) -> DriverStats:
         q: queue.Queue = queue.Queue(maxsize=self.prefetch)
@@ -74,10 +79,19 @@ class InferenceDriver:
 
         def produce() -> None:
             try:
-                for i, frame in enumerate(self.source):
-                    if max_frames and i >= max_frames:
+                it = iter(self.source)
+                i = 0
+                while not max_frames or i < max_frames:
+                    t0 = time.perf_counter()
+                    frame = next(it, _SENTINEL)
+                    if frame is _SENTINEL:
                         break
+                    if self.profiler is not None:
+                        # decode/read time, overlapped with infer by the
+                        # prefetch queue — visible here, not in e2e p50
+                        self.profiler.record("source", time.perf_counter() - t0)
                     q.put(frame)
+                    i += 1
             except BaseException as e:  # propagate into the consumer
                 error.append(e)
             finally:
@@ -105,10 +119,16 @@ class InferenceDriver:
             while frame is not _SENTINEL:
                 t0 = time.perf_counter()
                 result = self.infer(frame.data)
-                latencies.append(time.perf_counter() - t0)
+                dt = time.perf_counter() - t0
+                latencies.append(dt)
+                if self.profiler is not None:
+                    self.profiler.record("infer", dt)
                 n += 1
                 if self.sink is not None:
+                    t1 = time.perf_counter()
                     self.sink.write(frame, result)
+                    if self.profiler is not None:
+                        self.profiler.record("sink", time.perf_counter() - t1)
                 if self.evaluator is not None and self.gt_lookup is not None:
                     gts = self.gt_lookup(frame)
                     if gts is not None:

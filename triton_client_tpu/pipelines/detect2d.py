@@ -238,6 +238,9 @@ def _detect2d_spec(cfg: Detect2DConfig, n_predictions: int) -> ModelSpec:
             "model_input_hw": list(cfg.input_hw),
             "num_predictions": n_predictions,
             "num_classes": cfg.num_classes,
+            # Remote clients label/draw from served metadata
+            # (parse_model role, base_client.py:32-104).
+            "class_names": list(cfg.class_names),
         },
     )
 
@@ -349,6 +352,7 @@ def _detectron_spec(cfg: Detect2DConfig) -> ModelSpec:
             "conf_thresh": cfg.conf_thresh,
             "iou_thresh": cfg.iou_thresh,
             "scaling": cfg.scaling,
+            "class_names": list(cfg.class_names),
         },
     )
 

@@ -270,14 +270,18 @@ def build_model(
 
 
 def conversion_template(
-    family: str, model_kwargs: Mapping[str, Any] | None = None
+    family: str | None = None,
+    model_kwargs: Mapping[str, Any] | None = None,
+    doc: Mapping[str, Any] | None = None,
 ) -> Mapping:
     """Random-init variables tree for a family — the shape/structure
     template load_weights converts upstream checkpoints onto. Public
-    entry for deploy tooling (no model dir needed)."""
-    doc: dict[str, Any] = {"family": family}
-    if model_kwargs:
-        doc["model"] = dict(model_kwargs)
+    entry for deploy tooling (no model dir needed): pass either an
+    already-built config ``doc`` or ``family`` (+ ``model_kwargs``)."""
+    if doc is None:
+        doc = {"family": family}
+        if model_kwargs:
+            doc["model"] = dict(model_kwargs)
     return _Entry(pathlib.Path.cwd(), doc=doc).template()
 
 

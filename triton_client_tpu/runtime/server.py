@@ -56,9 +56,15 @@ def message_limit(repository: ModelRepository) -> int:
 
 
 class _Servicer(service.GRPCInferenceServiceServicer):
-    def __init__(self, repository: ModelRepository, channel: BaseChannel) -> None:
+    def __init__(
+        self,
+        repository: ModelRepository,
+        channel: BaseChannel,
+        profiler=None,
+    ) -> None:
         self._repo = repository
         self._channel = channel
+        self._profiler = profiler
 
     # -- health ---------------------------------------------------------------
 
@@ -143,6 +149,9 @@ class _Servicer(service.GRPCInferenceServiceServicer):
     # -- inference ------------------------------------------------------------
 
     def _infer(self, request):
+        import time
+
+        t0 = time.perf_counter()
         inputs = codec.parse_infer_request(request)
         result = self._channel.do_inference(
             InferRequest(
@@ -152,6 +161,12 @@ class _Servicer(service.GRPCInferenceServiceServicer):
                 request_id=request.id,
             )
         )
+        if self._profiler is not None:
+            # per-model request latency — the Triton :8002 serving
+            # metrics role (README.md:88-95)
+            self._profiler.record(
+                f"infer_{request.model_name}", time.perf_counter() - t0
+            )
         return codec.build_infer_response(
             model_name=result.model_name,
             model_version=result.model_version,
@@ -187,7 +202,39 @@ class InferenceServer:
         address: str = "0.0.0.0:8001",
         max_workers: int = 8,
         max_message_bytes: int | None = None,
+        profiler=None,
+        metrics_port: int = 0,
     ) -> None:
+        """``metrics_port``: serve per-model latency Histograms over
+        Prometheus (Triton's :8002 role); 0 disables. ``profiler``: a
+        StageProfiler to record into (created automatically when
+        metrics_port is set)."""
+        if metrics_port and profiler is None:
+            from triton_client_tpu.utils.profiling import StageProfiler
+
+            profiler = StageProfiler()
+        self.profiler = profiler
+        if metrics_port:
+            # Degrade, don't die: metrics are optional observability —
+            # a missing prometheus_client or an occupied port must not
+            # take down the inference service (the reference's optional
+            # import pattern, communicator/__init__.py:5-8).
+            try:
+                from triton_client_tpu.utils.profiling import (
+                    PrometheusStageExporter,
+                )
+
+                PrometheusStageExporter(metrics_port).attach(profiler)
+            except ImportError:
+                log.warning(
+                    "prometheus_client not installed; metrics port %d disabled",
+                    metrics_port,
+                )
+            except OSError as e:
+                log.warning(
+                    "could not bind metrics port %d (%s); metrics disabled",
+                    metrics_port, e,
+                )
         limit = max_message_bytes or message_limit(repository)
         self._server = grpc.server(
             concurrent.futures.ThreadPoolExecutor(max_workers=max_workers),
@@ -196,7 +243,9 @@ class InferenceServer:
                 ("grpc.max_receive_message_length", limit),
             ],
         )
-        service.add_servicer_to_server(_Servicer(repository, channel), self._server)
+        service.add_servicer_to_server(
+            _Servicer(repository, channel, profiler=profiler), self._server
+        )
         self._port = self._server.add_insecure_port(address)
         if self._port == 0:
             raise RuntimeError(f"could not bind {address}")
