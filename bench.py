@@ -1,6 +1,10 @@
-"""Benchmark: YOLOv5n fused pipeline frames/sec on one TPU chip.
+"""Benchmark: fused perception pipelines, frames/sec on one TPU chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line (the driver's contract): the primary metric is the
+YOLOv5n 512x512 fused end-to-end pipeline. Secondary metrics
+(PointPillars 3D end-to-end) go to stderr and BENCH_LOCAL.json so
+round-over-round history captures the whole surface without breaking
+the one-line contract.
 
 Methodology (BASELINE.md): the reference publishes no numbers; its
 serving path is one blocking gRPC round-trip per frame to a remote
@@ -12,6 +16,7 @@ round-over-round gains.
 """
 
 import json
+import sys
 import time
 
 import jax
@@ -22,9 +27,10 @@ BATCH = 8
 WARMUP = 3
 ITERS = 30
 CAMERA_FPS_BASELINE = 30.0
+LIDAR_HZ_BASELINE = 10.0  # KITTI/nuScenes lidar scan rate
 
 
-def main() -> None:
+def bench_yolov5() -> dict:
     from triton_client_tpu.models.yolov5 import init_yolov5
     from triton_client_tpu.ops.detect_postprocess import extract_boxes
     from triton_client_tpu.ops.preprocess import normalize_image
@@ -46,26 +52,89 @@ def main() -> None:
     )
 
     for _ in range(WARMUP):
-        dets, valid = pipeline(variables, frames)
-    jax.block_until_ready((dets, valid))
+        out = pipeline(variables, frames)
+    jax.block_until_ready(out)
 
     t0 = time.perf_counter()
     for _ in range(ITERS):
-        dets, valid = pipeline(variables, frames)
-    jax.block_until_ready((dets, valid))
+        out = pipeline(variables, frames)
+    jax.block_until_ready(out)
     dt = time.perf_counter() - t0
 
     fps = BATCH * ITERS / dt
-    print(
-        json.dumps(
-            {
-                "metric": "yolov5n_512_e2e_frames_per_sec_per_chip",
-                "value": round(fps, 2),
-                "unit": "frames/sec",
-                "vs_baseline": round(fps / CAMERA_FPS_BASELINE, 2),
-            }
-        )
+    return {
+        "metric": "yolov5n_512_e2e_frames_per_sec_per_chip",
+        "value": round(fps, 2),
+        "unit": "frames/sec",
+        "vs_baseline": round(fps / CAMERA_FPS_BASELINE, 2),
+    }
+
+
+def bench_pointpillars() -> dict:
+    """Full 3D path: voxelize -> PillarVFE -> scatter -> BEV CNN ->
+    anchor head -> top-k decode -> rotated NMS, KITTI grid
+    (data/kitti_pointpillars.yaml).
+
+    Same methodology as the 2D bench: the padded scan is staged on
+    device once and the fused jit is timed back-to-back (host-side
+    bucketing/padding is ~0.4 ms/scan, measured separately; over the
+    remote-chip tunnel used in CI, per-call host->device transfers would
+    otherwise dominate and measure the tunnel, not the chip)."""
+    from triton_client_tpu.dataset_config import detect3d_from_yaml
+    from triton_client_tpu.ops.voxelize import pad_points
+    from triton_client_tpu.pipelines.detect3d import build_pointpillars_pipeline
+
+    _, model_cfg, pipe_cfg = detect3d_from_yaml("data/kitti_pointpillars.yaml")
+    pipeline, _, _ = build_pointpillars_pipeline(
+        jax.random.PRNGKey(0), model_cfg=model_cfg, config=pipe_cfg
     )
+
+    rng = np.random.default_rng(0)
+    n_pts = 120_000  # ~KITTI velodyne scan
+    pc_range = model_cfg.voxel.point_cloud_range
+    pts = np.empty((n_pts, 4), np.float32)
+    pts[:, 0] = rng.uniform(pc_range[0], pc_range[3], n_pts)
+    pts[:, 1] = rng.uniform(pc_range[1], pc_range[4], n_pts)
+    pts[:, 2] = rng.uniform(pc_range[2], pc_range[5], n_pts)
+    pts[:, 3] = rng.uniform(0, 1, n_pts)
+    padded, m = pad_points(pts, max(pipe_cfg.point_buckets))
+    pj, mj = jnp.asarray(padded), jnp.asarray(m)
+
+    iters = max(10, ITERS // 3)
+    for _ in range(WARMUP):
+        out = pipeline._jit(pj, mj)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = pipeline._jit(pj, mj)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+
+    fps = iters / dt
+    return {
+        "metric": "pointpillars_kitti_e2e_scans_per_sec_per_chip",
+        "value": round(fps, 2),
+        "unit": "scans/sec",
+        "vs_baseline": round(fps / LIDAR_HZ_BASELINE, 2),
+    }
+
+
+def main() -> None:
+    primary = bench_yolov5()
+    results = [primary]
+    try:
+        results.append(bench_pointpillars())
+    except Exception as e:  # secondary metric must not break the contract
+        print(f"pointpillars bench failed: {e}", file=sys.stderr)
+
+    try:  # best-effort: the one-line stdout contract must survive
+        with open("BENCH_LOCAL.json", "w") as f:
+            json.dump(results, f, indent=2)
+    except OSError as e:
+        print(f"could not write BENCH_LOCAL.json: {e}", file=sys.stderr)
+    for secondary in results[1:]:
+        print(json.dumps(secondary), file=sys.stderr)
+    print(json.dumps(primary))
 
 
 if __name__ == "__main__":

@@ -115,3 +115,66 @@ def test_pipeline_empty_cloud():
     out = pipeline.infer(np.zeros((0, 4), np.float32))
     # random-weight scores may fire anywhere, but shapes must hold
     assert out["pred_boxes"].shape[1] == 7
+
+
+def test_decode_topk_matches_full_decode_path():
+    """The top-k-before-decode fast path must produce the same packed
+    detections as decode() + extract_boxes_3d (sigmoid is monotonic, so
+    ordering/gating are identical)."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from triton_client_tpu.models.pointpillars import (
+        PointPillarsConfig,
+        init_pointpillars,
+    )
+    from triton_client_tpu.ops.detect3d_postprocess import (
+        extract_boxes_3d,
+        nms_pack_3d,
+    )
+    from triton_client_tpu.ops.voxelize import VoxelConfig
+
+    cfg = PointPillarsConfig(
+        voxel=dataclasses.replace(
+            VoxelConfig(),
+            point_cloud_range=(0.0, -10.24, -3.0, 20.48, 10.24, 1.0),
+            max_voxels=256,
+        )
+    )
+    model, variables = init_pointpillars(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(3)
+    v = cfg.voxel
+    voxels = jnp.asarray(
+        rng.standard_normal((1, v.max_voxels, v.max_points_per_voxel, 4)),
+        jnp.float32,
+    )
+    nums = jnp.asarray(
+        rng.integers(0, v.max_points_per_voxel, (1, v.max_voxels)), jnp.int32
+    )
+    nx, ny, _ = v.grid_size
+    coords = jnp.stack(
+        [
+            jnp.asarray(rng.integers(0, nx, (1, v.max_voxels)), jnp.int32),
+            jnp.asarray(rng.integers(0, ny, (1, v.max_voxels)), jnp.int32),
+            jnp.zeros((1, v.max_voxels), jnp.int32),
+        ],
+        axis=-1,
+    )
+    heads = model.apply(variables, voxels, nums, coords, train=False)
+
+    pred = model.decode(heads)
+    ref_dets, ref_valid = extract_boxes_3d(
+        pred["boxes"], pred["scores"], score_thresh=0.1, iou_thresh=0.2,
+        max_det=32, pre_max=128,
+    )
+    cand = model.decode_topk(heads, pre_max=128, score_thresh=0.1)
+    fast_dets, fast_valid = nms_pack_3d(
+        cand["boxes"], cand["scores"], cand["labels"],
+        iou_thresh=0.2, max_det=32,
+    )
+    np.testing.assert_array_equal(np.asarray(ref_valid), np.asarray(fast_valid))
+    np.testing.assert_allclose(
+        np.asarray(ref_dets), np.asarray(fast_dets), atol=1e-5
+    )

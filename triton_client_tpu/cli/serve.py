@@ -55,7 +55,7 @@ def main(argv=None) -> None:
     )
     server.start()
     print(f"KServe v2 gRPC server listening on port {server.port}")
-    if args.metrics_port:
+    if server.metrics_enabled:
         print(f"Prometheus metrics on :{args.metrics_port}")
     try:
         server.wait()

@@ -310,6 +310,49 @@ class PointPillars(nn.Module):
             "dir": direction.reshape(b, h, w, a, cfg.num_dir_bins),
         }
 
+    def decode_topk(
+        self,
+        heads: dict[str, jnp.ndarray],
+        pre_max: int = 512,
+        score_thresh: float = 0.1,
+    ) -> dict[str, jnp.ndarray]:
+        """Gate + top-k on RAW class logits, then decode only the
+        survivors: boxes (B, K, 7), scores (B, K) with -inf on gated-out
+        slots, labels (B, K) 1-indexed.
+
+        Equivalent to decode() + extract_boxes_3d's prefilter (sigmoid
+        is monotonic, so top-k on max logits = top-k on max sigmoid
+        scores), but the full anchor grid (321k anchors for the KITTI
+        head) never goes through box decode — only K do. On a v5e chip
+        this removes the dominant decode cost from the fused pipeline."""
+        cfg = self.cfg
+        b, h, w, a, nc = heads["cls"].shape
+        n = h * w * a
+        cls = heads["cls"].reshape(b, n, nc)
+        box = heads["box"].reshape(b, n, 7)
+        dirs = heads["dir"].reshape(b, n, cfg.num_dir_bins)
+        anchors = generate_anchors(cfg).reshape(n, 7)
+
+        logit_max = cls.max(axis=-1)
+        labels = cls.argmax(axis=-1) + 1
+        k = min(pre_max, n)
+        top_logits, top_idx = jax.lax.top_k(logit_max, k)  # (B, K)
+
+        box_k = jnp.take_along_axis(box, top_idx[..., None], axis=1)
+        dir_k = jnp.take_along_axis(dirs, top_idx[..., None], axis=1)
+        labels_k = jnp.take_along_axis(labels, top_idx, axis=1)
+        anchors_k = anchors[top_idx]  # (B, K, 7)
+
+        decoded = decode_boxes(box_k, anchors_k)
+        dir_bin = jnp.argmax(dir_k, axis=-1)
+        rot = rectify_direction(
+            decoded[..., 6], dir_bin, cfg.num_dir_bins, cfg.dir_offset
+        )
+        decoded = jnp.concatenate([decoded[..., :6], rot[..., None]], axis=-1)
+        scores = jax.nn.sigmoid(top_logits)
+        scores = jnp.where(scores > score_thresh, scores, -jnp.inf)
+        return {"boxes": decoded, "scores": scores, "labels": labels_k}
+
     def decode(self, heads: dict[str, jnp.ndarray]) -> dict[str, jnp.ndarray]:
         """Raw head maps -> flat per-anchor predictions:
         boxes (B, N, 7), scores (B, N, num_classes) sigmoid, with

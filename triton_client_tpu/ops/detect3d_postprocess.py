@@ -41,18 +41,44 @@ def extract_boxes_3d(
         gated = jnp.where(cls_score > score_thresh, cls_score, -jnp.inf)
         k = min(pre_max, gated.shape[0])
         top_scores, top_idx = jax.lax.top_k(gated, k)
-        cand_boxes = b[top_idx]
-        idx, keep = nms_bev(
-            cand_boxes, top_scores, iou_thresh=iou_thresh, max_det=max_det
+        return _nms_pack_one(
+            b[top_idx], top_scores, label[top_idx], iou_thresh, max_det
         )
-        out = jnp.concatenate(
-            [
-                cand_boxes[idx],
-                jnp.where(keep, top_scores[idx], 0.0)[:, None],
-                (label[top_idx][idx]).astype(b.dtype)[:, None],
-            ],
-            axis=-1,
-        )
-        return jnp.where(keep[:, None], out, 0.0), keep
 
     return jax.vmap(one_image)(boxes, scores)
+
+
+def _nms_pack_one(cand_boxes, cand_scores, cand_labels, iou_thresh, max_det):
+    """(K, 7) candidates (+ scores with -inf padding, 1-indexed labels)
+    -> packed (max_det, 9) rows [box7, score, label] + valid mask."""
+    idx, keep = nms_bev(
+        cand_boxes, cand_scores, iou_thresh=iou_thresh, max_det=max_det
+    )
+    out = jnp.concatenate(
+        [
+            cand_boxes[idx],
+            jnp.where(keep, cand_scores[idx], 0.0)[:, None],
+            cand_labels[idx].astype(cand_boxes.dtype)[:, None],
+        ],
+        axis=-1,
+    )
+    return jnp.where(keep[:, None], out, 0.0), keep
+
+
+@functools.partial(jax.jit, static_argnames=("max_det",))
+def nms_pack_3d(
+    boxes: jnp.ndarray,
+    scores: jnp.ndarray,
+    labels: jnp.ndarray,
+    iou_thresh: float = 0.01,
+    max_det: int = 128,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Packed NMS over PRE-GATED candidates: boxes (B, K, 7), scores
+    (B, K) with -inf padding, labels (B, K) 1-indexed. The fast path for
+    models exposing decode_topk (top-k on raw logits before any box
+    decode, so only K boxes are ever decoded instead of the full anchor
+    grid — the OpenPCDet post_processing order, but with the gate moved
+    in front of the decode where XLA can't fuse it away itself)."""
+    return jax.vmap(
+        lambda b, s, l: _nms_pack_one(b, s, l, iou_thresh, max_det)
+    )(boxes, scores, labels)

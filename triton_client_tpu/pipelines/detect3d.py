@@ -32,7 +32,10 @@ from triton_client_tpu.models.pointpillars import (
     PointPillarsConfig,
     init_pointpillars,
 )
-from triton_client_tpu.ops.detect3d_postprocess import extract_boxes_3d
+from triton_client_tpu.ops.detect3d_postprocess import (
+    extract_boxes_3d,
+    nms_pack_3d,
+)
 from triton_client_tpu.ops.voxelize import pad_points, voxelize
 
 
@@ -73,15 +76,29 @@ class Detect3DPipeline:
             vox["coords"][None],
             train=False,
         )
-        pred = self.model.decode(heads)
-        dets, valid = extract_boxes_3d(
-            pred["boxes"],
-            pred["scores"],
-            score_thresh=cfg.score_thresh,
-            iou_thresh=cfg.iou_thresh,
-            max_det=cfg.max_det,
-            pre_max=cfg.pre_max,
-        )
+        if hasattr(self.model, "decode_topk"):
+            # Fast path: gate + top-k on raw logits BEFORE box decode —
+            # only pre_max boxes are ever decoded (see decode_topk).
+            cand = self.model.decode_topk(
+                heads, pre_max=cfg.pre_max, score_thresh=cfg.score_thresh
+            )
+            dets, valid = nms_pack_3d(
+                cand["boxes"],
+                cand["scores"],
+                cand["labels"],
+                iou_thresh=cfg.iou_thresh,
+                max_det=cfg.max_det,
+            )
+        else:
+            pred = self.model.decode(heads)
+            dets, valid = extract_boxes_3d(
+                pred["boxes"],
+                pred["scores"],
+                score_thresh=cfg.score_thresh,
+                iou_thresh=cfg.iou_thresh,
+                max_det=cfg.max_det,
+                pre_max=cfg.pre_max,
+            )
         return dets[0], valid[0]
 
     def infer(self, points: np.ndarray) -> dict[str, np.ndarray]:

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import concurrent.futures
 import logging
+import time
 
 import grpc
 
@@ -149,8 +150,6 @@ class _Servicer(service.GRPCInferenceServiceServicer):
     # -- inference ------------------------------------------------------------
 
     def _infer(self, request):
-        import time
-
         t0 = time.perf_counter()
         inputs = codec.parse_infer_request(request)
         result = self._channel.do_inference(
@@ -214,6 +213,7 @@ class InferenceServer:
 
             profiler = StageProfiler()
         self.profiler = profiler
+        self.metrics_enabled = False
         if metrics_port:
             # Degrade, don't die: metrics are optional observability —
             # a missing prometheus_client or an occupied port must not
@@ -225,6 +225,7 @@ class InferenceServer:
                 )
 
                 PrometheusStageExporter(metrics_port).attach(profiler)
+                self.metrics_enabled = True
             except ImportError:
                 log.warning(
                     "prometheus_client not installed; metrics port %d disabled",

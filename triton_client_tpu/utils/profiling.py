@@ -37,18 +37,23 @@ class StageProfiler:
     """
 
     def __init__(self, window: int = 4096) -> None:
+        import collections
+
         self._window = int(window)
         self._lock = threading.Lock()
-        self._stages: dict[str, list[float]] = {}
+        # deque(maxlen=...) evicts in O(1); a list's front-deletion would
+        # memmove the whole window on every sample in the serving path.
+        self._stages: dict[str, "collections.deque[float]"] = {}
+        self._deque = collections.deque
         self._counts: dict[str, int] = {}
         self._listeners: list[Callable[[str, float], None]] = []
 
     def record(self, stage: str, seconds: float) -> None:
         with self._lock:
-            buf = self._stages.setdefault(stage, [])
+            buf = self._stages.get(stage)
+            if buf is None:
+                buf = self._stages[stage] = self._deque(maxlen=self._window)
             buf.append(float(seconds))
-            if len(buf) > self._window:
-                del buf[: len(buf) - self._window]
             self._counts[stage] = self._counts.get(stage, 0) + 1
             listeners = list(self._listeners)
         for listener in listeners:
