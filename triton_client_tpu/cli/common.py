@@ -157,3 +157,29 @@ def maybe_device_trace(args):
     from triton_client_tpu.utils.profiling import device_trace
 
     return device_trace(log_dir)
+
+
+def parse_mesh(spec: str):
+    """'data=4,model=2' -> MeshConfig (empty string -> None: default
+    all-devices data-parallel mesh). Malformed specs exit with a usage
+    message, not a traceback."""
+    if not spec:
+        return None
+    from triton_client_tpu.parallel.mesh import MeshConfig
+
+    valid = {"data", "model", "seq", "pipe"}
+    kwargs = {}
+    for part in spec.split(","):
+        key, _, value = part.partition("=")
+        key = key.strip()
+        if key not in valid:
+            raise SystemExit(
+                f"--mesh: unknown axis {key!r} (valid: {sorted(valid)})"
+            )
+        try:
+            kwargs[key] = int(value)
+        except ValueError:
+            raise SystemExit(
+                f"--mesh: {part!r} is not <axis>=<int> (e.g. 'data=4')"
+            ) from None
+    return MeshConfig(**kwargs)

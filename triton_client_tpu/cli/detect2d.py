@@ -25,13 +25,88 @@ from triton_client_tpu.cli.common import (
     make_profiler,
     make_sink,
     maybe_device_trace,
+    parse_mesh,
     print_report,
 )
+
+
+def _run_multicam(args, channel, spec, class_names) -> None:
+    """Lockstep N-camera batch serving over the mesh data axis."""
+    import copy
+    import os
+
+    from triton_client_tpu.channel.base import InferRequest
+    from triton_client_tpu.drivers.multicam import (
+        MultiCameraDriver,
+        stats_as_driver,
+    )
+    from triton_client_tpu.io.sources import open_source
+
+    if args.gt:
+        raise SystemExit(
+            "--gt is single-stream only; run the evaluation pass without "
+            "--cameras (accuracy is camera-independent)"
+        )
+
+    sources = [
+        open_source(args.input, args.limit) for _ in range(args.cameras)
+    ]
+    profiler = make_profiler(args)
+
+    def infer(inputs):
+        resp = channel.do_inference(
+            InferRequest(
+                model_name=args.model_name or spec.name,
+                model_version=args.model_version,
+                inputs=inputs,
+            )
+        )
+        return resp.outputs
+
+    if profiler is not None:
+        infer = profiler.wrap("infer_batch", infer)
+
+    # One sink per camera rooted at <output>/cam<i>/ so per-camera
+    # outputs never collide on shared frame-numbered filenames.
+    sinks = []
+    for ci in range(args.cameras):
+        cam_args = copy.copy(args)
+        cam_args.output = os.path.join(args.output, f"cam{ci}")
+        sinks.append(make_sink(cam_args, class_names))
+
+    def cam_sink(ci, frame, result):
+        sinks[ci].write(frame, result)
+
+    driver = MultiCameraDriver(infer, sources, sink=cam_sink, warmup=args.warmup)
+    with maybe_device_trace(args):
+        stats = driver.run(max_ticks=args.limit)
+    for sink in sinks:
+        sink.close()
+    if profiler is not None:
+        import sys
+
+        print(profiler.report(), file=sys.stderr)
+    print_report(
+        stats_as_driver(stats), None,
+        {"model": spec.name, "cameras": args.cameras},
+    )
 
 
 def parse_args(argv=None) -> argparse.Namespace:
     parser = argparse.ArgumentParser(description=__doc__)
     add_common_flags(parser)
+    parser.add_argument(
+        "--mesh", default="",
+        help="device mesh for the in-process channel, e.g. 'data=4' "
+        "(multi-camera DP serving) or 'data=4,model=2'",
+    )
+    parser.add_argument(
+        "--cameras", type=int, default=1,
+        help="replicate the input source N times and run the lockstep "
+        "multi-camera driver: one (N, H, W, 3) batch per tick, sharded "
+        "over the mesh data axis (the reference's 'ensemble "
+        "multi-camera' serving, README.md:119)",
+    )
     parser.add_argument(
         "--input-size", type=int, default=512, help="model input H=W (reference 512)"
     )
@@ -164,8 +239,12 @@ def main(argv=None) -> None:
 
         repo = ModelRepository()
         repo.register(spec, pipe.infer_fn())
-        channel = TPUChannel(repo)
+        channel = TPUChannel(repo, mesh_config=parse_mesh(args.mesh))
         infer = channel_infer(channel, spec.name)
+
+    if args.cameras > 1:
+        _run_multicam(args, channel, spec, class_names)
+        return
 
     if args.input.startswith("ros:"):
         from triton_client_tpu.drivers import ros

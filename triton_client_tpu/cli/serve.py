@@ -23,6 +23,11 @@ def main(argv=None) -> None:
     p.add_argument("-a", "--address", default="0.0.0.0:8001")
     p.add_argument("--max-workers", type=int, default=8)
     p.add_argument(
+        "--mesh", default="",
+        help="device mesh, e.g. 'data=4' — batches shard over the data "
+        "axis (multi-camera DP serving)",
+    )
+    p.add_argument(
         "--metrics-port", type=int, default=8002,
         help="Prometheus per-model latency metrics (Triton :8002 parity; "
         "0 disables)",
@@ -36,6 +41,7 @@ def main(argv=None) -> None:
     logging.basicConfig(level=logging.DEBUG if args.verbose else logging.INFO)
 
     from triton_client_tpu.channel.tpu_channel import TPUChannel
+    from triton_client_tpu.cli.common import parse_mesh
     from triton_client_tpu.runtime.disk_repository import scan_disk
     from triton_client_tpu.runtime.server import InferenceServer
 
@@ -48,7 +54,7 @@ def main(argv=None) -> None:
 
     server = InferenceServer(
         repo,
-        TPUChannel(repo),
+        TPUChannel(repo, mesh_config=parse_mesh(args.mesh)),
         address=args.address,
         max_workers=args.max_workers,
         metrics_port=args.metrics_port,

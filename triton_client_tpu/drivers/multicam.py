@@ -1,0 +1,117 @@
+"""Multi-camera lockstep driver: N streams -> one DP-sharded batch.
+
+The reference's nearest analogue is "ensemble multi-camera" serving —
+declared server-side config only (README.md:119 TODO; instance_group
+replication). Here it is first-class: one frame is pulled from each
+camera source per tick, stacked into a (C, H, W, 3) batch whose leading
+axis TPUChannel shards over the mesh's ``data`` axis, inferred in ONE
+device dispatch, and the packed results are demuxed back to per-camera
+sinks. With C cameras on a data=C mesh each chip serves one camera, and
+the batch rides ICI instead of C separate host round-trips.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Mapping, Sequence
+
+import numpy as np
+
+from triton_client_tpu.drivers.driver import DriverStats
+
+
+@dataclasses.dataclass
+class MultiCamStats:
+    ticks: int = 0
+    frames: int = 0
+    wall_s: float = 0.0
+    fps: float = 0.0  # total frames (all cameras) per second
+    p50_ms: float = 0.0  # per-tick batch latency
+    p99_ms: float = 0.0
+    mean_ms: float = 0.0
+
+    def to_dict(self) -> dict[str, float]:
+        return dataclasses.asdict(self)
+
+
+class MultiCameraDriver:
+    """Lockstep pull loop over N frame sources.
+
+    ``infer`` receives {"images": (C, H, W, 3)} -> outputs whose leading
+    axis is the camera axis (the repository infer_fn contract). A sink
+    receives (camera_index, frame, per_camera_result). Streams advance
+    in lockstep; the run ends when ANY camera is exhausted (ragged tails
+    would silently skew a camera's latency statistics)."""
+
+    def __init__(
+        self,
+        infer: Callable[[Mapping[str, np.ndarray]], Mapping[str, Any]],
+        sources: Sequence[Any],
+        sink: Callable[[int, Any, Mapping[str, Any]], None] | None = None,
+        warmup: int = 1,
+    ) -> None:
+        if not sources:
+            raise ValueError("need at least one camera source")
+        self.infer = infer
+        self.sources = list(sources)
+        self.sink = sink
+        self.warmup = warmup
+
+    def run(self, max_ticks: int = 0) -> MultiCamStats:
+        iters = [iter(s) for s in self.sources]
+        latencies: list[float] = []
+        ticks = 0
+        t_start = None
+        while not max_ticks or ticks < max_ticks:
+            frames = []
+            for it in iters:
+                frame = next(it, None)
+                if frame is None:
+                    break
+                frames.append(frame)
+            if len(frames) < len(iters):
+                break
+            batch = np.stack([np.asarray(f.data) for f in frames])
+            if ticks == 0:
+                for _ in range(self.warmup):
+                    self.infer({"images": batch})
+                t_start = time.perf_counter()
+            t0 = time.perf_counter()
+            result = self.infer({"images": batch})
+            latencies.append(time.perf_counter() - t0)
+            if self.sink is not None:
+                for ci, frame in enumerate(frames):
+                    per_cam = {
+                        k: np.asarray(v)[ci]
+                        for k, v in result.items()
+                        if np.ndim(v) > 0 and np.shape(v)[0] == len(frames)
+                    }
+                    self.sink(ci, frame, per_cam)
+            ticks += 1
+
+        wall = (time.perf_counter() - t_start) if t_start is not None else 0.0
+        n_cams = len(self.sources)
+        lat_ms = np.asarray(latencies) * 1e3
+        return MultiCamStats(
+            ticks=ticks,
+            frames=ticks * n_cams,
+            wall_s=wall,
+            fps=ticks * n_cams / wall if wall > 0 else 0.0,
+            p50_ms=float(np.percentile(lat_ms, 50)) if ticks else 0.0,
+            p99_ms=float(np.percentile(lat_ms, 99)) if ticks else 0.0,
+            mean_ms=float(lat_ms.mean()) if ticks else 0.0,
+        )
+
+
+def stats_as_driver(stats: MultiCamStats) -> DriverStats:
+    """Project onto the single-stream DriverStats shape for the shared
+    report printer."""
+    return DriverStats(
+        frames=stats.frames,
+        wall_s=stats.wall_s,
+        fps=stats.fps,
+        p50_ms=stats.p50_ms,
+        p99_ms=stats.p99_ms,
+        mean_ms=stats.mean_ms,
+    )

@@ -55,6 +55,15 @@ class MeshConfig:
 def make_mesh(config: MeshConfig | None = None, devices=None) -> Mesh:
     devices = list(devices if devices is not None else jax.devices())
     config = config or MeshConfig()
+    if config.data > 0:
+        # Fully explicit mesh: claim only the devices it names, so e.g.
+        # --mesh data=4 works on an 8-device host (first 4 devices).
+        want = (
+            config.data
+            * max(1, config.model) * max(1, config.seq) * max(1, config.pipe)
+        )
+        if want < len(devices):
+            devices = devices[:want]
     data, model, seq, pipe = config.resolve(len(devices))
     arr = np.asarray(devices).reshape(data, model, seq, pipe)
     return Mesh(arr, (DATA_AXIS, MODEL_AXIS, SEQ_AXIS, PIPE_AXIS))
