@@ -256,3 +256,61 @@ class TestCenterPoint:
         out = pipeline.infer(pts)
         assert out["pred_boxes"].shape[1] == 7
         assert out["pred_scores"].shape == out["pred_labels"].shape
+
+
+def test_second_decode_topk_matches_full_decode_path():
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from triton_client_tpu.models.second import SECONDConfig, init_second
+    from triton_client_tpu.ops.detect3d_postprocess import (
+        extract_boxes_3d,
+        nms_pack_3d,
+    )
+    from triton_client_tpu.ops.voxelize import VoxelConfig
+
+    cfg = SECONDConfig(
+        voxel=dataclasses.replace(
+            VoxelConfig(),
+            point_cloud_range=(0.0, -10.24, -3.0, 20.48, 10.24, 1.0),
+            max_voxels=128,
+        )
+    )
+    model, variables = init_second(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(5)
+    v = cfg.voxel
+    voxels = jnp.asarray(
+        rng.standard_normal((1, v.max_voxels, v.max_points_per_voxel, 4)),
+        jnp.float32,
+    )
+    nums = jnp.asarray(
+        rng.integers(0, v.max_points_per_voxel, (1, v.max_voxels)), jnp.int32
+    )
+    nx, ny, _ = v.grid_size
+    coords = jnp.stack(
+        [
+            jnp.asarray(rng.integers(0, nx, (1, v.max_voxels)), jnp.int32),
+            jnp.asarray(rng.integers(0, ny, (1, v.max_voxels)), jnp.int32),
+            jnp.zeros((1, v.max_voxels), jnp.int32),
+        ],
+        axis=-1,
+    )
+    heads = model.apply(variables, voxels, nums, coords, train=False)
+
+    pred = model.decode(heads)
+    ref_dets, ref_valid = extract_boxes_3d(
+        pred["boxes"], pred["scores"], score_thresh=0.05, iou_thresh=0.2,
+        max_det=32, pre_max=128,
+    )
+    cand = model.decode_topk(heads, pre_max=128, score_thresh=0.05)
+    fast_dets, fast_valid = nms_pack_3d(
+        cand["boxes"], cand["scores"], cand["labels"],
+        iou_thresh=0.2, max_det=32,
+    )
+    np.testing.assert_array_equal(np.asarray(ref_valid), np.asarray(fast_valid))
+    np.testing.assert_allclose(
+        np.asarray(ref_dets), np.asarray(fast_dets), atol=1e-5
+    )

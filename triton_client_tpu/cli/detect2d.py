@@ -36,16 +36,19 @@ def _run_multicam(args, channel, spec, class_names) -> None:
     import os
 
     from triton_client_tpu.channel.base import InferRequest
-    from triton_client_tpu.drivers.multicam import (
-        MultiCameraDriver,
-        stats_as_driver,
-    )
+    from triton_client_tpu.drivers.multicam import MultiCameraDriver
     from triton_client_tpu.io.sources import open_source
 
     if args.gt:
         raise SystemExit(
             "--gt is single-stream only; run the evaluation pass without "
             "--cameras (accuracy is camera-independent)"
+        )
+    if args.input.startswith("ros:"):
+        raise SystemExit(
+            "--cameras is replay/synthetic-only for now; live multi-topic "
+            "ROS batching needs one subscriber per topic (run one "
+            "detect2d per topic, or drop --cameras)"
         )
 
     sources = [
@@ -78,18 +81,19 @@ def _run_multicam(args, channel, spec, class_names) -> None:
         sinks[ci].write(frame, result)
 
     driver = MultiCameraDriver(infer, sources, sink=cam_sink, warmup=args.warmup)
-    with maybe_device_trace(args):
-        stats = driver.run(max_ticks=args.limit)
-    for sink in sinks:
-        sink.close()
+    try:
+        with maybe_device_trace(args):
+            stats = driver.run(max_ticks=args.limit)
+    finally:
+        # flush buffered sinks even when infer raises mid-run (the
+        # single-stream driver closes its sink in a finally too)
+        for sink in sinks:
+            sink.close()
     if profiler is not None:
         import sys
 
         print(profiler.report(), file=sys.stderr)
-    print_report(
-        stats_as_driver(stats), None,
-        {"model": spec.name, "cameras": args.cameras},
-    )
+    print_report(stats, None, {"model": spec.name, "cameras": args.cameras})
 
 
 def parse_args(argv=None) -> argparse.Namespace:
@@ -219,6 +223,11 @@ def main(argv=None) -> None:
             raise SystemExit(
                 "--conf/--iou are server-side in remote mode: set them in "
                 "the model repository entry's config.yaml"
+            )
+        if args.mesh:
+            raise SystemExit(
+                "--mesh is server-side in remote mode: pass it to "
+                "'serve --mesh ...' instead"
             )
         from triton_client_tpu.channel.grpc_channel import GRPCChannel
 

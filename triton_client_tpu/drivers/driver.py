@@ -40,9 +40,27 @@ class DriverStats:
     p50_ms: float = 0.0
     p99_ms: float = 0.0
     mean_ms: float = 0.0
+    # device dispatches; == frames single-stream, frames/cameras for the
+    # multi-camera lockstep driver (latency percentiles are per-tick)
+    ticks: int = 0
 
     def to_dict(self) -> dict[str, float]:
         return dataclasses.asdict(self)
+
+
+def latency_stats(latencies_s: list, frames: int, wall_s: float, ticks: int) -> "DriverStats":
+    """Shared percentile/fps math for the single- and multi-stream drivers."""
+    lat_ms = np.asarray(latencies_s) * 1e3
+    n = len(latencies_s)
+    return DriverStats(
+        frames=frames,
+        wall_s=wall_s,
+        fps=frames / wall_s if wall_s > 0 else 0.0,
+        p50_ms=float(np.percentile(lat_ms, 50)) if n else 0.0,
+        p99_ms=float(np.percentile(lat_ms, 99)) if n else 0.0,
+        mean_ms=float(lat_ms.mean()) if n else 0.0,
+        ticks=ticks,
+    )
 
 
 class InferenceDriver:
@@ -148,15 +166,7 @@ class InferenceDriver:
         if error:
             raise error[0]
 
-        lat_ms = np.asarray(latencies) * 1e3
-        return DriverStats(
-            frames=n,
-            wall_s=wall,
-            fps=n / wall if wall > 0 else 0.0,
-            p50_ms=float(np.percentile(lat_ms, 50)) if n else 0.0,
-            p99_ms=float(np.percentile(lat_ms, 99)) if n else 0.0,
-            mean_ms=float(lat_ms.mean()) if n else 0.0,
-        )
+        return latency_stats(latencies, frames=n, wall_s=wall, ticks=n)
 
 
 def detect2d_infer(pipeline) -> InferFn:

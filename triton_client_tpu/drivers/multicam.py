@@ -12,27 +12,12 @@ the batch rides ICI instead of C separate host round-trips.
 
 from __future__ import annotations
 
-import dataclasses
 import time
 from typing import Any, Callable, Mapping, Sequence
 
 import numpy as np
 
-from triton_client_tpu.drivers.driver import DriverStats
-
-
-@dataclasses.dataclass
-class MultiCamStats:
-    ticks: int = 0
-    frames: int = 0
-    wall_s: float = 0.0
-    fps: float = 0.0  # total frames (all cameras) per second
-    p50_ms: float = 0.0  # per-tick batch latency
-    p99_ms: float = 0.0
-    mean_ms: float = 0.0
-
-    def to_dict(self) -> dict[str, float]:
-        return dataclasses.asdict(self)
+from triton_client_tpu.drivers.driver import DriverStats, latency_stats
 
 
 class MultiCameraDriver:
@@ -58,7 +43,7 @@ class MultiCameraDriver:
         self.sink = sink
         self.warmup = warmup
 
-    def run(self, max_ticks: int = 0) -> MultiCamStats:
+    def run(self, max_ticks: int = 0) -> DriverStats:
         iters = [iter(s) for s in self.sources]
         latencies: list[float] = []
         ticks = 0
@@ -91,27 +76,6 @@ class MultiCameraDriver:
             ticks += 1
 
         wall = (time.perf_counter() - t_start) if t_start is not None else 0.0
-        n_cams = len(self.sources)
-        lat_ms = np.asarray(latencies) * 1e3
-        return MultiCamStats(
-            ticks=ticks,
-            frames=ticks * n_cams,
-            wall_s=wall,
-            fps=ticks * n_cams / wall if wall > 0 else 0.0,
-            p50_ms=float(np.percentile(lat_ms, 50)) if ticks else 0.0,
-            p99_ms=float(np.percentile(lat_ms, 99)) if ticks else 0.0,
-            mean_ms=float(lat_ms.mean()) if ticks else 0.0,
+        return latency_stats(
+            latencies, frames=ticks * len(self.sources), wall_s=wall, ticks=ticks
         )
-
-
-def stats_as_driver(stats: MultiCamStats) -> DriverStats:
-    """Project onto the single-stream DriverStats shape for the shared
-    report printer."""
-    return DriverStats(
-        frames=stats.frames,
-        wall_s=stats.wall_s,
-        fps=stats.fps,
-        p50_ms=stats.p50_ms,
-        p99_ms=stats.p99_ms,
-        mean_ms=stats.mean_ms,
-    )

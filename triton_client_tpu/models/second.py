@@ -194,6 +194,54 @@ class SECONDIoU(nn.Module):
             "iou": iou.reshape(b, h, w, a),
         }
 
+    def decode_topk(
+        self,
+        heads: dict[str, jnp.ndarray],
+        pre_max: int = 512,
+        score_thresh: float = 0.1,
+    ) -> dict[str, jnp.ndarray]:
+        """Gate + top-k on the IoU-RECTIFIED score, then decode only the
+        survivors (the PointPillars.decode_topk counterpart).
+
+        Unlike the plain anchor head, the ranking metric here is
+        cls^(1-a) * q^a — not monotonic in the class logit alone — so
+        the rectified score is computed densely (cheap elementwise over
+        the anchor grid) and only the residual BOX decode is deferred to
+        the K gathered candidates. Ordering matches decode() +
+        extract_boxes_3d exactly."""
+        cfg = self.cfg
+        b, h, w, a_, nc = heads["cls"].shape
+        n = h * w * a_
+        cls_score = jax.nn.sigmoid(heads["cls"].reshape(b, n, nc))
+        q = jnp.clip(
+            (jnp.clip(heads["iou"].reshape(b, n), -1.0, 1.0) + 1.0) / 2.0,
+            1e-6, 1.0,
+        )
+        al = cfg.iou_alpha
+        score = cls_score ** (1.0 - al) * (q[..., None] ** al)
+
+        best = score.max(axis=-1)
+        labels = score.argmax(axis=-1) + 1
+        k = min(pre_max, n)
+        top_scores, top_idx = jax.lax.top_k(best, k)
+
+        box = heads["box"].reshape(b, n, 7)
+        dirs = heads["dir"].reshape(b, n, cfg.num_dir_bins)
+        anchors = generate_anchors(cfg).reshape(n, 7)
+        box_k = jnp.take_along_axis(box, top_idx[..., None], axis=1)
+        dir_k = jnp.take_along_axis(dirs, top_idx[..., None], axis=1)
+        labels_k = jnp.take_along_axis(labels, top_idx, axis=1)
+        anchors_k = anchors[top_idx]
+
+        decoded = decode_boxes(box_k, anchors_k)
+        dir_bin = jnp.argmax(dir_k, axis=-1)
+        rot = rectify_direction(
+            decoded[..., 6], dir_bin, cfg.num_dir_bins, cfg.dir_offset
+        )
+        decoded = jnp.concatenate([decoded[..., :6], rot[..., None]], axis=-1)
+        scores = jnp.where(top_scores > score_thresh, top_scores, -jnp.inf)
+        return {"boxes": decoded, "scores": scores, "labels": labels_k}
+
     def decode(self, heads: dict[str, jnp.ndarray]) -> dict[str, jnp.ndarray]:
         """Heads -> flat boxes (B, N, 7) + IoU-rectified scores
         (B, N, nc). The IoU head predicts in [-1, 1] (tanh-free raw

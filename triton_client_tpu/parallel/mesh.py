@@ -57,12 +57,20 @@ def make_mesh(config: MeshConfig | None = None, devices=None) -> Mesh:
     config = config or MeshConfig()
     if config.data > 0:
         # Fully explicit mesh: claim only the devices it names, so e.g.
-        # --mesh data=4 works on an 8-device host (first 4 devices).
+        # --mesh data=4 works on an 8-device host (first 4 devices) —
+        # loudly, so a mis-sized training config can't silently run at
+        # partial throughput.
         want = (
             config.data
             * max(1, config.model) * max(1, config.seq) * max(1, config.pipe)
         )
         if want < len(devices):
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "mesh %s uses %d of %d available devices",
+                config, want, len(devices),
+            )
             devices = devices[:want]
     data, model, seq, pipe = config.resolve(len(devices))
     arr = np.asarray(devices).reshape(data, model, seq, pipe)
