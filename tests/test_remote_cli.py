@@ -147,3 +147,42 @@ def test_channel_infer3d_over_grpc(yolo_server):
         channel.close()
     finally:
         srv.stop()
+
+
+def test_detect2d_cli_streaming_mode(yolo_server, tmp_path, capsys):
+    """--streaming pumps frames through one ModelStreamInfer stream."""
+    server, model_name = yolo_server
+    from triton_client_tpu.cli.detect2d import main
+
+    import json
+
+    main(
+        [
+            "-u", f"grpc:127.0.0.1:{server.port}",
+            "-m", model_name,
+            "--streaming",
+            "-i", "synthetic:5:64x64",
+            "--sink", "jsonl",
+            "-o", str(tmp_path),
+            "--limit", "5",
+        ]
+    )
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert report["streaming"] is True
+    assert report["driver"]["frames"] == 5
+    lines = (tmp_path / "detections.jsonl").read_text().splitlines()
+    assert len(lines) == 5
+
+
+def test_streaming_flag_combos_rejected(yolo_server, tmp_path):
+    server, model_name = yolo_server
+    from triton_client_tpu.cli.detect2d import main
+
+    base = ["-u", f"grpc:127.0.0.1:{server.port}", "-m", model_name,
+            "--streaming", "-i", "synthetic:2:64x64"]
+    with pytest.raises(SystemExit, match="unary-mode"):
+        main(base + ["--gt", str(tmp_path / "gt.jsonl")])
+    with pytest.raises(SystemExit, match="does not combine"):
+        main(base + ["--cameras", "2"])
+    with pytest.raises(SystemExit, match="remote ModelStreamInfer"):
+        main(["--streaming", "-i", "synthetic:2:64x64", "--input-size", "64"])

@@ -30,6 +30,81 @@ from triton_client_tpu.cli.common import (
 )
 
 
+def _run_streaming(args, channel, spec, class_names) -> None:
+    """Pump every source frame through ONE bidirectional
+    ModelStreamInfer stream and sink responses as they arrive — requests
+    pipeline instead of blocking one round-trip per frame."""
+    import time
+
+    import numpy as np
+
+    from triton_client_tpu.channel.base import InferRequest
+    from triton_client_tpu.drivers.driver import latency_stats
+    from triton_client_tpu.io.sources import open_source
+
+    if args.input.startswith("ros:"):
+        raise SystemExit("--streaming is replay-mode only; drop it for ros:")
+
+    source = open_source(args.input, args.limit)
+    frames = iter(source)
+    first = next(frames, None)
+    if first is None:
+        raise SystemExit("input source is empty")
+    # Warmup through the unary path so the server-side jit compile
+    # (minutes cold on TPU) never lands in the streamed latency stats —
+    # matching the InferenceDriver/MultiCameraDriver methodology.
+    for _ in range(args.warmup):
+        channel.do_inference(
+            InferRequest(
+                model_name=args.model_name,
+                model_version=args.model_version,
+                inputs={"images": np.asarray(first.data)[None]},
+            )
+        )
+
+    in_flight = {}
+    sent = {}
+
+    def req_iter():
+        import itertools
+
+        for i, frame in enumerate(itertools.chain([first], frames)):
+            if args.limit and i >= args.limit:
+                break
+            rid = str(i)
+            in_flight[rid] = frame
+            sent[rid] = time.perf_counter()
+            yield InferRequest(
+                model_name=args.model_name,
+                model_version=args.model_version,
+                inputs={"images": np.asarray(frame.data)[None]},
+                request_id=rid,
+            )
+
+    sink = make_sink(args, class_names)
+    latencies = []
+    n = 0
+    t0 = time.perf_counter()
+    try:
+        for resp in channel.infer_stream(req_iter()):
+            latencies.append(time.perf_counter() - sent.pop(resp.request_id))
+            frame = in_flight.pop(resp.request_id)
+            out = {
+                k: (v[0] if np.ndim(v) > 0 and np.shape(v)[0] == 1 else v)
+                for k, v in resp.outputs.items()
+            }
+            sink.write(frame, out)
+            n += 1
+    finally:
+        sink.close()
+    wall = time.perf_counter() - t0
+    print_report(
+        latency_stats(latencies, frames=n, wall_s=wall, ticks=n),
+        None,
+        {"model": spec.name, "streaming": True},
+    )
+
+
 def _run_multicam(args, channel, spec, class_names) -> None:
     """Lockstep N-camera batch serving over the mesh data axis."""
     import copy
@@ -236,10 +311,31 @@ def main(argv=None) -> None:
         class_names = load_names(args.names) or tuple(
             spec.extra.get("class_names", ())
         )
+        if args.streaming:
+            # the reference defines --streaming but never exercises it
+            # (main.py:66-70); here it is the pipelined ModelStreamInfer
+            # path: requests flow while earlier responses are in flight.
+            if args.gt:
+                raise SystemExit(
+                    "--gt is unary-mode only; drop --streaming to evaluate"
+                )
+            if args.cameras > 1:
+                raise SystemExit(
+                    "--cameras batches locally; it does not combine with "
+                    "--streaming"
+                )
+            _run_streaming(args, channel, spec, class_names)
+            return
         infer = channel_infer(
             channel, args.model_name, model_version=args.model_version
         )
     else:
+        if args.streaming:
+            raise SystemExit(
+                "--streaming is the remote ModelStreamInfer path; use "
+                "-u grpc:<host:port> (in-process inference has no wire "
+                "to stream over)"
+            )
         pipe, spec = build(args)
         class_names = load_names(args.names)
 
