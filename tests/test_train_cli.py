@@ -1,0 +1,82 @@
+"""train CLI: loss decreases, checkpoints land, export serves."""
+
+import json
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+
+def test_train_cli_synthetic_checkpoint_and_export(tmp_path, capsys):
+    from triton_client_tpu.cli.train import main
+
+    ckpt = tmp_path / "ckpts"
+    repo = tmp_path / "repo"
+    main(
+        [
+            "-i", "synthetic:8:64x64",
+            "--input-size", "64",
+            "-c", "2",
+            "-b", str(len(jax.devices())),
+            "--steps", "4",
+            "--mesh", f"data={len(jax.devices())}",
+            "--checkpoint-dir", str(ckpt),
+            "--save-every", "2",
+            "--export", str(repo),
+            "-m", "trained_tiny",
+            "--log-every", "2",
+        ]
+    )
+    out = capsys.readouterr().out
+    assert "step 4/4" in out
+    assert "exported" in out
+
+    from triton_client_tpu.runtime.checkpoint import CheckpointManager
+
+    assert CheckpointManager(str(ckpt)).latest_step() == 4
+
+    from triton_client_tpu.runtime import disk_repository as dr
+
+    served = dr.scan_disk(repo)
+    assert served.list_models() == [("trained_tiny", "1")]
+    got = served.get("trained_tiny").infer_fn(
+        {"images": np.zeros((1, 64, 64, 3), np.float32)}
+    )
+    assert got["detections"].shape[-1] == 6
+
+
+def test_train_cli_gt_jsonl_and_resume(tmp_path, capsys):
+    from triton_client_tpu.cli.train import main
+
+    gt = tmp_path / "gt.jsonl"
+    with open(gt, "w") as f:
+        for i in range(8):
+            f.write(json.dumps(
+                {"frame_id": i, "boxes": [[8, 8, 40, 40, 1]]}
+            ) + "\n")
+    ckpt = tmp_path / "ckpts"
+    base = [
+        "-i", "synthetic:8:64x64",
+        "--input-size", "64",
+        "-c", "2",
+        "-b", "2",
+        "--mesh", "data=2",
+        "--gt", str(gt),
+        "--checkpoint-dir", str(ckpt),
+        "--save-every", "2",
+        "--log-every", "1",
+    ]
+    main(base + ["--steps", "2"])
+    capsys.readouterr()
+    main(base + ["--steps", "4", "--resume"])
+    out = capsys.readouterr().out
+    assert "resumed from step 2" in out
+    assert "step 4/4" in out
+
+
+def test_train_cli_rejects_indivisible_batch():
+    from triton_client_tpu.cli.train import main
+
+    with pytest.raises(SystemExit, match="divide"):
+        main(["-b", "3", "--mesh", "data=2", "--steps", "1"])

@@ -5,6 +5,7 @@ Commands map 1:1 onto the reference's entry scripts:
   detect3d   — main3d.py / bag3d.py
   evaluate   — evaluate.py
   serve      — tritonserver --model-repository equivalent (KServe v2)
+  train      — sharded fine-tuning on the mesh (export -> serve)
   deploy     — deploy.sh parity (convert checkpoint -> push repo entry)
   fetch-model — download_model_s3_keycloak.py parity (OIDC + S3)
   pc-extract — tools/pc_extractor.py (bag -> .npy point clouds)
@@ -21,6 +22,7 @@ COMMANDS = (
     "detect3d",
     "evaluate",
     "serve",
+    "train",
     "deploy",
     "fetch-model",
     "pc-extract",
@@ -43,6 +45,8 @@ def main() -> None:
         from triton_client_tpu.cli.evaluate import main as run
     elif cmd == "serve":
         from triton_client_tpu.cli.serve import main as run
+    elif cmd == "train":
+        from triton_client_tpu.cli.train import main as run
     elif cmd == "deploy":
         from triton_client_tpu.deploy.push import main as run
     elif cmd == "fetch-model":

@@ -134,10 +134,16 @@ class GRPCChannel(BaseChannel):
         except grpc.RpcError:
             return False
 
-    def infer_stream(self, requests):
+    def infer_stream(self, requests, stream_timeout_s: float = 3600.0):
         """Bidirectional streaming inference (the reference's unused
         --streaming flag, main.py:66-70, made real). ``requests`` is an
-        iterable of InferRequest; yields InferResponse."""
+        iterable of InferRequest; yields InferResponse.
+
+        ``stream_timeout_s`` bounds the WHOLE stream (gRPC deadlines are
+        per-call): a stalled server or a silent network partition
+        surfaces as DEADLINE_EXCEEDED instead of hanging the client
+        forever — the unary path gets the same protection from
+        ``timeout_s`` per request."""
 
         def wire_iter():
             for r in requests:
@@ -148,7 +154,9 @@ class GRPCChannel(BaseChannel):
                     request_id=r.request_id,
                 )
 
-        for resp in self._stub.ModelStreamInfer(wire_iter()):
+        for resp in self._stub.ModelStreamInfer(
+            wire_iter(), timeout=stream_timeout_s
+        ):
             if resp.error_message:
                 raise RuntimeError(resp.error_message)
             inner = resp.infer_response

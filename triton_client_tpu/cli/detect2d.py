@@ -324,6 +324,12 @@ def main(argv=None) -> None:
                     "--cameras batches locally; it does not combine with "
                     "--streaming"
                 )
+            if args.profile or args.profile_trace:
+                raise SystemExit(
+                    "--profile/--profile-trace are not wired for the "
+                    "streaming path yet; per-request latency is already "
+                    "in the report"
+                )
             _run_streaming(args, channel, spec, class_names)
             return
         infer = channel_infer(

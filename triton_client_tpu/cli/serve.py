@@ -60,9 +60,11 @@ def main(argv=None) -> None:
         metrics_port=args.metrics_port,
     )
     server.start()
-    print(f"KServe v2 gRPC server listening on port {server.port}")
+    # flush=True: supervisors/drives parse this line through a pipe,
+    # where block buffering would hold it until exit.
+    print(f"KServe v2 gRPC server listening on port {server.port}", flush=True)
     if server.metrics_enabled:
-        print(f"Prometheus metrics on :{args.metrics_port}")
+        print(f"Prometheus metrics on :{args.metrics_port}", flush=True)
     try:
         server.wait()
     except KeyboardInterrupt:

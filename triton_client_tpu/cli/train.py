@@ -1,0 +1,213 @@
+"""``train`` entry point: sharded YOLOv5 fine-tuning on the mesh.
+
+The reference is inference-only — weights arrive as server-side
+artifacts trained elsewhere (SURVEY.md §5 checkpoint/resume). This
+closes the loop TPU-natively: fine-tune (e.g. the crop/weed classes)
+with data parallelism over the same mesh that serves, checkpoint with
+retention, resume, and export the result straight into a model
+repository entry the serve CLI loads.
+
+    python -m triton_client_tpu train -i images/ --gt gt.jsonl -c 2 \
+        --steps 500 --checkpoint-dir ckpts --export /opt/model_repo
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+
+import numpy as np
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("-i", "--input", default="synthetic:64",
+                   help="image dir | synthetic[:N[:HxW]]")
+    p.add_argument("--gt", default="",
+                   help="ground-truth JSONL ({frame_id, boxes:[[x1,y1,x2,y2,cls]]}); "
+                   "omitted with synthetic input -> random boxes")
+    p.add_argument("--variant", default="n", help="yolov5 variant (n/s/m/l/x)")
+    p.add_argument("-c", "--classes", type=int, default=2)
+    p.add_argument("--input-size", type=int, default=512)
+    p.add_argument("-b", "--batch-size", type=int, default=8)
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--max-boxes", type=int, default=32,
+                   help="targets padded per image (static shapes)")
+    p.add_argument("--mesh", default="",
+                   help="e.g. 'data=8' or 'data=4,model=2'")
+    p.add_argument("--checkpoint-dir", default="",
+                   help="save TrainState every --save-every steps")
+    p.add_argument("--save-every", type=int, default=100)
+    p.add_argument("--resume", action="store_true",
+                   help="restore the latest step from --checkpoint-dir")
+    p.add_argument("--export", default="",
+                   help="model-repository root to export final weights into")
+    p.add_argument("-m", "--model-name", default="yolov5_trained")
+    p.add_argument("--log-every", type=int, default=10)
+    return p.parse_args(argv)
+
+
+def _load_batches(args, rng: np.random.Generator):
+    """Yield (images (B, S, S, 3) f32, targets (B, T, 5) [cls, cx, cy,
+    w, h] pixels) forever, cycling the source."""
+    from triton_client_tpu.cli.common import load_gt_lookup
+    from triton_client_tpu.io.sources import open_source
+
+    size = args.input_size
+    lookup = load_gt_lookup(args.gt) if args.gt else None
+
+    def frame_stream():
+        while True:
+            source = open_source(args.input, 0)
+            empty = True
+            for frame in source:
+                empty = False
+                yield frame
+            if empty:
+                raise SystemExit(f"no frames in {args.input!r}")
+
+    def to_example(frame):
+        img = np.asarray(frame.data, np.float32)
+        h, w = img.shape[:2]
+        if (h, w) != (size, size):
+            import cv2
+
+            img = cv2.resize(img.astype(np.uint8), (size, size)).astype(np.float32)
+        # Train on the SERVING input distribution: the fused pipeline
+        # normalizes with scaling='yolo' (x/255, ops/preprocess.py), so
+        # the train step must see the same 0-1 range or the exported
+        # weights (incl. adapted batch_stats) are invalidated at serve
+        # time.
+        img = img / 255.0
+        targets = np.zeros((args.max_boxes, 5), np.float32)
+        if lookup is not None:
+            gts = lookup(frame)
+            if gts is not None and len(gts):
+                gts = np.asarray(gts, np.float32)[: args.max_boxes]
+                sx, sy = size / w, size / h
+                cx = (gts[:, 0] + gts[:, 2]) / 2 * sx
+                cy = (gts[:, 1] + gts[:, 3]) / 2 * sy
+                bw = (gts[:, 2] - gts[:, 0]) * sx
+                bh = (gts[:, 3] - gts[:, 1]) * sy
+                targets[: len(gts)] = np.stack(
+                    [gts[:, 4], cx, cy, bw, bh], axis=-1
+                )
+        else:
+            # synthetic self-supervision: random plausible boxes
+            n = rng.integers(1, 4)
+            for t in range(n):
+                bw, bh = rng.uniform(size * 0.1, size * 0.4, 2)
+                cx = rng.uniform(bw / 2, size - bw / 2)
+                cy = rng.uniform(bh / 2, size - bh / 2)
+                targets[t] = [rng.integers(0, args.classes), cx, cy, bw, bh]
+        return img, targets
+
+    stream = frame_stream()
+    while True:
+        examples = [to_example(f) for f in itertools.islice(stream, args.batch_size)]
+        yield (
+            np.stack([e[0] for e in examples]),
+            np.stack([e[1] for e in examples]),
+        )
+
+
+def main(argv=None) -> None:
+    args = parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from triton_client_tpu.cli.common import parse_mesh
+    from triton_client_tpu.models.yolov5 import DEFAULT_ANCHORS, init_yolov5
+    from triton_client_tpu.parallel.mesh import make_mesh
+    from triton_client_tpu.parallel.train import (
+        LossConfig,
+        TrainState,
+        init_train_state,
+        make_train_step,
+    )
+
+    mesh = make_mesh(parse_mesh(args.mesh))
+    if args.batch_size % mesh.shape["data"]:
+        raise SystemExit(
+            f"--batch-size {args.batch_size} must divide over the data "
+            f"axis ({mesh.shape['data']})"
+        )
+
+    model, variables = init_yolov5(
+        jax.random.PRNGKey(0),
+        num_classes=args.classes,
+        variant=args.variant,
+        input_hw=(args.input_size, args.input_size),
+    )
+    optimizer = optax.adam(args.lr)
+    loss_cfg = LossConfig(num_classes=args.classes, anchors=DEFAULT_ANCHORS)
+    state = init_train_state(model, variables, optimizer, mesh)
+
+    manager = None
+    if args.resume and not args.checkpoint_dir:
+        raise SystemExit("--resume requires --checkpoint-dir")
+    if args.checkpoint_dir:
+        from triton_client_tpu.runtime.checkpoint import CheckpointManager
+
+        manager = CheckpointManager(args.checkpoint_dir)
+        if args.resume and manager.latest_step() is None:
+            raise SystemExit(
+                f"--resume: no checkpoint found under {args.checkpoint_dir!r}"
+            )
+        if args.resume:
+            # Restore to host, then re-shard through the same init path
+            # (orbax restores leaf placements inconsistently against a
+            # mixed replicated/sharded `like` tree).
+            host = manager.restore(like=jax.tree.map(np.asarray, state))
+            fresh = init_train_state(
+                model, jax.tree.map(np.asarray, host.variables), optimizer, mesh
+            )
+            # opt_state stays as uncommitted host leaves — the jitted
+            # step places them to match the param shardings; committing
+            # them to a single device would conflict with the mesh.
+            state = TrainState(
+                variables=fresh.variables,
+                opt_state=jax.tree.map(np.asarray, host.opt_state),
+                step=np.asarray(host.step),
+            )
+            print(f"resumed from step {int(state.step)}")
+
+    step_fn = make_train_step(model, optimizer, loss_cfg, mesh)
+    rng = np.random.default_rng(0)
+    batches = _load_batches(args, rng)
+
+    start = int(state.step)
+    for step in range(start, args.steps):
+        images, targets = next(batches)
+        state, metrics = step_fn(state, jnp.asarray(images), jnp.asarray(targets))
+        if (step + 1) % args.log_every == 0 or step + 1 == args.steps:
+            m = {k: round(float(v), 4) for k, v in metrics.items()}
+            print(f"step {step + 1}/{args.steps} {m}")
+        if manager is not None and (step + 1) % args.save_every == 0:
+            manager.save(step + 1, state)
+    if manager is not None and int(state.step) > start:
+        manager.save(int(state.step), state)
+        manager.close()
+
+    if args.export:
+        from triton_client_tpu.runtime.disk_repository import export_model
+
+        doc = {
+            "family": "yolov5",
+            "model": {
+                "variant": args.variant,
+                "num_classes": args.classes,
+                "input_hw": [args.input_size, args.input_size],
+            },
+        }
+        # gather sharded leaves to host before serialization
+        host_vars = jax.tree.map(np.asarray, state.variables)
+        entry = export_model(args.export, args.model_name, doc, variables=host_vars)
+        print(f"exported {entry} (serve with: serve -r {args.export})")
+
+
+if __name__ == "__main__":
+    main()
