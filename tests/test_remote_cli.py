@@ -186,3 +186,40 @@ def test_streaming_flag_combos_rejected(yolo_server, tmp_path):
         main(base + ["--cameras", "2"])
     with pytest.raises(SystemExit, match="remote ModelStreamInfer"):
         main(["--streaming", "-i", "synthetic:2:64x64", "--input-size", "64"])
+
+
+def test_serve_with_batching_channel(tmp_path):
+    """Concurrent remote requests through a serve-style stack with the
+    micro-batcher in front of TPUChannel."""
+    import concurrent.futures
+
+    from triton_client_tpu.pipelines.detect2d import build_yolov5_pipeline
+    from triton_client_tpu.runtime.batching import BatchingChannel
+    from triton_client_tpu.channel.base import InferRequest
+
+    pipe, spec, _ = build_yolov5_pipeline(
+        jax.random.PRNGKey(0), variant="n", num_classes=2, input_hw=(64, 64)
+    )
+    repo = ModelRepository()
+    repo.register(spec, pipe.infer_fn())
+    channel = BatchingChannel(
+        TPUChannel(repo), max_batch=4, timeout_us=20_000
+    )
+    server = InferenceServer(repo, channel, address="127.0.0.1:0", max_workers=4)
+    server.start()
+    try:
+        grpc_channel = GRPCChannel(f"127.0.0.1:{server.port}", timeout_s=60.0)
+
+        def one(i):
+            img = np.full((1, 64, 64, 3), 10.0 * i, np.float32)
+            return grpc_channel.do_inference(
+                InferRequest(model_name=spec.name, inputs={"images": img})
+            ).outputs["detections"].shape
+
+        with concurrent.futures.ThreadPoolExecutor(max_workers=4) as ex:
+            shapes = list(ex.map(one, range(8)))
+        assert all(s == (1, 300, 6) for s in shapes)
+        grpc_channel.close()
+    finally:
+        server.stop()
+        channel.close()

@@ -134,7 +134,7 @@ class GRPCChannel(BaseChannel):
         except grpc.RpcError:
             return False
 
-    def infer_stream(self, requests, stream_timeout_s: float = 3600.0):
+    def infer_stream(self, requests, stream_timeout_s: float | None = 3600.0):
         """Bidirectional streaming inference (the reference's unused
         --streaming flag, main.py:66-70, made real). ``requests`` is an
         iterable of InferRequest; yields InferResponse.
@@ -143,7 +143,8 @@ class GRPCChannel(BaseChannel):
         per-call): a stalled server or a silent network partition
         surfaces as DEADLINE_EXCEEDED instead of hanging the client
         forever — the unary path gets the same protection from
-        ``timeout_s`` per request."""
+        ``timeout_s`` per request. Pass None for an unbounded session
+        (long-lived live streams)."""
 
         def wire_iter():
             for r in requests:

@@ -85,8 +85,11 @@ def _run_streaming(args, channel, spec, class_names) -> None:
     latencies = []
     n = 0
     t0 = time.perf_counter()
+    stream_timeout = args.stream_timeout_s if args.stream_timeout_s > 0 else None
     try:
-        for resp in channel.infer_stream(req_iter()):
+        for resp in channel.infer_stream(
+            req_iter(), stream_timeout_s=stream_timeout
+        ):
             latencies.append(time.perf_counter() - sent.pop(resp.request_id))
             frame = in_flight.pop(resp.request_id)
             out = {
@@ -185,6 +188,11 @@ def parse_args(argv=None) -> argparse.Namespace:
         "multi-camera driver: one (N, H, W, 3) batch per tick, sharded "
         "over the mesh data axis (the reference's 'ensemble "
         "multi-camera' serving, README.md:119)",
+    )
+    parser.add_argument(
+        "--stream-timeout-s", type=float, default=3600.0,
+        help="whole-stream deadline for --streaming (0 = unbounded for "
+        "long-lived live sessions)",
     )
     parser.add_argument(
         "--input-size", type=int, default=512, help="model input H=W (reference 512)"

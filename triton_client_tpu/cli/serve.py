@@ -28,6 +28,16 @@ def main(argv=None) -> None:
         "axis (multi-camera DP serving)",
     )
     p.add_argument(
+        "--batching", action="store_true",
+        help="micro-batch concurrent requests before dispatch (Triton's "
+        "dynamic batcher role; native C++ batcher with python fallback)",
+    )
+    p.add_argument("--max-batch", type=int, default=8)
+    p.add_argument(
+        "--batch-timeout-us", type=int, default=2000,
+        help="max time a request waits for batch-mates",
+    )
+    p.add_argument(
         "--metrics-port", type=int, default=8002,
         help="Prometheus per-model latency metrics (Triton :8002 parity; "
         "0 disables)",
@@ -52,9 +62,22 @@ def main(argv=None) -> None:
         if args.warmup and model.warmup is not None:
             model.warmup()
 
+    channel = TPUChannel(repo, mesh_config=parse_mesh(args.mesh))
+    if args.batching:
+        from triton_client_tpu.runtime.batching import BatchingChannel
+
+        channel = BatchingChannel(
+            channel,
+            max_batch=args.max_batch,
+            timeout_us=args.batch_timeout_us,
+        )
+        print(
+            f"micro-batching: max_batch={args.max_batch} "
+            f"timeout={args.batch_timeout_us}us", flush=True,
+        )
     server = InferenceServer(
         repo,
-        TPUChannel(repo, mesh_config=parse_mesh(args.mesh)),
+        channel,
         address=args.address,
         max_workers=args.max_workers,
         metrics_port=args.metrics_port,

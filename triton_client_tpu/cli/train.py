@@ -129,6 +129,17 @@ def main(argv=None) -> None:
         make_train_step,
     )
 
+    # cheap usage validation BEFORE paying for model/mesh init
+    if args.resume and not args.checkpoint_dir:
+        raise SystemExit("--resume requires --checkpoint-dir")
+    if args.resume:
+        from triton_client_tpu.runtime.checkpoint import CheckpointManager
+
+        if CheckpointManager(args.checkpoint_dir).latest_step() is None:
+            raise SystemExit(
+                f"--resume: no checkpoint found under {args.checkpoint_dir!r}"
+            )
+
     mesh = make_mesh(parse_mesh(args.mesh))
     if args.batch_size % mesh.shape["data"]:
         raise SystemExit(
@@ -147,17 +158,11 @@ def main(argv=None) -> None:
     state = init_train_state(model, variables, optimizer, mesh)
 
     manager = None
-    if args.resume and not args.checkpoint_dir:
-        raise SystemExit("--resume requires --checkpoint-dir")
     if args.checkpoint_dir:
         from triton_client_tpu.runtime.checkpoint import CheckpointManager
 
         manager = CheckpointManager(args.checkpoint_dir)
-        if args.resume and manager.latest_step() is None:
-            raise SystemExit(
-                f"--resume: no checkpoint found under {args.checkpoint_dir!r}"
-            )
-        if args.resume:
+        if args.resume:  # existence was validated before model init
             # Restore to host, then re-shard through the same init path
             # (orbax restores leaf placements inconsistently against a
             # mixed replicated/sharded `like` tree).
