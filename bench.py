@@ -24,8 +24,8 @@ import jax.numpy as jnp
 import numpy as np
 
 BATCH = 8
-WARMUP = 3
-ITERS = 30
+WARMUP = 5
+ITERS = 100  # enough reps to smooth remote-chip tunnel jitter
 CAMERA_FPS_BASELINE = 30.0
 LIDAR_HZ_BASELINE = 10.0  # KITTI/nuScenes lidar scan rate
 
