@@ -66,3 +66,29 @@ def test_bag_stitch_bare_topics_flag_copies_all(tmp_path):
     bag_stitch([bag, out, "--topics"])
     with rb.BagReader(out) as r:
         assert len(list(r.read_messages())) == 8
+
+
+def test_repo_index_local_dir(tmp_path, capsys):
+    import yaml
+
+    from triton_client_tpu.cli.tools import repo_index
+
+    d = tmp_path / "m1"
+    d.mkdir()
+    (d / "config.yaml").write_text(yaml.safe_dump({"family": "yolov5"}))
+    (d / "2").mkdir()
+    (d / "2" / "weights.msgpack").write_bytes(b"x")
+    (d / "3").mkdir()  # version dir with no artifact -> flagged
+    repo_index([str(tmp_path)])
+    out = capsys.readouterr().out
+    assert "m1:2  family=yolov5  weights.msgpack" in out
+    assert "m1:3  family=yolov5  MISSING WEIGHTS" in out
+
+
+def test_repo_index_examples_tree(capsys):
+    from triton_client_tpu.cli.tools import repo_index
+
+    repo_index(["examples"])
+    out = capsys.readouterr().out
+    assert "pointpillar_kitti:1  family=pointpillars" in out
+    assert "yolov5_crop:1  family=yolov5" in out

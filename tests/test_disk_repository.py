@@ -181,3 +181,19 @@ def test_warmup_compiles_native_shape(tmp_path):
     rm = dr.build_model(tmp_path / "tiny_yolo")
     assert rm.warmup is not None
     rm.warmup()  # must compile+run the (1, 64, 64, 3) native shape
+
+
+@pytest.mark.slow
+def test_examples_pointpillars_builds_and_infers():
+    """The 3D examples entry builds through the disk repository (full
+    KITTI grid — slow; the fast per-family coverage lives in
+    test_dataset_config)."""
+    rm = dr.build_model("examples/pointpillar_kitti", version="1")
+    assert rm.spec.name == "pointpillar_kitti"
+    out = rm.infer_fn(
+        {
+            "points": np.zeros((1024, 4), np.float32),
+            "num_points": np.asarray(16, np.int32),
+        }
+    )
+    assert out["detections"].shape[-1] == 9

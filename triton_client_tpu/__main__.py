@@ -10,6 +10,7 @@ Commands map 1:1 onto the reference's entry scripts:
   fetch-model — download_model_s3_keycloak.py parity (OIDC + S3)
   pc-extract — tools/pc_extractor.py (bag -> .npy point clouds)
   bag-stitch — tools/bag_stitch.py (truncate a bag)
+  repo-index — list a model repository (local dir or grpc:<addr>)
   bag-info   — rosbag info equivalent
 """
 
@@ -28,6 +29,7 @@ COMMANDS = (
     "pc-extract",
     "bag-stitch",
     "bag-info",
+    "repo-index",
 )
 
 
@@ -57,6 +59,8 @@ def main() -> None:
         from triton_client_tpu.cli.tools import bag_stitch as run
     elif cmd == "bag-info":
         from triton_client_tpu.cli.tools import bag_info as run
+    elif cmd == "repo-index":
+        from triton_client_tpu.cli.tools import repo_index as run
     else:
         print(f"unknown command '{cmd}'; commands: {', '.join(COMMANDS)}")
         raise SystemExit(2)

@@ -134,6 +134,15 @@ class GRPCChannel(BaseChannel):
         except grpc.RpcError:
             return False
 
+    def repository_index(self) -> list[tuple[str, str, str]]:
+        """[(name, version, state)] from the server's RepositoryIndex
+        (the 'what is actually being served' query the reference could
+        only get from Triton's logs)."""
+        resp = self._call(
+            self._stub.RepositoryIndex, pb.RepositoryIndexRequest()
+        )
+        return [(m.name, m.version, m.state) for m in resp.models]
+
     def infer_stream(self, requests, stream_timeout_s: float | None = 3600.0):
         """Bidirectional streaming inference (the reference's unused
         --streaming flag, main.py:66-70, made real). ``requests`` is an

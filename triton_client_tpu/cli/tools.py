@@ -85,3 +85,53 @@ def bag_info(argv=None) -> None:
         print(f"duration: {t1 - t0:.3f}s  messages: {sum(counts.values())}")
     for topic in sorted(counts):
         print(f"  {topic}  {types.get(topic, '?')}  {counts[topic]} msgs")
+
+
+def repo_index(argv=None) -> None:
+    """List a model repository: local directory (parsed, not built) or a
+    live server's RepositoryIndex over gRPC."""
+    p = argparse.ArgumentParser(
+        description="list model-repository contents (local dir or grpc:<addr>)"
+    )
+    p.add_argument("target", help="repository root dir or grpc:<host:port>")
+    args = p.parse_args(argv)
+
+    if args.target.startswith("grpc:"):
+        from triton_client_tpu.channel.grpc_channel import GRPCChannel
+
+        channel = GRPCChannel(args.target[len("grpc:"):])
+        try:
+            for name, version, state in channel.repository_index():
+                print(f"{name}:{version}  {state}")
+        finally:
+            channel.close()
+        return
+
+    import pathlib
+
+    from triton_client_tpu.dataset_config import load_yaml
+    from triton_client_tpu.runtime.disk_repository import (
+        _find_weights,
+        _version_dirs,
+    )
+
+    root = pathlib.Path(args.target)
+    if not root.is_dir():
+        raise SystemExit(f"{args.target!r} is not a directory or grpc: address")
+    for model_dir in sorted(d for d in root.iterdir() if d.is_dir()):
+        cfg = model_dir / "config.yaml"
+        if not cfg.exists():
+            continue
+        doc = load_yaml(str(cfg))
+        versions = _version_dirs(model_dir)
+        if not versions:
+            print(f"{model_dir.name}:1  family={doc.get('family')}  (fresh-init)")
+        for vdir in versions:
+            try:
+                artifact = _find_weights(vdir).name
+            except FileNotFoundError:
+                artifact = "MISSING WEIGHTS"
+            print(
+                f"{model_dir.name}:{vdir.name}  family={doc.get('family')}  "
+                f"{artifact}"
+            )
