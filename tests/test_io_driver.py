@@ -221,3 +221,62 @@ def test_cli_evaluate_smoke(tmp_path, capsys):
     report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert "eval" in report
     assert report["eval"]["frames"] == 2
+
+
+def test_driver_batched_dispatch_and_demux():
+    """batch_size frames stack into one dispatch; results demux back
+    per frame; trailing partial batch handled."""
+    import numpy as np
+
+    from triton_client_tpu.drivers.driver import InferenceDriver
+    from triton_client_tpu.io.sources import open_source
+
+    calls = []
+
+    def infer(data):
+        data = np.asarray(data)
+        calls.append(data.shape)
+        b = data.shape[0]
+        dets = np.zeros((b, 4, 6), np.float32)
+        dets[:, 0, 4] = data.reshape(b, -1).mean(axis=1)  # per-frame marker
+        return {"detections": dets, "valid": np.ones((b, 4), bool)}
+
+    sinked = []
+    driver = InferenceDriver(
+        infer,
+        open_source("synthetic:7:16x16", 7),
+        sink=type("S", (), {
+            "write": lambda self, f, r: sinked.append(
+                (f.frame_id, r["detections"].shape)
+            ),
+            "close": lambda self: None,
+        })(),
+        warmup=1,
+        batch_size=4,
+    )
+    stats = driver.run(max_frames=7)
+    assert stats.frames == 7
+    assert stats.ticks == 2  # 4 + 3 (padded)
+    # warmup batch + 2 real dispatches, ALL at the warmed (4, ...) shape
+    # (a trailing (3, ...) dispatch would retrace inside the timed loop)
+    assert calls == [(4, 16, 16, 3)] * 3
+    assert [fid for fid, _ in sinked] == list(range(7))  # pad row dropped
+    assert all(shape == (4, 6) for _, shape in sinked)
+
+
+def test_driver_batched_rejects_ragged_shapes():
+    import numpy as np
+
+    from triton_client_tpu.drivers.driver import InferenceDriver
+    from triton_client_tpu.io.sources import Frame
+
+    class Ragged:
+        def __iter__(self):
+            yield Frame(data=np.zeros((8, 8, 3)), frame_id=0, timestamp=0.0)
+            yield Frame(data=np.zeros((16, 8, 3)), frame_id=1, timestamp=1.0)
+
+    driver = InferenceDriver(
+        lambda d: {"x": np.zeros((2, 1))}, Ragged(), warmup=0, batch_size=2
+    )
+    with pytest.raises(ValueError, match="uniform frame shapes"):
+        driver.run()

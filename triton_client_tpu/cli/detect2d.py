@@ -392,11 +392,12 @@ def main(argv=None) -> None:
         infer,
         source,
         sink=make_sink(args, class_names),
-        prefetch=args.prefetch,
+        prefetch=max(args.prefetch, args.batch_size),
         warmup=args.warmup,
         evaluator=evaluator,
         gt_lookup=gt_lookup,
         profiler=profiler,
+        batch_size=args.batch_size,
     )
     with maybe_device_trace(args):
         stats = driver.run(max_frames=args.limit)

@@ -111,8 +111,8 @@ def repo_index(argv=None) -> None:
 
     from triton_client_tpu.dataset_config import load_yaml
     from triton_client_tpu.runtime.disk_repository import (
-        _find_weights,
-        _version_dirs,
+        find_weights,
+        version_dirs,
     )
 
     root = pathlib.Path(args.target)
@@ -123,12 +123,12 @@ def repo_index(argv=None) -> None:
         if not cfg.exists():
             continue
         doc = load_yaml(str(cfg))
-        versions = _version_dirs(model_dir)
+        versions = version_dirs(model_dir)
         if not versions:
             print(f"{model_dir.name}:1  family={doc.get('family')}  (fresh-init)")
         for vdir in versions:
             try:
-                artifact = _find_weights(vdir).name
+                artifact = find_weights(vdir).name
             except FileNotFoundError:
                 artifact = "MISSING WEIGHTS"
             print(

@@ -76,12 +76,17 @@ class InferenceDriver:
         evaluator=None,
         gt_lookup: Callable[[Frame], np.ndarray | None] | None = None,
         profiler=None,
+        batch_size: int = 1,
     ) -> None:
         """``evaluator``: DetectionEvaluator scored via ``gt_lookup``,
         which maps a frame to (n_gt, 5) [x1, y1, x2, y2, cls] or None.
         ``profiler``: optional StageProfiler; records source/infer/sink
         stage latencies (the per-stage view the reference only had as
-        commented-out prints, ros_inference3d.py:209-210)."""
+        commented-out prints, ros_inference3d.py:209-210).
+        ``batch_size`` > 1 stacks that many frames per device dispatch
+        (the reference's -b flag made real — it only ever sized the gRPC
+        message cap, grpc_channel.py:26-29); frames must share a shape
+        (resize upstream), and results demux back per frame."""
         self.infer = infer
         self.source = source
         self.sink = sink
@@ -90,6 +95,7 @@ class InferenceDriver:
         self.evaluator = evaluator
         self.gt_lookup = gt_lookup
         self.profiler = profiler
+        self.batch_size = max(1, int(batch_size))
 
     def run(self, max_frames: int = 0) -> DriverStats:
         q: queue.Queue = queue.Queue(maxsize=self.prefetch)
@@ -127,35 +133,80 @@ class InferenceDriver:
             return DriverStats()
         # Warmup compiles outside the timed window (first jit trace is
         # tens of seconds on TPU; the reference has no analogue because
-        # its compile cost sits server-side).
+        # its compile cost sits server-side). Batched mode warms the
+        # BATCHED shape — warming single-frame would leave the real
+        # trace cold.
         frame = first
+        b = self.batch_size
         for _ in range(self.warmup):
-            self.infer(frame.data)
+            if b > 1:
+                self.infer(np.stack([np.asarray(frame.data)] * b))
+            else:
+                self.infer(frame.data)
 
+        ticks = 0
         t_start = time.perf_counter()
         try:
             while frame is not _SENTINEL:
+                batch = [frame]
+                while len(batch) < b:
+                    nxt = q.get()
+                    if nxt is _SENTINEL:
+                        frame = _SENTINEL  # outer loop ends after this batch
+                        break
+                    batch.append(nxt)
+
                 t0 = time.perf_counter()
-                result = self.infer(frame.data)
+                if b > 1:
+                    datas = [np.asarray(f.data) for f in batch]
+                    if len({d.shape for d in datas}) > 1:
+                        raise ValueError(
+                            "batched dispatch needs uniform frame shapes; "
+                            f"got {sorted({d.shape for d in datas})} — "
+                            "resize upstream or use batch_size=1"
+                        )
+                    # pad a trailing partial batch to the warmed shape:
+                    # a (b-1, ...) dispatch would retrace/rejit inside
+                    # the timed loop (tens of seconds on TPU)
+                    datas += [datas[-1]] * (b - len(batch))
+                    result = self.infer(np.stack(datas))
+                else:
+                    result = self.infer(batch[0].data)
                 dt = time.perf_counter() - t0
                 latencies.append(dt)
+                ticks += 1
                 if self.profiler is not None:
                     self.profiler.record("infer", dt)
-                n += 1
-                if self.sink is not None:
-                    t1 = time.perf_counter()
-                    self.sink.write(frame, result)
-                    if self.profiler is not None:
-                        self.profiler.record("sink", time.perf_counter() - t1)
-                if self.evaluator is not None and self.gt_lookup is not None:
-                    gts = self.gt_lookup(frame)
-                    if gts is not None:
-                        self.evaluator.add_frame(
-                            np.asarray(result["detections"]),
-                            np.asarray(result["valid"]) if "valid" in result else None,
-                            gts,
-                        )
-                frame = q.get()
+                n += len(batch)
+
+                if b > 1:
+                    # one host conversion per batch, not per frame
+                    arrs = {k: np.asarray(v) for k, v in result.items()}
+                for i, f in enumerate(batch):
+                    if b > 1:
+                        per = {
+                            k: v[i]
+                            if np.ndim(v) > 0 and np.shape(v)[0] == b
+                            else v
+                            for k, v in arrs.items()
+                        }
+                    else:
+                        per = result
+                    if self.sink is not None:
+                        t1 = time.perf_counter()
+                        self.sink.write(f, per)
+                        if self.profiler is not None:
+                            self.profiler.record("sink", time.perf_counter() - t1)
+                    if self.evaluator is not None and self.gt_lookup is not None:
+                        gts = self.gt_lookup(f)
+                        if gts is not None:
+                            self.evaluator.add_frame(
+                                np.asarray(per["detections"]),
+                                np.asarray(per["valid"]) if "valid" in per else None,
+                                gts,
+                            )
+                if frame is not _SENTINEL:
+                    frame = q.get()
             wall = time.perf_counter() - t_start
         finally:
             # Close even on infer errors / KeyboardInterrupt: buffered
@@ -166,7 +217,7 @@ class InferenceDriver:
         if error:
             raise error[0]
 
-        return latency_stats(latencies, frames=n, wall_s=wall, ticks=n)
+        return latency_stats(latencies, frames=n, wall_s=wall, ticks=ticks)
 
 
 def detect2d_infer(pipeline) -> InferFn:

@@ -285,14 +285,14 @@ def conversion_template(
     return _Entry(pathlib.Path.cwd(), doc=doc).template()
 
 
-def _version_dirs(model_dir: pathlib.Path) -> list[pathlib.Path]:
+def version_dirs(model_dir: pathlib.Path) -> list[pathlib.Path]:
     return sorted(
         (d for d in model_dir.iterdir() if d.is_dir() and d.name.isdigit()),
         key=lambda d: int(d.name),
     )
 
 
-def _find_weights(version_dir: pathlib.Path) -> pathlib.Path:
+def find_weights(version_dir: pathlib.Path) -> pathlib.Path:
     """A version dir MUST carry a recognized artifact — registering
     random-init weights for a typo'd filename would serve garbage
     silently (fail-loudly policy; Triton likewise errors on a version
@@ -328,9 +328,9 @@ def scan_disk(
             log.info("skipping %s (no config.yaml)", model_dir)
             continue
         entry = _Entry(model_dir)
-        versions = _version_dirs(model_dir)
+        versions = version_dirs(model_dir)
         pairs = (
-            [(v.name, _find_weights(v)) for v in versions]
+            [(v.name, find_weights(v)) for v in versions]
             if versions
             else [("1", None)]
         )
