@@ -1,0 +1,364 @@
+"""The --async path: futures, channel overlap, and the driver's
+inflight pump.
+
+The reference defines ``--async`` (main.py:59-65) but never exercises
+it — its one driver issues one blocking ModelInfer per frame
+(communicator/channel/grpc_channel.py:73-78). Here the flag is real:
+channels issue work on do_inference_async and the driver keeps several
+requests outstanding. These tests cover the future semantics, both
+channel implementations (in-process TPU dispatch and the loopback gRPC
+server), the driver pump's ordering/overlap, and the CLI wiring.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from triton_client_tpu.channel.base import InferFuture, InferRequest
+from triton_client_tpu.channel.tpu_channel import TPUChannel
+from triton_client_tpu.config import ModelSpec, TensorSpec
+from triton_client_tpu.runtime.repository import ModelRepository
+
+
+def _spec(name="addone"):
+    return ModelSpec(
+        name=name,
+        version="1",
+        platform="jax",
+        inputs=(TensorSpec("x", (-1, 4), "FP32"),),
+        outputs=(TensorSpec("y", (-1, 4), "FP32"),),
+    )
+
+
+def _repo():
+    repo = ModelRepository()
+    repo.register(_spec(), lambda inputs: {"y": np.asarray(inputs["x"]) + 1.0})
+    return repo
+
+
+class TestInferFuture:
+    def test_resolves_once(self):
+        calls = []
+
+        def resolve():
+            calls.append(1)
+            return "v"
+
+        fut = InferFuture(resolve)
+        assert fut.result() == "v"
+        assert fut.result() == "v"
+        assert len(calls) == 1
+
+    def test_defers_errors(self):
+        fut = InferFuture(lambda: (_ for _ in ()).throw(RuntimeError("boom")))
+        with pytest.raises(RuntimeError, match="boom"):
+            fut.result()
+        # error is sticky, not re-resolved
+        with pytest.raises(RuntimeError, match="boom"):
+            fut.result()
+
+    def test_completed_and_failed(self):
+        assert InferFuture.completed(42).result() == 42
+        with pytest.raises(ValueError):
+            InferFuture.failed(ValueError("x")).result()
+
+    def test_map_is_lazy(self):
+        seen = []
+        fut = InferFuture.completed(2).map(lambda v: seen.append(v) or v * 10)
+        assert not seen
+        assert fut.result() == 20
+        assert seen == [2]
+
+
+class TestTPUChannelAsync:
+    def test_matches_sync(self, rng):
+        channel = TPUChannel(_repo())
+        x = rng.random((2, 4)).astype(np.float32)
+        req = InferRequest(model_name="addone", inputs={"x": x}, request_id="9")
+        sync = channel.do_inference(req)
+        fut = channel.do_inference_async(req)
+        resp = fut.result()
+        np.testing.assert_allclose(resp.outputs["y"], sync.outputs["y"])
+        np.testing.assert_allclose(resp.outputs["y"], x + 1.0, rtol=1e-6)
+        assert resp.request_id == "9"
+
+    def test_validation_errors_raise_at_issue(self):
+        # bad requests fail fast (at dispatch), not at result() —
+        # matching do_inference's contract
+        channel = TPUChannel(_repo())
+        with pytest.raises(ValueError, match="requires input"):
+            channel.do_inference_async(
+                InferRequest(model_name="addone", inputs={})
+            )
+
+    def test_base_channel_fallback(self):
+        # a channel that doesn't override do_inference_async still works
+        from triton_client_tpu.channel.base import BaseChannel, InferResponse
+
+        class Minimal(BaseChannel):
+            def register_channel(self):
+                pass
+
+            def fetch_channel(self):
+                return None
+
+            def get_metadata(self, model_name, model_version=""):
+                raise KeyError(model_name)
+
+            def do_inference(self, request):
+                return InferResponse(
+                    model_name=request.model_name,
+                    outputs={"y": np.asarray(request.inputs["x"]) + 1.0},
+                )
+
+        ch = Minimal()
+        x = np.ones((1, 4), np.float32)
+        resp = ch.do_inference_async(
+            InferRequest(model_name="m", inputs={"x": x})
+        ).result()
+        np.testing.assert_allclose(resp.outputs["y"], x + 1.0)
+
+
+class TestGRPCAsync:
+    @pytest.fixture()
+    def server_and_channel(self):
+        from triton_client_tpu.channel.grpc_channel import GRPCChannel
+        from triton_client_tpu.runtime.server import InferenceServer
+
+        repo = _repo()
+        server = InferenceServer(
+            repo, TPUChannel(repo), address="127.0.0.1:0", max_workers=4
+        )
+        server.start()
+        channel = GRPCChannel(f"127.0.0.1:{server.port}", timeout_s=10.0)
+        yield server, channel
+        channel.close()
+        server.stop()
+
+    def test_async_roundtrip(self, server_and_channel, rng):
+        _, channel = server_and_channel
+        x = rng.random((3, 4)).astype(np.float32)
+        fut = channel.do_inference_async(
+            InferRequest(model_name="addone", inputs={"x": x}, request_id="5")
+        )
+        resp = fut.result()
+        np.testing.assert_allclose(resp.outputs["y"], x + 1.0, rtol=1e-6)
+        assert resp.request_id == "5"
+
+    def test_many_inflight(self, server_and_channel):
+        _, channel = server_and_channel
+        futs = [
+            channel.do_inference_async(
+                InferRequest(
+                    model_name="addone",
+                    inputs={"x": np.full((1, 4), i, np.float32)},
+                    request_id=str(i),
+                )
+            )
+            for i in range(8)
+        ]
+        for i, fut in enumerate(futs):
+            resp = fut.result()
+            np.testing.assert_allclose(resp.outputs["y"], i + 1.0)
+
+    def test_async_unknown_model_raises_at_result(self, server_and_channel):
+        import grpc
+
+        _, channel = server_and_channel
+        fut = channel.do_inference_async(
+            InferRequest(model_name="nope", inputs={"x": np.zeros((1, 4), np.float32)})
+        )
+        with pytest.raises(grpc.RpcError):
+            fut.result()
+
+
+class _ListSource:
+    """Deterministic in-memory FrameSource."""
+
+    def __init__(self, n, shape=(4,)):
+        from triton_client_tpu.io.sources import Frame
+
+        self.frames = [
+            Frame(frame_id=i, data=np.full(shape, i, np.float32), timestamp=float(i))
+            for i in range(n)
+        ]
+
+    def __iter__(self):
+        return iter(self.frames)
+
+
+class _RecordingSink:
+    def __init__(self):
+        self.rows = []
+        self.closed = False
+
+    def write(self, frame, result):
+        self.rows.append((frame.frame_id, {k: np.asarray(v) for k, v in result.items()}))
+
+    def close(self):
+        self.closed = True
+
+
+def _threaded_async_infer(delay_s, concurrent: list, lock):
+    """Future-returning infer backed by worker threads, recording the
+    high-water mark of concurrent executions."""
+    state = {"now": 0}
+
+    def fn(data):
+        def work():
+            with lock:
+                state["now"] += 1
+                concurrent[0] = max(concurrent[0], state["now"])
+            time.sleep(delay_s)
+            with lock:
+                state["now"] -= 1
+            return {"value": np.asarray(data) * 2}
+
+        box = {}
+        err = []
+
+        def run():
+            try:
+                box["v"] = work()
+            except BaseException as e:  # pragma: no cover
+                err.append(e)
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+
+        def resolve():
+            t.join()
+            if err:
+                raise err[0]
+            return box["v"]
+
+        return InferFuture(resolve)
+
+    return fn
+
+
+class TestDriverInflight:
+    def _run(self, n_frames, inflight, delay_s=0.02):
+        from triton_client_tpu.drivers.driver import InferenceDriver
+
+        lock = threading.Lock()
+        high_water = [0]
+        infer = _threaded_async_infer(delay_s, high_water, lock)
+        sink = _RecordingSink()
+        driver = InferenceDriver(
+            infer,
+            _ListSource(n_frames),
+            sink=sink,
+            warmup=1,
+            inflight=inflight,
+        )
+        stats = driver.run()
+        return stats, sink, high_water[0]
+
+    def test_order_and_results(self):
+        stats, sink, _ = self._run(n_frames=8, inflight=3)
+        assert stats.frames == 8
+        assert [fid for fid, _ in sink.rows] == list(range(8))
+        for fid, result in sink.rows:
+            np.testing.assert_allclose(result["value"], fid * 2.0)
+        assert sink.closed
+
+    def test_overlap_happens(self):
+        _, _, high_water = self._run(n_frames=10, inflight=4, delay_s=0.05)
+        assert high_water >= 2  # requests genuinely overlapped
+
+    def test_inflight_bounded(self):
+        _, _, high_water = self._run(n_frames=10, inflight=3, delay_s=0.05)
+        assert high_water <= 3
+
+    def test_single_frame_stream(self):
+        stats, sink, _ = self._run(n_frames=1, inflight=4)
+        assert stats.frames == 1
+        assert [fid for fid, _ in sink.rows] == [0]
+
+    def test_batch_and_inflight_conflict(self):
+        from triton_client_tpu.drivers.driver import InferenceDriver
+
+        with pytest.raises(ValueError, match="pick one"):
+            InferenceDriver(
+                lambda d: {}, _ListSource(1), batch_size=2, inflight=2
+            )
+
+    def test_error_propagates(self):
+        from triton_client_tpu.drivers.driver import InferenceDriver
+
+        def bad(data):
+            return InferFuture(lambda: (_ for _ in ()).throw(RuntimeError("dead")))
+
+        sink = _RecordingSink()
+        driver = InferenceDriver(
+            bad, _ListSource(4), sink=sink, warmup=0, inflight=2
+        )
+        with pytest.raises(RuntimeError, match="dead"):
+            driver.run()
+        assert sink.closed  # buffered sinks still flush
+
+
+class TestPipelineDispatch:
+    def test_detect3d_infer_dispatch(self):
+        from triton_client_tpu.models.pointpillars import PointPillarsConfig
+        from triton_client_tpu.ops.voxelize import VoxelConfig
+        from triton_client_tpu.pipelines.detect3d import (
+            Detect3DConfig,
+            build_pointpillars_pipeline,
+        )
+
+        import jax
+
+        model_cfg = PointPillarsConfig(
+            voxel=VoxelConfig(max_voxels=128, max_points_per_voxel=8),
+            vfe_filters=8,
+            backbone_layers=(1,),
+            backbone_strides=(2,),
+            backbone_filters=(8,),
+            upsample_strides=(1,),
+            upsample_filters=(8,),
+        )
+        cfg = Detect3DConfig(point_buckets=(512,), max_det=16, pre_max=32)
+        pipe, _, _ = build_pointpillars_pipeline(
+            jax.random.PRNGKey(0), model_cfg=model_cfg, config=cfg
+        )
+        rng = np.random.default_rng(0)
+        pts = rng.uniform(0, 30, (300, 4)).astype(np.float32)
+        fut = pipe.infer_dispatch(pts)
+        got = fut.result()
+        want = pipe.infer(pts)
+        np.testing.assert_allclose(got["pred_boxes"], want["pred_boxes"])
+        np.testing.assert_allclose(got["pred_scores"], want["pred_scores"])
+        np.testing.assert_array_equal(got["pred_labels"], want["pred_labels"])
+
+
+class TestCLIAsync:
+    def test_detect2d_async_runs(self, tmp_path, capsys):
+        from triton_client_tpu.cli.detect2d import main
+
+        main(
+            [
+                "--async",
+                "-i", "synthetic:4:64x64",
+                "--input-size", "64",
+                "--sink", "jsonl",
+                "-o", str(tmp_path),
+                "-c", "2",
+            ]
+        )
+        out = capsys.readouterr().out
+        assert '"frames": 4' in out
+        assert (tmp_path / "detections.jsonl").exists()
+
+    def test_async_flag_guards(self):
+        from triton_client_tpu.cli.detect2d import main
+
+        with pytest.raises(SystemExit, match="pick one"):
+            main(["--async", "--streaming", "-i", "synthetic:2"])
+        with pytest.raises(SystemExit, match="batch"):
+            main(["--async", "-b", "4", "-i", "synthetic:2"])
+        with pytest.raises(SystemExit, match="inflight"):
+            main(["--async", "--inflight", "1", "-i", "synthetic:2"])

@@ -39,6 +39,56 @@ class InferResponse:
     latency_s: float = 0.0
 
 
+class InferFuture:
+    """Handle for an in-flight inference round-trip.
+
+    ``result()`` blocks until the response is ready and returns it (or
+    raises the deferred error). The reference defines an ``--async``
+    flag it never exercises (main.py:59-70); this future is the real
+    thing: channels issue the work on do_inference_async and the driver
+    keeps several requests in flight, overlapping host preprocess with
+    device/remote compute. Resolution is single-consumer: the driver
+    retires each future exactly once, in issue order.
+    """
+
+    __slots__ = ("_resolve", "_done", "_value", "_error")
+
+    def __init__(self, resolve) -> None:
+        self._resolve = resolve
+        self._done = False
+        self._value = None
+        self._error: BaseException | None = None
+
+    @classmethod
+    def completed(cls, value) -> "InferFuture":
+        fut = cls(lambda: value)
+        fut._done, fut._value = True, value
+        return fut
+
+    @classmethod
+    def failed(cls, error: BaseException) -> "InferFuture":
+        fut = cls(None)
+        fut._done, fut._error = True, error
+        return fut
+
+    def result(self):
+        if not self._done:
+            try:
+                self._value = self._resolve()
+            except BaseException as e:
+                self._error = e
+            finally:
+                self._done = True
+                self._resolve = None  # free the closure (it may pin buffers)
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+    def map(self, fn) -> "InferFuture":
+        """A future whose result is ``fn(self.result())`` (lazy)."""
+        return InferFuture(lambda: fn(self.result()))
+
+
 class BaseChannel(abc.ABC):
     """Transport abstraction between drivers (L4) and models."""
 
@@ -57,3 +107,15 @@ class BaseChannel(abc.ABC):
     @abc.abstractmethod
     def do_inference(self, request: InferRequest) -> InferResponse:
         """Run one inference round-trip."""
+
+    def do_inference_async(self, request: InferRequest) -> InferFuture:
+        """Issue an inference without blocking for the response.
+
+        Transports that can genuinely overlap (gRPC futures, JAX async
+        dispatch) override this; the base implementation degrades to the
+        blocking call wrapped in a completed future, so every channel
+        supports the async driver path with unchanged semantics."""
+        try:
+            return InferFuture.completed(self.do_inference(request))
+        except Exception as e:  # KeyboardInterrupt/SystemExit stay immediate
+            return InferFuture.failed(e)

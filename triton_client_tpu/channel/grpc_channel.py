@@ -25,7 +25,12 @@ import time
 
 import grpc
 
-from triton_client_tpu.channel.base import BaseChannel, InferRequest, InferResponse
+from triton_client_tpu.channel.base import (
+    BaseChannel,
+    InferFuture,
+    InferRequest,
+    InferResponse,
+)
 from triton_client_tpu.channel.kserve import codec, pb, service
 from triton_client_tpu.config import FRAMING_BYTES, ModelSpec, TensorSpec
 
@@ -123,6 +128,43 @@ class GRPCChannel(BaseChannel):
             request_id=resp.id,
             latency_s=time.perf_counter() - t0,
         )
+
+    def do_inference_async(self, request: InferRequest) -> InferFuture:
+        """Non-blocking ModelInfer via a gRPC call future (the --async
+        path): the RPC is on the wire when this returns; result() parses
+        the response. A retryable failure falls back to the sync retry
+        ladder at resolution time, so the async path keeps the same
+        failure story as do_inference."""
+        wire = codec.build_infer_request(
+            model_name=request.model_name,
+            inputs=request.inputs,
+            model_version=request.model_version,
+            request_id=request.request_id,
+        )
+        t0 = time.perf_counter()
+        call = self._stub.ModelInfer.future(wire, timeout=self._timeout_s)
+
+        def resolve() -> InferResponse:
+            try:
+                resp = call.result()
+            except grpc.RpcError as e:
+                code = e.code() if hasattr(e, "code") else None
+                if code not in _RETRYABLE:
+                    raise
+                log.warning(
+                    "async ModelInfer failed (%s); re-issuing on the "
+                    "sync retry path", code,
+                )
+                resp = self._call(self._stub.ModelInfer, wire)
+            return InferResponse(
+                model_name=resp.model_name,
+                model_version=resp.model_version,
+                outputs=codec.parse_infer_response(resp),
+                request_id=resp.id,
+                latency_s=time.perf_counter() - t0,
+            )
+
+        return InferFuture(resolve)
 
     # -- extras ---------------------------------------------------------------
 

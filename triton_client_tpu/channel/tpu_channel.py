@@ -22,7 +22,12 @@ import numpy as np
 
 from jax.sharding import NamedSharding, PartitionSpec
 
-from triton_client_tpu.channel.base import BaseChannel, InferRequest, InferResponse
+from triton_client_tpu.channel.base import (
+    BaseChannel,
+    InferFuture,
+    InferRequest,
+    InferResponse,
+)
 from triton_client_tpu.config import ModelSpec
 from triton_client_tpu.parallel.mesh import MeshConfig, batch_sharding, make_mesh
 from triton_client_tpu.runtime.repository import ModelRepository
@@ -55,6 +60,40 @@ class TPUChannel(BaseChannel):
         return self._repository.metadata(model_name, model_version)
 
     def do_inference(self, request: InferRequest) -> InferResponse:
+        model, outputs, t0 = self._dispatch(request)
+        outputs = {k: np.asarray(v) for k, v in outputs.items()}
+        return InferResponse(
+            model_name=request.model_name,
+            model_version=model.spec.version,
+            outputs=outputs,
+            request_id=request.request_id,
+            latency_s=time.perf_counter() - t0,
+        )
+
+    def do_inference_async(self, request: InferRequest) -> InferFuture:
+        """The in-process --async path: JAX dispatch is asynchronous, so
+        _dispatch returns as soon as the computation is enqueued on the
+        device; materializing numpy (the only blocking step) is deferred
+        to result(). The driver can therefore preprocess frame N+1 while
+        the chip runs frame N — no threads needed."""
+        model, outputs, t0 = self._dispatch(request)
+
+        def resolve() -> InferResponse:
+            host = {k: np.asarray(v) for k, v in outputs.items()}
+            return InferResponse(
+                model_name=request.model_name,
+                model_version=model.spec.version,
+                outputs=host,
+                request_id=request.request_id,
+                latency_s=time.perf_counter() - t0,
+            )
+
+        return InferFuture(resolve)
+
+    def _dispatch(self, request: InferRequest):
+        """Validate, stage inputs onto the mesh, enqueue the jitted
+        infer_fn; returns (model, device outputs, start time) without
+        forcing device->host transfer."""
         model = self._repository.get(request.model_name, request.model_version)
         if self._validate:
             for tensor_spec in model.spec.inputs:
@@ -87,12 +126,4 @@ class TPUChannel(BaseChannel):
             )
             device_inputs[name] = jax.device_put(arr, use)
         t0 = time.perf_counter()
-        outputs = model.infer_fn(device_inputs)
-        outputs = {k: np.asarray(v) for k, v in outputs.items()}
-        return InferResponse(
-            model_name=request.model_name,
-            model_version=model.spec.version,
-            outputs=outputs,
-            request_id=request.request_id,
-            latency_s=time.perf_counter() - t0,
-        )
+        return model, model.infer_fn(device_inputs), t0

@@ -3,9 +3,9 @@
 Flag parity with the reference argparse surface (main.py:51-113):
 ``-m`` model, ``-x`` version, ``-b`` batch size, ``-c`` class count,
 ``-s`` scaling mode, ``-i`` input, ``--async``/``--streaming`` retained
-(accepted and recorded; the reference defines but never exercises them
-— main.py:59-70). TPU-first additions: --variant/--width, --limit,
---sink, --gt, --prometheus-port.
+— the reference defines but never exercises them (main.py:59-70); here
+both are real (async-futures pipelining / ModelStreamInfer). TPU-first
+additions: --variant/--width, --limit, --sink, --gt, --prometheus-port.
 """
 
 from __future__ import annotations
@@ -70,11 +70,43 @@ def add_common_flags(parser: argparse.ArgumentParser) -> None:
         "--async",
         dest="async_set",
         action="store_true",
-        help="accepted for flag parity (unused in the reference too)",
+        help="pipeline inference with async futures: keep --inflight "
+        "requests outstanding so host prep overlaps device/remote "
+        "compute (the reference defines this flag but never exercises "
+        "it, main.py:59-65)",
+    )
+    parser.add_argument(
+        "--inflight", type=int, default=2,
+        help="max outstanding requests with --async (>=2)",
     )
     parser.add_argument("--streaming", action="store_true", help="flag parity")
     parser.add_argument("--prefetch", type=int, default=4)
     parser.add_argument("--warmup", type=int, default=1)
+
+
+def _check_async_flags(args) -> None:
+    """--async combination guards shared by the 2D/3D entry points."""
+    if getattr(args, "streaming", False):
+        raise SystemExit(
+            "--async and --streaming both pipeline requests; pick one"
+        )
+    if getattr(args, "cameras", 1) > 1:
+        raise SystemExit(
+            "--async does not combine with --cameras (the lockstep "
+            "multi-camera driver already batches the device)"
+        )
+    if args.batch_size > 1:
+        raise SystemExit(
+            "--async pipelines single-frame dispatches; it does not "
+            "combine with -b/--batch-size"
+        )
+    if args.input.startswith("ros:"):
+        raise SystemExit(
+            "--async is replay-mode only; the live ROS driver already "
+            "overlaps decode and compute through its bounded queue"
+        )
+    if args.inflight < 2:
+        raise SystemExit("--inflight must be >= 2 with --async")
 
 
 def make_sink(args, class_names: tuple[str, ...] = ()):

@@ -19,6 +19,7 @@ import dataclasses
 import jax
 
 from triton_client_tpu.cli.common import (
+    _check_async_flags,
     add_common_flags,
     load_gt_lookup,
     load_names,
@@ -293,6 +294,8 @@ def main(argv=None) -> None:
             "jsk box arrays, bag_inference3d.py:182-183); use --sink "
             "images or jsonl"
         )
+    if args.async_set:
+        _check_async_flags(args)
     from triton_client_tpu.drivers.driver import InferenceDriver, channel_infer
 
     if args.channel.startswith("grpc:"):
@@ -341,7 +344,10 @@ def main(argv=None) -> None:
             _run_streaming(args, channel, spec, class_names)
             return
         infer = channel_infer(
-            channel, args.model_name, model_version=args.model_version
+            channel,
+            args.model_name,
+            model_version=args.model_version,
+            asynchronous=args.async_set,
         )
     else:
         if args.streaming:
@@ -359,7 +365,7 @@ def main(argv=None) -> None:
         repo = ModelRepository()
         repo.register(spec, pipe.infer_fn())
         channel = TPUChannel(repo, mesh_config=parse_mesh(args.mesh))
-        infer = channel_infer(channel, spec.name)
+        infer = channel_infer(channel, spec.name, asynchronous=args.async_set)
 
     if args.cameras > 1:
         _run_multicam(args, channel, spec, class_names)
@@ -398,6 +404,7 @@ def main(argv=None) -> None:
         gt_lookup=gt_lookup,
         profiler=profiler,
         batch_size=args.batch_size,
+        inflight=args.inflight if args.async_set else 1,
     )
     with maybe_device_trace(args):
         stats = driver.run(max_frames=args.limit)

@@ -17,6 +17,7 @@ import dataclasses
 import jax
 
 from triton_client_tpu.cli.common import (
+    _check_async_flags,
     add_common_flags,
     make_profiler,
     make_sink,
@@ -55,10 +56,14 @@ def main(argv=None) -> None:
             "annotated frames); use --sink jsonl"
         )
 
+    if args.async_set:
+        _check_async_flags(args)
+
     from triton_client_tpu.drivers.driver import (
         InferenceDriver,
         channel_infer3d,
         detect3d_infer,
+        detect3d_infer_async,
     )
     from triton_client_tpu.pipelines.detect3d import (
         BUILDERS_3D as builders,
@@ -84,6 +89,7 @@ def main(argv=None) -> None:
             args.model_name,
             model_version=args.model_version,
             z_offset=args.z_offset,  # None -> served metadata value
+            asynchronous=args.async_set,
         )
         _run_3d(args, infer, args.model_name)
         return
@@ -106,7 +112,7 @@ def main(argv=None) -> None:
     pipe, spec, _ = builders[name](
         jax.random.PRNGKey(0), model_cfg=model_cfg, config=cfg
     )
-    infer = detect3d_infer(pipe)
+    infer = detect3d_infer_async(pipe) if args.async_set else detect3d_infer(pipe)
     _run_3d(args, infer, spec.name)
 
 
@@ -136,6 +142,7 @@ def _run_3d(args, infer, model_name: str) -> None:
         prefetch=args.prefetch,
         warmup=args.warmup,
         profiler=profiler,
+        inflight=args.inflight if args.async_set else 1,
     )
     with maybe_device_trace(args):
         stats = driver.run(max_frames=args.limit)

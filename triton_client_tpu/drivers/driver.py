@@ -28,6 +28,9 @@ from triton_client_tpu.io.sinks import Sink
 from triton_client_tpu.io.sources import Frame, FrameSource
 
 InferFn = Callable[[np.ndarray], Mapping[str, Any]]
+# The --async variant: the callable dispatches and returns a future
+# whose result() yields the Mapping (channel/base.py InferFuture).
+AsyncInferFn = Callable[[np.ndarray], Any]
 
 _SENTINEL = object()
 
@@ -77,6 +80,7 @@ class InferenceDriver:
         gt_lookup: Callable[[Frame], np.ndarray | None] | None = None,
         profiler=None,
         batch_size: int = 1,
+        inflight: int = 1,
     ) -> None:
         """``evaluator``: DetectionEvaluator scored via ``gt_lookup``,
         which maps a frame to (n_gt, 5) [x1, y1, x2, y2, cls] or None.
@@ -86,7 +90,12 @@ class InferenceDriver:
         ``batch_size`` > 1 stacks that many frames per device dispatch
         (the reference's -b flag made real — it only ever sized the gRPC
         message cap, grpc_channel.py:26-29); frames must share a shape
-        (resize upstream), and results demux back per frame."""
+        (resize upstream), and results demux back per frame.
+        ``inflight`` > 1 selects the async pump (the reference's unused
+        --async flag made real): ``infer`` must then return a future
+        (``.result() -> Mapping``) and up to ``inflight`` dispatches
+        overlap, retired in issue order. Mutually exclusive with
+        ``batch_size`` > 1."""
         self.infer = infer
         self.source = source
         self.sink = sink
@@ -96,6 +105,12 @@ class InferenceDriver:
         self.gt_lookup = gt_lookup
         self.profiler = profiler
         self.batch_size = max(1, int(batch_size))
+        self.inflight = max(1, int(inflight))
+        if self.batch_size > 1 and self.inflight > 1:
+            raise ValueError(
+                "batch_size and inflight both pipeline the device; "
+                "pick one (batched sync dispatch or async futures)"
+            )
 
     def run(self, max_frames: int = 0) -> DriverStats:
         q: queue.Queue = queue.Queue(maxsize=self.prefetch)
@@ -139,10 +154,15 @@ class InferenceDriver:
         frame = first
         b = self.batch_size
         for _ in range(self.warmup):
-            if b > 1:
+            if self.inflight > 1:
+                self.infer(frame.data).result()
+            elif b > 1:
                 self.infer(np.stack([np.asarray(frame.data)] * b))
             else:
                 self.infer(frame.data)
+
+        if self.inflight > 1:
+            return self._run_async(q, first, error)
 
         ticks = 0
         t_start = time.perf_counter()
@@ -192,19 +212,7 @@ class InferenceDriver:
                         }
                     else:
                         per = result
-                    if self.sink is not None:
-                        t1 = time.perf_counter()
-                        self.sink.write(f, per)
-                        if self.profiler is not None:
-                            self.profiler.record("sink", time.perf_counter() - t1)
-                    if self.evaluator is not None and self.gt_lookup is not None:
-                        gts = self.gt_lookup(f)
-                        if gts is not None:
-                            self.evaluator.add_frame(
-                                np.asarray(per["detections"]),
-                                np.asarray(per["valid"]) if "valid" in per else None,
-                                gts,
-                            )
+                    self._deliver(f, per)
                 if frame is not _SENTINEL:
                     frame = q.get()
             wall = time.perf_counter() - t_start
@@ -218,6 +226,74 @@ class InferenceDriver:
             raise error[0]
 
         return latency_stats(latencies, frames=n, wall_s=wall, ticks=ticks)
+
+    def _run_async(self, q: queue.Queue, first, error: list) -> DriverStats:
+        """Async pump: keep up to ``inflight`` dispatches outstanding,
+        retire in issue order. ``infer`` returns futures here. Per-frame
+        latency is issue->retire (true end-to-end including pipeline
+        wait), so p50 under load reads higher than the sync path's even
+        as fps improves — that is the honest tradeoff of pipelining."""
+        import collections
+
+        latencies: list[float] = []
+        pending: collections.deque = collections.deque()
+        n = 0
+        frame = first
+        t_start = time.perf_counter()
+
+        def retire() -> None:
+            nonlocal n
+            f, t0, fut = pending.popleft()
+            result = fut.result()
+            dt = time.perf_counter() - t0
+            latencies.append(dt)
+            if self.profiler is not None:
+                self.profiler.record("infer", dt)
+            n += 1
+            self._deliver(f, result)
+
+        try:
+            while True:
+                # dispatch the frame in hand, retire once the window is
+                # full, and only then block on the source for the next
+                # frame — a slow source therefore delays a ready result
+                # by at most one source period, not inflight periods
+                if frame is not _SENTINEL:
+                    t0 = time.perf_counter()
+                    pending.append((frame, t0, self.infer(frame.data)))
+                if pending and (
+                    frame is _SENTINEL or len(pending) >= self.inflight
+                ):
+                    retire()
+                if frame is _SENTINEL:
+                    if not pending:
+                        break
+                else:
+                    frame = q.get()
+            wall = time.perf_counter() - t_start
+        finally:
+            if self.sink is not None:
+                self.sink.close()
+        if error:
+            raise error[0]
+        return latency_stats(latencies, frames=n, wall_s=wall, ticks=n)
+
+    def _deliver(self, frame, per: Mapping[str, Any]) -> None:
+        """Per-frame tail shared by the sync and async loops: sink write
+        + optional GT scoring."""
+        if self.sink is not None:
+            t1 = time.perf_counter()
+            self.sink.write(frame, per)
+            if self.profiler is not None:
+                self.profiler.record("sink", time.perf_counter() - t1)
+        if self.evaluator is not None and self.gt_lookup is not None:
+            gts = self.gt_lookup(frame)
+            if gts is not None:
+                self.evaluator.add_frame(
+                    np.asarray(per["detections"]),
+                    np.asarray(per["valid"]) if "valid" in per else None,
+                    gts,
+                )
 
 
 def detect2d_infer(pipeline) -> InferFn:
@@ -240,12 +316,25 @@ def detect3d_infer(pipeline) -> InferFn:
     return fn
 
 
+def detect3d_infer_async(pipeline) -> AsyncInferFn:
+    """Async adapter for the in-process 3D pipeline: host prep + jit
+    dispatch happen at call time (JAX dispatch is asynchronous), the
+    blocking device->host read is deferred into the returned future —
+    so the driver voxel-pads scan N+1 while the chip runs scan N."""
+
+    def fn(points: np.ndarray):
+        return pipeline.infer_dispatch(points)
+
+    return fn
+
+
 def channel_infer3d(
     channel,
     model_name: str,
     model_version: str = "",
     z_offset: float | None = None,
-) -> InferFn:
+    asynchronous: bool = False,
+) -> InferFn | AsyncInferFn:
     """Remote 3D adapter: host-side prep (z offset, bucketed padding)
     configured from the SERVED metadata (override z_offset to force a
     client-side sensor correction), then the points/num_points padded
@@ -265,7 +354,7 @@ def channel_infer3d(
     if z_offset is None:
         z_offset = float(spec.extra.get("z_offset", 0.0))
 
-    def fn(points: np.ndarray) -> Mapping[str, Any]:
+    def make_request(points: np.ndarray) -> InferRequest:
         points = points[:, :4].astype(np.float32)
         if z_offset:
             points[:, 2] += z_offset
@@ -277,13 +366,13 @@ def channel_infer3d(
             )
         budget = buckets[min(bisect.bisect_left(buckets, len(points)), len(buckets) - 1)]
         padded, m = pad_points(points, budget)
-        resp = channel.do_inference(
-            InferRequest(
-                model_name=model_name,
-                model_version=model_version,
-                inputs={"points": padded, "num_points": np.asarray(m, np.int32)},
-            )
+        return InferRequest(
+            model_name=model_name,
+            model_version=model_version,
+            inputs={"points": padded, "num_points": np.asarray(m, np.int32)},
         )
+
+    def unpack(resp) -> Mapping[str, Any]:
         dets = np.asarray(resp.outputs["detections"])
         valid = np.asarray(resp.outputs["valid"])
         live = dets[valid]
@@ -293,7 +382,11 @@ def channel_infer3d(
             "pred_labels": live[:, 8].astype(np.int32),
         }
 
-    return fn
+    if asynchronous:
+        return lambda points: channel.do_inference_async(
+            make_request(points)
+        ).map(unpack)
+    return lambda points: unpack(channel.do_inference(make_request(points)))
 
 
 def channel_infer(
@@ -301,22 +394,24 @@ def channel_infer(
     model_name: str,
     input_name: str = "images",
     model_version: str = "",
-) -> InferFn:
+    asynchronous: bool = False,
+) -> InferFn | AsyncInferFn:
     """Adapter that round-trips through a BaseChannel (TPUChannel for
     in-process, GRPCChannel for the KServe facade) — the composition the
-    reference wires in main.py:131-139."""
+    reference wires in main.py:131-139. With ``asynchronous=True`` the
+    returned callable yields futures for the driver's inflight pump."""
     from triton_client_tpu.channel.base import InferRequest
 
-    def fn(data: np.ndarray) -> Mapping[str, Any]:
+    def make_request(data: np.ndarray) -> InferRequest:
         if input_name == "images" and data.ndim == 3:
             data = data[None]
-        resp = channel.do_inference(
-            InferRequest(
-                model_name=model_name,
-                model_version=model_version,
-                inputs={input_name: data},
-            )
+        return InferRequest(
+            model_name=model_name,
+            model_version=model_version,
+            inputs={input_name: data},
         )
+
+    def unpack(resp) -> Mapping[str, Any]:
         out = dict(resp.outputs)
         if input_name == "images" and "detections" in out:
             # un-batch single-frame results for sink/eval uniformity
@@ -324,4 +419,8 @@ def channel_infer(
                 out = {k: v[0] for k, v in out.items()}
         return out
 
-    return fn
+    if asynchronous:
+        return lambda data: channel.do_inference_async(
+            make_request(data)
+        ).map(unpack)
+    return lambda data: unpack(channel.do_inference(make_request(data)))

@@ -105,6 +105,15 @@ class Detect3DPipeline:
         """points: (M, 4+) raw cloud [x, y, z, intensity, ...]. Returns
         the reference 3D client contract: pred_boxes (n, 7), pred_scores
         (n,), pred_labels (n,) — n = live detections."""
+        return self.infer_dispatch(points).result()
+
+    def infer_dispatch(self, points: np.ndarray):
+        """Async half of infer (the driver's --async path): host prep +
+        jit enqueue happen here; the returned future's result() performs
+        the only blocking step (device->host read + packing), so callers
+        can overlap the next scan's prep with this scan's compute."""
+        from triton_client_tpu.channel.base import InferFuture
+
         buckets = self.config.point_buckets
         i = bisect.bisect_left(buckets, points.shape[0])
         budget = buckets[min(i, len(buckets) - 1)]
@@ -122,13 +131,17 @@ class Detect3DPipeline:
             points[:, 2] += self.config.z_offset
         padded, m = pad_points(points, budget)
         dets, valid = self._jit(jnp.asarray(padded), jnp.asarray(m))
-        dets, valid = np.asarray(dets), np.asarray(valid)
-        live = dets[valid]
-        return {
-            "pred_boxes": live[:, :7],
-            "pred_scores": live[:, 7],
-            "pred_labels": live[:, 8].astype(np.int32),
-        }
+
+        def resolve() -> dict[str, np.ndarray]:
+            d, v = np.asarray(dets), np.asarray(valid)
+            live = d[v]
+            return {
+                "pred_boxes": live[:, :7],
+                "pred_scores": live[:, 7],
+                "pred_labels": live[:, 8].astype(np.int32),
+            }
+
+        return InferFuture(resolve)
 
     def infer_fn(self):
         """Repository-facing adapter over the padded static contract."""
