@@ -323,11 +323,17 @@ def scan_disk(
     """
     root = pathlib.Path(root)
     repo = repository or ModelRepository()
+    ensembles: list[tuple[pathlib.Path, dict]] = []
     for model_dir in sorted(p for p in root.iterdir() if p.is_dir()):
         if not (model_dir / "config.yaml").exists():
             log.info("skipping %s (no config.yaml)", model_dir)
             continue
-        entry = _Entry(model_dir)
+        doc = dict(load_yaml(str(model_dir / "config.yaml")))
+        if doc.get("family") == "ensemble":
+            # composed over member models — register after them all
+            ensembles.append((model_dir, doc))
+            continue
+        entry = _Entry(model_dir, doc=doc)
         versions = version_dirs(model_dir)
         pairs = (
             [(v.name, find_weights(v)) for v in versions]
@@ -339,6 +345,31 @@ def scan_disk(
             repo.register(rm.spec, rm.infer_fn, warmup=rm.warmup)
             if entry.doc.get("warmup"):
                 rm.warmup()
+    if ensembles:
+        from triton_client_tpu.runtime.ensemble import build_ensemble_doc
+
+        # Dependency-order fixpoint: an ensemble whose step references a
+        # not-yet-registered sibling ensemble waits for the next round
+        # (nested ensembles must not depend on directory sort order).
+        pending = {d.name: (d, doc) for d, doc in ensembles}
+        while pending:
+            ready = [
+                name
+                for name, (_, doc) in pending.items()
+                if not any(
+                    s.get("model") in pending for s in doc.get("steps", [])
+                )
+            ]
+            if not ready:
+                raise ValueError(
+                    f"ensemble dependency cycle among {sorted(pending)}"
+                )
+            for name in ready:
+                model_dir, doc = pending.pop(name)
+                rm = build_ensemble_doc(repo, name, doc)
+                repo.register(rm.spec, rm.infer_fn, warmup=rm.warmup)
+                if doc.get("warmup"):
+                    rm.warmup()
     return repo
 
 

@@ -1,0 +1,223 @@
+"""Ensemble models: serve a DAG of registered models as one model.
+
+Triton ensembles (``platform: "ensemble"`` + ``ensemble_scheduling``
+steps with input_map/output_map) are the reference's acknowledged gap —
+"Ensemble mode for Triton server" sits unchecked in its TODO list
+(README.md:119) and nothing in its tree implements it. This module is
+the TPU-native version, and it is *better* placed here than in Triton:
+member models are jit-compiled JAX functions over device arrays, so
+intermediate tensors flow step-to-step **without leaving HBM** — Triton
+ensembles shuttle tensors through host memory between backends unless
+both sides opt into GPU tensors.
+
+An ensemble is declared in the model repository like any other entry::
+
+    <root>/<name>/config.yaml
+        family: ensemble
+        steps:
+          - model: detector            # registered model name
+            version: "2"               # optional (default: latest)
+            input_map:  {images: raw}  # step input <- ensemble tensor
+            output_map: {detections: boxes}  # step output -> ensemble tensor
+          - model: tracker
+            input_map:  {boxes: boxes}
+            output_map: {tracks: tracks}
+        outputs: [tracks]              # ensemble-level outputs
+
+Steps execute in declaration order (Triton semantics); build-time
+validation checks that every consumed tensor is an ensemble input or
+was produced by an earlier step, that referenced models/tensors exist,
+and that declared outputs are produced. The composed callable is just
+another InferFn, so ensembles serve through TPUChannel, the gRPC
+facade, and the micro-batcher unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Mapping, Sequence
+
+from triton_client_tpu.config import ModelSpec, TensorSpec
+from triton_client_tpu.runtime.repository import (
+    ModelRepository,
+    RegisteredModel,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class EnsembleStep:
+    """One scheduling step: run ``model`` with inputs pulled from the
+    ensemble tensor pool via ``input_map`` (step input name -> pool
+    name) and publish outputs back via ``output_map`` (step output
+    name -> pool name)."""
+
+    model: str
+    input_map: Mapping[str, str]
+    output_map: Mapping[str, str]
+    version: str = ""
+
+
+def parse_steps(doc_steps: Sequence[Mapping]) -> list[EnsembleStep]:
+    steps = []
+    for i, d in enumerate(doc_steps):
+        d = dict(d)
+        unknown = set(d) - {"model", "version", "input_map", "output_map"}
+        if unknown:
+            raise KeyError(
+                f"ensemble step {i}: unknown keys {sorted(unknown)}"
+            )
+        for key in ("model", "input_map", "output_map"):
+            if key not in d:
+                raise KeyError(f"ensemble step {i}: missing '{key}'")
+        steps.append(
+            EnsembleStep(
+                model=str(d["model"]),
+                version=str(d.get("version", "")),
+                input_map=dict(d["input_map"]),
+                output_map=dict(d["output_map"]),
+            )
+        )
+    if not steps:
+        raise ValueError("ensemble needs at least one step")
+    return steps
+
+
+def _rename(spec: TensorSpec, name: str) -> TensorSpec:
+    return dataclasses.replace(spec, name=name)
+
+
+def _check_compatible(
+    ensemble: str, step: str, pool_name: str, have: TensorSpec, want: TensorSpec
+) -> None:
+    """Producer/consumer contract check for one pool tensor: dtypes must
+    match exactly; dims must agree where both sides are static (-1 is a
+    wildcard). Triton validates ensemble tensor consistency at load
+    time; failing here keeps scan_disk's fail-loudly-at-startup policy."""
+    if have.dtype != want.dtype:
+        raise ValueError(
+            f"ensemble '{ensemble}': tensor '{pool_name}' is {have.dtype} "
+            f"but step '{step}' consumes it as {want.dtype}"
+        )
+    if len(have.shape) != len(want.shape) or any(
+        a != b for a, b in zip(have.shape, want.shape) if a >= 0 and b >= 0
+    ):
+        raise ValueError(
+            f"ensemble '{ensemble}': tensor '{pool_name}' has shape "
+            f"{have.shape} but step '{step}' expects {want.shape}"
+        )
+
+
+def build_ensemble(
+    repository: ModelRepository,
+    name: str,
+    steps: Sequence[EnsembleStep],
+    outputs: Sequence[str],
+    version: str = "1",
+    max_batch_size: int = 1,
+) -> RegisteredModel:
+    """Compose registered models into one RegisteredModel.
+
+    The ensemble's input contract is derived, not declared: every pool
+    tensor consumed before it is produced becomes an ensemble input,
+    typed by the first member input bound to it. Members are resolved
+    at BUILD time (snapshot semantics): reloading a member model means
+    rebuilding ensembles over it, exactly like Triton's.
+    """
+    if not outputs:
+        raise ValueError(f"ensemble '{name}': declare at least one output")
+    members = [repository.get(s.model, s.version) for s in steps]
+
+    produced: dict[str, TensorSpec] = {}
+    needed: dict[str, TensorSpec] = {}
+    for step, member in zip(steps, members):
+        in_names = {t.name for t in member.spec.inputs}
+        missing = set(step.input_map) - in_names
+        if missing:
+            raise KeyError(
+                f"ensemble '{name}': step '{step.model}' has no inputs "
+                f"{sorted(missing)} (has {sorted(in_names)})"
+            )
+        unbound = in_names - set(step.input_map)
+        if unbound:
+            raise KeyError(
+                f"ensemble '{name}': step '{step.model}' inputs "
+                f"{sorted(unbound)} are not bound in input_map"
+            )
+        for step_in, pool_name in step.input_map.items():
+            spec = member.spec.input_by_name(step_in)
+            have = produced.get(pool_name) or needed.get(pool_name)
+            if have is None:
+                needed[pool_name] = _rename(spec, pool_name)
+            else:
+                _check_compatible(name, step.model, pool_name, have, spec)
+        out_specs = {t.name: t for t in member.spec.outputs}
+        missing = set(step.output_map) - set(out_specs)
+        if missing:
+            raise KeyError(
+                f"ensemble '{name}': step '{step.model}' has no outputs "
+                f"{sorted(missing)} (has {sorted(out_specs)})"
+            )
+        for step_out, pool_name in step.output_map.items():
+            produced[pool_name] = _rename(out_specs[step_out], pool_name)
+
+    missing = [o for o in outputs if o not in produced and o not in needed]
+    if missing:
+        raise ValueError(
+            f"ensemble '{name}': outputs {missing} are never produced "
+            f"by any step (produced: {sorted(produced)})"
+        )
+
+    spec = ModelSpec(
+        name=name,
+        version=version,
+        platform="ensemble",
+        inputs=tuple(needed.values()),
+        outputs=tuple(
+            produced.get(o, needed.get(o)) for o in outputs
+        ),
+        max_batch_size=max_batch_size,
+        extra={"steps": [s.model for s in steps]},
+    )
+
+    step_list = list(zip(steps, members))
+    output_names = tuple(outputs)
+
+    def infer_fn(inputs: Mapping) -> dict:
+        pool = dict(inputs)
+        for step, member in step_list:
+            step_inputs = {
+                step_in: pool[pool_name]
+                for step_in, pool_name in step.input_map.items()
+            }
+            result = member.infer_fn(step_inputs)
+            for step_out, pool_name in step.output_map.items():
+                pool[pool_name] = result[step_out]
+        return {o: pool[o] for o in output_names}
+
+    def warmup() -> None:
+        for _, member in step_list:
+            if member.warmup is not None:
+                member.warmup()
+
+    return RegisteredModel(spec=spec, infer_fn=infer_fn, warmup=warmup)
+
+
+def build_ensemble_doc(
+    repository: ModelRepository, name: str, doc: Mapping, version: str = "1"
+) -> RegisteredModel:
+    """config.yaml dict -> RegisteredModel (the disk-repository hook)."""
+    unknown = set(doc) - {"family", "steps", "outputs", "max_batch_size", "warmup"}
+    if unknown:
+        raise KeyError(
+            f"ensemble '{name}': unknown config keys {sorted(unknown)}"
+        )
+    if "steps" not in doc or "outputs" not in doc:
+        raise KeyError(f"ensemble '{name}': config needs 'steps' and 'outputs'")
+    return build_ensemble(
+        repository,
+        name,
+        parse_steps(doc["steps"]),
+        outputs=list(doc["outputs"]),
+        version=version,
+        max_batch_size=int(doc.get("max_batch_size", 1)),
+    )
