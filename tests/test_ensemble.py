@@ -160,6 +160,16 @@ class TestBuildEnsemble:
                 outputs=["out"],
             )
 
+    def test_input_echo_output_rejected(self, repo):
+        # an output naming an ensemble INPUT (typo: echoing raw back)
+        # must fail at build, not silently pass input through
+        with pytest.raises(ValueError, match="never produced"):
+            build_ensemble(
+                repo, "e",
+                [EnsembleStep("scale", {"x": "raw"}, {"scaled": "mid"})],
+                outputs=["mid", "raw"],
+            )
+
     def test_undeclared_output(self, repo):
         with pytest.raises(ValueError, match="never produced"):
             build_ensemble(

@@ -117,6 +117,114 @@ def test_pipeline_empty_cloud():
     assert out["pred_boxes"].shape[1] == 7
 
 
+def _tiny_cloud(rng, n=400):
+    r = TINY.voxel.point_cloud_range
+    pts = np.empty((n, 4), np.float32)
+    pts[:, 0] = rng.uniform(r[0], r[3], n)
+    pts[:, 1] = rng.uniform(r[1], r[4], n)
+    pts[:, 2] = rng.uniform(r[2], r[5], n)
+    pts[:, 3] = rng.uniform(0, 1, n)
+    return pts
+
+
+def test_from_points_matches_grouped_path(tiny_model, rng):
+    """The sort-free scatter VFE must reproduce the grouped voxelizer
+    path exactly while the (max_voxels, max_points_per_voxel) budgets
+    are not hit (they exist only for the wire contract's static
+    shape)."""
+    from triton_client_tpu.ops.voxelize import pad_points, voxelize
+
+    model, variables = tiny_model
+    pts = _tiny_cloud(rng)
+    padded, m = pad_points(pts, 512)
+    pj, mj = jnp.asarray(padded), jnp.asarray(m)
+    vox = voxelize(pj, mj, TINY.voxel)
+    assert int(vox["num_points_per_voxel"].max()) <= TINY.voxel.max_points_per_voxel
+    assert int(vox["voxel_valid"].sum()) < TINY.voxel.max_voxels
+    grouped = model.apply(
+        variables,
+        vox["voxels"][None],
+        vox["num_points_per_voxel"][None],
+        vox["coords"][None],
+        train=False,
+    )
+    scatter = model.apply(variables, pj, mj, train=False, method=model.from_points)
+    for k in grouped:
+        np.testing.assert_allclose(
+            np.asarray(grouped[k]), np.asarray(scatter[k]), atol=1e-5,
+            err_msg=f"head {k}",
+        )
+
+
+def test_pipeline_vfe_modes_agree(rng):
+    """Detect3DConfig.vfe routing: 'auto' (scatter) and 'grouped' give
+    the same detections on an under-budget cloud; unknown modes fail."""
+    pts = _tiny_cloud(rng)
+    cfg = Detect3DConfig(point_buckets=(512,), max_det=16, pre_max=64)
+    auto, _, variables = build_pointpillars_pipeline(
+        jax.random.PRNGKey(0), model_cfg=TINY, config=cfg
+    )
+    grouped, _, _ = build_pointpillars_pipeline(
+        model_cfg=TINY,
+        config=Detect3DConfig(
+            point_buckets=(512,), max_det=16, pre_max=64, vfe="grouped"
+        ),
+        variables=variables,
+    )
+    a, g = auto.infer(pts), grouped.infer(pts)
+    np.testing.assert_allclose(a["pred_boxes"], g["pred_boxes"], atol=1e-5)
+    np.testing.assert_array_equal(a["pred_labels"], g["pred_labels"])
+
+    bad, _, _ = build_pointpillars_pipeline(
+        model_cfg=TINY,
+        config=Detect3DConfig(point_buckets=(512,), vfe="nope"),
+        variables=variables,
+    )
+    with pytest.raises(ValueError, match="unknown vfe mode"):
+        bad.infer(pts)
+
+
+def test_centerpoint_from_points_matches_grouped(rng):
+    from triton_client_tpu.models.centerpoint import (
+        CenterPointConfig,
+        init_centerpoint,
+    )
+    from triton_client_tpu.ops.voxelize import pad_points, voxelize
+
+    cfg = CenterPointConfig(
+        voxel=VoxelConfig(
+            point_cloud_range=(0.0, -6.4, -3.0, 12.8, 6.4, 1.0),
+            voxel_size=(0.2, 0.2, 4.0),
+            max_voxels=512,
+            max_points_per_voxel=8,
+        ),
+        backbone_layers=(1, 1, 1),
+    )
+    model, variables = init_centerpoint(jax.random.PRNGKey(0), cfg)
+    r = cfg.voxel.point_cloud_range
+    pts = np.empty((300, 4), np.float32)
+    pts[:, 0] = rng.uniform(r[0], r[3], 300)
+    pts[:, 1] = rng.uniform(r[1], r[4], 300)
+    pts[:, 2] = rng.uniform(r[2], r[5], 300)
+    pts[:, 3] = rng.uniform(0, 1, 300)
+    padded, m = pad_points(pts, 512)
+    pj, mj = jnp.asarray(padded), jnp.asarray(m)
+    vox = voxelize(pj, mj, cfg.voxel)
+    grouped = model.apply(
+        variables,
+        vox["voxels"][None],
+        vox["num_points_per_voxel"][None],
+        vox["coords"][None],
+        train=False,
+    )
+    scatter = model.apply(variables, pj, mj, train=False, method=model.from_points)
+    for k in grouped:
+        np.testing.assert_allclose(
+            np.asarray(grouped[k]), np.asarray(scatter[k]), atol=1e-5,
+            err_msg=f"head {k}",
+        )
+
+
 def test_decode_topk_matches_full_decode_path():
     """The top-k-before-decode fast path must produce the same packed
     detections as decode() + extract_boxes_3d (sigmoid is monotonic, so

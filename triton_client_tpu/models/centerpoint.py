@@ -29,6 +29,8 @@ from flax import linen as nn
 from triton_client_tpu.models.pointpillars import (
     BEVBackbone,
     PillarVFE,
+    augment_points,
+    scatter_max_canvas,
     scatter_to_bev,
 )
 from triton_client_tpu.ops.voxelize import VoxelConfig
@@ -130,7 +132,12 @@ class CenterPoint(nn.Module):
     cfg: CenterPointConfig = CenterPointConfig()
     dtype: jnp.dtype = jnp.float32
 
-    @nn.compact
+    def setup(self) -> None:
+        cfg, dt = self.cfg, self.dtype
+        self.vfe = PillarVFE(cfg.vfe_filters, cfg.voxel, dtype=dt)
+        self.backbone = BEVBackbone(cfg, dtype=dt)
+        self.head = CenterHead(cfg, dtype=dt)
+
     def __call__(
         self,
         voxels: jnp.ndarray,      # (B, V, K, F)
@@ -138,16 +145,26 @@ class CenterPoint(nn.Module):
         coords: jnp.ndarray,      # (B, V, 3) [z, y, x]
         train: bool = False,
     ) -> dict[str, jnp.ndarray]:
-        cfg, dt = self.cfg, self.dtype
-        nx, ny, _ = cfg.voxel.grid_size
-
-        vfe = PillarVFE(cfg.vfe_filters, cfg.voxel, dtype=dt, name="vfe")
-        feats = jax.vmap(lambda v, n, c: vfe(v, n, c, train))(
+        nx, ny, _ = self.cfg.voxel.grid_size
+        feats = jax.vmap(lambda v, n, c: self.vfe(v, n, c, train))(
             voxels, num_points, coords
         )
         canvas = jax.vmap(lambda f, c: scatter_to_bev(f, c, (ny, nx)))(feats, coords)
-        spatial = BEVBackbone(cfg, dtype=dt, name="backbone")(canvas, train)
-        return CenterHead(cfg, dtype=dt, name="head")(spatial, train)
+        return self.head(self.backbone(canvas, train), train)
+
+    def from_points(
+        self,
+        points: jnp.ndarray,  # (N, F>=4) padded cloud
+        count: jnp.ndarray,   # () real rows
+        train: bool = False,
+    ) -> dict[str, jnp.ndarray]:
+        """Sort-free scatter path (see PointPillars.from_points): same
+        parameters, no (V, K) grouping, batch 1."""
+        nx, ny, _ = self.cfg.voxel.grid_size
+        feats, vid, valid, cnt = augment_points(points, count, self.cfg.voxel)
+        x = self.vfe.encode(feats, train)
+        canvas = scatter_max_canvas(x, vid, valid, cnt, (ny, nx))
+        return self.head(self.backbone(canvas[None], train), train)
 
     def decode(self, heads: dict[str, jnp.ndarray]) -> dict[str, jnp.ndarray]:
         """Center decode -> flat predictions shaped like the anchor

@@ -25,7 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 from flax import linen as nn
 
-from triton_client_tpu.ops.voxelize import VoxelConfig
+from triton_client_tpu.ops.voxelize import VoxelConfig, assign_cells
 
 
 @dataclasses.dataclass(frozen=True)
@@ -154,13 +154,28 @@ class PillarVFE(nn.Module):
     """Pillar feature encoder: augment -> linear+BN+ReLU -> masked max.
 
     Feature augmentation per data/pointpillar.yaml (USE_ABSLOTE_XYZ):
-    [x, y, z, i, x-xmean, y-ymean, z-zmean, x-xc, y-yc, z-zc] (10)."""
+    [x, y, z, i, x-xmean, y-ymean, z-zmean, x-xc, y-yc, z-zc] (10).
+
+    Two entry points over the SAME parameters: ``__call__`` consumes
+    the grouped (V, K, F) voxel contract (the reference's OpenPCDet
+    wire shape); ``encode`` is the per-point MLP alone, used by the
+    sort-free scatter path (``from_points``) where the segment
+    mean/max are dense grid scatters instead of a K-axis reduction."""
 
     filters: int = 64
     voxel: VoxelConfig = VoxelConfig()
     dtype: jnp.dtype = jnp.float32
 
-    @nn.compact
+    def setup(self) -> None:
+        self.linear = nn.Dense(self.filters, use_bias=False, dtype=self.dtype)
+        self.bn = nn.BatchNorm(momentum=0.99, epsilon=1e-3, dtype=self.dtype)
+
+    def encode(self, feats: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        """(..., 10) augmented point features -> (..., filters)."""
+        x = self.linear(feats.astype(self.dtype))
+        x = self.bn(x, use_running_average=not train)
+        return nn.relu(x)
+
     def __call__(
         self,
         voxels: jnp.ndarray,       # (V, K, F>=4)
@@ -184,15 +199,62 @@ class PillarVFE(nn.Module):
             ],
             axis=-1,
         )
-        feats = jnp.where(mask, feats, 0.0).astype(self.dtype)
-        x = nn.Dense(self.filters, use_bias=False, dtype=self.dtype, name="linear")(feats)
-        x = nn.BatchNorm(
-            use_running_average=not train, momentum=0.99, epsilon=1e-3,
-            dtype=self.dtype, name="bn",
-        )(x)
-        x = nn.relu(x)
+        feats = jnp.where(mask, feats, 0.0)
+        x = self.encode(feats, train)
         x = jnp.where(mask, x, -jnp.inf).max(axis=1)  # (V, filters)
         return jnp.where(num_points[:, None] > 0, x, 0.0)
+
+
+def augment_points(
+    points: jnp.ndarray,   # (N, F>=4) padded cloud [x, y, z, i, ...]
+    count: jnp.ndarray,    # () real rows
+    voxel: VoxelConfig,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Per-point pillar assignment + the 10-feature VFE augmentation,
+    with the pillar mean computed as a dense-grid scatter instead of a
+    (V, K) grouping. This is the sort-free half of the scatter VFE path:
+    the voxelizer's 131k-point lax.sort (ops/voxelize.py) is the single
+    most expensive stage of the fused 3D pipeline on a v5e chip; pillar
+    mean/max are segment reductions, so they scatter straight into the
+    (ny*nx) grid the BEV canvas needs anyway. Pillar-grid specific
+    (collapses z: the pillar center z is the cell-0 center, identical
+    to the grouped path where coords z is always 0).
+
+    Returns (feats (N, 10), vid (N,) flat y*nx+x pillar id with
+    ny*nx as the invalid dump slot, valid (N,), cnt (ny*nx+1,) points
+    per pillar)."""
+    nx, ny, _ = voxel.grid_size
+    r = jnp.asarray(voxel.point_cloud_range)
+    vs = jnp.asarray(voxel.voxel_size)
+    xyz = points[:, :3]
+    ijk, valid = assign_cells(points, count, voxel)
+    dump = nx * ny
+    vid = jnp.where(valid, ijk[:, 1] * nx + ijk[:, 0], dump)
+    w = valid.astype(points.dtype)[:, None]
+    sums = jnp.zeros((dump + 1, 3), points.dtype).at[vid].add(xyz * w)
+    cnt = jnp.zeros((dump + 1,), points.dtype).at[vid].add(w[:, 0])
+    mean = sums[vid] / jnp.maximum(cnt[vid], 1.0)[:, None]
+    centers = (ijk.astype(jnp.float32) + 0.5) * vs + r[:3]
+    feats = jnp.concatenate([points[:, :4], xyz - mean, xyz - centers], axis=1)
+    return jnp.where(valid[:, None], feats, 0.0), vid, valid, cnt
+
+
+def scatter_max_canvas(
+    x: jnp.ndarray,      # (N, C) per-point features
+    vid: jnp.ndarray,    # (N,) flat y*nx+x pillar id (ny*nx = dump)
+    valid: jnp.ndarray,  # (N,)
+    cnt: jnp.ndarray,    # (ny*nx+1,) points per pillar
+    grid_hw: tuple[int, int],
+) -> jnp.ndarray:
+    """Pillar-max scatter to the (H, W, C) canvas — the segment-max half
+    of the sort-free VFE, shared by every pillar model's from_points so
+    the grouped/scatter bit-exactness fix lives in ONE place."""
+    h, w = grid_hw
+    x = jnp.where(valid[:, None], x, -jnp.inf)
+    canvas = jnp.full((h * w + 1, x.shape[-1]), -jnp.inf, x.dtype)
+    canvas = canvas.at[vid].max(x)[: h * w]
+    canvas = jnp.where(cnt[: h * w, None] > 0, canvas, 0.0)
+    return canvas.reshape(h, w, -1)
 
 
 def scatter_to_bev(
@@ -267,12 +329,22 @@ class PointPillars(nn.Module):
     """Full detector: VFE -> scatter -> backbone -> anchor head.
 
     __call__ consumes the voxelizer's output dict (batched) and returns
-    raw head maps; ``decode`` produces per-anchor boxes/scores."""
+    raw head maps; ``from_points`` is the sort-free single-scan path
+    (same parameters, no (V, K) grouping); ``decode`` produces
+    per-anchor boxes/scores."""
 
     cfg: PointPillarsConfig = PointPillarsConfig()
     dtype: jnp.dtype = jnp.float32
 
-    @nn.compact
+    def setup(self) -> None:
+        cfg, dt = self.cfg, self.dtype
+        self.vfe = PillarVFE(cfg.vfe_filters, cfg.voxel, dtype=dt)
+        self.backbone = BEVBackbone(cfg, dtype=dt)
+        a = cfg.anchors_per_loc
+        self.cls_head = nn.Conv(a * cfg.num_classes, (1, 1), dtype=jnp.float32)
+        self.box_head = nn.Conv(a * 7, (1, 1), dtype=jnp.float32)
+        self.dir_head = nn.Conv(a * cfg.num_dir_bins, (1, 1), dtype=jnp.float32)
+
     def __call__(
         self,
         voxels: jnp.ndarray,      # (B, V, K, F)
@@ -280,29 +352,43 @@ class PointPillars(nn.Module):
         coords: jnp.ndarray,      # (B, V, 3)
         train: bool = False,
     ) -> dict[str, jnp.ndarray]:
-        cfg, dt = self.cfg, self.dtype
-        nx, ny, _ = cfg.voxel.grid_size
-
-        vfe = PillarVFE(cfg.vfe_filters, cfg.voxel, dtype=dt, name="vfe")
-        feats = jax.vmap(lambda v, n, c: vfe(v, n, c, train))(
+        nx, ny, _ = self.cfg.voxel.grid_size
+        feats = jax.vmap(lambda v, n, c: self.vfe(v, n, c, train))(
             voxels, num_points, coords
         )  # (B, V, C)
         canvas = jax.vmap(lambda f, c: scatter_to_bev(f, c, (ny, nx)))(
             feats, coords
         )  # (B, ny, nx, C)
+        return self._heads(canvas, train)
 
-        spatial = BEVBackbone(cfg, dtype=dt, name="backbone")(canvas, train)
+    def from_points(
+        self,
+        points: jnp.ndarray,  # (N, F>=4) padded cloud
+        count: jnp.ndarray,   # () real rows
+        train: bool = False,
+    ) -> dict[str, jnp.ndarray]:
+        """Sort-free scatter path: points -> canvas -> heads (batch 1).
 
+        Equivalent to ``voxelize() + __call__`` whenever the voxelizer's
+        budgets (max_voxels, max_points_per_voxel) are not hit; beyond
+        them this path keeps ALL points and pillars (the budgets exist
+        only to give the grouped wire contract a static shape). Skips
+        the (N log N) point sort entirely — pillar mean and max are
+        dense-grid scatters."""
+        nx, ny, _ = self.cfg.voxel.grid_size
+        feats, vid, valid, cnt = augment_points(points, count, self.cfg.voxel)
+        x = self.vfe.encode(feats, train)  # (N, C)
+        canvas = scatter_max_canvas(x, vid, valid, cnt, (ny, nx))
+        return self._heads(canvas[None], train)
+
+    def _heads(self, canvas: jnp.ndarray, train: bool) -> dict[str, jnp.ndarray]:
+        cfg = self.cfg
+        spatial = self.backbone(canvas, train)
+        spatial = spatial.astype(jnp.float32)
+        cls = self.cls_head(spatial)
+        box = self.box_head(spatial)
+        direction = self.dir_head(spatial)
         a = cfg.anchors_per_loc
-        cls = nn.Conv(a * cfg.num_classes, (1, 1), dtype=jnp.float32, name="cls_head")(
-            spatial.astype(jnp.float32)
-        )
-        box = nn.Conv(a * 7, (1, 1), dtype=jnp.float32, name="box_head")(
-            spatial.astype(jnp.float32)
-        )
-        direction = nn.Conv(
-            a * cfg.num_dir_bins, (1, 1), dtype=jnp.float32, name="dir_head"
-        )(spatial.astype(jnp.float32))
         b, h, w, _ = cls.shape
         return {
             "cls": cls.reshape(b, h, w, a, cfg.num_classes),

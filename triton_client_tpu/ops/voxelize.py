@@ -54,6 +54,24 @@ class VoxelConfig:
         )
 
 
+def assign_cells(
+    points: jnp.ndarray, num_points: jnp.ndarray, config: VoxelConfig
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Shared cell assignment: (N, F>=3) padded cloud -> (ijk (N, 3)
+    int32 [x, y, z] cell, valid (N,) bool). The single source of the
+    grid-boundary semantics for the grouped voxelizer below AND the
+    sort-free scatter VFE (models/pointpillars.py augment_points) — the
+    two paths' bit-exact agreement depends on sharing this."""
+    n = points.shape[0]
+    nx, ny, nz = config.grid_size
+    r = jnp.asarray(config.point_cloud_range)
+    vs = jnp.asarray(config.voxel_size)
+    ijk = jnp.floor((points[:, :3] - r[:3]) / vs).astype(jnp.int32)
+    valid = jnp.all((ijk >= 0) & (ijk < jnp.asarray([nx, ny, nz])), axis=1)
+    valid &= jnp.arange(n) < num_points
+    return ijk, valid
+
+
 @functools.partial(jax.jit, static_argnames=("config",))
 def voxelize(
     points: jnp.ndarray, num_points: jnp.ndarray, config: VoxelConfig
@@ -68,13 +86,8 @@ def voxelize(
     n, f = points.shape
     nx, ny, nz = config.grid_size
     v_cap, k_cap = config.max_voxels, config.max_points_per_voxel
-    r = jnp.asarray(config.point_cloud_range)
-    vs = jnp.asarray(config.voxel_size)
 
-    xyz = points[:, :3]
-    ijk = jnp.floor((xyz - r[:3]) / vs).astype(jnp.int32)  # (N, 3) x,y,z cell
-    in_range = jnp.all((ijk >= 0) & (ijk < jnp.asarray([nx, ny, nz])), axis=1)
-    in_range &= jnp.arange(n) < num_points
+    ijk, in_range = assign_cells(points, num_points, config)
 
     # Linearized voxel id; invalid points get a sentinel that sorts last.
     vid = (ijk[:, 2] * ny + ijk[:, 1]) * nx + ijk[:, 0]

@@ -52,6 +52,12 @@ class Detect3DConfig:
     # adds 1.5 m for its lidar mount)
     z_offset: float = 0.0
     class_names: tuple[str, ...] = ("Car", "Pedestrian", "Cyclist")
+    # VFE routing: "auto" uses the model's sort-free from_points path
+    # when it has one (pillar models), "grouped" forces the (V, K)
+    # voxelizer contract (exact OpenPCDet budget semantics — caps at
+    # max_voxels/max_points_per_voxel; the scatter path keeps all
+    # points, which can only add information).
+    vfe: str = "auto"
 
 
 class Detect3DPipeline:
@@ -68,14 +74,25 @@ class Detect3DPipeline:
 
     def _pipeline(self, points: jnp.ndarray, count: jnp.ndarray):
         cfg = self.config
-        vox = voxelize(points, count, self.model.cfg.voxel)
-        heads = self.model.apply(
-            self.variables,
-            vox["voxels"][None],
-            vox["num_points_per_voxel"][None],
-            vox["coords"][None],
-            train=False,
-        )
+        use_scatter = cfg.vfe == "auto" and hasattr(self.model, "from_points")
+        if cfg.vfe not in ("auto", "grouped"):
+            raise ValueError(f"unknown vfe mode {cfg.vfe!r} (auto|grouped)")
+        if use_scatter:
+            # sort-free path: pillar mean/max as dense-grid scatters,
+            # no (V, K) grouping (see PointPillars.from_points)
+            heads = self.model.apply(
+                self.variables, points, count, train=False,
+                method=self.model.from_points,
+            )
+        else:
+            vox = voxelize(points, count, self.model.cfg.voxel)
+            heads = self.model.apply(
+                self.variables,
+                vox["voxels"][None],
+                vox["num_points_per_voxel"][None],
+                vox["coords"][None],
+                train=False,
+            )
         if hasattr(self.model, "decode_topk"):
             # Fast path: gate + top-k on raw logits BEFORE box decode —
             # only pre_max boxes are ever decoded (see decode_topk).

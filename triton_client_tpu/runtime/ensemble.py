@@ -160,8 +160,11 @@ def build_ensemble(
         for step_out, pool_name in step.output_map.items():
             produced[pool_name] = _rename(out_specs[step_out], pool_name)
 
-    missing = [o for o in outputs if o not in produced and o not in needed]
+    missing = [o for o in outputs if o not in produced]
     if missing:
+        # ensemble inputs don't qualify: echoing an input back is almost
+        # always a config typo (Triton likewise requires every ensemble
+        # output to come from a step's output_map)
         raise ValueError(
             f"ensemble '{name}': outputs {missing} are never produced "
             f"by any step (produced: {sorted(produced)})"
@@ -172,9 +175,7 @@ def build_ensemble(
         version=version,
         platform="ensemble",
         inputs=tuple(needed.values()),
-        outputs=tuple(
-            produced.get(o, needed.get(o)) for o in outputs
-        ),
+        outputs=tuple(produced[o] for o in outputs),
         max_batch_size=max_batch_size,
         extra={"steps": [s.model for s in steps]},
     )
