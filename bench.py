@@ -30,14 +30,15 @@ CAMERA_FPS_BASELINE = 30.0
 LIDAR_HZ_BASELINE = 10.0  # KITTI/nuScenes lidar scan rate
 
 
-def bench_yolov5() -> dict:
+def bench_yolov5(dtype=None) -> dict:
     from triton_client_tpu.models.yolov5 import init_yolov5
     from triton_client_tpu.ops.detect_postprocess import extract_boxes
     from triton_client_tpu.ops.preprocess import normalize_image
 
     input_hw = (512, 512)
     model, variables = init_yolov5(
-        jax.random.PRNGKey(0), num_classes=2, variant="n", input_hw=input_hw
+        jax.random.PRNGKey(0), num_classes=2, variant="n", input_hw=input_hw,
+        dtype=dtype or jnp.float32,
     )
 
     @jax.jit
@@ -62,8 +63,9 @@ def bench_yolov5() -> dict:
     dt = time.perf_counter() - t0
 
     fps = BATCH * ITERS / dt
+    suffix = "_bf16" if dtype == jnp.bfloat16 else ""
     return {
-        "metric": "yolov5n_512_e2e_frames_per_sec_per_chip",
+        "metric": f"yolov5n_512{suffix}_e2e_frames_per_sec_per_chip",
         "value": round(fps, 2),
         "unit": "frames/sec",
         "vs_baseline": round(fps / CAMERA_FPS_BASELINE, 2),
@@ -122,10 +124,14 @@ def bench_pointpillars() -> dict:
 def main() -> None:
     primary = bench_yolov5()
     results = [primary]
-    try:
-        results.append(bench_pointpillars())
-    except Exception as e:  # secondary metric must not break the contract
-        print(f"pointpillars bench failed: {e}", file=sys.stderr)
+    for secondary_fn in (
+        lambda: bench_yolov5(dtype=jnp.bfloat16),
+        bench_pointpillars,
+    ):
+        try:
+            results.append(secondary_fn())
+        except Exception as e:  # secondary metrics must not break the contract
+            print(f"secondary bench failed: {e}", file=sys.stderr)
 
     try:  # best-effort: the one-line stdout contract must survive
         with open("BENCH_LOCAL.json", "w") as f:

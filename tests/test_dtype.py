@@ -1,0 +1,114 @@
+"""bf16 compute path: backbone in bfloat16, heads/decode/NMS in fp32.
+
+TPU-first guidance is bfloat16 on the MXU; heads stay fp32 in every
+pipeline (models/*.py cast `spatial` before the 1x1 head convs), so the
+wire contract and decode math are unchanged. Exposed as --dtype bf16 on
+the CLI and `model: {dtype: bf16}` in repository config.yaml entries.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+
+class TestYolov5Bf16:
+    def test_pipeline_runs_and_outputs_fp32(self, rng):
+        from triton_client_tpu.pipelines.detect2d import build_yolov5_pipeline
+
+        pipe, spec, _ = build_yolov5_pipeline(
+            jax.random.PRNGKey(0),
+            variant="n",
+            num_classes=2,
+            input_hw=(64, 64),
+            dtype=jnp.bfloat16,
+        )
+        frame = rng.integers(0, 255, (64, 64, 3)).astype(np.float32)
+        dets, valid = pipe.infer(frame)
+        assert dets.dtype == np.float32
+        assert np.isfinite(dets[valid]).all()
+
+    def test_bf16_boxes_close_to_fp32(self, rng):
+        # same weights, both precisions: the box geometry of confident
+        # detections must agree to bf16 tolerance (~1e-2 relative)
+        from triton_client_tpu.models.yolov5 import init_yolov5
+
+        model32, variables = init_yolov5(
+            jax.random.PRNGKey(0), num_classes=2, variant="n", input_hw=(64, 64)
+        )
+        from triton_client_tpu.models.yolov5 import YoloV5
+
+        model16 = YoloV5(num_classes=2, variant="n", dtype=jnp.bfloat16)
+        x = jnp.asarray(rng.random((1, 64, 64, 3)).astype(np.float32))
+        p32 = np.asarray(model32.decode(model32.apply(variables, x, train=False)))
+        p16 = np.asarray(model16.decode(model16.apply(variables, x, train=False)))
+        assert p32.shape == p16.shape
+        # predictions are pre-sigmoid-decoded (cx, cy, w, h, obj, cls):
+        # agreement within a few percent of the value range
+        scale = np.abs(p32).max()
+        assert np.abs(p32 - p16).max() < 0.05 * scale
+
+
+class TestCLIDtype:
+    def test_detect2d_bf16_smoke(self, tmp_path, capsys):
+        from triton_client_tpu.cli.detect2d import main
+
+        main(
+            [
+                "--dtype", "bf16",
+                "-i", "synthetic:2:64x64",
+                "--input-size", "64",
+                "-c", "2",
+                "-o", str(tmp_path),
+            ]
+        )
+        assert '"frames": 2' in capsys.readouterr().out
+
+    def test_bad_dtype_rejected(self):
+        from triton_client_tpu.cli.common import parse_dtype
+
+        with pytest.raises(SystemExit):
+            parse_dtype("fp64")
+
+
+class TestRepoDtype:
+    def test_disk_entry_bf16(self, tmp_path):
+        import yaml
+
+        from triton_client_tpu.runtime.disk_repository import scan_disk
+
+        d = tmp_path / "det"
+        d.mkdir()
+        (d / "config.yaml").write_text(
+            yaml.safe_dump(
+                {
+                    "family": "yolov5",
+                    "model": {
+                        "variant": "n",
+                        "num_classes": 2,
+                        "input_hw": [64, 64],
+                        "dtype": "bf16",
+                    },
+                }
+            )
+        )
+        repo = scan_disk(tmp_path)
+        out = repo.get("det").infer_fn(
+            {"images": np.zeros((1, 64, 64, 3), np.float32)}
+        )
+        assert np.asarray(out["detections"]).dtype == np.float32
+
+    def test_disk_entry_bad_dtype(self, tmp_path):
+        import yaml
+
+        from triton_client_tpu.runtime.disk_repository import scan_disk
+
+        d = tmp_path / "det"
+        d.mkdir()
+        (d / "config.yaml").write_text(
+            yaml.safe_dump(
+                {"family": "yolov5", "model": {"dtype": "int4"}}
+            )
+        )
+        with pytest.raises(ValueError, match="unknown model dtype"):
+            scan_disk(tmp_path)

@@ -184,6 +184,36 @@ def test_pipeline_vfe_modes_agree(rng):
         bad.infer(pts)
 
 
+def test_from_points_rejects_tall_grids(tiny_model):
+    """nz > 1 would silently merge z cells in the scatter path: the
+    model method rejects it and the pipeline router falls back to the
+    grouped voxelizer."""
+    tall_voxel = VoxelConfig(
+        point_cloud_range=(0.0, -6.4, -3.0, 12.8, 6.4, 1.0),
+        voxel_size=(0.2, 0.2, 1.0),  # nz = 4
+        max_voxels=512,
+        max_points_per_voxel=8,
+    )
+    cfg = PointPillarsConfig(voxel=tall_voxel, backbone_layers=(1, 1, 1))
+    model, variables = init_pointpillars(jax.random.PRNGKey(0), cfg)
+    with pytest.raises(ValueError, match="nz == 1"):
+        model.apply(
+            variables,
+            jnp.zeros((16, 4)),
+            jnp.asarray(0),
+            train=False,
+            method=model.from_points,
+        )
+    # router: auto must NOT pick the scatter path for a tall grid
+    pipe, _, _ = build_pointpillars_pipeline(
+        model_cfg=cfg,
+        config=Detect3DConfig(point_buckets=(64,), max_det=8, pre_max=16),
+        variables=variables,
+    )
+    out = pipe.infer(np.zeros((16, 4), np.float32))  # grouped fallback works
+    assert "pred_boxes" in out
+
+
 def test_centerpoint_from_points_matches_grouped(rng):
     from triton_client_tpu.models.centerpoint import (
         CenterPointConfig,

@@ -82,6 +82,25 @@ def add_common_flags(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--streaming", action="store_true", help="flag parity")
     parser.add_argument("--prefetch", type=int, default=4)
     parser.add_argument("--warmup", type=int, default=1)
+    parser.add_argument(
+        "--dtype",
+        default="fp32",
+        choices=("fp32", "bf16"),
+        help="model compute dtype: bf16 runs the backbone on the MXU's "
+        "native precision (~15%% faster on v5e; heads/decode/NMS stay "
+        "fp32). fp32 is the default pending mAP-parity measurement "
+        "with real weights",
+    )
+
+
+def parse_dtype(name: str):
+    """--dtype string -> jnp dtype (SystemExit on bad input)."""
+    from triton_client_tpu.config import parse_compute_dtype
+
+    try:
+        return parse_compute_dtype(name)
+    except ValueError as e:
+        raise SystemExit(str(e))
 
 
 def _check_async_flags(args) -> None:

@@ -21,6 +21,7 @@ import jax
 from triton_client_tpu.cli.common import (
     _check_async_flags,
     add_common_flags,
+    parse_dtype,
     load_gt_lookup,
     load_names,
     make_profiler,
@@ -238,6 +239,7 @@ def build(args):
             num_classes=args.classes,
             input_hw=hw,
             config=cfg,
+            dtype=parse_dtype(args.dtype),
         )
     elif name == "yolov4":
         pipe, spec, _ = build_yolov4_pipeline(
@@ -246,6 +248,7 @@ def build(args):
             width=args.width,
             input_hw=hw,
             config=cfg,
+            dtype=parse_dtype(args.dtype),
         )
     elif name.partition("_")[0] in ("retinanet", "fcos"):
         from triton_client_tpu.models.retinanet import RESNET_DEPTHS
@@ -277,6 +280,7 @@ def build(args):
             depth=depth,
             input_hw=hw,
             config=cfg,
+            dtype=parse_dtype(args.dtype),
         )
     else:
         raise SystemExit(

@@ -19,6 +19,7 @@ import jax
 from triton_client_tpu.cli.common import (
     _check_async_flags,
     add_common_flags,
+    parse_dtype,
     make_profiler,
     make_sink,
     maybe_device_trace,
@@ -110,7 +111,8 @@ def main(argv=None) -> None:
     if name not in builders:
         raise SystemExit(f"unknown 3D model '{name}' (choose from {sorted(builders)})")
     pipe, spec, _ = builders[name](
-        jax.random.PRNGKey(0), model_cfg=model_cfg, config=cfg
+        jax.random.PRNGKey(0), model_cfg=model_cfg, config=cfg,
+        dtype=parse_dtype(args.dtype),
     )
     infer = detect3d_infer_async(pipe) if args.async_set else detect3d_infer(pipe)
     _run_3d(args, infer, spec.name)

@@ -46,6 +46,20 @@ _ITEMSIZE = {k: (2 if v is None else np.dtype(v).itemsize) for k, v in _DTYPES.i
 FRAMING_BYTES = 1 << 20
 
 
+def parse_compute_dtype(name: str):
+    """Model compute-dtype string ('fp32'/'bf16' + long aliases) ->
+    jnp dtype. Single source for the CLI --dtype flag and repository
+    config.yaml 'model: {dtype: ...}' entries (raises ValueError; the
+    CLI wraps it into SystemExit)."""
+    import jax.numpy as jnp
+
+    table = {"fp32": jnp.float32, "float32": jnp.float32,
+             "bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16}
+    if name not in table:
+        raise ValueError(f"unknown model dtype {name!r} (fp32|bf16)")
+    return table[name]
+
+
 def config_dtypes() -> dict:
     """The canonical KServe dtype table (BF16 maps to None — resolved
     to ml_dtypes.bfloat16 at the codec layer). Single source for spec

@@ -374,8 +374,15 @@ class PointPillars(nn.Module):
         them this path keeps ALL points and pillars (the budgets exist
         only to give the grouped wire contract a static shape). Skips
         the (N log N) point sort entirely — pillar mean and max are
-        dense-grid scatters."""
-        nx, ny, _ = self.cfg.voxel.grid_size
+        dense-grid scatters. Pillar grids only: nz > 1 would silently
+        merge z cells, so it is rejected (the pipeline router falls back
+        to the grouped path instead of calling this)."""
+        nx, ny, nz = self.cfg.voxel.grid_size
+        if nz != 1:
+            raise ValueError(
+                f"from_points is a pillar (nz == 1) path; this grid has "
+                f"nz={nz} — use the grouped voxelizer (vfe='grouped')"
+            )
         feats, vid, valid, cnt = augment_points(points, count, self.cfg.voxel)
         x = self.vfe.encode(feats, train)  # (N, C)
         canvas = scatter_max_canvas(x, vid, valid, cnt, (ny, nx))

@@ -74,7 +74,13 @@ class Detect3DPipeline:
 
     def _pipeline(self, points: jnp.ndarray, count: jnp.ndarray):
         cfg = self.config
-        use_scatter = cfg.vfe == "auto" and hasattr(self.model, "from_points")
+        # scatter VFE is pillar-grid-only (nz == 1): a taller grid's z
+        # cells would merge silently, so auto falls back to grouped
+        use_scatter = (
+            cfg.vfe == "auto"
+            and hasattr(self.model, "from_points")
+            and self.model.cfg.voxel.grid_size[2] == 1
+        )
         if cfg.vfe not in ("auto", "grouped"):
             raise ValueError(f"unknown vfe mode {cfg.vfe!r} (auto|grouped)")
         if use_scatter:

@@ -119,6 +119,10 @@ def _build_2d(family: str, doc: Mapping[str, Any], model_dir: pathlib.Path):
     model_kwargs = dict(doc.get("model", {}))
     if "input_hw" in model_kwargs:
         model_kwargs["input_hw"] = tuple(model_kwargs["input_hw"])
+    if "dtype" in model_kwargs:
+        from triton_client_tpu.config import parse_compute_dtype
+
+        model_kwargs["dtype"] = parse_compute_dtype(model_kwargs["dtype"])
 
     pipe_d = dict(doc.get("pipeline", {}))
     names_file = pipe_d.pop("class_names_file", None)
@@ -157,6 +161,10 @@ def _build_3d(family: str, doc: Mapping[str, Any], model_dir: pathlib.Path):
     from triton_client_tpu.pipelines import detect3d
 
     builders = detect3d.BUILDERS_3D
+    model_doc = dict(doc.get("model", {}))
+    from triton_client_tpu.config import parse_compute_dtype
+
+    dtype = parse_compute_dtype(model_doc.pop("dtype", "fp32"))
     if "dataset" in doc:
         got_family, model_cfg, pipe_cfg = detect3d_from_yaml(
             _resolve(doc["dataset"], model_dir)
@@ -166,7 +174,7 @@ def _build_3d(family: str, doc: Mapping[str, Any], model_dir: pathlib.Path):
                 f"config.yaml family {family!r} != dataset yaml model {got_family!r}"
             )
     else:
-        model_cfg = model_config_from_dict(family, dict(doc.get("model", {})))
+        model_cfg = model_config_from_dict(family, model_doc)
         pipe_cfg = _apply_overrides(
             detect3d.default_detect3d_config(family),
             dict(doc.get("pipeline", {})),
@@ -176,7 +184,7 @@ def _build_3d(family: str, doc: Mapping[str, Any], model_dir: pathlib.Path):
     def build(variables=None, config=pipe_cfg):
         return builders[family](
             rng=jax.random.PRNGKey(0), model_cfg=model_cfg, config=config,
-            variables=variables,
+            variables=variables, dtype=dtype,
         )
 
     return build, lambda _default: pipe_cfg
