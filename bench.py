@@ -124,14 +124,14 @@ def bench_pointpillars() -> dict:
 def main() -> None:
     primary = bench_yolov5()
     results = [primary]
-    for secondary_fn in (
-        lambda: bench_yolov5(dtype=jnp.bfloat16),
-        bench_pointpillars,
+    for label, secondary_fn in (
+        ("yolov5n_bf16", lambda: bench_yolov5(dtype=jnp.bfloat16)),
+        ("pointpillars", bench_pointpillars),
     ):
         try:
             results.append(secondary_fn())
         except Exception as e:  # secondary metrics must not break the contract
-            print(f"secondary bench failed: {e}", file=sys.stderr)
+            print(f"{label} bench failed: {e}", file=sys.stderr)
 
     try:  # best-effort: the one-line stdout contract must survive
         with open("BENCH_LOCAL.json", "w") as f:

@@ -89,6 +89,60 @@ class TestSECOND:
         assert heads["iou"].shape == (1, h, w, a)
 
     @pytest.mark.slow
+    def test_from_points_matches_grouped(self, model_and_vars, rng):
+        """SECOND's scatter mean VFE keys on the full 3D cell id, so it
+        must match the grouped path on this tall (nz = 8) grid while the
+        voxel budgets hold."""
+        from triton_client_tpu.ops.voxelize import pad_points, voxelize
+
+        model, variables = model_and_vars
+        r = TINY_SECOND.voxel.point_cloud_range
+        n = 150  # sparse cells: must stay under the 256-voxel budget
+        pts = np.empty((n, 4), np.float32)
+        pts[:, 0] = rng.uniform(r[0], r[3], n)
+        pts[:, 1] = rng.uniform(r[1], r[4], n)
+        pts[:, 2] = rng.uniform(r[2], r[5], n)
+        pts[:, 3] = rng.uniform(0, 1, n)
+        padded, m = pad_points(pts, 512)
+        pj, mj = jnp.asarray(padded), jnp.asarray(m)
+        vox = voxelize(pj, mj, TINY_SECOND.voxel)
+        assert int(vox["voxel_valid"].sum()) < TINY_SECOND.voxel.max_voxels
+        grouped = model.apply(
+            variables,
+            vox["voxels"][None],
+            vox["num_points_per_voxel"][None],
+            vox["coords"][None],
+            train=False,
+        )
+        scatter = model.apply(
+            variables, pj, mj, train=False, method=model.from_points
+        )
+        for k in grouped:
+            np.testing.assert_allclose(
+                np.asarray(grouped[k]), np.asarray(scatter[k]), atol=1e-4,
+                err_msg=f"head {k}",
+            )
+
+    def test_pipeline_routes_scatter_for_tall_grid(self):
+        """Detect3DConfig.vfe='auto' must pick the scatter path for
+        SECOND despite nz > 1 (scatter_any_nz)."""
+        from triton_client_tpu.pipelines.detect3d import (
+            Detect3DConfig,
+            build_second_pipeline,
+        )
+
+        pipe, _, _ = build_second_pipeline(
+            jax.random.PRNGKey(0),
+            model_cfg=TINY_SECOND,
+            config=Detect3DConfig(
+                model_name="second_iou", point_buckets=(512,),
+                max_det=8, pre_max=16,
+            ),
+        )
+        assert pipe.model.scatter_any_nz
+        out = pipe.infer(np.zeros((32, 4), np.float32))
+        assert "pred_boxes" in out
+
     def test_decode_rectifies_scores(self, model_and_vars):
         model, _ = model_and_vars
         cfg = TINY_SECOND

@@ -30,6 +30,7 @@ from triton_client_tpu.models.pointpillars import (
     BEVBackbone,
     PillarVFE,
     augment_points,
+    require_pillar_grid,
     scatter_max_canvas,
     scatter_to_bev,
 )
@@ -160,12 +161,8 @@ class CenterPoint(nn.Module):
     ) -> dict[str, jnp.ndarray]:
         """Sort-free scatter path (see PointPillars.from_points): same
         parameters, no (V, K) grouping, batch 1. Pillar grids only."""
-        nx, ny, nz = self.cfg.voxel.grid_size
-        if nz != 1:
-            raise ValueError(
-                f"from_points is a pillar (nz == 1) path; this grid has "
-                f"nz={nz} — use the grouped voxelizer (vfe='grouped')"
-            )
+        require_pillar_grid(self.cfg.voxel.grid_size)
+        nx, ny, _ = self.cfg.voxel.grid_size
         feats, vid, valid, cnt = augment_points(points, count, self.cfg.voxel)
         x = self.vfe.encode(feats, train)
         canvas = scatter_max_canvas(x, vid, valid, cnt, (ny, nx))

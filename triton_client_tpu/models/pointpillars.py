@@ -205,6 +205,19 @@ class PillarVFE(nn.Module):
         return jnp.where(num_points[:, None] > 0, x, 0.0)
 
 
+def require_pillar_grid(grid_size: tuple[int, int, int]) -> None:
+    """Shared nz == 1 guard for the pillar scatter paths (PointPillars
+    and CenterPoint from_points): a taller grid's z cells would merge
+    silently. The pipeline router falls back to the grouped voxelizer
+    instead of tripping this (pipelines/detect3d.py)."""
+    nz = grid_size[2]
+    if nz != 1:
+        raise ValueError(
+            f"from_points is a pillar (nz == 1) path; this grid has "
+            f"nz={nz} — use the grouped voxelizer (vfe='grouped')"
+        )
+
+
 def augment_points(
     points: jnp.ndarray,   # (N, F>=4) padded cloud [x, y, z, i, ...]
     count: jnp.ndarray,    # () real rows
@@ -374,15 +387,9 @@ class PointPillars(nn.Module):
         them this path keeps ALL points and pillars (the budgets exist
         only to give the grouped wire contract a static shape). Skips
         the (N log N) point sort entirely — pillar mean and max are
-        dense-grid scatters. Pillar grids only: nz > 1 would silently
-        merge z cells, so it is rejected (the pipeline router falls back
-        to the grouped path instead of calling this)."""
-        nx, ny, nz = self.cfg.voxel.grid_size
-        if nz != 1:
-            raise ValueError(
-                f"from_points is a pillar (nz == 1) path; this grid has "
-                f"nz={nz} — use the grouped voxelizer (vfe='grouped')"
-            )
+        dense-grid scatters. Pillar grids only (require_pillar_grid)."""
+        require_pillar_grid(self.cfg.voxel.grid_size)
+        nx, ny, _ = self.cfg.voxel.grid_size
         feats, vid, valid, cnt = augment_points(points, count, self.cfg.voxel)
         x = self.vfe.encode(feats, train)  # (N, C)
         canvas = scatter_max_canvas(x, vid, valid, cnt, (ny, nx))

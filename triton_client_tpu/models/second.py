@@ -147,12 +147,29 @@ class DenseMiddleEncoder(nn.Module):
 
 class SECONDIoU(nn.Module):
     """MeanVFE -> densify -> 3D encoder -> BEV backbone -> anchor +
-    IoU-quality heads."""
+    IoU-quality heads. ``from_points`` is the sort-free single-scan
+    path: MeanVFE is parameter-free, so the mean volume is computed
+    directly with dense-grid scatter-add (no (V, K) grouping, no point
+    sort) — works for ANY nz since the full 3D cell id is used."""
 
     cfg: SECONDConfig = SECONDConfig()
     dtype: jnp.dtype = jnp.float32
 
-    @nn.compact
+    # mean VFE keys on the full 3D cell id, so the scatter path is valid
+    # for tall (nz > 1) grids too — the pillar models' is not
+    scatter_any_nz = True
+
+    def setup(self) -> None:
+        cfg, dt = self.cfg, self.dtype
+        self.vfe = MeanVFE()
+        self.middle = DenseMiddleEncoder(cfg.middle_filters, dtype=dt)
+        self.backbone = BEVBackbone(cfg, dtype=dt)
+        a = cfg.anchors_per_loc
+        self.cls_head = nn.Conv(a * cfg.num_classes, (1, 1), dtype=jnp.float32)
+        self.box_head = nn.Conv(a * 7, (1, 1), dtype=jnp.float32)
+        self.dir_head = nn.Conv(a * cfg.num_dir_bins, (1, 1), dtype=jnp.float32)
+        self.iou_head = nn.Conv(a, (1, 1), dtype=jnp.float32)
+
     def __call__(
         self,
         voxels: jnp.ndarray,      # (B, V, K, F)
@@ -160,32 +177,47 @@ class SECONDIoU(nn.Module):
         coords: jnp.ndarray,      # (B, V, 3) [z, y, x]
         train: bool = False,
     ) -> dict[str, jnp.ndarray]:
-        cfg, dt = self.cfg, self.dtype
-        nx, ny, nz = cfg.voxel.grid_size
-
-        vfe = MeanVFE(name="vfe")
-        feats = jax.vmap(vfe)(voxels, num_points)  # (B, V, F)
+        nx, ny, nz = self.cfg.voxel.grid_size
+        feats = jax.vmap(self.vfe)(voxels, num_points)  # (B, V, F)
         volume = jax.vmap(lambda f, c: scatter_to_volume(f, c, (nz, ny, nx)))(
             feats, coords
         )  # (B, nz, ny, nx, F)
+        return self._heads(volume, train)
 
-        encoder = DenseMiddleEncoder(cfg.middle_filters, dtype=dt, name="middle")
-        bev = jax.vmap(lambda v: encoder(v, train))(volume)  # (B, h, w, C)
-        spatial = BEVBackbone(cfg, dtype=dt, name="backbone")(bev, train)
+    def from_points(
+        self,
+        points: jnp.ndarray,  # (N, F>=4) padded cloud
+        count: jnp.ndarray,   # () real rows
+        train: bool = False,
+    ) -> dict[str, jnp.ndarray]:
+        """Sort-free scatter path: per-cell mean via scatter-add (batch
+        1). Bit-exact vs the grouped path (up to fp addition order)
+        while the voxel budgets are not hit."""
+        from triton_client_tpu.ops.voxelize import assign_cells
 
+        nx, ny, nz = self.cfg.voxel.grid_size
+        ijk, valid = assign_cells(points, count, self.cfg.voxel)
+        n_cells = nz * ny * nx
+        vid = (ijk[:, 2] * ny + ijk[:, 1]) * nx + ijk[:, 0]
+        vid = jnp.where(valid, vid, n_cells)  # dump slot
+        w = valid.astype(points.dtype)[:, None]
+        f = points.shape[-1]
+        sums = jnp.zeros((n_cells + 1, f), points.dtype)
+        sums = sums.at[vid].add(points * w)
+        cnt = jnp.zeros((n_cells + 1,), points.dtype).at[vid].add(w[:, 0])
+        volume = sums[:n_cells] / jnp.maximum(cnt[:n_cells], 1.0)[:, None]
+        volume = volume.reshape(1, nz, ny, nx, f)
+        return self._heads(volume, train)
+
+    def _heads(self, volume: jnp.ndarray, train: bool) -> dict[str, jnp.ndarray]:
+        cfg = self.cfg
+        bev = jax.vmap(lambda v: self.middle(v, train))(volume)  # (B, h, w, C)
+        spatial = self.backbone(bev, train).astype(jnp.float32)
+        cls = self.cls_head(spatial)
+        box = self.box_head(spatial)
+        direction = self.dir_head(spatial)
+        iou = self.iou_head(spatial)
         a = cfg.anchors_per_loc
-        cls = nn.Conv(a * cfg.num_classes, (1, 1), dtype=jnp.float32, name="cls_head")(
-            spatial.astype(jnp.float32)
-        )
-        box = nn.Conv(a * 7, (1, 1), dtype=jnp.float32, name="box_head")(
-            spatial.astype(jnp.float32)
-        )
-        direction = nn.Conv(
-            a * cfg.num_dir_bins, (1, 1), dtype=jnp.float32, name="dir_head"
-        )(spatial.astype(jnp.float32))
-        iou = nn.Conv(a, (1, 1), dtype=jnp.float32, name="iou_head")(
-            spatial.astype(jnp.float32)
-        )
         b, h, w, _ = cls.shape
         return {
             "cls": cls.reshape(b, h, w, a, cfg.num_classes),

@@ -74,12 +74,17 @@ class Detect3DPipeline:
 
     def _pipeline(self, points: jnp.ndarray, count: jnp.ndarray):
         cfg = self.config
-        # scatter VFE is pillar-grid-only (nz == 1): a taller grid's z
-        # cells would merge silently, so auto falls back to grouped
+        # pillar scatter VFE is nz == 1 only (a taller grid's z cells
+        # would merge silently), so auto falls back to grouped there;
+        # models whose scatter path keys on the full 3D cell (SECOND's
+        # mean VFE) declare scatter_any_nz
         use_scatter = (
             cfg.vfe == "auto"
             and hasattr(self.model, "from_points")
-            and self.model.cfg.voxel.grid_size[2] == 1
+            and (
+                self.model.cfg.voxel.grid_size[2] == 1
+                or getattr(self.model, "scatter_any_nz", False)
+            )
         )
         if cfg.vfe not in ("auto", "grouped"):
             raise ValueError(f"unknown vfe mode {cfg.vfe!r} (auto|grouped)")
