@@ -121,12 +121,55 @@ def bench_pointpillars() -> dict:
     }
 
 
+def bench_second() -> dict:
+    """SECOND-IoU end-to-end (scatter mean VFE -> dense 3D middle
+    encoder -> BEV backbone -> IoU-rectified decode -> rotated NMS),
+    same methodology as the PointPillars bench."""
+    from triton_client_tpu.ops.voxelize import pad_points
+    from triton_client_tpu.pipelines.detect3d import (
+        Detect3DConfig,
+        build_second_pipeline,
+    )
+
+    cfg = Detect3DConfig(model_name="second_iou")
+    pipeline, _, _ = build_second_pipeline(jax.random.PRNGKey(0), config=cfg)
+    rng = np.random.default_rng(0)
+    n_pts = 120_000
+    pc_range = pipeline.model.cfg.voxel.point_cloud_range
+    pts = np.empty((n_pts, 4), np.float32)
+    pts[:, 0] = rng.uniform(pc_range[0], pc_range[3], n_pts)
+    pts[:, 1] = rng.uniform(pc_range[1], pc_range[4], n_pts)
+    pts[:, 2] = rng.uniform(pc_range[2], pc_range[5], n_pts)
+    pts[:, 3] = rng.uniform(0, 1, n_pts)
+    padded, m = pad_points(pts, max(cfg.point_buckets))
+    pj, mj = jnp.asarray(padded), jnp.asarray(m)
+
+    iters = max(10, ITERS // 3)
+    for _ in range(WARMUP):
+        out = pipeline._jit(pj, mj)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = pipeline._jit(pj, mj)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+
+    fps = iters / dt
+    return {
+        "metric": "second_iou_kitti_e2e_scans_per_sec_per_chip",
+        "value": round(fps, 2),
+        "unit": "scans/sec",
+        "vs_baseline": round(fps / LIDAR_HZ_BASELINE, 2),
+    }
+
+
 def main() -> None:
     primary = bench_yolov5()
     results = [primary]
     for label, secondary_fn in (
         ("yolov5n_bf16", lambda: bench_yolov5(dtype=jnp.bfloat16)),
         ("pointpillars", bench_pointpillars),
+        ("second_iou", bench_second),
     ):
         try:
             results.append(secondary_fn())

@@ -193,13 +193,11 @@ class SECONDIoU(nn.Module):
         """Sort-free scatter path: per-cell mean via scatter-add (batch
         1). Bit-exact vs the grouped path (up to fp addition order)
         while the voxel budgets are not hit."""
-        from triton_client_tpu.ops.voxelize import assign_cells
+        from triton_client_tpu.ops.voxelize import assign_cells, linearize_zyx
 
         nx, ny, nz = self.cfg.voxel.grid_size
         ijk, valid = assign_cells(points, count, self.cfg.voxel)
-        n_cells = nz * ny * nx
-        vid = (ijk[:, 2] * ny + ijk[:, 1]) * nx + ijk[:, 0]
-        vid = jnp.where(valid, vid, n_cells)  # dump slot
+        vid, n_cells = linearize_zyx(ijk, valid, self.cfg.voxel)
         w = valid.astype(points.dtype)[:, None]
         f = points.shape[-1]
         sums = jnp.zeros((n_cells + 1, f), points.dtype)

@@ -72,6 +72,19 @@ def assign_cells(
     return ijk, valid
 
 
+def linearize_zyx(
+    ijk: jnp.ndarray, valid: jnp.ndarray, config: VoxelConfig
+) -> tuple[jnp.ndarray, int]:
+    """Flatten [x, y, z] integer cells to the canonical z-major cell id
+    ((z*ny + y)*nx + x); invalid rows get the dump id n_cells. Shared by
+    the grouped voxelizer and SECOND's scatter mean VFE so the two
+    paths' linearization can never diverge. Returns (vid, n_cells)."""
+    nx, ny, nz = config.grid_size
+    n_cells = nx * ny * nz
+    vid = (ijk[:, 2] * ny + ijk[:, 1]) * nx + ijk[:, 0]
+    return jnp.where(valid, vid, n_cells), n_cells
+
+
 @functools.partial(jax.jit, static_argnames=("config",))
 def voxelize(
     points: jnp.ndarray, num_points: jnp.ndarray, config: VoxelConfig
@@ -90,9 +103,7 @@ def voxelize(
     ijk, in_range = assign_cells(points, num_points, config)
 
     # Linearized voxel id; invalid points get a sentinel that sorts last.
-    vid = (ijk[:, 2] * ny + ijk[:, 1]) * nx + ijk[:, 0]
-    sentinel = nx * ny * nz
-    vid = jnp.where(in_range, vid, sentinel)
+    vid, sentinel = linearize_zyx(ijk, in_range, config)
 
     # Sort points by voxel id (stable, static shape).
     order = jnp.argsort(vid)

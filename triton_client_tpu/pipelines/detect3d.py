@@ -53,10 +53,12 @@ class Detect3DConfig:
     z_offset: float = 0.0
     class_names: tuple[str, ...] = ("Car", "Pedestrian", "Cyclist")
     # VFE routing: "auto" uses the model's sort-free from_points path
-    # when it has one (pillar models), "grouped" forces the (V, K)
-    # voxelizer contract (exact OpenPCDet budget semantics — caps at
-    # max_voxels/max_points_per_voxel; the scatter path keeps all
-    # points, which can only add information).
+    # when it has one — pillar models on nz == 1 grids, plus models
+    # that declare scatter_any_nz (SECOND's mean VFE keys on the full
+    # 3D cell, so tall grids route scatter too). "grouped" forces the
+    # (V, K) voxelizer contract (exact OpenPCDet budget semantics —
+    # caps at max_voxels/max_points_per_voxel; the scatter path keeps
+    # all points, which can only add information).
     vfe: str = "auto"
 
 
