@@ -72,34 +72,25 @@ def bench_yolov5(dtype=None) -> dict:
     }
 
 
-def bench_pointpillars() -> dict:
-    """Full 3D path: voxelize -> PillarVFE -> scatter -> BEV CNN ->
-    anchor head -> top-k decode -> rotated NMS, KITTI grid
-    (data/kitti_pointpillars.yaml).
-
-    Same methodology as the 2D bench: the padded scan is staged on
-    device once and the fused jit is timed back-to-back (host-side
-    bucketing/padding is ~0.4 ms/scan, measured separately; over the
-    remote-chip tunnel used in CI, per-call host->device transfers would
-    otherwise dominate and measure the tunnel, not the chip)."""
-    from triton_client_tpu.dataset_config import detect3d_from_yaml
+def _bench_3d_pipeline(pipeline, point_buckets, metric: str) -> dict:
+    """Shared 3D-bench methodology (both lidar models): a ~KITTI-sized
+    synthetic scan is padded and staged on device once, then the fused
+    jit (voxel/scatter VFE -> CNN -> top-k decode -> rotated NMS) is
+    timed back-to-back. Host-side bucketing/padding is ~0.4 ms/scan,
+    measured separately; over the remote-chip tunnel used in CI,
+    per-call host->device transfers would otherwise dominate and
+    measure the tunnel, not the chip."""
     from triton_client_tpu.ops.voxelize import pad_points
-    from triton_client_tpu.pipelines.detect3d import build_pointpillars_pipeline
-
-    _, model_cfg, pipe_cfg = detect3d_from_yaml("data/kitti_pointpillars.yaml")
-    pipeline, _, _ = build_pointpillars_pipeline(
-        jax.random.PRNGKey(0), model_cfg=model_cfg, config=pipe_cfg
-    )
 
     rng = np.random.default_rng(0)
     n_pts = 120_000  # ~KITTI velodyne scan
-    pc_range = model_cfg.voxel.point_cloud_range
+    pc_range = pipeline.model.cfg.voxel.point_cloud_range
     pts = np.empty((n_pts, 4), np.float32)
     pts[:, 0] = rng.uniform(pc_range[0], pc_range[3], n_pts)
     pts[:, 1] = rng.uniform(pc_range[1], pc_range[4], n_pts)
     pts[:, 2] = rng.uniform(pc_range[2], pc_range[5], n_pts)
     pts[:, 3] = rng.uniform(0, 1, n_pts)
-    padded, m = pad_points(pts, max(pipe_cfg.point_buckets))
+    padded, m = pad_points(pts, max(point_buckets))
     pj, mj = jnp.asarray(padded), jnp.asarray(m)
 
     iters = max(10, ITERS // 3)
@@ -114,18 +105,32 @@ def bench_pointpillars() -> dict:
 
     fps = iters / dt
     return {
-        "metric": "pointpillars_kitti_e2e_scans_per_sec_per_chip",
+        "metric": metric,
         "value": round(fps, 2),
         "unit": "scans/sec",
         "vs_baseline": round(fps / LIDAR_HZ_BASELINE, 2),
     }
 
 
+def bench_pointpillars() -> dict:
+    """PointPillars end-to-end, KITTI grid (data/kitti_pointpillars.yaml)."""
+    from triton_client_tpu.dataset_config import detect3d_from_yaml
+    from triton_client_tpu.pipelines.detect3d import build_pointpillars_pipeline
+
+    _, model_cfg, pipe_cfg = detect3d_from_yaml("data/kitti_pointpillars.yaml")
+    pipeline, _, _ = build_pointpillars_pipeline(
+        jax.random.PRNGKey(0), model_cfg=model_cfg, config=pipe_cfg
+    )
+    return _bench_3d_pipeline(
+        pipeline,
+        pipe_cfg.point_buckets,
+        "pointpillars_kitti_e2e_scans_per_sec_per_chip",
+    )
+
+
 def bench_second() -> dict:
     """SECOND-IoU end-to-end (scatter mean VFE -> dense 3D middle
-    encoder -> BEV backbone -> IoU-rectified decode -> rotated NMS),
-    same methodology as the PointPillars bench."""
-    from triton_client_tpu.ops.voxelize import pad_points
+    encoder -> BEV backbone -> IoU-rectified decode -> rotated NMS)."""
     from triton_client_tpu.pipelines.detect3d import (
         Detect3DConfig,
         build_second_pipeline,
@@ -133,34 +138,11 @@ def bench_second() -> dict:
 
     cfg = Detect3DConfig(model_name="second_iou")
     pipeline, _, _ = build_second_pipeline(jax.random.PRNGKey(0), config=cfg)
-    rng = np.random.default_rng(0)
-    n_pts = 120_000
-    pc_range = pipeline.model.cfg.voxel.point_cloud_range
-    pts = np.empty((n_pts, 4), np.float32)
-    pts[:, 0] = rng.uniform(pc_range[0], pc_range[3], n_pts)
-    pts[:, 1] = rng.uniform(pc_range[1], pc_range[4], n_pts)
-    pts[:, 2] = rng.uniform(pc_range[2], pc_range[5], n_pts)
-    pts[:, 3] = rng.uniform(0, 1, n_pts)
-    padded, m = pad_points(pts, max(cfg.point_buckets))
-    pj, mj = jnp.asarray(padded), jnp.asarray(m)
-
-    iters = max(10, ITERS // 3)
-    for _ in range(WARMUP):
-        out = pipeline._jit(pj, mj)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = pipeline._jit(pj, mj)
-    jax.block_until_ready(out)
-    dt = time.perf_counter() - t0
-
-    fps = iters / dt
-    return {
-        "metric": "second_iou_kitti_e2e_scans_per_sec_per_chip",
-        "value": round(fps, 2),
-        "unit": "scans/sec",
-        "vs_baseline": round(fps / LIDAR_HZ_BASELINE, 2),
-    }
+    return _bench_3d_pipeline(
+        pipeline,
+        cfg.point_buckets,
+        "second_iou_kitti_e2e_scans_per_sec_per_chip",
+    )
 
 
 def main() -> None:

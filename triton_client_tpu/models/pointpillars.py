@@ -244,9 +244,13 @@ def augment_points(
     dump = nx * ny
     vid = jnp.where(valid, ijk[:, 1] * nx + ijk[:, 0], dump)
     w = valid.astype(points.dtype)[:, None]
-    sums = jnp.zeros((dump + 1, 3), points.dtype).at[vid].add(xyz * w)
-    cnt = jnp.zeros((dump + 1,), points.dtype).at[vid].add(w[:, 0])
-    mean = sums[vid] / jnp.maximum(cnt[vid], 1.0)[:, None]
+    # one fused scatter-add for xyz sums AND the count (column 3 is the
+    # per-point weight), halving the scatter passes over the grid
+    acc = jnp.zeros((dump + 1, 4), points.dtype)
+    acc = acc.at[vid].add(jnp.concatenate([xyz, jnp.ones_like(w)], axis=1) * w)
+    per_point = acc[vid]  # (N, 4) gather once
+    mean = per_point[:, :3] / jnp.maximum(per_point[:, 3:], 1.0)
+    cnt = acc[:, 3]
     centers = (ijk.astype(jnp.float32) + 0.5) * vs + r[:3]
     feats = jnp.concatenate([points[:, :4], xyz - mean, xyz - centers], axis=1)
     return jnp.where(valid[:, None], feats, 0.0), vid, valid, cnt

@@ -200,10 +200,14 @@ class SECONDIoU(nn.Module):
         vid, n_cells = linearize_zyx(ijk, valid, self.cfg.voxel)
         w = valid.astype(points.dtype)[:, None]
         f = points.shape[-1]
-        sums = jnp.zeros((n_cells + 1, f), points.dtype)
-        sums = sums.at[vid].add(points * w)
-        cnt = jnp.zeros((n_cells + 1,), points.dtype).at[vid].add(w[:, 0])
-        volume = sums[:n_cells] / jnp.maximum(cnt[:n_cells], 1.0)[:, None]
+        # one fused scatter-add for feature sums AND counts (last
+        # column is the per-point weight) — a 131k-row TPU scatter
+        # costs ~5 ms, so halving the passes is directly measurable
+        acc = jnp.zeros((n_cells + 1, f + 1), points.dtype)
+        acc = acc.at[vid].add(
+            jnp.concatenate([points, jnp.ones_like(w)], axis=1) * w
+        )
+        volume = acc[:n_cells, :f] / jnp.maximum(acc[:n_cells, f:], 1.0)
         volume = volume.reshape(1, nz, ny, nx, f)
         return self._heads(volume, train)
 
