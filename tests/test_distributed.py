@@ -1,0 +1,148 @@
+"""Multi-host runtime (parallel/distributed.py).
+
+Real multi-process clusters can't run inside one pytest process; these
+tests cover what can be validated single-process: spec parsing, the
+host-major device ordering, the global-mesh axis-placement policy, the
+process-local batch feed (single-process path of
+make_array_from_process_local_data), and the train CLI wiring.
+"""
+
+import numpy as np
+import pytest
+
+from triton_client_tpu.parallel.distributed import (
+    DistributedConfig,
+    global_mesh,
+    host_major_devices,
+    init_distributed,
+    is_coordinator,
+    shard_host_batch,
+)
+from triton_client_tpu.parallel.mesh import MeshConfig
+
+
+class TestConfigParsing:
+    def test_explicit_spec(self):
+        cfg = DistributedConfig.from_spec("host0:9876,4,2")
+        assert cfg == DistributedConfig("host0:9876", 4, 2)
+
+    def test_env_spec(self, monkeypatch):
+        monkeypatch.setenv("COORDINATOR", "c:1")
+        monkeypatch.setenv("NPROC", "8")
+        monkeypatch.setenv("PROC_ID", "3")
+        cfg = DistributedConfig.from_spec("env")
+        assert cfg == DistributedConfig("c:1", 8, 3)
+
+    def test_env_alias(self, monkeypatch):
+        monkeypatch.delenv("COORDINATOR", raising=False)
+        monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "c:2")
+        monkeypatch.setenv("NPROC", "2")
+        monkeypatch.setenv("PROC_ID", "0")
+        assert DistributedConfig.from_spec("env").coordinator == "c:2"
+
+    def test_env_missing(self, monkeypatch):
+        for k in ("COORDINATOR", "JAX_COORDINATOR_ADDRESS"):
+            monkeypatch.delenv(k, raising=False)
+        with pytest.raises(ValueError, match="COORDINATOR"):
+            DistributedConfig.from_spec("env")
+
+    def test_bad_spec(self):
+        with pytest.raises(ValueError, match="host:port"):
+            DistributedConfig.from_spec("host0:9876,4")
+
+    def test_bad_process_id(self):
+        with pytest.raises(ValueError, match="outside"):
+            DistributedConfig.from_spec("c:1,4,4")
+
+
+class _FakeDevice:
+    def __init__(self, process_index, dev_id):
+        self.process_index = process_index
+        self.id = dev_id
+
+    def __repr__(self):
+        return f"dev(p{self.process_index}, {self.id})"
+
+
+class TestHostMajorOrdering:
+    def test_sorts_by_process_then_id(self):
+        devs = [
+            _FakeDevice(1, 5), _FakeDevice(0, 2),
+            _FakeDevice(1, 4), _FakeDevice(0, 3),
+        ]
+        ordered = host_major_devices(devs)
+        assert [(d.process_index, d.id) for d in ordered] == [
+            (0, 2), (0, 3), (1, 4), (1, 5),
+        ]
+
+
+class TestSingleProcessPaths:
+    def test_init_noop_single_process(self):
+        # num_processes=1 must not try to dial a coordinator
+        init_distributed(DistributedConfig("nowhere:1", 1, 0))
+
+    def test_is_coordinator_single_process(self):
+        assert is_coordinator()
+
+    def test_global_mesh_axes(self):
+        mesh = global_mesh(MeshConfig(data=4, model=2))
+        assert mesh.shape["data"] == 4
+        assert mesh.shape["model"] == 2
+
+    def test_shard_host_batch_roundtrip(self):
+        mesh = global_mesh(MeshConfig(data=8))
+        local = np.arange(8 * 3, dtype=np.float32).reshape(8, 3)
+        arr = shard_host_batch(local, mesh)
+        assert arr.shape == (8, 3)
+        np.testing.assert_array_equal(np.asarray(arr), local)
+        # sharded over the data axis
+        assert len(arr.sharding.device_set) == 8
+
+
+class TestMultiHostGuards:
+    def test_explicit_mesh_must_cover_all_devices(self, monkeypatch):
+        # under >1 processes, a device-prefix mesh would strand hosts —
+        # global_mesh must refuse rather than truncate
+        import triton_client_tpu.parallel.distributed as dist
+
+        monkeypatch.setattr(dist.jax, "process_count", lambda: 2)
+        with pytest.raises(ValueError, match="all 8 global devices"):
+            global_mesh(MeshConfig(data=4))
+
+    def test_init_does_not_touch_backend_before_initialize(self):
+        # the idempotency probe must not call process_count()/devices()
+        # (they'd initialize XLA and make jax.distributed.initialize
+        # unusable); _client_already_up is the only allowed probe
+        import inspect
+
+        import triton_client_tpu.parallel.distributed as dist
+
+        src = inspect.getsource(dist.init_distributed)
+        assert "process_count()" not in src.split("jax.distributed.initialize")[0]
+
+
+class TestTrainCLIWiring:
+    def test_bad_distributed_spec_exits(self):
+        from triton_client_tpu.cli.train import main
+
+        with pytest.raises(SystemExit, match="host:port"):
+            main(["--distributed", "nope", "--steps", "1"])
+
+    def test_single_process_distributed_env(self, monkeypatch, tmp_path, capsys):
+        # 'env' spec with NPROC=1: init is a no-op, training runs
+        monkeypatch.setenv("COORDINATOR", "localhost:1")
+        monkeypatch.setenv("NPROC", "1")
+        monkeypatch.setenv("PROC_ID", "0")
+        from triton_client_tpu.cli.train import main
+
+        main(
+            [
+                "--distributed", "env",
+                "-i", "synthetic:8",
+                "--steps", "2",
+                "-b", "8",
+                "--input-size", "64",
+                "--log-every", "1",
+            ]
+        )
+        assert "step 2/2" in capsys.readouterr().out

@@ -34,6 +34,14 @@ def parse_args(argv=None):
     p.add_argument("--lr", type=float, default=1e-3)
     p.add_argument("--max-boxes", type=int, default=32,
                    help="targets padded per image (static shapes)")
+    p.add_argument(
+        "--distributed", default="",
+        help="join a multi-host jax.distributed cluster before building "
+        "the mesh: 'env' (COORDINATOR/NPROC/PROC_ID env vars) or "
+        "'<host:port>,<num_processes>,<process_id>'. Run the same "
+        "command on every host; the mesh then spans all hosts' chips "
+        "(data axis over DCN, model/seq/pipe on intra-host ICI)",
+    )
     p.add_argument("--mesh", default="",
                    help="e.g. 'data=8' or 'data=4,model=2'")
     p.add_argument("--checkpoint-dir", default="",
@@ -140,7 +148,23 @@ def main(argv=None) -> None:
                 f"--resume: no checkpoint found under {args.checkpoint_dir!r}"
             )
 
-    mesh = make_mesh(parse_mesh(args.mesh))
+    if args.distributed:
+        from triton_client_tpu.parallel.distributed import (
+            DistributedConfig,
+            global_mesh,
+            init_distributed,
+            is_coordinator,
+        )
+
+        try:
+            init_distributed(DistributedConfig.from_spec(args.distributed))
+        except ValueError as e:
+            raise SystemExit(str(e))
+        mesh = global_mesh(parse_mesh(args.mesh))
+        singleton = is_coordinator()
+    else:
+        mesh = make_mesh(parse_mesh(args.mesh))
+        singleton = True
     if args.batch_size % mesh.shape["data"]:
         raise SystemExit(
             f"--batch-size {args.batch_size} must divide over the data "
@@ -184,19 +208,48 @@ def main(argv=None) -> None:
     rng = np.random.default_rng(0)
     batches = _load_batches(args, rng)
 
+    if args.distributed and jax.process_count() > 1:
+        # multi-host feed: --batch-size is the GLOBAL batch; every host
+        # contributes ITS process_index-th block of rows and the slices
+        # assemble into one global jax.Array (no cross-host gathering).
+        # With a shared -i source this keeps all global rows distinct;
+        # pointing each host at its own cameras/bags works the same way.
+        from triton_client_tpu.parallel.distributed import shard_host_batch
+
+        if args.batch_size % jax.process_count():
+            raise SystemExit(
+                f"--batch-size {args.batch_size} must divide across "
+                f"{jax.process_count()} processes"
+            )
+        per_host = args.batch_size // jax.process_count()
+        row0 = jax.process_index() * per_host
+
+        def feed(arr):
+            return shard_host_batch(
+                np.asarray(arr)[row0 : row0 + per_host], mesh
+            )
+    else:
+        feed = jnp.asarray
+
+    # checkpoint/log/export are coordinator-only under jax.distributed:
+    # DP training replicates params so process 0 holds the full state
+    # (model/seq-sharded multi-host checkpointing would need orbax's
+    # multihost path — out of scope for the DP train CLI)
     start = int(state.step)
     for step in range(start, args.steps):
         images, targets = next(batches)
-        state, metrics = step_fn(state, jnp.asarray(images), jnp.asarray(targets))
-        if (step + 1) % args.log_every == 0 or step + 1 == args.steps:
+        state, metrics = step_fn(state, feed(images), feed(targets))
+        if singleton and ((step + 1) % args.log_every == 0 or step + 1 == args.steps):
             m = {k: round(float(v), 4) for k, v in metrics.items()}
             print(f"step {step + 1}/{args.steps} {m}")
-        if manager is not None and (step + 1) % args.save_every == 0:
+        if manager is not None and singleton and (step + 1) % args.save_every == 0:
             manager.save(step + 1, state)
-    if manager is not None and int(state.step) > start:
+    if manager is not None and singleton and int(state.step) > start:
         manager.save(int(state.step), state)
         manager.close()
 
+    if not singleton:
+        return
     if args.export:
         from triton_client_tpu.runtime.disk_repository import export_model
 

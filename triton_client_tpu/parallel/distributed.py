@@ -1,0 +1,205 @@
+"""Multi-host runtime: jax.distributed + host-aware mesh + data feeds.
+
+The reference's only cross-machine transport is one blocking gRPC call
+per frame (communicator/channel/grpc_channel.py:73-78, SURVEY.md §2.10:
+"no NCCL/MPI/Gloo/UCX"). This module is the TPU-native distributed
+backend that replaces that role at scale: processes join a
+`jax.distributed` cluster (the coordination layer NCCL/MPI provide
+elsewhere), computation is expressed once over a GLOBAL mesh spanning
+every host's chips, and XLA inserts the collectives — riding ICI
+within a slice and DCN between hosts.
+
+Layout policy (the scaling-book recipe): the mesh's device array is
+built host-major, and `MeshConfig.resolve` factors axes as
+(data, model, seq, pipe) with `data` leading — so whenever
+model*seq*pipe <= chips-per-host, those axes land INSIDE a host (ICI)
+and only data-parallel gradient/batch traffic crosses DCN. A config
+whose model axis would straddle hosts is accepted but warned, since
+tensor-parallel collectives over DCN are the classic silent 10x.
+
+Launch (one command per host — the reference's docker-compose role):
+
+    COORDINATOR=<host0>:9876 NPROC=4 PROC_ID=<i> \
+        python -m triton_client_tpu train --distributed env ...
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+
+import jax
+import numpy as np
+
+from triton_client_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MeshConfig,
+    Mesh,
+)
+
+log = logging.getLogger(__name__)
+
+_ENV_COORD = ("COORDINATOR", "JAX_COORDINATOR_ADDRESS")
+_ENV_NPROC = ("NPROC", "JAX_NUM_PROCESSES")
+_ENV_PROC = ("PROC_ID", "JAX_PROCESS_ID")
+
+
+@dataclasses.dataclass(frozen=True)
+class DistributedConfig:
+    """Cluster coordinates. ``from_spec`` parses the CLI form:
+    'env' (read COORDINATOR/NPROC/PROC_ID) or
+    '<host:port>,<num_processes>,<process_id>'."""
+
+    coordinator: str
+    num_processes: int
+    process_id: int
+
+    @classmethod
+    def from_spec(cls, spec: str) -> "DistributedConfig":
+        if spec == "env":
+            vals = []
+            for names in (_ENV_COORD, _ENV_NPROC, _ENV_PROC):
+                for name in names:
+                    if name in os.environ:
+                        vals.append(os.environ[name])
+                        break
+                else:
+                    raise ValueError(
+                        f"--distributed env: set one of {names} "
+                        "(coordinator host:port, process count, process id)"
+                    )
+            coordinator, nproc, pid = vals
+        else:
+            parts = spec.split(",")
+            if len(parts) != 3:
+                raise ValueError(
+                    "--distributed takes 'env' or "
+                    "'<host:port>,<num_processes>,<process_id>', got "
+                    f"{spec!r}"
+                )
+            coordinator, nproc, pid = parts
+        cfg = cls(coordinator, int(nproc), int(pid))
+        if not (0 <= cfg.process_id < cfg.num_processes):
+            raise ValueError(
+                f"process_id {cfg.process_id} outside "
+                f"[0, {cfg.num_processes})"
+            )
+        return cfg
+
+
+_initialized = False
+
+
+def _client_already_up() -> bool:
+    """Whether some caller already ran jax.distributed.initialize.
+    Deliberately avoids jax.process_count()/jax.devices() here: those
+    lazily initialize the XLA backend, and initialize() REFUSES to run
+    after backend init — probing with them would break every real
+    multi-host launch."""
+    try:
+        from jax._src import distributed as _jdist
+
+        return _jdist.global_state.client is not None
+    except Exception:  # private API moved: assume not initialized
+        return False
+
+
+def init_distributed(config: DistributedConfig) -> None:
+    """Join the cluster (idempotent). After this, jax.devices() is the
+    GLOBAL device list across every process and pjit/collectives span
+    hosts — the single runtime switch between one machine and a pod.
+
+    Must run before anything touches the XLA backend (jax.devices(),
+    any jit call): jax.distributed.initialize raises otherwise."""
+    global _initialized
+    if config.num_processes <= 1:
+        return
+    if _initialized or _client_already_up():
+        _initialized = True
+        return
+    jax.distributed.initialize(
+        coordinator_address=config.coordinator,
+        num_processes=config.num_processes,
+        process_id=config.process_id,
+    )
+    _initialized = True
+    log.info(
+        "joined cluster: process %d/%d, %d local / %d global devices",
+        config.process_id, config.num_processes,
+        jax.local_device_count(), jax.device_count(),
+    )
+
+
+def is_coordinator() -> bool:
+    """True on the process that should do singleton work (checkpoint
+    writes, metric export, repository scans that print)."""
+    return jax.process_index() == 0
+
+
+def host_major_devices(devices=None) -> list:
+    """Global devices ordered host-major (all of process 0's chips,
+    then process 1's, ...). Feeding this to make_mesh puts trailing
+    mesh axes (model/seq/pipe) on intra-host ICI whenever they fit."""
+    devices = list(devices if devices is not None else jax.devices())
+    return sorted(devices, key=lambda d: (d.process_index, d.id))
+
+
+def global_mesh(config: MeshConfig | None = None) -> Mesh:
+    """Host-aware mesh over ALL processes' devices (host-major, data
+    axis leading => data parallelism crosses DCN, everything else stays
+    on ICI when it fits in one host). Warns when a non-data axis
+    straddles hosts."""
+    from triton_client_tpu.parallel.mesh import make_mesh
+
+    devices = host_major_devices()
+    multi_host = jax.process_count() > 1
+    if multi_host and config is not None and config.data > 0:
+        want = (
+            config.data
+            * max(1, config.model) * max(1, config.seq) * max(1, config.pipe)
+        )
+        if want != len(devices):
+            # make_mesh's single-host convenience (claim a device
+            # prefix) would silently drop whole HOSTS here, stranding
+            # their processes outside the mesh (hangs/errors at the
+            # first collective) — refuse instead.
+            raise ValueError(
+                f"multi-host mesh must use all {len(devices)} global "
+                f"devices; config {config} names {want} — drop data= to "
+                "auto-fill, or resize the cluster"
+            )
+    mesh = make_mesh(config, devices)
+    per_host = max(
+        1,
+        len([d for d in devices if d.process_index == devices[0].process_index]),
+    )
+    trailing = int(np.prod([mesh.shape[a] for a in mesh.axis_names if a != DATA_AXIS]))
+    if multi_host and per_host % trailing != 0:
+        # covers both trailing > per_host and non-dividing trailing —
+        # either way some model/seq/pipe group straddles a host boundary
+        log.warning(
+            "mesh axes %s (trailing %d) do not pack into the %d devices "
+            "per host: tensor/seq/pipe collectives will cross DCN "
+            "(slow); keep model*seq*pipe a divisor of %d and scale data "
+            "across hosts",
+            dict(mesh.shape), trailing, per_host, per_host,
+        )
+    return mesh
+
+
+def shard_host_batch(global_batch, mesh: Mesh, spec=None):
+    """Per-host input feed: each process holds ITS slice of the global
+    batch (the reference streams every frame through one client
+    process; here every host reads its own cameras/bags) and the pieces
+    assemble into one global jax.Array without any host gathering.
+
+    ``global_batch``: this process's local shard, a numpy array whose
+    leading dim is global_batch_size / process_count.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    sharding = NamedSharding(mesh, spec or PartitionSpec(DATA_AXIS))
+    return jax.make_array_from_process_local_data(
+        sharding, np.asarray(global_batch)
+    )
