@@ -118,7 +118,9 @@ class TestMultiHostGuards:
         import triton_client_tpu.parallel.distributed as dist
 
         src = inspect.getsource(dist.init_distributed)
-        assert "process_count()" not in src.split("jax.distributed.initialize")[0]
+        # anchor on the CALL (with paren) so the docstring's mention of
+        # initialize doesn't truncate the checked prefix
+        assert "process_count()" not in src.split("jax.distributed.initialize(")[0]
 
 
 class TestTrainCLIWiring:

@@ -214,6 +214,26 @@ def test_from_points_rejects_tall_grids(tiny_model):
     assert "pred_boxes" in out
 
 
+def test_detect3d_cli_vfe_flag(tmp_path, capsys):
+    from triton_client_tpu.cli.detect3d import main
+
+    main(
+        [
+            "--vfe", "grouped",
+            "-i", "synthetic:2",
+            "--limit", "2",
+            "--sink", "jsonl",
+            "-o", str(tmp_path),
+        ]
+    )
+    assert '"frames": 2' in capsys.readouterr().out
+    # remote mode rejects client-side --vfe
+    import pytest as _pytest
+
+    with _pytest.raises(SystemExit, match="server-side"):
+        main(["-u", "grpc:localhost:1", "-m", "pp", "--vfe", "grouped"])
+
+
 def test_centerpoint_from_points_matches_grouped(rng):
     from triton_client_tpu.models.centerpoint import (
         CenterPointConfig,

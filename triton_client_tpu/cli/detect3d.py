@@ -46,6 +46,14 @@ def parse_args(argv=None) -> argparse.Namespace:
         help="dataset/model YAML (data/kitti_pointpillars.yaml etc.; the "
         "reference's data/pointpillar.yaml role) — overrides -m",
     )
+    parser.add_argument(
+        "--vfe",
+        default=None,
+        choices=("auto", "grouped"),
+        help="voxel-feature path: 'auto' (sort-free scatter VFE when the "
+        "model supports it — the fast path) or 'grouped' (exact OpenPCDet "
+        "(V, K) budget semantics: caps at max_voxels/max_points_per_voxel)",
+    )
     return parser.parse_args(argv)
 
 
@@ -74,13 +82,13 @@ def main(argv=None) -> None:
     if args.channel.startswith("grpc:"):
         if not args.model_name:
             raise SystemExit("--channel grpc:... requires -m/--model-name")
-        if args.config or args.score is not None:
+        if args.config or args.score is not None or args.vfe is not None:
             # Thresholds/model config are baked into the SERVER's jitted
             # pipeline (the repo entry's config.yaml) — silently
             # accepting them here would mislead.
             raise SystemExit(
-                "--config/--score are server-side in remote mode: set them "
-                "in the model repository entry's config.yaml"
+                "--config/--score/--vfe are server-side in remote mode: set "
+                "them in the model repository entry's config.yaml"
             )
         from triton_client_tpu.channel.grpc_channel import GRPCChannel
 
@@ -108,6 +116,8 @@ def main(argv=None) -> None:
         cfg = dataclasses.replace(cfg, score_thresh=args.score)
     if args.z_offset is not None:
         cfg = dataclasses.replace(cfg, z_offset=args.z_offset)
+    if args.vfe is not None:
+        cfg = dataclasses.replace(cfg, vfe=args.vfe)
     if name not in builders:
         raise SystemExit(f"unknown 3D model '{name}' (choose from {sorted(builders)})")
     pipe, spec, _ = builders[name](

@@ -56,9 +56,12 @@ def parse_args(argv=None):
     return p.parse_args(argv)
 
 
-def _load_batches(args, rng: np.random.Generator):
-    """Yield (images (B, S, S, 3) f32, targets (B, T, 5) [cls, cx, cy,
-    w, h] pixels) forever, cycling the source."""
+def _load_batches(args, rng: np.random.Generator, row0: int = 0, rows: int | None = None):
+    """Yield (images (rows, S, S, 3) f32, targets (rows, T, 5) [cls,
+    cx, cy, w, h] pixels) forever, cycling the source. ``row0``/``rows``
+    window the GLOBAL batch for multi-host runs: the stream advances by
+    the full batch_size each step, but only this host's rows are
+    decoded/resized — no redundant preprocessing of other hosts' data."""
     from triton_client_tpu.cli.common import load_gt_lookup
     from triton_client_tpu.io.sources import open_source
 
@@ -112,8 +115,10 @@ def _load_batches(args, rng: np.random.Generator):
         return img, targets
 
     stream = frame_stream()
+    rows = args.batch_size if rows is None else rows
     while True:
-        examples = [to_example(f) for f in itertools.islice(stream, args.batch_size)]
+        frames = list(itertools.islice(stream, args.batch_size))
+        examples = [to_example(f) for f in frames[row0 : row0 + rows]]
         yield (
             np.stack([e[0] for e in examples]),
             np.stack([e[1] for e in examples]),
@@ -170,6 +175,11 @@ def main(argv=None) -> None:
             f"--batch-size {args.batch_size} must divide over the data "
             f"axis ({mesh.shape['data']})"
         )
+    if args.distributed and args.batch_size % jax.process_count():
+        raise SystemExit(
+            f"--batch-size {args.batch_size} must divide across "
+            f"{jax.process_count()} processes"
+        )
 
     model, variables = init_yolov5(
         jax.random.PRNGKey(0),
@@ -206,29 +216,25 @@ def main(argv=None) -> None:
 
     step_fn = make_train_step(model, optimizer, loss_cfg, mesh)
     rng = np.random.default_rng(0)
-    batches = _load_batches(args, rng)
 
     if args.distributed and jax.process_count() > 1:
         # multi-host feed: --batch-size is the GLOBAL batch; every host
-        # contributes ITS process_index-th block of rows and the slices
-        # assemble into one global jax.Array (no cross-host gathering).
-        # With a shared -i source this keeps all global rows distinct;
-        # pointing each host at its own cameras/bags works the same way.
+        # decodes only ITS process_index-th block of rows (the loader
+        # windows the shared stream, so global rows stay distinct) and
+        # the blocks assemble into one global jax.Array — no cross-host
+        # gathering. Pointing each host at its own cameras/bags works
+        # the same way.
         from triton_client_tpu.parallel.distributed import shard_host_batch
 
-        if args.batch_size % jax.process_count():
-            raise SystemExit(
-                f"--batch-size {args.batch_size} must divide across "
-                f"{jax.process_count()} processes"
-            )
         per_host = args.batch_size // jax.process_count()
-        row0 = jax.process_index() * per_host
+        batches = _load_batches(
+            args, rng, row0=jax.process_index() * per_host, rows=per_host
+        )
 
         def feed(arr):
-            return shard_host_batch(
-                np.asarray(arr)[row0 : row0 + per_host], mesh
-            )
+            return shard_host_batch(arr, mesh)
     else:
+        batches = _load_batches(args, rng)
         feed = jnp.asarray
 
     # checkpoint/log/export are coordinator-only under jax.distributed:
