@@ -84,14 +84,16 @@ class TestTPUChannelAsync:
         np.testing.assert_allclose(resp.outputs["y"], x + 1.0, rtol=1e-6)
         assert resp.request_id == "9"
 
-    def test_validation_errors_raise_at_issue(self):
-        # bad requests fail fast (at dispatch), not at result() —
-        # matching do_inference's contract
+    def test_validation_errors_surface_at_result(self):
+        # bad requests do NOT raise at dispatch: per the BaseChannel
+        # async contract every error surfaces at result(), so async
+        # callers have exactly one error-handling point
         channel = TPUChannel(_repo())
+        fut = channel.do_inference_async(
+            InferRequest(model_name="addone", inputs={})
+        )
         with pytest.raises(ValueError, match="requires input"):
-            channel.do_inference_async(
-                InferRequest(model_name="addone", inputs={})
-            )
+            fut.result()
 
     def test_base_channel_fallback(self):
         # a channel that doesn't override do_inference_async still works

@@ -80,3 +80,64 @@ def test_train_cli_rejects_indivisible_batch():
 
     with pytest.raises(SystemExit, match="divide"):
         main(["-b", "3", "--mesh", "data=2", "--steps", "1"])
+
+
+def _loader_args(frames_dir):
+    import types
+
+    return types.SimpleNamespace(
+        input=str(frames_dir), input_size=64, batch_size=4,
+        max_boxes=4, classes=2, gt="",
+    )
+
+
+def _write_frames(frames_dir, n=8):
+    cv2 = pytest.importorskip("cv2")
+
+    frames_dir.mkdir(exist_ok=True)
+    for i in range(n):
+        cv2.imwrite(
+            str(frames_dir / f"{i:02d}.png"),
+            np.full((64, 64, 3), i * 30, np.uint8),
+        )
+
+
+def _vals(images):
+    # loader normalizes to [0,1]; recover the written frame index marker
+    return [int(round(float(im[0, 0, 0]) * 255)) for im in images]
+
+
+def test_load_batches_shared_source_windows_global_batch(tmp_path):
+    """Multi-host shared source: host p decodes rows [p*per_host,
+    (p+1)*per_host) of a stream that advances by the GLOBAL batch, so
+    hosts see disjoint frames and no frame is decoded twice."""
+    from triton_client_tpu.cli.train import _load_batches
+
+    _write_frames(tmp_path / "frames")
+    args = _loader_args(tmp_path / "frames")
+    # host 1 of 2: per_host=2, row0=2
+    batches = _load_batches(args, np.random.default_rng(0), row0=2, rows=2)
+    first, _ = next(batches)
+    second, _ = next(batches)
+    assert first.shape[0] == 2
+    assert _vals(first) == [60, 90]     # rows 2,3 of global batch 0
+    assert _vals(second) == [180, 210]  # rows 2,3 of global batch 1
+
+
+def test_load_batches_per_host_source_consumes_every_frame(tmp_path):
+    """--per-host-source: the stream advances by per_host only, so a
+    host pointed at its own cameras/bags consumes every frame (the
+    ADVICE.md round-1 finding: a global stride here would silently
+    discard (P-1)/P of each host's frames)."""
+    from triton_client_tpu.cli.train import _load_batches
+
+    _write_frames(tmp_path / "frames")
+    args = _loader_args(tmp_path / "frames")
+    batches = _load_batches(
+        args, np.random.default_rng(0), row0=0, rows=2, stride=2
+    )
+    seen = []
+    for _ in range(4):
+        images, _ = next(batches)
+        seen += _vals(images)
+    assert seen == [0, 30, 60, 90, 120, 150, 180, 210]

@@ -41,6 +41,9 @@ _RETRYABLE = (
     grpc.StatusCode.DEADLINE_EXCEEDED,
     grpc.StatusCode.RESOURCE_EXHAUSTED,
 )
+# ModelInfer may have executed server-side when the deadline fires, so
+# only connection-level failures are safe to re-issue automatically.
+_INFER_RETRYABLE = (grpc.StatusCode.UNAVAILABLE,)
 
 
 class GRPCChannel(BaseChannel):
@@ -120,7 +123,7 @@ class GRPCChannel(BaseChannel):
             request_id=request.request_id,
         )
         t0 = time.perf_counter()
-        resp = self._call(self._stub.ModelInfer, wire)
+        resp = self._call(self._stub.ModelInfer, wire, retryable=_INFER_RETRYABLE)
         return InferResponse(
             model_name=resp.model_name,
             model_version=resp.model_version,
@@ -132,30 +135,42 @@ class GRPCChannel(BaseChannel):
     def do_inference_async(self, request: InferRequest) -> InferFuture:
         """Non-blocking ModelInfer via a gRPC call future (the --async
         path): the RPC is on the wire when this returns; result() parses
-        the response. A retryable failure falls back to the sync retry
-        ladder at resolution time, so the async path keeps the same
-        failure story as do_inference."""
-        wire = codec.build_infer_request(
-            model_name=request.model_name,
-            inputs=request.inputs,
-            model_version=request.model_version,
-            request_id=request.request_id,
-        )
-        t0 = time.perf_counter()
-        call = self._stub.ModelInfer.future(wire, timeout=self._timeout_s)
+        the response. A connection-level failure (UNAVAILABLE — the only
+        code safe to re-issue, see _call) falls back to the sync retry
+        ladder at resolution time; all other errors surface at result()."""
+        try:
+            wire = codec.build_infer_request(
+                model_name=request.model_name,
+                inputs=request.inputs,
+                model_version=request.model_version,
+                request_id=request.request_id,
+            )
+            t0 = time.perf_counter()
+            call = self._stub.ModelInfer.future(wire, timeout=self._timeout_s)
+        except Exception as e:  # async contract: errors surface at result()
+            return InferFuture.failed(e)
 
         def resolve() -> InferResponse:
             try:
                 resp = call.result()
             except grpc.RpcError as e:
                 code = e.code() if hasattr(e, "code") else None
-                if code not in _RETRYABLE:
+                # Only connection-level failures (UNAVAILABLE) are
+                # re-issued automatically — the code least likely to mean
+                # the request executed server-side (no such gRPC code
+                # guarantees it). DEADLINE_EXCEEDED/RESOURCE_EXHAUSTED
+                # requests frequently HAVE executed, so re-running those
+                # is unsafe for non-idempotent models and doubles load
+                # exactly when the server is saturated.
+                if code not in _INFER_RETRYABLE:
                     raise
                 log.warning(
                     "async ModelInfer failed (%s); re-issuing on the "
                     "sync retry path", code,
                 )
-                resp = self._call(self._stub.ModelInfer, wire)
+                resp = self._call(
+                    self._stub.ModelInfer, wire, retryable=_INFER_RETRYABLE
+                )
             return InferResponse(
                 model_name=resp.model_name,
                 model_version=resp.model_version,
@@ -228,14 +243,21 @@ class GRPCChannel(BaseChannel):
 
     # -- internals ------------------------------------------------------------
 
-    def _call(self, method, request):
+    def _call(self, method, request, retryable=_RETRYABLE):
+        """Retry ladder with exponential backoff. ``retryable`` is the
+        set of status codes safe to re-issue for THIS method: idempotent
+        queries (metadata, liveness, index) retry on the full set, while
+        ModelInfer must pass only connection-level codes (UNAVAILABLE) —
+        a DEADLINE_EXCEEDED/RESOURCE_EXHAUSTED request may have executed
+        server-side, and re-running it is unsafe for non-idempotent
+        models and doubles load exactly when the server is saturated."""
         delay = self._backoff_s
         for attempt in range(self._retries + 1):
             try:
                 return method(request, timeout=self._timeout_s)
             except grpc.RpcError as e:
                 code = e.code() if hasattr(e, "code") else None
-                if attempt >= self._retries or code not in _RETRYABLE:
+                if attempt >= self._retries or code not in retryable:
                     raise
                 log.warning(
                     "rpc %s failed (%s); retry %d/%d in %.2fs",

@@ -75,8 +75,15 @@ class TPUChannel(BaseChannel):
         _dispatch returns as soon as the computation is enqueued on the
         device; materializing numpy (the only blocking step) is deferred
         to result(). The driver can therefore preprocess frame N+1 while
-        the chip runs frame N — no threads needed."""
-        model, outputs, t0 = self._dispatch(request)
+        the chip runs frame N — no threads needed.
+
+        Per the BaseChannel contract, dispatch-time errors (validation,
+        unknown model, staging) are deferred to result() rather than
+        raised here, so async callers have one error-surfacing point."""
+        try:
+            model, outputs, t0 = self._dispatch(request)
+        except Exception as e:
+            return InferFuture.failed(e)
 
         def resolve() -> InferResponse:
             host = {k: np.asarray(v) for k, v in outputs.items()}

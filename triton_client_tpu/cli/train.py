@@ -44,6 +44,10 @@ def parse_args(argv=None):
     )
     p.add_argument("--mesh", default="",
                    help="e.g. 'data=8' or 'data=4,model=2'")
+    p.add_argument("--per-host-source", action="store_true",
+                   help="multi-host: --input names THIS host's own "
+                        "cameras/bags (each host consumes its stream "
+                        "fully) instead of a source shared by all hosts")
     p.add_argument("--checkpoint-dir", default="",
                    help="save TrainState every --save-every steps")
     p.add_argument("--save-every", type=int, default=100)
@@ -56,12 +60,21 @@ def parse_args(argv=None):
     return p.parse_args(argv)
 
 
-def _load_batches(args, rng: np.random.Generator, row0: int = 0, rows: int | None = None):
+def _load_batches(
+    args,
+    rng: np.random.Generator,
+    row0: int = 0,
+    rows: int | None = None,
+    stride: int | None = None,
+):
     """Yield (images (rows, S, S, 3) f32, targets (rows, T, 5) [cls,
     cx, cy, w, h] pixels) forever, cycling the source. ``row0``/``rows``
-    window the GLOBAL batch for multi-host runs: the stream advances by
-    the full batch_size each step, but only this host's rows are
-    decoded/resized — no redundant preprocessing of other hosts' data."""
+    window the stream for multi-host runs; ``stride`` is how many frames
+    the stream advances per step. Shared source: stride=global batch,
+    row0=process_index*per_host — hosts decode disjoint blocks of the
+    same stream. Per-host sources (--per-host-source): stride=rows,
+    row0=0 — each host consumes its own stream fully (a global stride
+    there would silently discard (P-1)/P of every host's frames)."""
     from triton_client_tpu.cli.common import load_gt_lookup
     from triton_client_tpu.io.sources import open_source
 
@@ -116,8 +129,9 @@ def _load_batches(args, rng: np.random.Generator, row0: int = 0, rows: int | Non
 
     stream = frame_stream()
     rows = args.batch_size if rows is None else rows
+    stride = args.batch_size if stride is None else stride
     while True:
-        frames = list(itertools.islice(stream, args.batch_size))
+        frames = list(itertools.islice(stream, stride))
         examples = [to_example(f) for f in frames[row0 : row0 + rows]]
         yield (
             np.stack([e[0] for e in examples]),
@@ -218,18 +232,24 @@ def main(argv=None) -> None:
     rng = np.random.default_rng(0)
 
     if args.distributed and jax.process_count() > 1:
-        # multi-host feed: --batch-size is the GLOBAL batch; every host
-        # decodes only ITS process_index-th block of rows (the loader
-        # windows the shared stream, so global rows stay distinct) and
-        # the blocks assemble into one global jax.Array — no cross-host
-        # gathering. Pointing each host at its own cameras/bags works
-        # the same way.
+        # multi-host feed: --batch-size is the GLOBAL batch; the blocks
+        # assemble into one global jax.Array — no cross-host gathering.
+        # Shared source (default): every host decodes only ITS
+        # process_index-th block of rows of the common stream, which
+        # advances by the global batch. --per-host-source: each host's
+        # --input is its own cameras/bags, so it decodes rows [0,
+        # per_host) and advances by per_host only.
         from triton_client_tpu.parallel.distributed import shard_host_batch
 
         per_host = args.batch_size // jax.process_count()
-        batches = _load_batches(
-            args, rng, row0=jax.process_index() * per_host, rows=per_host
-        )
+        if args.per_host_source:
+            batches = _load_batches(
+                args, rng, row0=0, rows=per_host, stride=per_host
+            )
+        else:
+            batches = _load_batches(
+                args, rng, row0=jax.process_index() * per_host, rows=per_host
+            )
 
         def feed(arr):
             return shard_host_batch(arr, mesh)

@@ -72,24 +72,34 @@ class Detect3DPipeline:
         self.config = config
         self.model = model
         self.variables = variables
-        self._jit = jax.jit(self._pipeline)
-
-    def _pipeline(self, points: jnp.ndarray, count: jnp.ndarray):
-        cfg = self.config
+        if config.vfe not in ("auto", "grouped"):
+            raise ValueError(f"unknown vfe mode {config.vfe!r} (auto|grouped)")
         # pillar scatter VFE is nz == 1 only (a taller grid's z cells
         # would merge silently), so auto falls back to grouped there;
         # models whose scatter path keys on the full 3D cell (SECOND's
         # mean VFE) declare scatter_any_nz
-        use_scatter = (
-            cfg.vfe == "auto"
-            and hasattr(self.model, "from_points")
+        self.use_scatter = (
+            config.vfe == "auto"
+            and hasattr(model, "from_points")
             and (
-                self.model.cfg.voxel.grid_size[2] == 1
-                or getattr(self.model, "scatter_any_nz", False)
+                model.cfg.voxel.grid_size[2] == 1
+                or getattr(model, "scatter_any_nz", False)
             )
         )
-        if cfg.vfe not in ("auto", "grouped"):
-            raise ValueError(f"unknown vfe mode {cfg.vfe!r} (auto|grouped)")
+        if self.use_scatter:
+            logger.info(
+                "vfe=auto routes %s to the sort-free scatter VFE: all points "
+                "and pillars are kept, so outputs differ from the OpenPCDet "
+                "budget contract (max_voxels/max_points_per_voxel caps) "
+                "whenever budgets would have been exceeded; use vfe='grouped' "
+                "for exact reference budget semantics",
+                config.model_name,
+            )
+        self._jit = jax.jit(self._pipeline)
+
+    def _pipeline(self, points: jnp.ndarray, count: jnp.ndarray):
+        cfg = self.config
+        use_scatter = self.use_scatter
         if use_scatter:
             # sort-free path: pillar mean/max as dense-grid scatters,
             # no (V, K) grouping (see PointPillars.from_points)
