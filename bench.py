@@ -1,21 +1,40 @@
-"""Benchmark: fused perception pipelines, frames/sec on one TPU chip.
+"""Benchmark: fused perception pipelines on one TPU chip.
 
 Prints ONE JSON line (the driver's contract): the primary metric is the
-YOLOv5n 512x512 fused end-to-end pipeline. Secondary metrics
-(PointPillars 3D end-to-end) go to stderr and BENCH_LOCAL.json so
-round-over-round history captures the whole surface without breaking
-the one-line contract.
+YOLOv5n 512x512 fused end-to-end pipeline. Secondary metrics (bf16,
+PointPillars, SECOND-IoU) go to stderr and BENCH_LOCAL.json.
 
-Methodology (BASELINE.md): the reference publishes no numbers; its
-serving path is one blocking gRPC round-trip per frame to a remote
-Triton GPU. The honest local anchor is real-time camera rate (30 fps) —
-the rate the reference's ROS pipeline must sustain per stream
-(sub_topic camera streams, SURVEY.md section 3.1). vs_baseline is
-frames/sec/chip divided by that 30 fps anchor; BENCH history tracks
-round-over-round gains.
+Methodology (round 2 — trustworthy numbers over the remote-chip tunnel):
+
+* Every timed call is CHAINED: a scalar token computed from the full
+  output is folded into the next call's input, so successive dispatches
+  cannot overlap or be elided, and a single float() readback of the
+  last token forces completion of the whole trial. On this container's
+  tunnel, ``jax.block_until_ready`` can acknowledge repeated identical
+  dispatches early (phantom ~0.02 ms timings) — forced scalar readback
+  is the only reliable fence.
+* Configs are INTERLEAVED round-robin (A/B/A/B...) and the reported
+  value is the median across trials, so slow tunnel phases hit all
+  configs equally instead of biasing one.
+* Per-request p50/p99 latency is measured separately with a readback
+  per call (the BASELINE.json "p50 e2e latency" contract), alongside a
+  tunnel round-trip probe so chip time vs tunnel time is explicit.
+* MFU is derived from the compiled executable's own FLOP count
+  (cost_analysis) against the v5e MXU peak. NOTE: jax's default matmul
+  precision on TPU feeds the MXU bf16 inputs with f32 accumulation
+  even for f32 arrays, so fp32 and bf16 model dtypes run the MXU at
+  the same rate — the honest peak for both is the bf16 peak.
+
+The reference publishes no numbers; its serving path is one blocking
+gRPC round-trip per frame to a remote Triton GPU. vs_baseline remains
+anchored to the real-time sensor rates its ROS pipelines must sustain
+(30 fps camera / 10 Hz lidar, SURVEY.md section 3.1) — a deployment
+headroom ratio, not a hardware comparison; p50/p99/MFU are the
+hardware-meaningful numbers.
 """
 
 import json
+import statistics
 import sys
 import time
 
@@ -25,12 +44,99 @@ import numpy as np
 
 BATCH = 8
 WARMUP = 5
-ITERS = 100  # enough reps to smooth remote-chip tunnel jitter
+TRIALS = 12          # interleaved rounds per config
+REPS = 25            # chained dispatches per trial
+LAT_CALLS = 30       # single-call latency samples (readback per call)
 CAMERA_FPS_BASELINE = 30.0
 LIDAR_HZ_BASELINE = 10.0  # KITTI/nuScenes lidar scan rate
+V5E_PEAK_FLOPS = 197e12   # bf16 MXU peak; fp32 runs the MXU at the same
+                          # rate under jax's default (bf16xN) precision
 
 
-def bench_yolov5(dtype=None) -> dict:
+def _tunnel_rtt_ms() -> float:
+    """Median host<->device round trip for a scalar readback: the
+    per-call latency floor the tunnel imposes regardless of compute."""
+    one = jnp.float32(1.0)
+    f = jax.jit(lambda x: x + 1.0)
+    float(f(one))  # compile
+    samples = []
+    for _ in range(20):
+        t0 = time.perf_counter()
+        float(f(one))
+        samples.append((time.perf_counter() - t0) * 1e3)
+    return statistics.median(samples)
+
+
+class Config:
+    """One benchmarked pipeline: a jitted ``step(tok) -> tok`` whose
+    scalar token chains successive dispatches (no overlap, no elision)
+    plus bookkeeping to turn trial times into the output dict."""
+
+    def __init__(self, name, metric, step, unit_per_call, baseline_hz):
+        self.name = name
+        self.metric = metric
+        self.step = step                  # tok -> tok, jitted
+        self.unit_per_call = unit_per_call  # frames (batch) or scans per call
+        self.baseline_hz = baseline_hz
+        self.trial_ms = []                # per-call ms, one entry per trial
+        self.flops_per_call = None
+
+    def warmup(self):
+        tok = jnp.float32(0.0)
+        for _ in range(WARMUP):
+            tok = self.step(tok)
+        float(tok)
+        try:
+            cost = self.step.lower(jnp.float32(0.0)).compile().cost_analysis()
+            if cost and cost.get("flops"):
+                self.flops_per_call = float(cost["flops"])
+        except Exception:
+            pass  # cost analysis is best-effort over the tunnel
+
+    def run_trial(self):
+        tok = jnp.float32(0.0)
+        t0 = time.perf_counter()
+        for _ in range(REPS):
+            tok = self.step(tok)
+        float(tok)  # forces the whole chained trial
+        self.trial_ms.append((time.perf_counter() - t0) * 1e3 / REPS)
+
+    def latency_profile(self):
+        """Per-request e2e latency: one forced readback per call."""
+        samples = []
+        tok = jnp.float32(0.0)
+        for _ in range(LAT_CALLS):
+            t0 = time.perf_counter()
+            tok = self.step(tok)
+            float(tok)
+            samples.append((time.perf_counter() - t0) * 1e3)
+        return samples
+
+    def result(self, rtt_ms: float) -> dict:
+        per_call_ms = statistics.median(self.trial_ms)
+        spread = (max(self.trial_ms) - min(self.trial_ms)) / per_call_ms
+        rate = self.unit_per_call / (per_call_ms / 1e3)
+        lat = self.latency_profile()
+        out = {
+            "metric": self.metric,
+            "value": round(rate, 2),
+            "unit": ("frames/sec" if self.unit_per_call > 1 else "scans/sec"),
+            "vs_baseline": round(rate / self.baseline_hz, 2),
+            "per_call_ms": round(per_call_ms, 4),
+            "p50_e2e_ms": round(float(np.percentile(lat, 50)), 3),
+            "p99_e2e_ms": round(float(np.percentile(lat, 99)), 3),
+            "tunnel_rtt_ms": round(rtt_ms, 3),
+            "trial_spread": round(spread, 3),
+        }
+        if self.flops_per_call:
+            out["flops_per_call"] = self.flops_per_call
+            out["mfu"] = round(
+                self.flops_per_call / (per_call_ms / 1e3) / V5E_PEAK_FLOPS, 4
+            )
+        return out
+
+
+def make_yolov5(dtype=None) -> Config:
     from triton_client_tpu.models.yolov5 import init_yolov5
     from triton_client_tpu.ops.detect_postprocess import extract_boxes
     from triton_client_tpu.ops.preprocess import normalize_image
@@ -40,46 +146,28 @@ def bench_yolov5(dtype=None) -> dict:
         jax.random.PRNGKey(0), num_classes=2, variant="n", input_hw=input_hw,
         dtype=dtype or jnp.float32,
     )
-
-    @jax.jit
-    def pipeline(variables, images):
-        x = normalize_image(images, "yolo")
-        pred = model.decode(model.apply(variables, x, train=False))
-        return extract_boxes(pred, conf_thresh=0.3, iou_thresh=0.45)
-
     rng = np.random.default_rng(0)
     frames = jnp.asarray(
         rng.integers(0, 255, (BATCH, *input_hw, 3)).astype(np.float32)
     )
 
-    for _ in range(WARMUP):
-        out = pipeline(variables, frames)
-    jax.block_until_ready(out)
+    @jax.jit
+    def step(tok):
+        x = normalize_image(frames + tok * 0.0, "yolo")
+        pred = model.decode(model.apply(variables, x, train=False))
+        dets, valid = extract_boxes(pred, conf_thresh=0.3, iou_thresh=0.45)
+        # token depends on every output row -> readback fences the call
+        return (jnp.sum(valid) + jnp.sum(dets) * 1e-12).astype(jnp.float32)
 
-    t0 = time.perf_counter()
-    for _ in range(ITERS):
-        out = pipeline(variables, frames)
-    jax.block_until_ready(out)
-    dt = time.perf_counter() - t0
-
-    fps = BATCH * ITERS / dt
     suffix = "_bf16" if dtype == jnp.bfloat16 else ""
-    return {
-        "metric": f"yolov5n_512{suffix}_e2e_frames_per_sec_per_chip",
-        "value": round(fps, 2),
-        "unit": "frames/sec",
-        "vs_baseline": round(fps / CAMERA_FPS_BASELINE, 2),
-    }
+    return Config(
+        f"yolov5n{suffix}",
+        f"yolov5n_512{suffix}_e2e_frames_per_sec_per_chip",
+        step, BATCH, CAMERA_FPS_BASELINE,
+    )
 
 
-def _bench_3d_pipeline(pipeline, point_buckets, metric: str) -> dict:
-    """Shared 3D-bench methodology (both lidar models): a ~KITTI-sized
-    synthetic scan is padded and staged on device once, then the fused
-    jit (voxel/scatter VFE -> CNN -> top-k decode -> rotated NMS) is
-    timed back-to-back. Host-side bucketing/padding is ~0.4 ms/scan,
-    measured separately; over the remote-chip tunnel used in CI,
-    per-call host->device transfers would otherwise dominate and
-    measure the tunnel, not the chip."""
+def _make_3d(pipeline, point_budget, name, metric) -> Config:
     from triton_client_tpu.ops.voxelize import pad_points
 
     rng = np.random.default_rng(0)
@@ -90,30 +178,20 @@ def _bench_3d_pipeline(pipeline, point_buckets, metric: str) -> dict:
     pts[:, 1] = rng.uniform(pc_range[1], pc_range[4], n_pts)
     pts[:, 2] = rng.uniform(pc_range[2], pc_range[5], n_pts)
     pts[:, 3] = rng.uniform(0, 1, n_pts)
-    padded, m = pad_points(pts, max(point_buckets))
+    padded, m = pad_points(pts, point_budget)
     pj, mj = jnp.asarray(padded), jnp.asarray(m)
 
-    iters = max(10, ITERS // 3)
-    for _ in range(WARMUP):
-        out = pipeline._jit(pj, mj)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = pipeline._jit(pj, mj)
-    jax.block_until_ready(out)
-    dt = time.perf_counter() - t0
+    inner = pipeline._jit
 
-    fps = iters / dt
-    return {
-        "metric": metric,
-        "value": round(fps, 2),
-        "unit": "scans/sec",
-        "vs_baseline": round(fps / LIDAR_HZ_BASELINE, 2),
-    }
+    @jax.jit
+    def step(tok):
+        dets, valid = inner(pj + tok * 0.0, mj)
+        return (jnp.sum(valid) + jnp.sum(dets) * 1e-12).astype(jnp.float32)
+
+    return Config(name, metric, step, 1, LIDAR_HZ_BASELINE)
 
 
-def bench_pointpillars() -> dict:
-    """PointPillars end-to-end, KITTI grid (data/kitti_pointpillars.yaml)."""
+def make_pointpillars() -> Config:
     from triton_client_tpu.dataset_config import detect3d_from_yaml
     from triton_client_tpu.pipelines.detect3d import build_pointpillars_pipeline
 
@@ -121,16 +199,13 @@ def bench_pointpillars() -> dict:
     pipeline, _, _ = build_pointpillars_pipeline(
         jax.random.PRNGKey(0), model_cfg=model_cfg, config=pipe_cfg
     )
-    return _bench_3d_pipeline(
-        pipeline,
-        pipe_cfg.point_buckets,
+    return _make_3d(
+        pipeline, max(pipe_cfg.point_buckets), "pointpillars",
         "pointpillars_kitti_e2e_scans_per_sec_per_chip",
     )
 
 
-def bench_second() -> dict:
-    """SECOND-IoU end-to-end (scatter mean VFE -> dense 3D middle
-    encoder -> BEV backbone -> IoU-rectified decode -> rotated NMS)."""
+def make_second() -> Config:
     from triton_client_tpu.pipelines.detect3d import (
         Detect3DConfig,
         build_second_pipeline,
@@ -138,34 +213,113 @@ def bench_second() -> dict:
 
     cfg = Detect3DConfig(model_name="second_iou")
     pipeline, _, _ = build_second_pipeline(jax.random.PRNGKey(0), config=cfg)
-    return _bench_3d_pipeline(
-        pipeline,
-        cfg.point_buckets,
+    return _make_3d(
+        pipeline, max(cfg.point_buckets), "second_iou",
         "second_iou_kitti_e2e_scans_per_sec_per_chip",
     )
 
 
+def validate_pallas_nms() -> dict:
+    """Once per bench session: run the Pallas NMS kernel and the XLA
+    loop on the LIVE backend on the same inputs and require identical
+    selected-index sequences — a Mosaic lowering regression fails the
+    bench run, not a customer (VERDICT r1: interpret-mode tests alone
+    never exercised the real TPU lowering)."""
+    from triton_client_tpu.ops.nms import _nms_xla
+    from triton_client_tpu.ops.pallas_nms import nms_pallas
+
+    if jax.default_backend() != "tpu":
+        return {"pallas_nms_on_tpu": "skipped (backend=%s)" % jax.default_backend()}
+    rng = np.random.default_rng(7)
+    checked = 0
+    for n in (128, 512, 1024):
+        centers = rng.uniform(0, 512, (n, 2))
+        wh = rng.uniform(8, 96, (n, 2))
+        boxes = jnp.asarray(
+            np.concatenate([centers - wh / 2, centers + wh / 2], axis=1),
+            jnp.float32,
+        )
+        scores = jnp.asarray(rng.uniform(0.01, 1.0, n), jnp.float32)
+        for thresh in (0.3, 0.45, 0.6):
+            pi, pv = nms_pallas(
+                boxes, scores, iou_thresh=thresh, max_det=128, interpret=False
+            )
+            xi, xv = _nms_xla(boxes, scores, thresh, max_det=128)
+            pi, pv, xi, xv = (np.asarray(a) for a in (pi, pv, xi, xv))
+            if not (np.array_equal(pv, xv) and np.array_equal(pi[pv], xi[xv])):
+                raise AssertionError(
+                    f"Pallas NMS diverges from XLA on TPU (n={n}, "
+                    f"thresh={thresh}): pallas={pi[pv][:10]} xla={xi[xv][:10]}"
+                )
+            checked += 1
+    return {"pallas_nms_on_tpu": f"identical to XLA loop ({checked} cases)"}
+
+
 def main() -> None:
-    primary = bench_yolov5()
-    results = [primary]
-    for label, secondary_fn in (
-        ("yolov5n_bf16", lambda: bench_yolov5(dtype=jnp.bfloat16)),
-        ("pointpillars", bench_pointpillars),
-        ("second_iou", bench_second),
+    nms_check = validate_pallas_nms()
+    print(json.dumps(nms_check), file=sys.stderr)
+
+    configs = [make_yolov5()]
+    for label, factory in (
+        ("yolov5n_bf16", lambda: make_yolov5(dtype=jnp.bfloat16)),
+        ("pointpillars", make_pointpillars),
+        ("second_iou", make_second),
     ):
         try:
-            results.append(secondary_fn())
-        except Exception as e:  # secondary metrics must not break the contract
-            print(f"{label} bench failed: {e}", file=sys.stderr)
+            configs.append(factory())
+        except Exception as e:  # secondaries must not break the contract
+            print(f"{label} bench setup failed: {e}", file=sys.stderr)
 
+    rtt = _tunnel_rtt_ms()
+    print(f"tunnel rtt {rtt:.2f} ms", file=sys.stderr)
+
+    def drop(c, stage, e):
+        """A secondary failing mid-bench must never cost the primary
+        its one-line stdout contract: log, remove, keep going. The
+        primary config failing is fatal by design."""
+        if c is configs[0]:
+            raise e
+        print(f"{c.name} dropped ({stage}): {e}", file=sys.stderr)
+        configs.remove(c)
+
+    for c in list(configs):
+        t0 = time.perf_counter()
+        try:
+            c.warmup()
+        except Exception as e:
+            drop(c, "warmup", e)
+            continue
+        print(
+            f"warmup {c.name}: {time.perf_counter() - t0:.1f}s "
+            f"(flops/call={c.flops_per_call})",
+            file=sys.stderr,
+        )
+    t0 = time.perf_counter()
+    for t in range(TRIALS):          # interleaved: A/B/C/D A/B/C/D ...
+        for c in list(configs):
+            try:
+                c.run_trial()
+            except Exception as e:
+                drop(c, "trial", e)
+        print(
+            f"trial {t + 1}/{TRIALS} done at {time.perf_counter() - t0:.1f}s",
+            file=sys.stderr,
+        )
+
+    results = []
+    for c in list(configs):
+        try:
+            results.append(c.result(rtt))
+        except Exception as e:
+            drop(c, "result", e)
     try:  # best-effort: the one-line stdout contract must survive
         with open("BENCH_LOCAL.json", "w") as f:
-            json.dump(results, f, indent=2)
+            json.dump({"nms_check": nms_check, "results": results}, f, indent=2)
     except OSError as e:
         print(f"could not write BENCH_LOCAL.json: {e}", file=sys.stderr)
     for secondary in results[1:]:
         print(json.dumps(secondary), file=sys.stderr)
-    print(json.dumps(primary))
+    print(json.dumps(results[0]))
 
 
 if __name__ == "__main__":

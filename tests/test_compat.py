@@ -170,3 +170,56 @@ def test_plot_boxes_writes_file(tmp_path):
     import os
 
     assert os.path.exists(out_path)
+
+
+def test_extract_boxes_triton_two_output_contract():
+    """YOLOv4 wire contract (utils/postprocess.py:201-266): confs
+    [B,num,nc] + boxes [B,num,1,4] -> [x1,y1,x2,y2,conf,conf,cls] rows,
+    gated at 0.4, per-class NMS at 0.6, class-major ordering."""
+    # 4 candidates: two heavy-overlap class-0 (one must be suppressed),
+    # one class-1, one below the conf gate
+    boxes = np.array(
+        [[[[0.10, 0.10, 0.30, 0.30]],
+          [[0.11, 0.11, 0.31, 0.31]],
+          [[0.60, 0.60, 0.80, 0.80]],
+          [[0.40, 0.40, 0.50, 0.50]]]],
+        np.float32,
+    )
+    confs = np.array(
+        [[[0.90, 0.05],
+          [0.80, 0.05],
+          [0.10, 0.70],
+          [0.30, 0.20]]],
+        np.float32,
+    )
+    out = compat.extract_boxes_triton((confs, boxes))
+    assert len(out) == 1
+    rows = out[0]
+    assert len(rows) == 2
+    # class-major ordering: class 0 row first, then class 1
+    np.testing.assert_allclose(rows[0][:4], [0.10, 0.10, 0.30, 0.30])
+    assert rows[0][4] == rows[0][5] == pytest.approx(0.90)
+    assert rows[0][6] == 0.0
+    np.testing.assert_allclose(rows[1][:4], [0.60, 0.60, 0.80, 0.80])
+    assert rows[1][4] == rows[1][5] == pytest.approx(0.70)
+    assert rows[1][6] == 1.0
+
+
+def test_extract_boxes_triton_per_class_nms_keeps_cross_class_overlap():
+    # identical boxes in DIFFERENT argmax classes both survive: NMS is
+    # per class in the v1 path
+    boxes = np.tile(np.array([[[0.2, 0.2, 0.4, 0.4]]], np.float32), (1, 2, 1, 1))
+    confs = np.array([[[0.9, 0.0], [0.0, 0.8]]], np.float32)
+    out = compat.extract_boxes_triton((confs, boxes))
+    assert len(out[0]) == 2
+    assert [r[6] for r in out[0]] == [0.0, 1.0]
+
+
+def test_extract_boxes_triton_dict_and_empty():
+    out = compat.extract_boxes_triton(
+        {
+            "confs": np.zeros((2, 8, 3), np.float32),
+            "boxes": np.zeros((2, 8, 1, 4), np.float32),
+        }
+    )
+    assert out == [[], []]

@@ -1,0 +1,134 @@
+"""Cross-runtime numeric validation of the 2D decode+NMS pipeline.
+
+VERDICT r1 gap (component #19): nothing validated the 2D postprocess
+numerics against an implementation the builder didn't also write. The
+reference used onnxruntime for this role (yolo_onnx_test.py:50-143);
+that is unavailable here, so the independent oracles are OpenCV's
+C++ greedy NMS (cv2.dnn.NMSBoxes / NMSBoxesBatched — same algorithm
+family as the torchvision op the reference's client calls,
+clients/postprocess/yolov5_postprocess.py:108) and torch-native tensor
+math for the decode formulas.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+torch = pytest.importorskip("torch")
+cv2 = pytest.importorskip("cv2")
+import jax.numpy as jnp
+
+
+def _cv2_nms(boxes_xyxy, scores, thresh):
+    """OpenCV C++ greedy NMS; takes xywh rects, returns kept indices
+    in descending-score order."""
+    rects = np.concatenate(
+        [boxes_xyxy[:, :2], boxes_xyxy[:, 2:] - boxes_xyxy[:, :2]], axis=1
+    )
+    keep = cv2.dnn.NMSBoxes(rects.tolist(), scores.tolist(), 0.0, float(thresh))
+    return np.asarray(keep).reshape(-1)
+
+
+def _random_boxes(rng, n, lo=0, hi=512):
+    centers = rng.uniform(lo + 50, hi - 50, (n, 2))
+    wh = rng.uniform(8, 96, (n, 2))
+    return np.concatenate([centers - wh / 2, centers + wh / 2], 1).astype(np.float32)
+
+
+def test_nms_matches_opencv_cpp():
+    """Greedy NMS kept-index sequence == cv2.dnn.NMSBoxes (C++)
+    across sizes and thresholds."""
+    from triton_client_tpu.ops.nms import nms
+
+    rng = np.random.default_rng(11)
+    for n in (16, 128, 777):
+        boxes = _random_boxes(rng, n)
+        scores = rng.uniform(0.01, 1.0, n).astype(np.float32)
+        for thresh in (0.3, 0.45, 0.7):
+            idx, valid = nms(
+                jnp.asarray(boxes), jnp.asarray(scores),
+                iou_thresh=thresh, max_det=64,
+            )
+            ours = np.asarray(idx)[np.asarray(valid)]
+            ref = _cv2_nms(boxes, scores, thresh)[: len(ours)]
+            np.testing.assert_array_equal(
+                ours, ref, err_msg=f"n={n} thresh={thresh}"
+            )
+
+
+def test_extract_boxes_matches_opencv_batched_nms():
+    """Full postprocess (conf=obj*cls gate, best-class, xywh->xyxy,
+    class-aware NMS) against a torch gate/convert pipeline whose
+    per-class suppression is OpenCV's C++ NMS."""
+    from triton_client_tpu.ops.detect_postprocess import extract_boxes
+
+    rng = np.random.default_rng(23)
+    n, nc = 400, 5
+    conf_thresh, iou_thresh, max_det = 0.25, 0.45, 50
+    pred = np.zeros((1, n, 5 + nc), np.float32)
+    centers = rng.uniform(60, 450, (n, 2))
+    wh = rng.uniform(10, 90, (n, 2))
+    pred[0, :, 0:2] = centers
+    pred[0, :, 2:4] = wh
+    pred[0, :, 4] = rng.uniform(0, 1, n)
+    pred[0, :, 5:] = rng.uniform(0, 1, (n, nc))
+
+    dets, valid = extract_boxes(
+        jnp.asarray(pred), conf_thresh=conf_thresh, iou_thresh=iou_thresh,
+        max_det=max_det,
+    )
+    ours = np.asarray(dets)[0][np.asarray(valid)[0].astype(bool)]
+
+    t = torch.from_numpy(pred[0])
+    conf = t[:, 4:5] * t[:, 5:]
+    scores, cls = conf.max(dim=1)
+    keep = scores > conf_thresh
+    xy, twh = t[keep, 0:2], t[keep, 2:4]
+    boxes = torch.cat([xy - twh / 2, xy + twh / 2], dim=1)
+    # class-aware NMS via the class-offset trick over the C++ kernel
+    offset = cls[keep][:, None].float() * 10000.0
+    order = torch.from_numpy(
+        _cv2_nms(
+            (boxes + offset).numpy(), scores[keep].numpy(), iou_thresh
+        )
+    ).long()[:max_det]
+
+    assert len(ours) == len(order)
+    np.testing.assert_allclose(
+        ours[:, :4], boxes[order].numpy(), atol=1e-3
+    )
+    np.testing.assert_allclose(ours[:, 4], scores[keep][order].numpy(), atol=1e-5)
+    np.testing.assert_array_equal(
+        ours[:, 5].astype(int), cls[keep][order].numpy()
+    )
+
+
+@pytest.mark.parametrize("variant", ["v5", "v4"])
+def test_decode_yolo_grid_matches_torch_math(variant):
+    """Grid decode formulas recomputed with torch ops (sigmoid/exp/grid
+    arithmetic in a different framework and accumulation order)."""
+    from triton_client_tpu.ops.yolo_decode import decode_yolo_grid
+
+    rng = np.random.default_rng(31)
+    b, h, w, a, nc = 2, 8, 6, 3, 4
+    stride = 16
+    raw = rng.standard_normal((b, h, w, a, 5 + nc)).astype(np.float32)
+    anchors = rng.uniform(10, 120, (a, 2)).astype(np.float32)
+
+    out = np.asarray(
+        decode_yolo_grid(jnp.asarray(raw), anchors, stride, variant)
+    )
+
+    t = torch.from_numpy(raw)
+    gy, gx = torch.meshgrid(torch.arange(h), torch.arange(w), indexing="ij")
+    grid = torch.stack([gx, gy], dim=-1).float()[None, :, :, None, :]
+    ta = torch.from_numpy(anchors).view(1, 1, 1, a, 2)
+    if variant == "v5":
+        xy = (torch.sigmoid(t[..., :2]) * 2 - 0.5 + grid) * stride
+        wh = (torch.sigmoid(t[..., 2:4]) * 2) ** 2 * ta
+    else:
+        xy = (torch.sigmoid(t[..., :2]) + grid) * stride
+        wh = torch.exp(t[..., 2:4]) * ta
+    rest = torch.sigmoid(t[..., 4:])
+    ref = torch.cat([xy, wh, rest], dim=-1).reshape(b, h * w * a, 5 + nc)
+    np.testing.assert_allclose(out, ref.numpy(), atol=2e-5, rtol=1e-5)

@@ -148,3 +148,112 @@ class TestTrainCLIWiring:
             ]
         )
         assert "step 2/2" in capsys.readouterr().out
+
+
+_CHILD_SRC = '''
+"""Two-process jax.distributed child: joins the cluster through the
+framework's own entry points and proves the host-major mesh layout and
+a real cross-host psum (the DCN/ICI axis-placement claim of
+parallel/mesh.py:15-18, executed rather than narrated)."""
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from triton_client_tpu.parallel.distributed import (
+    DistributedConfig,
+    global_mesh,
+    init_distributed,
+    is_coordinator,
+    shard_host_batch,
+)
+from triton_client_tpu.parallel.mesh import MeshConfig
+
+init_distributed(DistributedConfig.from_spec("env"))
+pid = jax.process_index()
+assert jax.process_count() == 2, jax.process_count()
+assert jax.device_count() == 4, jax.device_count()
+assert is_coordinator() == (pid == 0)
+
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+mesh = global_mesh(MeshConfig(data=4, model=1))
+# host-major: the data axis walks process 0's devices first, then
+# process 1's — so a (model/seq/pipe)-group never straddles hosts
+# when it fits in one
+flat = mesh.devices.reshape(-1)
+assert [d.process_index for d in flat] == [0, 0, 1, 1], [
+    d.process_index for d in flat
+]
+
+# per-host feed -> one global array (no host gathering)
+local = np.full((2, 4), pid + 1.0, np.float32)
+garr = shard_host_batch(local, mesh)
+assert garr.shape == (4, 4)
+
+# cross-host collective: psum over the data axis spans both processes
+psum = shard_map(
+    lambda x: jax.lax.psum(jnp.sum(x), "data"),
+    mesh=mesh,
+    in_specs=P("data"),
+    out_specs=P(),
+)
+total = float(jax.jit(psum)(garr))
+assert total == 2 * 4 * 1.0 + 2 * 4 * 2.0, total  # both hosts contributed
+print(f"CHILD {pid} OK total={total}")
+'''
+
+
+def test_two_process_cluster_host_major_mesh_and_cross_host_psum(tmp_path):
+    """Launch TWO real jax.distributed processes on localhost CPU and
+    assert the host-major mesh layout plus a cross-host psum through
+    the framework's own init/mesh/feed entry points."""
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    child = tmp_path / "dist_child.py"
+    child.write_text(_CHILD_SRC)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.update(
+            COORDINATOR=f"127.0.0.1:{port}",
+            NPROC="2",
+            PROC_ID=str(pid),
+            PYTHONPATH=repo_root,
+        )
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, str(child)],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+        )
+    outs = []
+    for pid, proc in enumerate(procs):
+        try:
+            out, _ = proc.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for p in procs:
+                p.kill()
+            raise
+        outs.append(out)
+        assert proc.returncode == 0, f"process {pid} failed:\n{out}"
+    for pid, out in enumerate(outs):
+        assert f"CHILD {pid} OK" in out, out

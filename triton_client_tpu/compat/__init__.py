@@ -5,6 +5,7 @@ from triton_client_tpu.compat.functional import (  # noqa: F401
     deserialize_bytes_float,
     deserialize_bytes_int,
     extract_boxes_detectron,
+    extract_boxes_triton,
     extract_boxes_yolov5,
     image_adjust,
     load_class_names,

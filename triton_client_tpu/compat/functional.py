@@ -264,6 +264,77 @@ def extract_boxes_yolov5(
     return [dets[i][valid[i].astype(bool)] for i in range(dets.shape[0])]
 
 
+def extract_boxes_triton(
+    outputs: dict[str, np.ndarray] | Sequence[np.ndarray],
+    conf_thresh: float = 0.4,
+    nms_thresh: float = 0.6,
+) -> list[list[list[float]]]:
+    """YOLOv4 two-output contract: confs [B, num, nc] + boxes
+    [B, num, 1, 4] -> per-image list of
+    [x1, y1, x2, y2, conf, conf, cls] rows (conf duplicated — the v1
+    wire quirk preserved; utils/postprocess.py:201-266 semantics).
+
+    Per image: rows gate on the per-box max class confidence, then
+    greedy NMS runs independently per argmax class; surviving rows are
+    emitted class-by-class in ascending class order, score-descending
+    within a class — the exact v1 ordering. Accepts the two arrays, a
+    {'confs', 'boxes'} dict, or an InferResponse-style outputs dict
+    keyed by the served names."""
+    if isinstance(outputs, dict):
+        confs = outputs.get("confs")
+        boxes = outputs.get("boxes")
+        if confs is None or boxes is None:
+            # served-name fallback: the two arrays are structurally
+            # distinguishable — boxes is the 4-D [B, num, 1, 4] tensor
+            # (or trailing dim 4), confs the 3-D [B, num, nc] one — so
+            # pair by shape, not by dict order
+            vals = [np.asarray(v) for v in outputs.values()]
+            if len(vals) != 2:
+                raise ValueError(
+                    "extract_boxes_triton needs exactly the confs + boxes "
+                    f"outputs; got {len(vals)} arrays"
+                )
+            a, b = vals
+            boxes_first = a.ndim == 4 or (b.ndim == 3 and a.shape[-1] == 4)
+            confs, boxes = (b, a) if boxes_first else (a, b)
+    else:
+        confs, boxes = outputs[0], outputs[1]
+    confs = np.asarray(confs, np.float32)
+    boxes = np.asarray(boxes, np.float32)
+    if boxes.ndim == 4:  # [B, num, 1, 4] -> [B, num, 4]
+        boxes = boxes[:, :, 0]
+    num_classes = confs.shape[2]
+
+    max_conf = confs.max(axis=2)
+    max_id = confs.argmax(axis=2)
+
+    batch_boxes: list[list[list[float]]] = []
+    for i in range(boxes.shape[0]):
+        gate = max_conf[i] > conf_thresh
+        g_boxes, g_conf, g_id = boxes[i][gate], max_conf[i][gate], max_id[i][gate]
+        rows: list[list[float]] = []
+        for j in range(num_classes):
+            sel = g_id == j
+            if not sel.any():
+                continue
+            c_boxes, c_conf = g_boxes[sel], g_conf[sel]
+            keep = nms_cpu(c_boxes, c_conf, nms_thresh)
+            for k in keep:
+                rows.append(
+                    [
+                        float(c_boxes[k, 0]),
+                        float(c_boxes[k, 1]),
+                        float(c_boxes[k, 2]),
+                        float(c_boxes[k, 3]),
+                        float(c_conf[k]),
+                        float(c_conf[k]),
+                        float(j),
+                    ]
+                )
+        batch_boxes.append(rows)
+    return batch_boxes
+
+
 def extract_boxes_detectron(
     outputs: dict[str, np.ndarray] | Sequence[np.ndarray],
     conf_thres: float = 0.6,

@@ -52,9 +52,8 @@ def voxel_from_dict(d: Mapping[str, Any], base: VoxelConfig | None = None) -> Vo
     return dataclasses.replace(
         base,
         **{
-            k: (_tup(d[k]) if k in ("point_cloud_range", "voxel_size") else int(d[k]))
-            for k in ("point_cloud_range", "voxel_size", "max_voxels", "max_points_per_voxel")
-            if k in d
+            k: (_tup(v) if k in ("point_cloud_range", "voxel_size") else int(v))
+            for k, v in d.items()
         },
     )
 

@@ -353,9 +353,20 @@ def channel_infer3d(
     buckets = sorted(spec.extra.get("point_buckets", [32768, 65536, 131072]))
     if z_offset is None:
         z_offset = float(spec.extra.get("z_offset", 0.0))
+    # served contract widths: input features (5 for sweep-time models)
+    # and detection-row layout [box7, extras..., score, label]
+    pf = int(spec.inputs[0].shape[-1]) if len(spec.inputs[0].shape) else 4
+    if pf <= 0:
+        pf = 4  # wildcard dim: the classic 4-feature contract
+    det_w = int(spec.outputs[0].shape[-1])
 
     def make_request(points: np.ndarray) -> InferRequest:
-        points = points[:, :4].astype(np.float32)
+        points = points[:, :pf].astype(np.float32)
+        if points.shape[1] < pf:
+            # narrow cloud into a wider served contract: zero-fill the
+            # missing channels (single sweep -> Δt = 0), mirroring
+            # Detect3DPipeline.infer_dispatch
+            points = np.pad(points, ((0, 0), (0, pf - points.shape[1])))
         if z_offset:
             points[:, 2] += z_offset
         if len(points) > buckets[-1]:
@@ -376,11 +387,17 @@ def channel_infer3d(
         dets = np.asarray(resp.outputs["detections"])
         valid = np.asarray(resp.outputs["valid"])
         live = dets[valid]
-        return {
+        # width-relative: rows are [box7, extras..., score, label]
+        # (CenterPoint velocity models serve det_w == 11)
+        w = live.shape[1] if live.ndim == 2 else det_w
+        out = {
             "pred_boxes": live[:, :7],
-            "pred_scores": live[:, 7],
-            "pred_labels": live[:, 8].astype(np.int32),
+            "pred_scores": live[:, w - 2],
+            "pred_labels": live[:, w - 1].astype(np.int32),
         }
+        if w == 11:
+            out["pred_velocities"] = live[:, 7:9]
+        return out
 
     if asynchronous:
         return lambda points: channel.do_inference_async(

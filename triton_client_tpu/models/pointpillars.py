@@ -193,7 +193,7 @@ class PillarVFE(nn.Module):
         centers = (coords[:, ::-1].astype(jnp.float32) + 0.5) * vs + r0  # (V, 3) xyz
         feats = jnp.concatenate(
             [
-                voxels[..., :4],
+                voxels[..., : self.voxel.point_features],
                 xyz - mean,
                 xyz - centers[:, None, :],
             ],
@@ -252,7 +252,9 @@ def augment_points(
     mean = per_point[:, :3] / jnp.maximum(per_point[:, 3:], 1.0)
     cnt = acc[:, 3]
     centers = (ijk.astype(jnp.float32) + 0.5) * vs + r[:3]
-    feats = jnp.concatenate([points[:, :4], xyz - mean, xyz - centers], axis=1)
+    feats = jnp.concatenate(
+        [points[:, : voxel.point_features], xyz - mean, xyz - centers], axis=1
+    )
     return jnp.where(valid[:, None], feats, 0.0), vid, valid, cnt
 
 
@@ -483,7 +485,7 @@ def init_pointpillars(rng, cfg: PointPillarsConfig | None = None, dtype=jnp.floa
     v, k = cfg.voxel.max_voxels, cfg.voxel.max_points_per_voxel
     variables = model.init(
         rng,
-        jnp.zeros((1, v, k, 4)),
+        jnp.zeros((1, v, k, cfg.voxel.point_features)),
         jnp.zeros((1, v), jnp.int32),
         jnp.full((1, v, 3), -1, jnp.int32),
         train=False,

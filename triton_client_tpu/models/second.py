@@ -307,7 +307,7 @@ def init_second(rng, cfg: SECONDConfig | None = None, dtype=jnp.float32):
     v, k = cfg.voxel.max_voxels, cfg.voxel.max_points_per_voxel
     variables = model.init(
         rng,
-        jnp.zeros((1, v, k, 4)),
+        jnp.zeros((1, v, k, cfg.voxel.point_features)),
         jnp.zeros((1, v), jnp.int32),
         jnp.full((1, v, 3), -1, jnp.int32),
         train=False,

@@ -27,10 +27,15 @@ def extract_boxes_3d(
     max_det: int = 128,
     pre_max: int = 512,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """boxes (B, N, 7), scores (B, N, nc) -> packed per-image detections.
+    """boxes (B, N, 7+e), scores (B, N, nc) -> packed per-image
+    detections. Columns past the canonical 7 ride along untouched
+    (CenterPoint appends its 2 velocity channels there; the reference's
+    det3d decode carries them the same way) — NMS geometry always reads
+    the first 7.
 
-    Returns (detections (B, max_det, 9), valid (B, max_det)) with rows
-    [x, y, z, dx, dy, dz, heading, score, label]; label is 1-indexed
+    Returns (detections (B, max_det, 9+e), valid (B, max_det)) with
+    rows [x, y, z, dx, dy, dz, heading, extras..., score, label];
+    label is 1-indexed
     (0 reserved for background, the OpenPCDet convention the reference's
     pedestrian filter indexes against, communicator/ros_inference3d.py:156).
     """
@@ -49,10 +54,11 @@ def extract_boxes_3d(
 
 
 def _nms_pack_one(cand_boxes, cand_scores, cand_labels, iou_thresh, max_det):
-    """(K, 7) candidates (+ scores with -inf padding, 1-indexed labels)
-    -> packed (max_det, 9) rows [box7, score, label] + valid mask."""
+    """(K, 7+e) candidates (+ scores with -inf padding, 1-indexed
+    labels) -> packed (max_det, 9+e) rows [box7, extras..., score,
+    label] + valid mask. BEV NMS reads only the canonical 7 columns."""
     idx, keep = nms_bev(
-        cand_boxes, cand_scores, iou_thresh=iou_thresh, max_det=max_det
+        cand_boxes[:, :7], cand_scores, iou_thresh=iou_thresh, max_det=max_det
     )
     out = jnp.concatenate(
         [
@@ -73,7 +79,7 @@ def nms_pack_3d(
     iou_thresh: float = 0.01,
     max_det: int = 128,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Packed NMS over PRE-GATED candidates: boxes (B, K, 7), scores
+    """Packed NMS over PRE-GATED candidates: boxes (B, K, 7+e), scores
     (B, K) with -inf padding, labels (B, K) 1-indexed. The fast path for
     models exposing decode_topk (top-k on raw logits before any box
     decode, so only K boxes are ever decoded instead of the full anchor

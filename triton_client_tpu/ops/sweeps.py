@@ -1,0 +1,116 @@
+"""Multi-sweep point-cloud aggregation (nuScenes 10-sweep semantics).
+
+The reference's CenterPoint path is explicitly the 10-sweep config
+(data/nusc_centerpoint_pp_02voxel_two_pfn_10sweep.py; its client zero-
+pads a time column onto single sweeps, clients/preprocess/voxelize.py:
+38-40 — the degenerate 1-sweep case of this module). Upstream det3d
+stacks the keyframe with up to 9 prior sweeps, each transformed into
+the keyframe's sensor frame, and appends a per-point time-lag channel
+Δt = t_key - t_sweep so the network can infer motion (the velocity
+head's input signal).
+
+Host-side numpy: aggregation is stream prep (like JPEG decode on the
+2D path), the padded result feeds the jitted pipeline whose VFE takes
+``VoxelConfig.point_features = 5`` columns.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Sequence
+
+import numpy as np
+
+
+def aggregate_sweeps(
+    sweeps: Sequence[np.ndarray],
+    times: Sequence[float] | None = None,
+    transforms: Sequence[np.ndarray] | None = None,
+) -> np.ndarray:
+    """Stack sweeps (keyframe FIRST) into one (N, 5) cloud
+    [x, y, z, intensity, Δt].
+
+    Args:
+      sweeps: per-sweep (M_i, >=3) arrays, newest (keyframe) first; a
+        missing intensity column is zero-filled.
+      times: per-sweep timestamps (seconds). Δt_i = times[0] - times[i]
+        (keyframe lag 0; older sweeps positive). None -> all zeros (the
+        reference's single-sweep zero-pad).
+      transforms: optional per-sweep (4, 4) homogeneous transforms
+        mapping sweep i's sensor frame into the KEYFRAME's frame (ego
+        motion compensation; identity for the keyframe). None -> static
+        platform assumed.
+    """
+    if not sweeps:
+        raise ValueError("aggregate_sweeps needs at least one sweep")
+    if times is not None and len(times) != len(sweeps):
+        raise ValueError(f"{len(times)} times for {len(sweeps)} sweeps")
+    if transforms is not None and len(transforms) != len(sweeps):
+        raise ValueError(f"{len(transforms)} transforms for {len(sweeps)} sweeps")
+
+    parts = []
+    t0 = times[0] if times is not None else 0.0
+    for i, sweep in enumerate(sweeps):
+        pts = np.asarray(sweep, np.float32)
+        if pts.ndim != 2 or pts.shape[1] < 3:
+            raise ValueError(f"sweep {i}: expected (M, >=3), got {pts.shape}")
+        xyz = pts[:, :3]
+        if transforms is not None:
+            tf = np.asarray(transforms[i], np.float32)
+            xyz = xyz @ tf[:3, :3].T + tf[:3, 3]
+        inten = (
+            pts[:, 3:4]
+            if pts.shape[1] >= 4
+            else np.zeros((len(pts), 1), np.float32)
+        )
+        dt = np.full(
+            (len(pts), 1),
+            (t0 - times[i]) if times is not None else 0.0,
+            np.float32,
+        )
+        parts.append(np.concatenate([xyz, inten, dt], axis=1))
+    return np.concatenate(parts, axis=0)
+
+
+class SweepBuffer:
+    """Rolling window of the last ``nsweeps`` scans for a live/replay
+    stream: push the newest scan (+ timestamp), get the aggregated
+    (N, 5) cloud with the newest scan as keyframe.
+
+    Without ego poses in the stream (rosbags carry none on the
+    reference's topics) the platform is assumed static — sweeps stack
+    untransformed, which is exact for a stationary sensor and an
+    explicit, documented approximation otherwise."""
+
+    def __init__(self, nsweeps: int = 10):
+        if nsweeps < 1:
+            raise ValueError(f"nsweeps must be >= 1, got {nsweeps}")
+        self.nsweeps = nsweeps
+        self._window: collections.deque = collections.deque(maxlen=nsweeps)
+
+    def push(self, points: np.ndarray, timestamp: float) -> np.ndarray:
+        """Add the newest scan; returns the aggregated cloud (newest
+        first, Δt relative to it)."""
+        self._window.appendleft((np.asarray(points, np.float32), float(timestamp)))
+        sweeps = [p for p, _ in self._window]
+        times = [t for _, t in self._window]
+        return aggregate_sweeps(sweeps, times)
+
+    def __len__(self) -> int:
+        return len(self._window)
+
+
+def sweep_source(source, nsweeps: int):
+    """Wrap a pull-driven FrameSource so each yielded frame's data is
+    the aggregation of the last ``nsweeps`` scans (Δt from the frames'
+    own timestamps). Identity when nsweeps == 1 — single sweeps still
+    gain their zero Δt column from the pipeline's column pad."""
+    import dataclasses
+
+    if nsweeps <= 1:
+        yield from source
+        return
+    buf = SweepBuffer(nsweeps)
+    for frame in source:
+        agg = buf.push(np.asarray(frame.data), frame.timestamp)
+        yield dataclasses.replace(frame, data=agg)

@@ -42,6 +42,12 @@ class VoxelConfig:
     voxel_size: tuple[float, float, float] = (0.16, 0.16, 4.0)
     max_voxels: int = 16000
     max_points_per_voxel: int = 32
+    # Raw per-point features fed to the VFE: 4 = [x, y, z, intensity]
+    # (KITTI), 5 adds the sweep time-lag channel Δt (nuScenes 10-sweep
+    # aggregation, reference data/nusc_centerpoint_pp_02voxel_two_pfn_
+    # 10sweep.py + clients/preprocess/voxelize.py:38-47 where single
+    # sweeps get a zero-padded time column).
+    point_features: int = 4
 
     @property
     def grid_size(self) -> tuple[int, int, int]:

@@ -52,6 +52,12 @@ class Detect3DConfig:
     # adds 1.5 m for its lidar mount)
     z_offset: float = 0.0
     class_names: tuple[str, ...] = ("Car", "Pedestrian", "Cyclist")
+    # Sweeps aggregated per inference by the stream layer (ops/sweeps
+    # .py sweep_source): 1 = single scan (KITTI), 10 = the reference's
+    # nuScenes CenterPoint config. The pipeline itself always consumes
+    # ONE aggregated cloud; this field carries the dataset default to
+    # the CLI/driver layer.
+    nsweeps: int = 1
     # VFE routing: "auto" uses the model's sort-free from_points path
     # when it has one — pillar models on nz == 1 grids, plus models
     # that declare scatter_any_nz (SECOND's mean VFE keys on the full
@@ -131,8 +137,14 @@ class Detect3DPipeline:
             )
         else:
             pred = self.model.decode(heads)
+            boxes = pred["boxes"]
+            if "velocity" in pred:
+                # ride-along columns: velocity survives NMS packing and
+                # surfaces as pred_velocities (the det3d wire carries
+                # vx/vy the same way for CenterPoint)
+                boxes = jnp.concatenate([boxes, pred["velocity"]], axis=-1)
             dets, valid = extract_boxes_3d(
-                pred["boxes"],
+                boxes,
                 pred["scores"],
                 score_thresh=cfg.score_thresh,
                 iou_thresh=cfg.iou_thresh,
@@ -166,7 +178,14 @@ class Detect3DPipeline:
             )
         # astype(copy=True default) always returns a fresh array, so the
         # in-place z shift below never aliases caller memory.
-        points = points[:, :4].astype(np.float32)
+        pf = self.model.cfg.voxel.point_features
+        points = points[:, :pf].astype(np.float32)
+        if points.shape[1] < pf:
+            # narrower cloud than the model's VFE contract: zero-fill
+            # the missing trailing channels — a single sweep's Δt=0,
+            # exactly the reference's zero-padded time column
+            # (clients/preprocess/voxelize.py:38-40)
+            points = np.pad(points, ((0, 0), (0, pf - points.shape[1])))
         if self.config.z_offset:
             points[:, 2] += self.config.z_offset
         padded, m = pad_points(points, budget)
@@ -175,11 +194,17 @@ class Detect3DPipeline:
         def resolve() -> dict[str, np.ndarray]:
             d, v = np.asarray(dets), np.asarray(valid)
             live = d[v]
-            return {
+            # rows are [box7, extras..., score, label]; extras width 2
+            # is CenterPoint's (vx, vy)
+            w = live.shape[1]
+            out = {
                 "pred_boxes": live[:, :7],
-                "pred_scores": live[:, 7],
-                "pred_labels": live[:, 8].astype(np.int32),
+                "pred_scores": live[:, w - 2],
+                "pred_labels": live[:, w - 1].astype(np.int32),
             }
+            if w == 11:
+                out["pred_velocities"] = live[:, 7:9]
+            return out
 
         return InferFuture(resolve)
 
@@ -197,17 +222,21 @@ def _detect3d_spec(
     cfg: Detect3DConfig, model_cfg, extra: dict | None = None
 ) -> ModelSpec:
     """Serving spec shared by every 3D pipeline (the analogue of
-    examples/pointpillar_kitti/config.pbtxt + examples/second_iou)."""
+    examples/pointpillar_kitti/config.pbtxt + examples/second_iou).
+    Detection rows are [box7, extras..., score, label]; CenterPoint's
+    velocity rides as 2 extra columns."""
+    n_extra = 2 if (extra or {}).get("with_velocity") else 0
+    pf = model_cfg.voxel.point_features
     return ModelSpec(
         name=cfg.model_name,
         version="1",
         platform="jax",
         inputs=(
-            TensorSpec("points", (-1, 4), "FP32"),
+            TensorSpec("points", (-1, pf), "FP32"),
             TensorSpec("num_points", (), "INT32"),
         ),
         outputs=(
-            TensorSpec("detections", (cfg.max_det, 9), "FP32"),
+            TensorSpec("detections", (cfg.max_det, 9 + n_extra), "FP32"),
             TensorSpec("valid", (cfg.max_det,), "BOOL"),
         ),
         extra={
@@ -281,9 +310,11 @@ def build_centerpoint_pipeline(
     """CenterPoint-pillar, nuScenes config (the reference's det3d path,
     clients/preprocess/voxelize.py + data/nusc_centerpoint_pp...py).
     decode emits one-hot class scores so the shared rotated-NMS
-    postprocess applies unchanged; with_velocity is dropped at the
-    packed-detection boundary (the reference's 3D wire contract carries
-    boxes/scores/labels only, clients/detector_3d_client.py:29-34)."""
+    postprocess applies unchanged; with_velocity rides through the
+    packed rows as 2 extra columns and surfaces as pred_velocities
+    (the reference's base 3D wire carries boxes/scores/labels only,
+    clients/detector_3d_client.py:29-34 — velocity is the det3d
+    extension this config exists for)."""
     from triton_client_tpu.models.centerpoint import (
         CenterPointConfig,
         CenterPoint,
