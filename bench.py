@@ -6,13 +6,19 @@ PointPillars, SECOND-IoU) go to stderr and BENCH_LOCAL.json.
 
 Methodology (round 2 — trustworthy numbers over the remote-chip tunnel):
 
-* Every timed call is CHAINED: a scalar token computed from the full
-  output is folded into the next call's input, so successive dispatches
-  cannot overlap or be elided, and a single float() readback of the
-  last token forces completion of the whole trial. On this container's
-  tunnel, ``jax.block_until_ready`` can acknowledge repeated identical
+* Every timed call is CHAINED through a scalar token computed from the
+  full output, so successive calls cannot overlap or be elided, and a
+  float() readback forces completion. On this container's tunnel,
+  ``jax.block_until_ready`` can acknowledge repeated identical
   dispatches early (phantom ~0.02 ms timings) — forced scalar readback
   is the only reliable fence.
+* Throughput trials run the chained rep-loop INSIDE one jit
+  (lax.fori_loop): the tunnel charges ~5 ms per DISPATCH (measured: a
+  trivial scalar add costs the same as a full pipeline call when
+  dispatched individually), so per-dispatch timing measures the tunnel,
+  not the chip. One dispatch per trial + one readback amortizes that
+  overhead to noise; per-request latency (which legitimately pays
+  dispatch + RTT) is reported separately from single-dispatch calls.
 * Configs are INTERLEAVED round-robin (A/B/A/B...) and the reported
   value is the median across trials, so slow tunnel phases hit all
   configs equally instead of biasing one.
@@ -43,7 +49,6 @@ import jax.numpy as jnp
 import numpy as np
 
 BATCH = 8
-WARMUP = 5
 TRIALS = 12          # interleaved rounds per config
 REPS = 25            # chained dispatches per trial
 LAT_CALLS = 30       # single-call latency samples (readback per call)
@@ -68,14 +73,19 @@ def _tunnel_rtt_ms() -> float:
 
 
 class Config:
-    """One benchmarked pipeline: a jitted ``step(tok) -> tok`` whose
-    scalar token chains successive dispatches (no overlap, no elision)
-    plus bookkeeping to turn trial times into the output dict."""
+    """One benchmarked pipeline: ``one(tok) -> tok`` chains the full
+    pipeline through a scalar token. Throughput runs REPS chained
+    iterations inside ONE jitted fori_loop dispatch; latency uses the
+    single-step jit (a real per-request dispatch)."""
 
-    def __init__(self, name, metric, step, unit_per_call, baseline_hz):
+    def __init__(self, name, metric, one, unit_per_call, baseline_hz):
         self.name = name
         self.metric = metric
-        self.step = step                  # tok -> tok, jitted
+        self.one = one
+        self.step = jax.jit(one)          # single-dispatch form (latency)
+        self.looped = jax.jit(
+            lambda tok: jax.lax.fori_loop(0, REPS, lambda i, t: one(t), tok)
+        )
         self.unit_per_call = unit_per_call  # frames (batch) or scans per call
         self.baseline_hz = baseline_hz
         self.trial_ms = []                # per-call ms, one entry per trial
@@ -83,9 +93,10 @@ class Config:
 
     def warmup(self):
         tok = jnp.float32(0.0)
-        for _ in range(WARMUP):
-            tok = self.step(tok)
+        for _ in range(2):
+            tok = self.looped(tok)
         float(tok)
+        float(self.step(jnp.float32(0.0)))
         try:
             cost = self.step.lower(jnp.float32(0.0)).compile().cost_analysis()
             if cost and cost.get("flops"):
@@ -96,9 +107,8 @@ class Config:
     def run_trial(self):
         tok = jnp.float32(0.0)
         t0 = time.perf_counter()
-        for _ in range(REPS):
-            tok = self.step(tok)
-        float(tok)  # forces the whole chained trial
+        tok = self.looped(tok)  # REPS chained calls, ONE dispatch
+        float(tok)
         self.trial_ms.append((time.perf_counter() - t0) * 1e3 / REPS)
 
     def latency_profile(self):
@@ -136,7 +146,7 @@ class Config:
         return out
 
 
-def make_yolov5(dtype=None) -> Config:
+def make_yolov5(dtype=None, batch=BATCH) -> Config:
     from triton_client_tpu.models.yolov5 import init_yolov5
     from triton_client_tpu.ops.detect_postprocess import extract_boxes
     from triton_client_tpu.ops.preprocess import normalize_image
@@ -148,10 +158,9 @@ def make_yolov5(dtype=None) -> Config:
     )
     rng = np.random.default_rng(0)
     frames = jnp.asarray(
-        rng.integers(0, 255, (BATCH, *input_hw, 3)).astype(np.float32)
+        rng.integers(0, 255, (batch, *input_hw, 3)).astype(np.float32)
     )
 
-    @jax.jit
     def step(tok):
         x = normalize_image(frames + tok * 0.0, "yolo")
         pred = model.decode(model.apply(variables, x, train=False))
@@ -159,11 +168,13 @@ def make_yolov5(dtype=None) -> Config:
         # token depends on every output row -> readback fences the call
         return (jnp.sum(valid) + jnp.sum(dets) * 1e-12).astype(jnp.float32)
 
-    suffix = "_bf16" if dtype == jnp.bfloat16 else ""
+    suffix = ("_bf16" if dtype == jnp.bfloat16 else "") + (
+        f"_b{batch}" if batch != BATCH else ""
+    )
     return Config(
         f"yolov5n{suffix}",
         f"yolov5n_512{suffix}_e2e_frames_per_sec_per_chip",
-        step, BATCH, CAMERA_FPS_BASELINE,
+        step, batch, CAMERA_FPS_BASELINE,
     )
 
 
@@ -183,7 +194,6 @@ def _make_3d(pipeline, point_budget, name, metric) -> Config:
 
     inner = pipeline._jit
 
-    @jax.jit
     def step(tok):
         dets, valid = inner(pj + tok * 0.0, mj)
         return (jnp.sum(valid) + jnp.sum(dets) * 1e-12).astype(jnp.float32)
@@ -262,6 +272,10 @@ def main() -> None:
     configs = [make_yolov5()]
     for label, factory in (
         ("yolov5n_bf16", lambda: make_yolov5(dtype=jnp.bfloat16)),
+        # max-throughput config: batch amortizes the small-channel
+        # convs' fixed overhead (b8 ~800 -> b64 ~3200 fps measured);
+        # b8 stays primary for round-over-round continuity
+        ("yolov5n_b64", lambda: make_yolov5(batch=64)),
         ("pointpillars", make_pointpillars),
         ("second_iou", make_second),
     ):

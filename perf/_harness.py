@@ -1,0 +1,67 @@
+"""Shared on-chip timing harness for the perf/ scripts.
+
+Methodology (BASELINE.md "measurement campaign"): the tunnel charges
+~5 ms per dispatch and `block_until_ready` can acknowledge repeated
+identical dispatches early, so a trustworthy trial runs INNER chained
+iterations INSIDE one jit (`lax.fori_loop` over a scalar token computed
+from the full output) and pays one dispatch + one forced `float()`
+readback. Run configs interleaved and compare medians; any future
+tunnel-quirk fix belongs HERE, not copy-pasted per script.
+"""
+
+import os
+import statistics
+import sys
+import time
+
+# perf/ scripts run as `python perf/<script>.py` from the repo root;
+# make the package importable without PYTHONPATH (which breaks the
+# axon TPU plugin discovery — see .claude/skills/verify/SKILL.md).
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+
+def tokify(*outs) -> jnp.ndarray:
+    """Scalar fencing token depending on every output element."""
+    return sum(
+        jnp.sum(o) * 1e-12 for o in jax.tree.leaves(outs)
+    ).astype(jnp.float32)
+
+
+def compile_looped(one, inner: int):
+    """jit of `inner` chained iterations of ``one(tok) -> tok``; warmed."""
+    looped = jax.jit(
+        lambda tok: jax.lax.fori_loop(0, inner, lambda i, t: one(t), tok)
+    )
+    tok = jnp.float32(0.0)
+    for _ in range(2):
+        tok = looped(tok)
+    float(tok)
+    return looped
+
+
+def run_trials(cases, inner: int, outer: int = 2, trials: int = 6) -> dict:
+    """cases: [(name, looped_jit)]. Interleaved rounds; returns
+    {name: median ms-per-inner-iteration} and prints each line."""
+    acc = {name: [] for name, _ in cases}
+    for _ in range(trials):
+        for name, step in cases:
+            tok = jnp.float32(0.0)
+            t0 = time.perf_counter()
+            for _ in range(outer):
+                tok = step(tok)
+            float(tok)
+            acc[name].append((time.perf_counter() - t0) * 1e3 / (outer * inner))
+    out = {}
+    for name, _ in cases:
+        out[name] = statistics.median(acc[name])
+        print(f"{name:46s} {out[name]:8.3f} ms", file=sys.stderr)
+    return out
+
+
+def timed(name, one, inner: int = 10, outer: int = 2, trials: int = 6) -> float:
+    """One-off: compile + run a single case."""
+    looped = compile_looped(one, inner)
+    return run_trials([(name, looped)], inner, outer, trials)[name]

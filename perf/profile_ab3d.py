@@ -1,0 +1,106 @@
+"""Device-true A/B for the PointPillars 3D pipeline (in-jit rep loop).
+
+Round-1 claimed scatter-add + scatter-max ≈ 9.2 of ~13 ms — but with
+the same per-dispatch methodology whose 2D numbers proved phantom.
+Variants here bound the scatters' true in-context cost:
+  * full          — the shipping sort-free scatter path
+  * grouped       — the (V, K) sort-based voxelizer path
+  * no-scatters   — both grid scatters replaced by shape-preserving
+    non-scatter math (canvas from a reshape; mean from a global sum):
+    NOT numerically meaningful, purely the everything-else floor.
+"""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from _harness import compile_looped, run_trials, tokify
+
+INNER = 10
+
+from triton_client_tpu.dataset_config import detect3d_from_yaml
+from triton_client_tpu.models.pointpillars import scatter_max_canvas
+from triton_client_tpu.ops.voxelize import assign_cells, pad_points
+from triton_client_tpu.pipelines.detect3d import (
+    Detect3DConfig,
+    build_pointpillars_pipeline,
+)
+import dataclasses
+
+_, model_cfg, pipe_cfg = detect3d_from_yaml("data/kitti_pointpillars.yaml")
+pipe, _, variables = build_pointpillars_pipeline(
+    jax.random.PRNGKey(0), model_cfg=model_cfg, config=pipe_cfg
+)
+grouped_pipe, _, _ = build_pointpillars_pipeline(
+    model_cfg=model_cfg,
+    config=dataclasses.replace(pipe_cfg, vfe="grouped"),
+    variables=variables,
+)
+model = pipe.model
+voxel = model.cfg.voxel
+nx, ny, _ = voxel.grid_size
+
+rng = np.random.default_rng(0)
+n_pts = 120_000
+r = voxel.point_cloud_range
+pts = np.stack(
+    [
+        rng.uniform(r[0], r[3], n_pts),
+        rng.uniform(r[1], r[4], n_pts),
+        rng.uniform(r[2], r[5], n_pts),
+        rng.uniform(0, 1, n_pts),
+    ],
+    axis=1,
+).astype(np.float32)
+padded, m = pad_points(pts, max(pipe_cfg.point_buckets))
+pj, mj = jnp.asarray(padded), jnp.asarray(m)
+
+
+def full_one(tok):
+    dets, valid = pipe._jit(pj + tok * 0.0, mj)
+    return tokify(dets, valid)
+
+
+def grouped_one(tok):
+    dets, valid = grouped_pipe._jit(pj + tok * 0.0, mj)
+    return tokify(dets, valid)
+
+
+def noscatter_one(tok):
+    """Everything-else floor: same VFE math, no grid scatters."""
+    p = pj + tok * 0.0
+    xyz = p[:, :3]
+    ijk, valid = assign_cells(p, mj, voxel)
+    mean = jnp.mean(xyz, axis=0, keepdims=True)  # fake (global) mean
+    vs = jnp.asarray(voxel.voxel_size)
+    r0 = jnp.asarray(voxel.point_cloud_range[:3])
+    centers = (ijk.astype(jnp.float32) + 0.5) * vs + r0
+    feats = jnp.concatenate([p[:, :4], xyz - mean, xyz - centers], axis=1)
+    feats = jnp.where(valid[:, None], feats, 0.0)
+    x = model.apply(
+        variables, feats, method=lambda mdl, f: mdl.vfe.encode(f, False)
+    )
+    # canvas from a reshape: (ny*nx, C) rows taken round-robin from
+    # point features — shape-correct, numerically meaningless
+    canvas = jnp.resize(x, (ny * nx, x.shape[-1])).reshape(ny, nx, -1)
+    heads = model.apply(
+        variables, canvas[None], False, method=lambda mdl, c, t: mdl._heads(c, t)
+    )
+    return tokify(heads)
+
+
+CASES = [
+    ("full (scatter VFE)", full_one),
+    ("grouped (sort VFE)", grouped_one),
+    ("no-scatters floor ", noscatter_one),
+]
+steps = []
+for name, one in CASES:
+    t0 = time.perf_counter()
+    steps.append((name, compile_looped(one, INNER)))
+    print(f"compiled {name} in {time.perf_counter() - t0:.0f}s", file=sys.stderr)
+
+for n, ms in run_trials(steps, INNER).items():
+    print(f"{n}  {1000 / ms:6.1f} scans/s", file=sys.stderr)

@@ -1,0 +1,92 @@
+"""Micro-profile of the 2D postprocess + NMS variants on the live chip."""
+
+import _harness  # noqa: F401  (sys.path bootstrap)
+import os
+import statistics
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+REPS, TRIALS = 20, 7
+
+
+def timed(name, step):
+    tok = jnp.float32(0.0)
+    for _ in range(3):
+        tok = step(tok)
+    float(tok)
+    trials = []
+    for _ in range(TRIALS):
+        tok = jnp.float32(0.0)
+        t0 = time.perf_counter()
+        for _ in range(REPS):
+            tok = step(tok)
+        float(tok)
+        trials.append((time.perf_counter() - t0) * 1e3 / REPS)
+    print(f"{name:46s} {statistics.median(trials):8.3f} ms", file=sys.stderr)
+
+
+rng = np.random.default_rng(0)
+pred = jnp.asarray(rng.standard_normal((8, 16128, 7)).astype(np.float32))
+# plausible decoded values: centers in [0,512], sizes, sigmoided scores
+pred = pred.at[..., :4].set(jnp.abs(pred[..., :4]) * 60 + 10)
+pred = pred.at[..., 4:].set(jax.nn.sigmoid(pred[..., 4:]))
+
+from triton_client_tpu.ops.detect_postprocess import extract_boxes
+from triton_client_tpu.ops.nms import _nms_fixpoint, _nms_xla
+from triton_client_tpu.ops.pallas_nms import nms_pallas
+
+
+@jax.jit
+def gate_topk_only(tok):
+    p = pred + tok * 0.0
+    boxes = p[..., :4]
+    conf = p[..., 4:5] * p[..., 5:]
+    scores = jnp.max(conf, axis=-1)
+    gated = jnp.where(scores > 0.3, scores, -jnp.inf)
+    top_scores, top_idx = jax.lax.top_k(gated, 1024)
+    return (jnp.sum(top_scores) * 1e-12 + jnp.sum(top_idx) * 1e-12).astype(
+        jnp.float32
+    )
+
+
+@jax.jit
+def full_extract(tok):
+    dets, valid = extract_boxes(
+        pred + tok * 0.0, conf_thresh=0.3, iou_thresh=0.45
+    )
+    return (jnp.sum(valid) + jnp.sum(dets) * 1e-12).astype(jnp.float32)
+
+
+# isolated NMS variants on (8, 1024) candidates
+cboxes = jnp.asarray(rng.uniform(0, 512, (8, 1024, 4)).astype(np.float32))
+cboxes = cboxes.at[..., 2:].set(cboxes[..., :2] + rng.uniform(8, 96, (8, 1024, 2)))
+cscores = jnp.asarray(rng.uniform(0, 1, (8, 1024)).astype(np.float32))
+
+
+def variant(fn):
+    @jax.jit
+    def step(tok):
+        idx, valid = jax.vmap(lambda b, s: fn(b + tok * 0.0, s))(cboxes, cscores)
+        return (jnp.sum(idx) * 1e-12 + jnp.sum(valid)).astype(jnp.float32)
+
+    return step
+
+
+timed("gate + conf + top_k(16128->1024) only", gate_topk_only)
+timed("extract_boxes full (fixpoint nms)", full_extract)
+timed(
+    "nms fixpoint (8x1024)",
+    variant(lambda b, s: _nms_fixpoint(b, s, 0.45, max_det=300)),
+)
+timed(
+    "nms xla loop (8x1024)",
+    variant(lambda b, s: _nms_xla(b, s, 0.45, max_det=300)),
+)
+timed(
+    "nms pallas (8x1024)",
+    variant(lambda b, s: nms_pallas(b, s, iou_thresh=0.45, max_det=300)),
+)

@@ -170,3 +170,66 @@ def test_batched_nms_normalized_boxes_high_class_id():
     classes = jnp.asarray([79, 79, 79])
     _, valid = batched_nms(boxes, scores, classes, 0.5, max_det=10)
     assert np.asarray(valid).sum() == 2
+
+
+class TestFixpointEquivalence:
+    """The fixpoint matrix formulation must reproduce the sequential
+    greedy loop EXACTLY — indices, order, tie breaks, padding."""
+
+    def _check(self, boxes, scores, thresh=0.45, max_det=32):
+        from triton_client_tpu.ops.nms import _nms_fixpoint, _nms_xla
+
+        fi, fv = _nms_fixpoint(
+            jnp.asarray(boxes), jnp.asarray(scores), thresh, max_det=max_det
+        )
+        xi, xv = _nms_xla(
+            jnp.asarray(boxes), jnp.asarray(scores), thresh, max_det=max_det
+        )
+        np.testing.assert_array_equal(np.asarray(fv), np.asarray(xv))
+        np.testing.assert_array_equal(
+            np.asarray(fi)[np.asarray(fv)], np.asarray(xi)[np.asarray(xv)]
+        )
+
+    def test_random_fuzz(self, rng):
+        for trial in range(20):
+            n = int(rng.integers(4, 200))
+            centers = rng.uniform(20, 200, (n, 2))
+            wh = rng.uniform(5, 80, (n, 2))
+            boxes = np.concatenate([centers - wh / 2, centers + wh / 2], 1)
+            scores = rng.uniform(0.01, 1, n).astype(np.float32)
+            for thresh in (0.2, 0.5, 0.8):
+                self._check(boxes.astype(np.float32), scores, thresh)
+
+    def test_suppression_chain_revival(self):
+        """A > B > C where A kills B, B would kill C, A doesn't touch C:
+        greedy keeps C (its suppressor died) — the case a naive
+        one-pass matrix NMS gets wrong."""
+        boxes = np.array(
+            [[0, 0, 10, 10], [4, 0, 14, 10], [9, 0, 19, 10]], np.float32
+        )
+        scores = np.array([0.9, 0.8, 0.7], np.float32)
+        self._check(boxes, scores, thresh=0.3)
+        from triton_client_tpu.ops.nms import _nms_fixpoint
+
+        idx, valid = _nms_fixpoint(
+            jnp.asarray(boxes), jnp.asarray(scores), 0.3, max_det=3
+        )
+        np.testing.assert_array_equal(np.asarray(idx)[np.asarray(valid)], [0, 2])
+
+    def test_score_ties_break_by_index(self):
+        boxes = np.array(
+            [[0, 0, 10, 10], [100, 100, 110, 110], [0, 0, 10, 10]], np.float32
+        )
+        scores = np.array([0.5, 0.5, 0.5], np.float32)
+        self._check(boxes, scores, thresh=0.5)
+
+    def test_padding_and_max_det_cap(self, rng):
+        n = 64
+        centers = rng.uniform(20, 100, (n, 2))
+        wh = rng.uniform(5, 30, (n, 2))
+        boxes = np.concatenate([centers - wh / 2, centers + wh / 2], 1).astype(
+            np.float32
+        )
+        scores = rng.uniform(0.1, 1, n).astype(np.float32)
+        scores[standing := rng.integers(0, n, 20)] = -np.inf  # padded slots
+        self._check(boxes, scores, thresh=0.4, max_det=5)  # cap < kept count

@@ -165,7 +165,7 @@ class CenterPoint(nn.Module):
         nx, ny, _ = self.cfg.voxel.grid_size
         feats, vid, valid, cnt = augment_points(points, count, self.cfg.voxel)
         x = self.vfe.encode(feats, train)
-        canvas = scatter_max_canvas(x, vid, valid, cnt, (ny, nx))
+        canvas = scatter_max_canvas(x, vid, valid, (ny, nx))
         return self.head(self.backbone(canvas[None], train), train)
 
     def decode(self, heads: dict[str, jnp.ndarray]) -> dict[str, jnp.ndarray]:

@@ -31,27 +31,35 @@ import jax.numpy as jnp
 from triton_client_tpu.ops.boxes import box_area
 
 
-def _use_pallas(n: int, max_det: int) -> bool:
-    """Route to the Pallas kernel on TPU (env override:
-    TRITON_CLIENT_TPU_NMS=pallas|xla). Decided at trace time — shapes
-    are static under jit, so the choice is baked into the executable."""
-    mode = os.environ.get("TRITON_CLIENT_TPU_NMS", "auto")
-    if mode == "xla":
-        return False
-    from triton_client_tpu.ops.pallas_nms import vmem_fits
+# The (N, N) IoU matrix the fixpoint formulation materializes: 4 bytes
+# x N^2 — 64 MB at 4096, past which the sequential loop wins on memory.
+_FIXPOINT_MAX_N = 4096
 
-    fits = vmem_fits(n, max_det)
+
+def _nms_mode(n: int, max_det: int) -> str:
+    """Route between the NMS formulations (env override:
+    TRITON_CLIENT_TPU_NMS=fixpoint|pallas|xla). Decided at trace time —
+    shapes are static under jit, so the choice is baked into the
+    executable. Auto: the fixpoint matrix form (sequential-step count =
+    suppression-chain depth, not max_det) whenever the IoU matrix is
+    affordable; the sequential XLA loop otherwise."""
+    mode = os.environ.get("TRITON_CLIENT_TPU_NMS", "auto")
+    if mode in ("xla", "fixpoint"):
+        return mode
     if mode == "pallas":
-        if not fits:
+        from triton_client_tpu.ops.pallas_nms import vmem_fits
+
+        if not vmem_fits(n, max_det):
             import logging
 
             logging.getLogger(__name__).warning(
                 "TRITON_CLIENT_TPU_NMS=pallas but n=%d exceeds the VMEM "
-                "budget; falling back to the XLA loop",
+                "budget; falling back to the fixpoint form",
                 n,
             )
-        return fits
-    return jax.default_backend() == "tpu" and fits
+            return "fixpoint" if n <= _FIXPOINT_MAX_N else "xla"
+        return "pallas"
+    return "fixpoint" if n <= _FIXPOINT_MAX_N else "xla"
 
 
 def _iou_row(
@@ -79,12 +87,15 @@ def nms(
     (arbitrary where invalid) and a (max_det,) bool mask. Slots whose
     input score is -inf (padding) are never selected.
 
-    Backend routing (XLA loop vs Pallas kernel) happens at TRACE time:
-    callers jitted around this see the choice baked into their
-    executable until retrace (TRITON_CLIENT_TPU_NMS env override).
+    Formulation routing (fixpoint matrix form / Pallas kernel /
+    sequential XLA loop) happens at TRACE time: callers jitted around
+    this see the choice baked into their executable until retrace
+    (TRITON_CLIENT_TPU_NMS env override). All three produce identical
+    kept-index sequences.
     """
     n = boxes.shape[0]
-    if _use_pallas(n, max_det):
+    mode = _nms_mode(n, max_det)
+    if mode == "pallas":
         from triton_client_tpu.ops.pallas_nms import nms_pallas
 
         return nms_pallas(
@@ -95,7 +106,88 @@ def nms(
             # Off-TPU (forced via env) the kernel runs interpreted.
             interpret=jax.default_backend() != "tpu",
         )
+    if mode == "fixpoint":
+        return _nms_fixpoint(boxes, scores, iou_thresh, max_det=max_det)
     return _nms_xla(boxes, scores, iou_thresh, max_det=max_det)
+
+
+@functools.partial(jax.jit, static_argnames=("max_det",))
+def _nms_fixpoint(
+    boxes: jnp.ndarray,
+    scores: jnp.ndarray,
+    iou_thresh: float = 0.45,
+    max_det: int = 300,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Exact greedy NMS as a suppression-graph fixpoint — the TPU-shaped
+    formulation.
+
+    The textbook greedy loop (argmax -> suppress -> repeat, `_nms_xla`)
+    runs max_det tiny sequential steps; on TPU each step is
+    latency-bound, so 300 iterations dominate the whole 2D pipeline.
+    Greedy NMS is equivalently the unique fixpoint of
+
+        kept_i = valid_i and not any(edge_ji and kept_j)
+
+    over the score-ordered suppression DAG (edge_ji: j outscores i and
+    IoU > thresh). Iterating that recurrence finalizes one DAG layer
+    per pass, so it converges in max-chain-depth passes (single digits
+    in practice) of WIDE (N, N) vector ops instead of max_det narrow
+    ones. Equivalence to the sequential loop (incl. first-index tie
+    breaks) is pinned by tests against `_nms_xla` and OpenCV's C++ NMS.
+    """
+    neg_inf = jnp.asarray(-jnp.inf, scores.dtype)
+    # Stable descending score order reproduces argmax's first-max-wins
+    # tie break; -inf rows (padding) sink to the bottom.
+    order = jnp.argsort(-scores, stable=True).astype(jnp.int32)
+    sboxes = boxes[order].astype(jnp.float32)
+    valid0 = scores[order] > neg_inf
+
+    areas = box_area(sboxes)
+    lt = jnp.maximum(sboxes[:, None, :2], sboxes[None, :, :2])
+    rb = jnp.minimum(sboxes[:, None, 2:], sboxes[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0.0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    iou = inter / jnp.maximum(areas[:, None] + areas[None, :] - inter, 1e-9)
+    return fixpoint_keep_sorted(iou, valid0, order, iou_thresh, max_det)
+
+
+def fixpoint_keep_sorted(
+    siou: jnp.ndarray,
+    valid0: jnp.ndarray,
+    order: jnp.ndarray,
+    iou_thresh,
+    max_det: int,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Fixpoint core shared by axis-aligned and rotated-BEV NMS:
+    ``siou`` is the (N, N) IoU matrix of SCORE-SORTED candidates,
+    ``valid0`` their live mask, ``order`` the sorted->original index
+    map. Returns the sequential loop's ((max_det,) indices into the
+    ORIGINAL array, valid) contract."""
+    n = siou.shape[0]
+    # edge[j, i]: j (strictly higher-ranked) suppresses i when kept
+    rank = jnp.arange(n)
+    edge = (siou > iou_thresh) & (rank[:, None] < rank[None, :]) & valid0[:, None]
+
+    def cond(state):
+        kept, prev, it = state
+        return (it < n) & jnp.any(kept != prev)
+
+    def body(state):
+        kept, _, it = state
+        new = valid0 & ~jnp.any(edge & kept[:, None], axis=0)
+        return new, kept, it + 1
+
+    kept, _, _ = jax.lax.while_loop(
+        cond, body, (valid0, jnp.zeros_like(valid0), jnp.int32(0))
+    )
+
+    # Pack the first max_det kept (already score-ordered) into the
+    # sequential loop's (indices, valid) contract.
+    kept_rank = jnp.cumsum(kept) - 1
+    slot = jnp.where(kept & (kept_rank < max_det), kept_rank, max_det)
+    indices = jnp.zeros((max_det + 1,), jnp.int32).at[slot].set(order)[:max_det]
+    valid = jnp.arange(max_det) < jnp.sum(kept)
+    return indices, valid
 
 
 @functools.partial(jax.jit, static_argnames=("max_det",))
