@@ -1,0 +1,69 @@
+"""Does batching scans amortize the 3D pipeline like it did 2D?
+
+Multi-lidar serving (several vehicles / sensors per chip) is the 3D
+analogue of the multi-camera batch: vmap the sort-free from_points
+pipeline over B scans and measure scans/s vs B.
+"""
+
+import _harness  # noqa: F401  (sys.path bootstrap)
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from _harness import compile_looped, run_trials, tokify
+from triton_client_tpu.dataset_config import detect3d_from_yaml
+from triton_client_tpu.ops.voxelize import pad_points
+from triton_client_tpu.pipelines.detect3d import build_pointpillars_pipeline
+
+INNER = 10
+
+_, model_cfg, pipe_cfg = detect3d_from_yaml("data/kitti_pointpillars.yaml")
+pipe, _, variables = build_pointpillars_pipeline(
+    jax.random.PRNGKey(0), model_cfg=model_cfg, config=pipe_cfg
+)
+model = pipe.model
+voxel = model.cfg.voxel
+rng = np.random.default_rng(0)
+r = voxel.point_cloud_range
+budget = max(pipe_cfg.point_buckets)
+
+
+def scan():
+    n = 120_000
+    pts = np.stack(
+        [
+            rng.uniform(r[0], r[3], n),
+            rng.uniform(r[1], r[4], n),
+            rng.uniform(r[2], r[5], n),
+            rng.uniform(0, 1, n),
+        ],
+        axis=1,
+    ).astype(np.float32)
+    return pad_points(pts, budget)
+
+
+cases = []
+for b in (1, 2, 4, 8):
+    scans = [scan() for _ in range(b)]
+    pj = jnp.asarray(np.stack([s[0] for s in scans]))
+    mj = jnp.asarray(np.asarray([s[1] for s in scans], np.int32))
+
+    def one(tok, pj=pj, mj=mj):
+        heads = jax.vmap(
+            lambda p, m: model.apply(
+                variables, p, m, train=False, method=model.from_points
+            )
+        )(pj + tok * 0.0, mj)
+        return tokify(heads)
+
+    t0 = time.perf_counter()
+    cases.append((f"b{b}", compile_looped(one, INNER), b))
+    print(f"compiled b{b} in {time.perf_counter() - t0:.0f}s", file=sys.stderr)
+
+res = run_trials([(n, s) for n, s, _ in cases], INNER)
+for name, _, b in cases:
+    ms = res[name]
+    print(f"{name}: {ms:8.2f} ms/call = {b / ms * 1000:6.1f} scans/s", file=sys.stderr)

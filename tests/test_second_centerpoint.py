@@ -368,3 +368,47 @@ def test_second_decode_topk_matches_full_decode_path():
     np.testing.assert_allclose(
         np.asarray(ref_dets), np.asarray(fast_dets), atol=1e-5
     )
+
+
+def test_non_divisible_grid_rejected_at_build():
+    """A voxel size whose BEV grid doesn't divide the composed stride
+    (e.g. 0.15 m over the 70.4x80 m KITTI range -> 469x533) must fail
+    loudly at init, not as a reshape error mid-trace
+    (perf/profile_second_grid.py found the silent variant)."""
+    from triton_client_tpu.models.second import SECONDConfig, init_second
+    from triton_client_tpu.ops.voxelize import VoxelConfig
+
+    bad = SECONDConfig(
+        voxel=VoxelConfig(
+            point_cloud_range=(0.0, -40.0, -3.0, 70.4, 40.0, 1.0),
+            voxel_size=(0.15, 0.15, 0.3),
+            max_voxels=512,
+            max_points_per_voxel=4,
+        )
+    )
+    with pytest.raises(ValueError, match="divisible"):
+        init_second(jax.random.PRNGKey(0), bad)
+
+    # the direct flax path (no init_* wrapper) is guarded too: setup()
+    # validates, so model.init fails loudly before any trace math
+    from triton_client_tpu.models.second import SECONDIoU
+
+    with pytest.raises(ValueError, match="divisible"):
+        SECONDIoU(bad).init(
+            jax.random.PRNGKey(0),
+            jnp.zeros((1, 8, 4, 4)),
+            jnp.zeros((1, 8), jnp.int32),
+            jnp.full((1, 8, 3), -1, jnp.int32),
+            train=False,
+        )
+
+    # 0.1 m divides -> accepted (shape-only check, no forward)
+    ok = SECONDConfig(
+        voxel=VoxelConfig(
+            point_cloud_range=(0.0, -40.0, -3.0, 70.4, 40.0, 1.0),
+            voxel_size=(0.1, 0.1, 0.2),
+            max_voxels=512,
+            max_points_per_voxel=4,
+        )
+    )
+    ok.validate()

@@ -87,6 +87,11 @@ class CenterPointConfig:
         s = self.head_stride
         return ny // s, nx // s
 
+    def validate(self) -> None:
+        from triton_client_tpu.models.pointpillars import validate_bev_divisible
+
+        validate_bev_divisible(self.voxel, int(np.prod(self.backbone_strides)))
+
 
 class CenterHead(nn.Module):
     """Shared 3x3 conv + per-branch 1x1 heads over the BEV features."""
@@ -135,6 +140,7 @@ class CenterPoint(nn.Module):
 
     def setup(self) -> None:
         cfg, dt = self.cfg, self.dtype
+        cfg.validate()
         self.vfe = PillarVFE(cfg.vfe_filters, cfg.voxel, dtype=dt)
         self.backbone = BEVBackbone(cfg, dtype=dt)
         self.head = CenterHead(cfg, dtype=dt)

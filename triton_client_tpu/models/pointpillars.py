@@ -78,6 +78,28 @@ class PointPillarsConfig:
         s = self.head_stride
         return ny // s, nx // s
 
+    def validate(self) -> None:
+        validate_bev_divisible(self.voxel, int(np.prod(self.backbone_strides)))
+
+
+def validate_bev_divisible(voxel: VoxelConfig, stride: int) -> None:
+    """BEV dims must divide the deepest composed downsample exactly:
+    with odd sizes the strided conv (ceil) and the floor-based head
+    grid disagree, and parallel upsample branches of different strides
+    cannot even concatenate — fail loudly at model build instead of a
+    cryptic reshape error mid-trace (seen at 0.15 m voxels: 469x533
+    grid, perf/profile_second_grid.py). Each branch downsamples by
+    prod(strides[:i+1]) before its deconv restores the common scale,
+    so divisibility by the product covers every stage. Shared by the
+    PointPillars/SECOND/CenterPoint configs."""
+    nx, ny, _ = voxel.grid_size
+    if nx % stride or ny % stride:
+        raise ValueError(
+            f"BEV grid {nx}x{ny} (from voxel_size {voxel.voxel_size}) "
+            f"must be divisible by the deepest composed downsample "
+            f"{stride}; pick a voxel size whose grid divides it"
+        )
+
 
 def generate_anchors(cfg: PointPillarsConfig) -> jnp.ndarray:
     """Dense anchor grid (H, W, A, 7) [x, y, z, dx, dy, dz, rot] in
@@ -364,6 +386,7 @@ class PointPillars(nn.Module):
 
     def setup(self) -> None:
         cfg, dt = self.cfg, self.dtype
+        cfg.validate()
         self.vfe = PillarVFE(cfg.vfe_filters, cfg.voxel, dtype=dt)
         self.backbone = BEVBackbone(cfg, dtype=dt)
         a = cfg.anchors_per_loc

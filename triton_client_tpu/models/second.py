@@ -91,6 +91,13 @@ class SECONDConfig:
         s = self.head_stride
         return ny // s, nx // s
 
+    def validate(self) -> None:
+        from triton_client_tpu.models.pointpillars import validate_bev_divisible
+
+        validate_bev_divisible(
+            self.voxel, self.middle_stride * int(np.prod(self.backbone_strides))
+        )
+
 
 def scatter_to_volume(
     voxel_feats: jnp.ndarray,  # (V, C)
@@ -161,6 +168,7 @@ class SECONDIoU(nn.Module):
 
     def setup(self) -> None:
         cfg, dt = self.cfg, self.dtype
+        cfg.validate()
         self.vfe = MeanVFE()
         self.middle = DenseMiddleEncoder(cfg.middle_filters, dtype=dt)
         self.backbone = BEVBackbone(cfg, dtype=dt)
