@@ -2,7 +2,8 @@
 
 Prints ONE JSON line (the driver's contract): the primary metric is the
 YOLOv5n 512x512 fused end-to-end pipeline. Secondary metrics (bf16,
-PointPillars, SECOND-IoU) go to stderr and BENCH_LOCAL.json.
+batch-64, PointPillars, SECOND-IoU, CenterPoint 10-sweep) go to stderr
+and BENCH_LOCAL.json.
 
 Methodology (round 2 — trustworthy numbers over the remote-chip tunnel):
 
@@ -178,18 +179,22 @@ def make_yolov5(dtype=None, batch=BATCH) -> Config:
     )
 
 
-def _make_3d(pipeline, point_budget, name, metric) -> Config:
+def _make_3d(pipeline, point_budget, name, metric, cloud=None) -> Config:
+    """Shared 3D config builder; ``cloud`` overrides the default
+    synthetic KITTI-sized scan (CenterPoint passes its aggregated
+    multi-sweep cloud) so the fencing-token step exists in ONE place."""
     from triton_client_tpu.ops.voxelize import pad_points
 
-    rng = np.random.default_rng(0)
-    n_pts = 120_000  # ~KITTI velodyne scan
-    pc_range = pipeline.model.cfg.voxel.point_cloud_range
-    pts = np.empty((n_pts, 4), np.float32)
-    pts[:, 0] = rng.uniform(pc_range[0], pc_range[3], n_pts)
-    pts[:, 1] = rng.uniform(pc_range[1], pc_range[4], n_pts)
-    pts[:, 2] = rng.uniform(pc_range[2], pc_range[5], n_pts)
-    pts[:, 3] = rng.uniform(0, 1, n_pts)
-    padded, m = pad_points(pts, point_budget)
+    if cloud is None:
+        rng = np.random.default_rng(0)
+        n_pts = 120_000  # ~KITTI velodyne scan
+        pc_range = pipeline.model.cfg.voxel.point_cloud_range
+        cloud = np.empty((n_pts, 4), np.float32)
+        cloud[:, 0] = rng.uniform(pc_range[0], pc_range[3], n_pts)
+        cloud[:, 1] = rng.uniform(pc_range[1], pc_range[4], n_pts)
+        cloud[:, 2] = rng.uniform(pc_range[2], pc_range[5], n_pts)
+        cloud[:, 3] = rng.uniform(0, 1, n_pts)
+    padded, m = pad_points(cloud, point_budget)
     pj, mj = jnp.asarray(padded), jnp.asarray(m)
 
     inner = pipeline._jit
@@ -212,6 +217,47 @@ def make_pointpillars() -> Config:
     return _make_3d(
         pipeline, max(pipe_cfg.point_buckets), "pointpillars",
         "pointpillars_kitti_e2e_scans_per_sec_per_chip",
+    )
+
+
+def make_centerpoint() -> Config:
+    """CenterPoint-pillar, nuScenes 10-sweep config
+    (data/nusc_centerpoint.yaml): a 5-feature aggregated cloud
+    (x, y, z, i, Δt) through the velocity-head pipeline."""
+    import dataclasses
+
+    from triton_client_tpu.dataset_config import detect3d_from_yaml
+    from triton_client_tpu.ops.sweeps import aggregate_sweeps
+    from triton_client_tpu.ops.voxelize import pad_points
+    from triton_client_tpu.pipelines.detect3d import build_centerpoint_pipeline
+
+    _, model_cfg, pipe_cfg = detect3d_from_yaml("data/nusc_centerpoint.yaml")
+    pipe_cfg = dataclasses.replace(pipe_cfg, point_buckets=(131072,))
+    pipeline, _, _ = build_centerpoint_pipeline(
+        jax.random.PRNGKey(0), model_cfg=model_cfg, config=pipe_cfg
+    )
+    rng = np.random.default_rng(0)
+    r = model_cfg.voxel.point_cloud_range
+    sweeps, times = [], []
+    for i in range(10):  # ~13k points/sweep -> ~131k aggregated
+        n = 13_000
+        sweeps.append(
+            np.stack(
+                [
+                    rng.uniform(r[0], r[3], n),
+                    rng.uniform(r[1], r[4], n),
+                    rng.uniform(r[2], r[5], n),
+                    rng.uniform(0, 1, n),
+                ],
+                axis=1,
+            ).astype(np.float32)
+        )
+        times.append(-0.05 * i)
+    cloud = aggregate_sweeps(sweeps, times=times)
+    return _make_3d(
+        pipeline, 131072, "centerpoint",
+        "centerpoint_nusc_10sweep_e2e_scans_per_sec_per_chip",
+        cloud=cloud,
     )
 
 
@@ -278,6 +324,7 @@ def main() -> None:
         ("yolov5n_b64", lambda: make_yolov5(batch=64)),
         ("pointpillars", make_pointpillars),
         ("second_iou", make_second),
+        ("centerpoint", make_centerpoint),
     ):
         try:
             configs.append(factory())

@@ -33,6 +33,7 @@ from triton_client_tpu.models.pointpillars import (
     require_pillar_grid,
     scatter_max_canvas,
     scatter_to_bev,
+    validate_bev_divisible,
 )
 from triton_client_tpu.ops.voxelize import VoxelConfig
 
@@ -88,8 +89,6 @@ class CenterPointConfig:
         return ny // s, nx // s
 
     def validate(self) -> None:
-        from triton_client_tpu.models.pointpillars import validate_bev_divisible
-
         validate_bev_divisible(self.voxel, int(np.prod(self.backbone_strides)))
 
 

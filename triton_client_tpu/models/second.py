@@ -38,6 +38,7 @@ from triton_client_tpu.models.pointpillars import (
     decode_boxes,
     generate_anchors,
     rectify_direction,
+    validate_bev_divisible,
 )
 from triton_client_tpu.ops.voxelize import VoxelConfig
 
@@ -92,8 +93,6 @@ class SECONDConfig:
         return ny // s, nx // s
 
     def validate(self) -> None:
-        from triton_client_tpu.models.pointpillars import validate_bev_divisible
-
         validate_bev_divisible(
             self.voxel, self.middle_stride * int(np.prod(self.backbone_strides))
         )
