@@ -223,3 +223,21 @@ def test_extract_boxes_triton_dict_and_empty():
         }
     )
     assert out == [[], []]
+
+
+def test_extract_boxes_triton_served_name_fallback_disambiguation():
+    # unambiguous fallback: 4-D boxes tensor identified regardless of
+    # dict order, even when nc == 4 makes confs also end in 4
+    confs = np.zeros((1, 8, 4), np.float32)
+    confs[0, 0, 1] = 0.9
+    boxes = np.zeros((1, 8, 1, 4), np.float32)
+    boxes[0, 0, 0] = [0.1, 0.1, 0.3, 0.3]
+    out = compat.extract_boxes_triton({"det_confs": confs, "det_boxes": boxes})
+    assert len(out[0]) == 1 and out[0][0][6] == 1.0
+
+    # ambiguous: 4-class confs + pre-squeezed (B, num, 4) boxes are
+    # structurally identical -> must raise, not guess
+    with pytest.raises(ValueError, match="cannot tell confs from boxes"):
+        compat.extract_boxes_triton(
+            {"a": np.zeros((1, 8, 4), np.float32), "b": np.zeros((1, 8, 4), np.float32)}
+        )

@@ -1,0 +1,109 @@
+"""Synthetic labeled dataset generators (io/synthdata.py) — the
+in-environment accuracy oracle's data source."""
+
+import json
+
+import numpy as np
+import pytest
+
+from triton_client_tpu.io import synthdata
+
+cv2 = pytest.importorskip("cv2")
+
+
+def test_2d_frame_boxes_tight_and_in_bounds():
+    rng = np.random.default_rng(0)
+    img, boxes = synthdata.synth_detection_frame(rng, (256, 320), num_classes=3)
+    assert img.shape == (256, 320, 3) and img.dtype == np.uint8
+    assert boxes.ndim == 2 and boxes.shape[1] == 5
+    assert len(boxes) >= 1
+    for x1, y1, x2, y2, cls in boxes:
+        assert 0 <= x1 < x2 <= 320 and 0 <= y1 < y2 <= 256
+        assert cls in (0.0, 1.0, 2.0)
+    # objects must actually be drawn: the patch inside a GT box differs
+    # from a fresh background render far more than noise
+    x1, y1, x2, y2, _ = boxes[0].astype(int)
+    patch = img[y1:y2, x1:x2].astype(np.float32)
+    assert patch.std() > 5.0
+
+
+def test_2d_frame_pairwise_iou_bounded():
+    rng = np.random.default_rng(3)
+    _, boxes = synthdata.synth_detection_frame(rng, (320, 320), max_objects=6)
+    for i in range(len(boxes)):
+        for j in range(i + 1, len(boxes)):
+            assert synthdata._iou_xyxy(boxes[i], boxes[j]) < 0.2
+
+
+def test_2d_writer_roundtrip(tmp_path):
+    from triton_client_tpu.cli.common import load_gt_lookup
+    from triton_client_tpu.io.sources import ImageDirSource
+
+    images_dir, gt_path = synthdata.write_detection_dataset(
+        str(tmp_path), 4, hw=(96, 96), num_classes=2, seed=7
+    )
+    source = ImageDirSource(images_dir)
+    assert len(source) == 4
+    lookup = load_gt_lookup(gt_path)
+    n_gt = 0
+    for frame in source:
+        gts = lookup(frame)
+        assert gts is not None and gts.shape[1] == 5
+        n_gt += len(gts)
+    assert n_gt >= 4  # at least one object per frame
+
+
+def test_2d_determinism():
+    a = synthdata.synth_detection_frame(np.random.default_rng(5), (128, 128))
+    b = synthdata.synth_detection_frame(np.random.default_rng(5), (128, 128))
+    np.testing.assert_array_equal(a[0], b[0])
+    np.testing.assert_array_equal(a[1], b[1])
+
+
+def test_3d_scene_points_inside_boxes():
+    rng = np.random.default_rng(1)
+    points, boxes = synthdata.synth_scene_frame(rng, n_objects=6, n_clutter=4000)
+    assert points.shape[1] == 4 and boxes.shape[1] == 8
+    assert len(boxes) >= 1
+    # every GT box contains >= min_points returns (observability), and
+    # the contained points respect the yaw-rotated extent
+    for cx, cy, cz, dx, dy, dz, ry, cls in boxes:
+        rel = points[:, :3] - [cx, cy, cz]
+        c, s = np.cos(-ry), np.sin(-ry)
+        lx = rel[:, 0] * c - rel[:, 1] * s
+        ly = rel[:, 0] * s + rel[:, 1] * c
+        inside = (
+            (np.abs(lx) <= dx / 2 + 1e-3)
+            & (np.abs(ly) <= dy / 2 + 1e-3)
+            & (np.abs(rel[:, 2]) <= dz / 2 + 1e-3)
+        )
+        assert inside.sum() >= 20
+        assert cls in (0.0, 1.0, 2.0)
+
+
+def test_3d_boxes_disjoint():
+    rng = np.random.default_rng(2)
+    _, boxes = synthdata.synth_scene_frame(rng, n_objects=8, n_clutter=1000)
+    for i in range(len(boxes)):
+        for j in range(i + 1, len(boxes)):
+            d = np.hypot(
+                boxes[i][0] - boxes[j][0], boxes[i][1] - boxes[j][1]
+            )
+            assert d > 1.0  # separated centres
+
+
+def test_3d_writer_roundtrip(tmp_path):
+    from triton_client_tpu.io.sources import NpyPointCloudSource
+
+    clouds_dir, gt_path = synthdata.write_scene_dataset(
+        str(tmp_path), 3, seed=11, n_objects=4, n_clutter=2000
+    )
+    source = NpyPointCloudSource(clouds_dir)
+    assert len(source) == 3
+    lookup = synthdata.load_gt3d_lookup(gt_path)
+    for frame in source:
+        assert frame.data.shape[1] == 4
+        gts = lookup(frame)
+        assert gts is not None and gts.shape[1] == 8
+    with open(gt_path) as f:
+        assert len(f.readlines()) == 3

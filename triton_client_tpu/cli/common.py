@@ -36,6 +36,13 @@ def add_common_flags(parser: argparse.ArgumentParser) -> None:
         help="capture a jax.profiler device trace into this directory "
         "(TensorBoard/Perfetto timeline)",
     )
+    parser.add_argument(
+        "-r", "--repo", default="",
+        help="model repository root: load -m's TRAINED weights from "
+        "<repo>/<model>/ (config.yaml + version dirs — the layout serve "
+        "-r and train --export use) instead of random init; -x picks "
+        "the version (default: latest)",
+    )
     parser.add_argument("-b", "--batch-size", type=int, default=1)
     parser.add_argument(
         "-c", "--classes", type=int, default=80, help="number of classes"
@@ -170,6 +177,35 @@ def load_gt_lookup(path: str) -> Callable:
         return table.get(frame.frame_id)
 
     return lookup
+
+
+def load_repo_pipeline(args, overrides: dict, kind: str, conflicts: dict):
+    """--repo -> (pipeline, spec) with trained weights, with the loud
+    guards both detect CLIs share: -m required, wrong-family entries
+    rejected, and explicitly-set model-shape flags (which the entry's
+    config.yaml owns) refused rather than silently ignored.
+    ``conflicts`` maps flag name -> True when set to a non-default."""
+    import os
+
+    from triton_client_tpu.runtime.disk_repository import load_pipeline
+
+    if not args.model_name:
+        raise SystemExit("--repo requires -m/--model-name")
+    bad = sorted(flag for flag, set_ in conflicts.items() if set_)
+    if bad:
+        raise SystemExit(
+            f"{', '.join(bad)} conflict with --repo: the repo entry's "
+            "config.yaml owns the model shape; edit the entry instead"
+        )
+    try:
+        return load_pipeline(
+            os.path.join(args.repo, args.model_name),
+            args.model_version,
+            overrides or None,
+            kind=kind,
+        )
+    except (ValueError, FileNotFoundError) as e:
+        raise SystemExit(str(e))
 
 
 def load_names(path: str) -> tuple[str, ...]:

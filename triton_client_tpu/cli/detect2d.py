@@ -211,7 +211,27 @@ def parse_args(argv=None) -> argparse.Namespace:
 
 def build(args):
     """Model name -> (pipeline, spec). yolov5{n,s,m,l,x}, yolov4,
-    retinanet[_<depth>] or fcos[_<depth>] (depth: tiny|resnet18|34|50)."""
+    retinanet[_<depth>] or fcos[_<depth>] (depth: tiny|resnet18|34|50).
+    With --repo, the model is instead loaded from the repository entry
+    (trained weights + its config.yaml; --conf/--iou still override)."""
+    if args.repo:
+        from triton_client_tpu.cli.common import load_repo_pipeline
+
+        overrides = {}
+        if args.conf is not None:
+            overrides["conf_thresh"] = args.conf
+        if args.iou is not None:
+            overrides["iou_thresh"] = args.iou
+        return load_repo_pipeline(
+            args, overrides, "2d",
+            conflicts={
+                "--input-size": args.input_size != 512,
+                "--classes": args.classes != 80,
+                "--width": args.width != 1.0,
+                "--scaling": args.scaling != "yolo",
+                "--dtype": args.dtype != "fp32",
+            },
+        )
     from triton_client_tpu.pipelines.detect2d import (
         Detect2DConfig,
         build_fcos_pipeline,
@@ -307,6 +327,11 @@ def main(argv=None) -> None:
         # the serving process, this client only decodes/draws/publishes.
         if not args.model_name:
             raise SystemExit("--channel grpc:... requires -m/--model-name")
+        if args.repo:
+            raise SystemExit(
+                "--repo is in-process mode; in remote mode the SERVER "
+                "loads the repository (serve -r ...)"
+            )
         if args.conf is not None or args.iou is not None:
             # Thresholds are baked into the SERVER's jitted pipeline
             # (repo entry config.yaml) — same guard as detect3d's.
@@ -361,7 +386,12 @@ def main(argv=None) -> None:
                 "to stream over)"
             )
         pipe, spec = build(args)
-        class_names = load_names(args.names)
+        # --names wins; a --repo entry's own class vocabulary (its
+        # config.yaml class_names_file) labels sinks like the grpc
+        # path's served metadata does
+        class_names = load_names(args.names) or tuple(
+            spec.extra.get("class_names", ())
+        )
 
         from triton_client_tpu.channel.tpu_channel import TPUChannel
         from triton_client_tpu.runtime.repository import ModelRepository

@@ -91,6 +91,11 @@ def main(argv=None) -> None:
     if args.channel.startswith("grpc:"):
         if not args.model_name:
             raise SystemExit("--channel grpc:... requires -m/--model-name")
+        if args.repo:
+            raise SystemExit(
+                "--repo is in-process mode; in remote mode the SERVER "
+                "loads the repository (serve -r ...)"
+            )
         if args.config or args.score is not None or args.vfe is not None:
             # Thresholds/model config are baked into the SERVER's jitted
             # pipeline (the repo entry's config.yaml) — silently
@@ -110,6 +115,33 @@ def main(argv=None) -> None:
             asynchronous=args.async_set,
         )
         _run_3d(args, infer, args.model_name, nsweeps=args.sweeps or 1)
+        return
+
+    if args.repo:
+        from triton_client_tpu.cli.common import load_repo_pipeline
+
+        overrides = {}
+        if args.score is not None:
+            overrides["score_thresh"] = args.score
+        if args.z_offset is not None:
+            overrides["z_offset"] = args.z_offset
+        if args.vfe is not None:
+            overrides["vfe"] = args.vfe
+        pipe, spec = load_repo_pipeline(
+            args, overrides, "3d",
+            conflicts={
+                "--config": bool(args.config),
+                "--dtype": args.dtype != "fp32",
+            },
+        )
+        infer = (
+            detect3d_infer_async(pipe) if args.async_set else detect3d_infer(pipe)
+        )
+        _run_3d(
+            args, infer, spec.name,
+            nsweeps=args.sweeps if args.sweeps is not None
+            else pipe.config.nsweeps,
+        )
         return
 
     model_cfg = None

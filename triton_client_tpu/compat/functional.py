@@ -284,10 +284,12 @@ def extract_boxes_triton(
         confs = outputs.get("confs")
         boxes = outputs.get("boxes")
         if confs is None or boxes is None:
-            # served-name fallback: the two arrays are structurally
-            # distinguishable — boxes is the 4-D [B, num, 1, 4] tensor
-            # (or trailing dim 4), confs the 3-D [B, num, nc] one — so
-            # pair by shape, not by dict order
+            # served-name fallback: pair by shape, not dict order. Only
+            # an UNambiguous signature is accepted — boxes as the 4-D
+            # [B, num, 1, 4] tensor, or exactly one of the pair with
+            # trailing dim 4. A 4-class model whose boxes arrive
+            # pre-squeezed to (B, num, 4) makes both arrays look alike;
+            # raise rather than guess confs for boxes.
             vals = [np.asarray(v) for v in outputs.values()]
             if len(vals) != 2:
                 raise ValueError(
@@ -295,7 +297,20 @@ def extract_boxes_triton(
                     f"outputs; got {len(vals)} arrays"
                 )
             a, b = vals
-            boxes_first = a.ndim == 4 or (b.ndim == 3 and a.shape[-1] == 4)
+            a_4d = a.ndim == 4 and a.shape[-1] == 4
+            b_4d = b.ndim == 4 and b.shape[-1] == 4
+            a_3d = a.ndim == 3 and a.shape[-1] == 4
+            b_3d = b.ndim == 3 and b.shape[-1] == 4
+            if a_4d != b_4d:
+                boxes_first = a_4d
+            elif a_3d != b_3d:
+                boxes_first = a_3d
+            else:
+                raise ValueError(
+                    "extract_boxes_triton: cannot tell confs from boxes by "
+                    f"shape ({a.shape} vs {b.shape}); pass a dict keyed "
+                    "'confs'/'boxes' or serve boxes as [B, num, 1, 4]"
+                )
             confs, boxes = (b, a) if boxes_first else (a, b)
     else:
         confs, boxes = outputs[0], outputs[1]

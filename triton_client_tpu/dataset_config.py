@@ -49,13 +49,17 @@ def _check_keys(d: Mapping[str, Any], cls, what: str) -> None:
 def voxel_from_dict(d: Mapping[str, Any], base: VoxelConfig | None = None) -> VoxelConfig:
     base = base or VoxelConfig()
     _check_keys(d, VoxelConfig, "voxel config")
-    return dataclasses.replace(
-        base,
-        **{
-            k: (_tup(v) if k in ("point_cloud_range", "voxel_size") else int(v))
-            for k, v in d.items()
-        },
-    )
+    # coerce per the dataclass field's declared type so a future
+    # float-valued scalar field is not silently truncated by int()
+    types = {f.name: f.type for f in dataclasses.fields(VoxelConfig)}
+
+    def _coerce(k: str, v: Any):
+        if k in ("point_cloud_range", "voxel_size"):
+            return _tup(v)
+        t = str(types.get(k, "int"))
+        return float(v) if "float" in t else int(v)
+
+    return dataclasses.replace(base, **{k: _coerce(k, v) for k, v in d.items()})
 
 
 def _anchor_classes(rows: list[Mapping[str, Any]]):

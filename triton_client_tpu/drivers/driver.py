@@ -383,19 +383,25 @@ def channel_infer3d(
             inputs={"points": padded, "num_points": np.asarray(m, np.int32)},
         )
 
+    # rows are [box7, extras..., score, label]; velocity presence comes
+    # from the served metadata flag when the server publishes one (this
+    # repo's servers always do); third-party KServe servers that don't
+    # fall back to the classic CenterPoint row width of 11
+    has_velocity = spec.extra.get("with_velocity")
+    if has_velocity is None:
+        has_velocity = det_w == 11
+
     def unpack(resp) -> Mapping[str, Any]:
         dets = np.asarray(resp.outputs["detections"])
         valid = np.asarray(resp.outputs["valid"])
         live = dets[valid]
-        # width-relative: rows are [box7, extras..., score, label]
-        # (CenterPoint velocity models serve det_w == 11)
         w = live.shape[1] if live.ndim == 2 else det_w
         out = {
             "pred_boxes": live[:, :7],
             "pred_scores": live[:, w - 2],
             "pred_labels": live[:, w - 1].astype(np.int32),
         }
-        if w == 11:
+        if has_velocity:
             out["pred_velocities"] = live[:, 7:9]
         return out
 

@@ -1,0 +1,302 @@
+"""Synthetic labeled datasets: the in-environment accuracy oracle.
+
+The reference proves accuracy by serving *trained* weights and scoring
+them online against a ground-truth topic (communicator/
+evaluate_inference.py:400-446); its weights arrive from outside the
+repo. With no artifact access, the equivalent proof is a closed loop:
+generate labeled scenes with known ground truth, train with the `train`
+CLI, then run the FULL detect pipeline (decode + NMS included) and
+assert nonzero mAP through eval/detection_map.py.
+
+Two generators, matching the reference's two domains:
+
+* 2D (`write_detection_dataset`): crop-field-like images — textured
+  ground, shape-classed objects (ellipse / rotated box / triangle for
+  the crop/weed-style classes of data/crop.names), line+speckle
+  distractors — with tight [x1, y1, x2, y2, cls] ground truth in the
+  gt-JSONL schema `cli/common.load_gt_lookup` reads.
+* 3D (`write_scene_dataset`): KITTI-like lidar scenes — ground clutter
+  + surface-sampled, yaw-rotated objects with 1/r^2 return density
+  (grown from perf/profile_second_grid.py's scene model) — as .npy
+  clouds plus [cx, cy, cz, dx, dy, dz, yaw, cls] ground truth.
+
+Determinism: everything derives from the seed, so train/holdout splits
+are reproducible by seed alone.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+# KITTI anchor geometry (data/pointpillar.yaml anchor_sizes), reused by
+# the 3D scene generator so synthetic objects match the anchor priors.
+KITTI_CLASS_GEOMETRY = {
+    # name: ((dx, dy, dz), bottom_z)
+    "Car": ((3.9, 1.6, 1.56), -1.78),
+    "Pedestrian": ((0.8, 0.6, 1.73), -0.6),
+    "Cyclist": ((1.76, 0.6, 1.73), -0.6),
+}
+
+
+# --------------------------------------------------------------------------
+# 2D: shape-classed field scenes
+# --------------------------------------------------------------------------
+
+def _background(rng: np.random.Generator, hw: tuple[int, int]) -> np.ndarray:
+    """Low-frequency field texture + speckle, uint8 RGB."""
+    h, w = hw
+    # coarse noise upsampled -> smooth patches (soil/foliage blobs)
+    coarse = rng.uniform(0.0, 1.0, (max(h // 32, 2), max(w // 32, 2), 3))
+    idx_y = np.linspace(0, coarse.shape[0] - 1, h)
+    idx_x = np.linspace(0, coarse.shape[1] - 1, w)
+    smooth = coarse[idx_y.astype(int)][:, idx_x.astype(int)]
+    base = np.array([90.0, 70.0, 50.0]) + smooth * np.array([60.0, 50.0, 30.0])
+    img = base + rng.normal(0, 12.0, (h, w, 3))
+    return np.clip(img, 0, 255).astype(np.uint8)
+
+
+_SHAPE_COLORS = (
+    (60, 180, 60),   # vivid green
+    (200, 60, 60),   # red
+    (60, 80, 210),   # blue
+    (220, 200, 40),  # yellow
+    (180, 60, 200),  # magenta
+)
+
+
+def _draw_object(img, rng, cls: int, box: tuple[int, int, int, int]) -> None:
+    """Draw one class-`cls` shape tightly inside `box` (x1, y1, x2, y2)."""
+    import cv2
+
+    x1, y1, x2, y2 = box
+    color = tuple(
+        int(np.clip(c + rng.normal(0, 20), 0, 255))
+        for c in _SHAPE_COLORS[rng.integers(0, len(_SHAPE_COLORS))]
+    )
+    cx, cy = (x1 + x2) // 2, (y1 + y2) // 2
+    if cls == 0:  # ellipse
+        cv2.ellipse(
+            img, (cx, cy), ((x2 - x1) // 2, (y2 - y1) // 2), 0, 0, 360,
+            color, -1, cv2.LINE_AA,
+        )
+    elif cls == 1:  # filled box with an inner notch (distinct from ellipse)
+        cv2.rectangle(img, (x1, y1), (x2, y2), color, -1)
+        nw, nh = max((x2 - x1) // 4, 1), max((y2 - y1) // 4, 1)
+        dark = tuple(int(c * 0.35) for c in color)
+        cv2.rectangle(img, (cx - nw // 2, cy - nh // 2),
+                      (cx + nw // 2, cy + nh // 2), dark, -1)
+    else:  # triangle touching the box edges
+        pts = np.array(
+            [[cx, y1], [x1, y2], [x2, y2]], np.int32
+        )
+        cv2.fillPoly(img, [pts], color, cv2.LINE_AA)
+
+
+def _iou_xyxy(a: np.ndarray, b: np.ndarray) -> float:
+    ix = max(0.0, min(a[2], b[2]) - max(a[0], b[0]))
+    iy = max(0.0, min(a[3], b[3]) - max(a[1], b[1]))
+    inter = ix * iy
+    ua = (a[2] - a[0]) * (a[3] - a[1]) + (b[2] - b[0]) * (b[3] - b[1]) - inter
+    return inter / max(ua, 1e-9)
+
+
+def synth_detection_frame(
+    rng: np.random.Generator,
+    hw: tuple[int, int] = (512, 512),
+    num_classes: int = 2,
+    max_objects: int = 6,
+    distractors: bool = True,
+) -> tuple[np.ndarray, np.ndarray]:
+    """One labeled frame: (img uint8 (H, W, 3), boxes (n, 5)
+    [x1, y1, x2, y2, cls] float32). Objects are shape-classed, sized
+    8-30% of the short side, rejection-sampled to pairwise IoU < 0.2."""
+    import cv2
+
+    h, w = hw
+    img = _background(rng, hw)
+    if distractors:
+        for _ in range(int(rng.integers(4, 10))):
+            p1 = (int(rng.integers(0, w)), int(rng.integers(0, h)))
+            p2 = (int(rng.integers(0, w)), int(rng.integers(0, h)))
+            shade = int(rng.integers(30, 90))
+            cv2.line(img, p1, p2, (shade, shade, shade), 1, cv2.LINE_AA)
+        for _ in range(int(rng.integers(8, 20))):
+            c = (int(rng.integers(0, w)), int(rng.integers(0, h)))
+            shade = tuple(int(v) for v in rng.integers(40, 140, 3))
+            cv2.circle(img, c, int(rng.integers(1, 3)), shade, -1)
+
+    short = min(h, w)
+    boxes: list[np.ndarray] = []
+    n_obj = int(rng.integers(1, max_objects + 1))
+    for _ in range(n_obj):
+        for _attempt in range(20):
+            bw = int(rng.uniform(0.08, 0.30) * short)
+            bh = int(bw * rng.uniform(0.7, 1.4))
+            x1 = int(rng.uniform(2, w - bw - 2))
+            y1 = int(rng.uniform(2, h - bh - 2))
+            cand = np.array([x1, y1, x1 + bw, y1 + bh], np.float32)
+            if all(_iou_xyxy(cand, b[:4]) < 0.2 for b in boxes):
+                cls = int(rng.integers(0, num_classes))
+                _draw_object(img, rng, cls, (x1, y1, x1 + bw, y1 + bh))
+                boxes.append(np.append(cand, np.float32(cls)))
+                break
+    return img, np.stack(boxes).astype(np.float32)
+
+
+def write_detection_dataset(
+    out_dir: str,
+    n_images: int,
+    hw: tuple[int, int] = (512, 512),
+    num_classes: int = 2,
+    seed: int = 0,
+    max_objects: int = 6,
+) -> tuple[str, str]:
+    """Write `<out_dir>/images/%06d.png` + `<out_dir>/gt.jsonl`
+    (frame_id = sorted-filename index, the ImageDirSource contract).
+    Returns (images_dir, gt_path)."""
+    import cv2
+
+    rng = np.random.default_rng(seed)
+    images_dir = os.path.join(out_dir, "images")
+    os.makedirs(images_dir, exist_ok=True)
+    gt_path = os.path.join(out_dir, "gt.jsonl")
+    with open(gt_path, "w") as f:
+        for i in range(n_images):
+            img, boxes = synth_detection_frame(
+                rng, hw, num_classes, max_objects
+            )
+            cv2.imwrite(
+                os.path.join(images_dir, f"{i:06d}.png"), img[..., ::-1]
+            )
+            f.write(
+                json.dumps(
+                    {"frame_id": i, "boxes": [list(map(float, b)) for b in boxes]}
+                )
+                + "\n"
+            )
+    return images_dir, gt_path
+
+
+# --------------------------------------------------------------------------
+# 3D: KITTI-like lidar scenes with yaw-rotated ground truth
+# --------------------------------------------------------------------------
+
+def synth_scene_frame(
+    rng: np.random.Generator,
+    pc_range: tuple = (0.0, -40.0, -3.0, 70.4, 40.0, 1.0),
+    n_objects: int = 8,
+    n_clutter: int = 16_000,
+    class_names: tuple[str, ...] = ("Car", "Pedestrian", "Cyclist"),
+    yaw: bool = True,
+    min_points: int = 20,
+) -> tuple[np.ndarray, np.ndarray]:
+    """One labeled scan: (points (N, 4) [x, y, z, intensity] float32,
+    boxes (n, 8) [cx, cy, cz, dx, dy, dz, yaw, cls] float32).
+
+    Ground plane clutter + surface-sampled objects whose return density
+    falls ~1/r^2 with range (perf/profile_second_grid.py's scene model,
+    plus per-object yaw so the rotated-IoU eval path is exercised);
+    objects closer than `min_points` returns are rejected so every GT
+    box is actually observable."""
+    x0, y0, _z0, x1, y1, _z1 = pc_range
+    ground = np.stack(
+        [
+            rng.uniform(x0, x1, n_clutter),
+            rng.uniform(y0, y1, n_clutter),
+            rng.normal(-1.9, 0.05, n_clutter),
+            rng.uniform(0, 1, n_clutter),
+        ],
+        axis=1,
+    ).astype(np.float32)
+    parts = [ground]
+    boxes: list[list[float]] = []
+    for _ in range(n_objects):
+        for _attempt in range(20):
+            cls = int(rng.integers(0, len(class_names)))
+            (dx, dy, dz), bz = KITTI_CLASS_GEOMETRY[class_names[cls]]
+            cx = float(rng.uniform(x0 + 5, min(x1 - 3, 60)))
+            cy = float(rng.uniform(y0 + 5, y1 - 5))
+            cz = bz + dz / 2
+            ry = float(rng.uniform(-np.pi, np.pi)) if yaw else 0.0
+            r = float(np.hypot(cx, cy))
+            n_pts = int(60_000 / max(r, 5) ** 2)
+            if n_pts < min_points:
+                continue
+            # keep objects separated (no overlapping GT): centre
+            # distance vs the larger footprint diagonal
+            too_close = any(
+                np.hypot(cx - b[0], cy - b[1])
+                < 0.7 * (np.hypot(dx, dy) + np.hypot(b[3], b[4]))
+                for b in boxes
+            )
+            if too_close:
+                continue
+            face = rng.integers(0, 3, n_pts)
+            u = rng.uniform(-0.5, 0.5, (n_pts, 3))
+            u[face == 0, 0] = np.sign(u[face == 0, 0]) * 0.5
+            u[face == 1, 1] = np.sign(u[face == 1, 1]) * 0.5
+            u[face == 2, 2] = 0.5  # top surface
+            lx, ly, lz = u[:, 0] * dx, u[:, 1] * dy, u[:, 2] * dz
+            c, s = np.cos(ry), np.sin(ry)
+            pts = np.stack(
+                [
+                    cx + lx * c - ly * s,
+                    cy + lx * s + ly * c,
+                    cz + lz,
+                    rng.uniform(0, 1, n_pts),
+                ],
+                axis=1,
+            ).astype(np.float32)
+            parts.append(pts)
+            boxes.append([cx, cy, cz, dx, dy, dz, ry, float(cls)])
+            break
+    points = np.concatenate(parts)
+    return points, np.asarray(boxes, np.float32).reshape(-1, 8)
+
+
+def write_scene_dataset(
+    out_dir: str,
+    n_scenes: int,
+    seed: int = 0,
+    **scene_kwargs,
+) -> tuple[str, str]:
+    """Write `<out_dir>/clouds/%06d.npy` + `<out_dir>/gt3d.jsonl`
+    ({"frame_id", "boxes": [[cx, cy, cz, dx, dy, dz, yaw, cls]]}).
+    Returns (clouds_dir, gt_path)."""
+    rng = np.random.default_rng(seed)
+    clouds_dir = os.path.join(out_dir, "clouds")
+    os.makedirs(clouds_dir, exist_ok=True)
+    gt_path = os.path.join(out_dir, "gt3d.jsonl")
+    with open(gt_path, "w") as f:
+        for i in range(n_scenes):
+            points, boxes = synth_scene_frame(rng, **scene_kwargs)
+            np.save(os.path.join(clouds_dir, f"{i:06d}.npy"), points)
+            f.write(
+                json.dumps(
+                    {"frame_id": i, "boxes": [list(map(float, b)) for b in boxes]}
+                )
+                + "\n"
+            )
+    return clouds_dir, gt_path
+
+
+def load_gt3d_lookup(path: str):
+    """gt3d JSONL -> frame lookup of (n, 8) [cx, cy, cz, dx, dy, dz,
+    yaw, cls] arrays (the 3D sibling of cli/common.load_gt_lookup)."""
+    table: dict[int, np.ndarray] = {}
+    with open(path) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            row = json.loads(line)
+            table[int(row["frame_id"])] = np.asarray(
+                row["boxes"], np.float64
+            ).reshape(-1, 8)
+
+    def lookup(frame):
+        return table.get(frame.frame_id)
+
+    return lookup

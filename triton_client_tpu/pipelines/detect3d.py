@@ -194,15 +194,16 @@ class Detect3DPipeline:
         def resolve() -> dict[str, np.ndarray]:
             d, v = np.asarray(dets), np.asarray(valid)
             live = d[v]
-            # rows are [box7, extras..., score, label]; extras width 2
-            # is CenterPoint's (vx, vy)
+            # rows are [box7, extras..., score, label]; whether the
+            # extras are CenterPoint's (vx, vy) is a model-config fact,
+            # not a row-width guess
             w = live.shape[1]
             out = {
                 "pred_boxes": live[:, :7],
                 "pred_scores": live[:, w - 2],
                 "pred_labels": live[:, w - 1].astype(np.int32),
             }
-            if w == 11:
+            if getattr(self.model.cfg, "with_velocity", False):
                 out["pred_velocities"] = live[:, 7:9]
             return out
 

@@ -277,6 +277,54 @@ def build_model(
     return _Entry(model_dir).registered(version, weights)
 
 
+def load_pipeline(
+    model_dir: str | pathlib.Path,
+    version: str = "",
+    config_overrides: Mapping[str, Any] | None = None,
+    kind: str = "",
+):
+    """One model dir -> (pipeline, spec) with its TRAINED weights, for
+    direct in-process use — the detect CLIs' --repo path (the reference
+    always runs served artifacts, never random init; this is the
+    client-side equivalent of Triton loading a version dir). Empty
+    ``version`` picks the latest; ``config_overrides`` overlays the
+    entry's pipeline config (e.g. eval-time conf/iou thresholds);
+    ``kind`` ('2d'/'3d') rejects a wrong-dimensionality entry up front
+    instead of crashing deep in the pipeline."""
+    entry = _Entry(model_dir)
+    if kind:
+        families = _families_2d() if kind == "2d" else _families_3d()
+        if entry.family not in families:
+            other = "3d" if kind == "2d" else "2d"
+            raise ValueError(
+                f"{entry.model_dir}: family {entry.family!r} is a {other} "
+                f"model; use the detect{other} CLI for this entry"
+            )
+    cfg = entry.cfg
+    if config_overrides:
+        cfg = dataclasses.replace(cfg, **config_overrides)
+    if version:
+        vdir = entry.model_dir / version
+        if not vdir.is_dir():
+            raise FileNotFoundError(
+                f"{entry.model_dir}: no version dir {version!r}"
+            )
+    else:
+        vdirs = version_dirs(entry.model_dir)
+        if not vdirs:
+            raise FileNotFoundError(
+                f"{entry.model_dir}: no version dirs with weights "
+                "(a --repo entry must carry trained artifacts)"
+            )
+        vdir = vdirs[-1]
+    variables = load_weights(find_weights(vdir), entry.family, entry.template())
+    pipeline, spec, _ = entry._build(variables=variables, config=cfg)
+    spec = dataclasses.replace(
+        spec, name=entry.model_dir.name, version=vdir.name
+    )
+    return pipeline, spec
+
+
 def conversion_template(
     family: str | None = None,
     model_kwargs: Mapping[str, Any] | None = None,
