@@ -128,3 +128,68 @@ def test_load_pipeline_rejects_wrong_family(tmp_path):
         dr.load_pipeline(tmp_path / "m2", kind="3d")
     pipe, _ = dr.load_pipeline(tmp_path / "m2", kind="2d")
     assert pipe is not None
+
+
+TINY3D_YAML = """\
+model: pointpillars
+voxel:
+  point_cloud_range: [0.0, -8.0, -3.0, 16.0, 8.0, 1.0]
+  voxel_size: [0.5, 0.5, 4.0]
+  max_voxels: 512
+  max_points_per_voxel: 8
+vfe_filters: 16
+backbone_layers: [1, 1, 1]
+backbone_filters: [16, 16, 16]
+upsample_filters: [16, 16, 16]
+"""
+
+
+def test_3d_loop_train_export_eval(tmp_path, capsys):
+    from triton_client_tpu.cli.detect3d import main as detect_main
+    from triton_client_tpu.cli.train import main as train_main
+    from triton_client_tpu.io.synthdata import write_scene_dataset
+
+    cfg_path = tmp_path / "tiny3d.yaml"
+    cfg_path.write_text(TINY3D_YAML)
+    scene_kwargs = dict(
+        pc_range=(0.0, -8.0, -3.0, 16.0, 8.0, 1.0),
+        n_objects=2,
+        n_clutter=500,
+        min_points=10,
+    )
+    clouds, gt = write_scene_dataset(
+        str(tmp_path / "train"), 2, seed=0, **scene_kwargs
+    )
+    hold_clouds, hold_gt = write_scene_dataset(
+        str(tmp_path / "hold"), 2, seed=9, **scene_kwargs
+    )
+    repo = tmp_path / "repo"
+    train_main(
+        [
+            "--family", "pointpillars",
+            "--config", str(cfg_path),
+            "-i", clouds,
+            "--gt", gt,
+            "-b", "1",
+            "--mesh", "data=1",
+            "--points", "4096",
+            "--max-boxes", "8",
+            "--steps", "2",
+            "--export", str(repo),
+            "-m", "loop3d",
+        ]
+    )
+    capsys.readouterr()
+
+    detect_main(
+        [
+            "-m", "loop3d",
+            "--repo", str(repo),
+            "-i", hold_clouds,
+            "--gt", hold_gt,
+        ]
+    )
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert report["model"] == "loop3d"
+    assert report["eval"]["frames"] == 2
+    assert 0.0 <= report["eval"]["map50"] <= 1.0

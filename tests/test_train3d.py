@@ -1,0 +1,208 @@
+"""3D anchor-head training: assignment, loss semantics, step smoke."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from triton_client_tpu.models.pointpillars import (  # noqa: E402
+    PointPillars,
+    PointPillarsConfig,
+    encode_boxes,
+    generate_anchors,
+    init_pointpillars,
+)
+from triton_client_tpu.ops.voxelize import VoxelConfig  # noqa: E402
+from triton_client_tpu.parallel import train3d  # noqa: E402
+
+TINY = PointPillarsConfig(
+    voxel=VoxelConfig(
+        point_cloud_range=(0.0, -8.0, -3.0, 16.0, 8.0, 1.0),
+        voxel_size=(0.5, 0.5, 4.0),
+        max_voxels=512,
+        max_points_per_voxel=8,
+    ),
+    vfe_filters=16,
+    backbone_layers=(1, 1, 1),
+    backbone_filters=(16, 16, 16),
+    upsample_filters=(16, 16, 16),
+)
+
+
+def _flat_anchor_meta(cfg):
+    h, w = cfg.head_hw
+    n = h * w * cfg.anchors_per_loc
+    anchors = generate_anchors(cfg).reshape(n, 7)
+    per = np.concatenate(
+        [np.full(2, i, np.int32) for i in range(cfg.num_classes)]
+    )
+    anchor_cls = jnp.asarray(np.tile(per, h * w))
+    m = jnp.asarray(
+        np.tile(
+            np.concatenate(
+                [np.full(2, c.matched_thresh, np.float32) for c in cfg.anchor_classes]
+            ),
+            h * w,
+        )
+    )
+    u = jnp.asarray(
+        np.tile(
+            np.concatenate(
+                [np.full(2, c.unmatched_thresh, np.float32) for c in cfg.anchor_classes]
+            ),
+            h * w,
+        )
+    )
+    return anchors, anchor_cls, m, u
+
+
+def test_assignment_gt_on_anchor_is_positive():
+    anchors, anchor_cls, m, u = _flat_anchor_meta(TINY)
+    # GT = exactly a class-0 rot-0 anchor -> IoU 1 at that anchor
+    target_anchor = 123 * TINY.anchors_per_loc  # class 0, rot 0 slot
+    box = np.asarray(anchors[target_anchor])
+    gt = np.full((4, 8), -1, np.float32)
+    gt[0, :7] = box
+    gt[0, 7] = 0.0
+    matched, pos, neg = train3d.assign_targets(
+        anchors, anchor_cls, m, u, jnp.asarray(gt)
+    )
+    assert bool(pos[target_anchor])
+    assert int(matched[target_anchor]) == 0
+    assert not bool(neg[target_anchor])
+    # far-away anchors stay negative with no match
+    assert int(matched[5]) == -1 and bool(neg[5])
+    # wrong-class anchor at the same location is NOT positive
+    assert not bool(pos[target_anchor + 2])  # class-1 slot same cell
+
+
+def test_assignment_force_match_low_iou_gt():
+    anchors, anchor_cls, m, u = _flat_anchor_meta(TINY)
+    # a GT far smaller than the car anchor: IoU << matched_thresh
+    gt = np.full((2, 8), -1, np.float32)
+    gt[0] = [4.25, 0.25, -1.0, 1.2, 0.5, 1.5, 0.0, 0.0]
+    matched, pos, neg = train3d.assign_targets(
+        anchors, anchor_cls, m, u, jnp.asarray(gt)
+    )
+    assert int(pos.sum()) >= 1  # force match claimed the best anchor
+    claimed = int(jnp.argmax(pos))
+    assert int(matched[claimed]) == 0
+    assert not bool(neg[claimed])
+
+
+def test_assignment_all_padding_no_positives():
+    anchors, anchor_cls, m, u = _flat_anchor_meta(TINY)
+    gt = np.full((3, 8), -1, np.float32)
+    matched, pos, neg = train3d.assign_targets(
+        anchors, anchor_cls, m, u, jnp.asarray(gt)
+    )
+    assert int(pos.sum()) == 0
+    assert bool(neg.all())
+    assert int(matched.max()) == -1
+
+
+def test_loss_perfect_prediction_near_zero_box():
+    cfg = TINY
+    h, w = cfg.head_hw
+    a = cfg.anchors_per_loc
+    n = h * w * a
+    anchors = generate_anchors(cfg).reshape(n, 7)
+    target_anchor = (h // 2 * w + w // 2) * a  # center cell, class 0 rot 0
+    box = np.asarray(anchors[target_anchor]).copy()
+    gt = np.full((1, 4, 8), -1, np.float32)
+    gt[0, 0, :7] = box
+    gt[0, 0, 7] = 0.0
+
+    # heads that predict exactly the encoded GT at every anchor, strong
+    # class-0 logit at the matched anchor, strong negatives elsewhere
+    enc = encode_boxes(jnp.asarray(box)[None], anchors)  # (N, 7)
+    cls = np.full((1, h, w, a, cfg.num_classes), -12.0, np.float32)
+    flat_idx = np.unravel_index(target_anchor, (h, w, a))
+    cls[(0, *flat_idx, 0)] = 12.0
+    heads = {
+        "cls": jnp.asarray(cls),
+        "box": jnp.asarray(np.asarray(enc).reshape(1, h, w, a, 7)),
+        "dir": jnp.zeros((1, h, w, a, 2), jnp.float32)
+        .at[(0, *flat_idx, 0)]
+        .set(12.0),
+    }
+    loss, metrics = train3d.detection3d_loss(
+        heads, jnp.asarray(gt), cfg, train3d.Loss3DConfig()
+    )
+    assert float(metrics["box"]) < 1e-4
+    assert float(metrics["cls"]) < 1e-3
+    assert float(metrics["n_pos"]) >= 1
+    assert float(loss) < 0.05
+
+    # corrupting the box prediction at the positive raises box loss
+    bad = heads["box"].at[(0, *flat_idx, 0)].add(1.0)
+    _, worse = train3d.detection3d_loss(
+        {**heads, "box": bad}, jnp.asarray(gt), cfg, train3d.Loss3DConfig()
+    )
+    assert float(worse["box"]) > float(metrics["box"]) + 0.1
+
+
+def test_from_points_batch_matches_single():
+    model, variables = init_pointpillars(jax.random.PRNGKey(0), TINY)
+    rng = np.random.default_rng(0)
+    pts = np.zeros((1, 256, 4), np.float32)
+    real = 200
+    pts[0, :real, 0] = rng.uniform(0, 16, real)
+    pts[0, :real, 1] = rng.uniform(-8, 8, real)
+    pts[0, :real, 2] = rng.uniform(-2, 0, real)
+    pts[0, :real, 3] = rng.uniform(0, 1, real)
+    counts = np.asarray([real], np.int32)
+
+    single = model.apply(
+        variables, jnp.asarray(pts[0]), jnp.asarray(counts[0]),
+        method=PointPillars.from_points,
+    )
+    batched = model.apply(
+        variables, jnp.asarray(pts), jnp.asarray(counts),
+        method=PointPillars.from_points_batch,
+    )
+    for k in ("cls", "box", "dir"):
+        np.testing.assert_allclose(
+            np.asarray(single[k]), np.asarray(batched[k]), rtol=1e-5, atol=1e-5
+        )
+
+
+def test_train3d_step_loss_decreases():
+    import optax
+
+    from triton_client_tpu.io.synthdata import synth_scene_frame
+    from triton_client_tpu.parallel.mesh import MeshConfig, make_mesh
+
+    model, variables = init_pointpillars(jax.random.PRNGKey(0), TINY)
+    mesh = make_mesh(MeshConfig(data=1))
+    optimizer = optax.adam(3e-3)
+    state = train3d.init_train3d_state(model, variables, optimizer, mesh)
+    step = train3d.make_train3d_step(
+        model, optimizer, train3d.Loss3DConfig(), mesh
+    )
+
+    rng = np.random.default_rng(4)
+    points, boxes = synth_scene_frame(
+        rng,
+        pc_range=(0.0, -8.0, -3.0, 16.0, 8.0, 1.0),
+        n_objects=2,
+        n_clutter=300,
+        min_points=10,
+    )
+    budget = 2048
+    pts = np.zeros((1, budget, 4), np.float32)
+    m = min(len(points), budget)
+    pts[0, :m] = points[:m]
+    counts = np.asarray([m], np.int32)
+    tgt = np.full((1, 8, 8), -1, np.float32)
+    tgt[0, : len(boxes)] = boxes
+
+    losses = []
+    for _ in range(8):
+        state, metrics = step(
+            state, jnp.asarray(pts), jnp.asarray(counts), jnp.asarray(tgt)
+        )
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0]
+    assert np.isfinite(losses).all()

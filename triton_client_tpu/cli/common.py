@@ -179,6 +179,18 @@ def load_gt_lookup(path: str) -> Callable:
     return lookup
 
 
+def flags_given(argv, *names) -> bool:
+    """True when any of ``names`` was explicitly passed on the command
+    line (exact flag or --flag=value form) — how the --repo guards tell
+    'user asked for this' from 'parser default', since an explicitly
+    passed default value must conflict just as loudly."""
+    if argv is None:
+        import sys
+
+        argv = sys.argv[1:]
+    return any(a == n or a.startswith(n + "=") for a in argv for n in names)
+
+
 def load_repo_pipeline(args, overrides: dict, kind: str, conflicts: dict):
     """--repo -> (pipeline, spec) with trained weights, with the loud
     guards both detect CLIs share: -m required, wrong-family entries
@@ -204,7 +216,9 @@ def load_repo_pipeline(args, overrides: dict, kind: str, conflicts: dict):
             overrides or None,
             kind=kind,
         )
-    except (ValueError, FileNotFoundError) as e:
+    except (ValueError, FileNotFoundError, KeyError) as e:
+        # KeyError: _Entry's unknown-config.yaml-key guard — the loud
+        # failure must still be a clean usage exit, not a traceback
         raise SystemExit(str(e))
 
 

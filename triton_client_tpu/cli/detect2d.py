@@ -206,7 +206,13 @@ def parse_args(argv=None) -> argparse.Namespace:
     parser.add_argument(
         "--width", type=float, default=1.0, help="YOLOv4 width multiple"
     )
-    return parser.parse_args(argv)
+    args = parser.parse_args(argv)
+    # keep the raw argv so --repo guards can tell an explicitly passed
+    # flag from a parser default (cli/common.flags_given)
+    import sys
+
+    args.argv = list(argv) if argv is not None else sys.argv[1:]
+    return args
 
 
 def build(args):
@@ -215,21 +221,22 @@ def build(args):
     With --repo, the model is instead loaded from the repository entry
     (trained weights + its config.yaml; --conf/--iou still override)."""
     if args.repo:
-        from triton_client_tpu.cli.common import load_repo_pipeline
+        from triton_client_tpu.cli.common import flags_given, load_repo_pipeline
 
         overrides = {}
         if args.conf is not None:
             overrides["conf_thresh"] = args.conf
         if args.iou is not None:
             overrides["iou_thresh"] = args.iou
+        argv = getattr(args, "argv", None)
         return load_repo_pipeline(
             args, overrides, "2d",
             conflicts={
-                "--input-size": args.input_size != 512,
-                "--classes": args.classes != 80,
-                "--width": args.width != 1.0,
-                "--scaling": args.scaling != "yolo",
-                "--dtype": args.dtype != "fp32",
+                "--input-size": flags_given(argv, "--input-size"),
+                "--classes": flags_given(argv, "-c", "--classes"),
+                "--width": flags_given(argv, "--width"),
+                "--scaling": flags_given(argv, "-s", "--scaling"),
+                "--dtype": flags_given(argv, "--dtype"),
             },
         )
     from triton_client_tpu.pipelines.detect2d import (

@@ -63,7 +63,13 @@ def parse_args(argv=None) -> argparse.Namespace:
         "model supports it — the fast path) or 'grouped' (exact OpenPCDet "
         "(V, K) budget semantics: caps at max_voxels/max_points_per_voxel)",
     )
-    return parser.parse_args(argv)
+    args = parser.parse_args(argv)
+    # keep the raw argv so --repo guards can tell an explicitly passed
+    # flag from a parser default (cli/common.flags_given)
+    import sys
+
+    args.argv = list(argv) if argv is not None else sys.argv[1:]
+    return args
 
 
 def main(argv=None) -> None:
@@ -118,7 +124,7 @@ def main(argv=None) -> None:
         return
 
     if args.repo:
-        from triton_client_tpu.cli.common import load_repo_pipeline
+        from triton_client_tpu.cli.common import flags_given, load_repo_pipeline
 
         overrides = {}
         if args.score is not None:
@@ -131,7 +137,7 @@ def main(argv=None) -> None:
             args, overrides, "3d",
             conflicts={
                 "--config": bool(args.config),
-                "--dtype": args.dtype != "fp32",
+                "--dtype": flags_given(getattr(args, "argv", None), "--dtype"),
             },
         )
         infer = (
@@ -201,6 +207,13 @@ def _run_3d(args, infer, model_name: str, nsweeps: int = 1) -> None:
         from triton_client_tpu.ops.sweeps import sweep_source
 
         source = sweep_source(source, nsweeps)
+    evaluator = gt_lookup = None
+    if args.gt:
+        from triton_client_tpu.eval.detection_map import Detection3DEvaluator
+        from triton_client_tpu.io.synthdata import load_gt3d_lookup
+
+        evaluator = Detection3DEvaluator()
+        gt_lookup = load_gt3d_lookup(args.gt)
     profiler = make_profiler(args)
     driver = InferenceDriver(
         infer,
@@ -208,6 +221,8 @@ def _run_3d(args, infer, model_name: str, nsweeps: int = 1) -> None:
         sink=make_sink(args),
         prefetch=args.prefetch,
         warmup=args.warmup,
+        evaluator=evaluator,
+        gt_lookup=gt_lookup,
         profiler=profiler,
         inflight=args.inflight if args.async_set else 1,
     )
@@ -217,7 +232,8 @@ def _run_3d(args, infer, model_name: str, nsweeps: int = 1) -> None:
         import sys
 
         print(profiler.report(), file=sys.stderr)
-    print_report(stats, None, {"model": model_name})
+    summary = evaluator.summary() if evaluator is not None else None
+    print_report(stats, summary, {"model": model_name})
 
 
 if __name__ == "__main__":

@@ -14,13 +14,18 @@ there is nothing to race.
 from __future__ import annotations
 
 import argparse
+import sys
 
 from triton_client_tpu.cli import detect2d
 from triton_client_tpu.cli.common import add_common_flags
 
 
 def main(argv=None) -> None:
-    # evaluate == detect2d with --gt required and eval defaults on.
+    # evaluate == detect2d with --gt required and eval defaults on. The
+    # ORIGINAL argv forwards verbatim (every evaluate flag is a
+    # detect2d flag), so detect2d's explicit-flag guards (--repo
+    # conflicts) still see exactly what the user typed rather than
+    # re-serialized parser defaults.
     parser = argparse.ArgumentParser(description=__doc__)
     add_common_flags(parser)
     parser.add_argument("--input-size", type=int, default=512)
@@ -30,19 +35,10 @@ def main(argv=None) -> None:
     args = parser.parse_args(argv)
     if not args.gt:
         parser.error("--gt <file.jsonl> is required for evaluation")
-    if args.prometheus_port == 0:
-        args.prometheus_port = 7658
 
-    forwarded = []
-    for key, val in vars(args).items():
-        flag = "--" + key.replace("_", "-")
-        if key == "async_set":
-            flag = "--async"
-        if isinstance(val, bool):
-            if val:
-                forwarded.append(flag)
-        elif val != "" and val is not None:
-            forwarded.extend([flag, str(val)])
+    forwarded = list(argv) if argv is not None else list(sys.argv[1:])
+    if args.prometheus_port == 0:
+        forwarded += ["--prometheus-port", "7658"]
     detect2d.main(forwarded)
 
 

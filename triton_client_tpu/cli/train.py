@@ -14,6 +14,7 @@ repository entry the serve CLI loads.
 from __future__ import annotations
 
 import argparse
+import functools
 import itertools
 
 import numpy as np
@@ -21,17 +22,31 @@ import numpy as np
 
 def parse_args(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--family", default="yolov5",
+                   choices=("yolov5", "pointpillars"),
+                   help="model family: yolov5 (2D, image sources) or "
+                   "pointpillars (3D, .npy cloud sources + gt3d JSONL)")
     p.add_argument("-i", "--input", default="synthetic:64",
-                   help="image dir | synthetic[:N[:HxW]]")
+                   help="image dir | synthetic[:N[:HxW]] (2D); .npy cloud "
+                   "dir (3D)")
     p.add_argument("--gt", default="",
-                   help="ground-truth JSONL ({frame_id, boxes:[[x1,y1,x2,y2,cls]]}); "
-                   "omitted with synthetic input -> random boxes")
+                   help="ground-truth JSONL: {frame_id, boxes:[[x1,y1,x2,y2,"
+                   "cls]]} (2D) or [[cx,cy,cz,dx,dy,dz,yaw,cls]] (3D); "
+                   "omitted with synthetic 2D input -> random boxes")
+    p.add_argument("--points", type=int, default=20000,
+                   help="3D: per-scan point budget (static pad)")
+    p.add_argument("--config", default="",
+                   help="3D: dataset/model yaml (detect3d --config schema); "
+                   "copied into the exported entry as its dataset.yaml")
     p.add_argument("--variant", default="n", help="yolov5 variant (n/s/m/l/x)")
     p.add_argument("-c", "--classes", type=int, default=2)
     p.add_argument("--input-size", type=int, default=512)
     p.add_argument("-b", "--batch-size", type=int, default=8)
     p.add_argument("--steps", type=int, default=100)
     p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--lr-final", type=float, default=0.0,
+                   help="> 0: cosine-decay the lr from --lr to this over "
+                   "--steps (0 = constant lr)")
     p.add_argument("--max-boxes", type=int, default=32,
                    help="targets padded per image (static shapes)")
     p.add_argument(
@@ -139,6 +154,79 @@ def _load_batches(
         )
 
 
+def _load_batches3d(
+    args,
+    rng: np.random.Generator,
+    row0: int = 0,
+    rows: int | None = None,
+    stride: int | None = None,
+    pc_range: tuple | None = None,
+):
+    """3D sibling of _load_batches: yield (points (rows, P, 4) padded,
+    counts (rows,), targets (rows, T, 8) [box7, cls] padded with -1)
+    forever. `synthetic[:N]` input generates N labeled scenes in-memory
+    (io/synthdata.py) inside ``pc_range`` — the MODEL's grid range, or
+    objects would fall outside the voxel grid and train nothing; file
+    sources need --gt with the gt3d schema."""
+    from triton_client_tpu.io.synthdata import (
+        load_gt3d_lookup,
+        synth_scene_frame,
+    )
+
+    budget, t_max = args.points, args.max_boxes
+
+    if args.input.startswith("synthetic"):
+        parts = args.input.split(":")
+        n = int(parts[1]) if len(parts) > 1 and parts[1] else 64
+        scene_kwargs = {} if pc_range is None else {"pc_range": tuple(pc_range)}
+
+        def pair_stream():
+            while True:
+                r = np.random.default_rng(0)
+                for _ in range(n):
+                    yield synth_scene_frame(r, **scene_kwargs)
+
+    else:
+        if not args.gt:
+            raise SystemExit(
+                "--family pointpillars with a file source requires --gt "
+                "(gt3d JSONL; generate with io/synthdata.py)"
+            )
+        from triton_client_tpu.io.sources import open_source
+
+        lookup = load_gt3d_lookup(args.gt)
+
+        def pair_stream():
+            while True:
+                source = open_source(args.input, 0, kind="pointcloud")
+                empty = True
+                for frame in source:
+                    empty = False
+                    gts = lookup(frame)
+                    yield (
+                        frame.data,
+                        gts if gts is not None else np.zeros((0, 8)),
+                    )
+                if empty:
+                    raise SystemExit(f"no clouds in {args.input!r}")
+
+    stream = pair_stream()
+    rows = args.batch_size if rows is None else rows
+    stride = args.batch_size if stride is None else stride
+    while True:
+        pairs = list(itertools.islice(stream, stride))[row0 : row0 + rows]
+        points = np.zeros((rows, budget, 4), np.float32)
+        counts = np.zeros((rows,), np.int32)
+        targets = np.full((rows, t_max, 8), -1.0, np.float32)
+        for i, (pts, boxes) in enumerate(pairs):
+            m = min(len(pts), budget)
+            points[i, :m] = pts[:m, :4]
+            counts[i] = m
+            k = min(len(boxes), t_max)
+            targets[i, :k] = boxes[:k]
+        yield points, counts, targets
+
+
 def main(argv=None) -> None:
     args = parse_args(argv)
 
@@ -147,14 +235,8 @@ def main(argv=None) -> None:
     import optax
 
     from triton_client_tpu.cli.common import parse_mesh
-    from triton_client_tpu.models.yolov5 import DEFAULT_ANCHORS, init_yolov5
     from triton_client_tpu.parallel.mesh import make_mesh
-    from triton_client_tpu.parallel.train import (
-        LossConfig,
-        TrainState,
-        init_train_state,
-        make_train_step,
-    )
+    from triton_client_tpu.parallel.train import TrainState
 
     # cheap usage validation BEFORE paying for model/mesh init
     if args.resume and not args.checkpoint_dir:
@@ -195,15 +277,78 @@ def main(argv=None) -> None:
             f"{jax.process_count()} processes"
         )
 
-    model, variables = init_yolov5(
-        jax.random.PRNGKey(0),
-        num_classes=args.classes,
-        variant=args.variant,
-        input_hw=(args.input_size, args.input_size),
-    )
-    optimizer = optax.adam(args.lr)
-    loss_cfg = LossConfig(num_classes=args.classes, anchors=DEFAULT_ANCHORS)
-    state = init_train_state(model, variables, optimizer, mesh)
+    if args.lr_final > 0:
+        schedule = optax.cosine_decay_schedule(
+            args.lr, args.steps, alpha=args.lr_final / args.lr
+        )
+        optimizer = optax.adam(schedule)
+    else:
+        optimizer = optax.adam(args.lr)
+    if args.family == "pointpillars":
+        from triton_client_tpu.models.pointpillars import init_pointpillars
+        from triton_client_tpu.parallel.train3d import (
+            Loss3DConfig,
+            init_train3d_state,
+            make_train3d_step,
+        )
+
+        model_cfg = None
+        if args.config:
+            from triton_client_tpu.dataset_config import detect3d_from_yaml
+
+            fam, model_cfg, _ = detect3d_from_yaml(args.config)
+            if fam != "pointpillars":
+                raise SystemExit(
+                    f"--config model {fam!r}: only the pointpillars family "
+                    "is trainable (anchor-head loss, parallel/train3d.py)"
+                )
+        model, variables = init_pointpillars(jax.random.PRNGKey(0), model_cfg)
+
+        def init_state(vars_):
+            return init_train3d_state(model, vars_, optimizer, mesh)
+
+        step_fn = make_train3d_step(model, optimizer, Loss3DConfig(), mesh)
+        loader = functools.partial(
+            _load_batches3d, pc_range=model.cfg.voxel.point_cloud_range
+        )
+        export_doc = {"family": "pointpillars"}
+        if args.config:
+            export_doc["dataset"] = "dataset.yaml"
+    else:
+        if args.config:
+            raise SystemExit(
+                "--config is 3D-only; the yolov5 shape comes from "
+                "--variant/--input-size/-c"
+            )
+        from triton_client_tpu.models.yolov5 import DEFAULT_ANCHORS, init_yolov5
+        from triton_client_tpu.parallel.train import (
+            LossConfig,
+            init_train_state,
+            make_train_step,
+        )
+
+        model, variables = init_yolov5(
+            jax.random.PRNGKey(0),
+            num_classes=args.classes,
+            variant=args.variant,
+            input_hw=(args.input_size, args.input_size),
+        )
+        loss_cfg = LossConfig(num_classes=args.classes, anchors=DEFAULT_ANCHORS)
+
+        def init_state(vars_):
+            return init_train_state(model, vars_, optimizer, mesh)
+
+        step_fn = make_train_step(model, optimizer, loss_cfg, mesh)
+        loader = _load_batches
+        export_doc = {
+            "family": "yolov5",
+            "model": {
+                "variant": args.variant,
+                "num_classes": args.classes,
+                "input_hw": [args.input_size, args.input_size],
+            },
+        }
+    state = init_state(variables)
 
     manager = None
     if args.checkpoint_dir:
@@ -215,9 +360,7 @@ def main(argv=None) -> None:
             # (orbax restores leaf placements inconsistently against a
             # mixed replicated/sharded `like` tree).
             host = manager.restore(like=jax.tree.map(np.asarray, state))
-            fresh = init_train_state(
-                model, jax.tree.map(np.asarray, host.variables), optimizer, mesh
-            )
+            fresh = init_state(jax.tree.map(np.asarray, host.variables))
             # opt_state stays as uncommitted host leaves — the jitted
             # step places them to match the param shardings; committing
             # them to a single device would conflict with the mesh.
@@ -228,7 +371,6 @@ def main(argv=None) -> None:
             )
             print(f"resumed from step {int(state.step)}")
 
-    step_fn = make_train_step(model, optimizer, loss_cfg, mesh)
     rng = np.random.default_rng(0)
 
     if args.distributed and jax.process_count() > 1:
@@ -243,18 +385,18 @@ def main(argv=None) -> None:
 
         per_host = args.batch_size // jax.process_count()
         if args.per_host_source:
-            batches = _load_batches(
+            batches = loader(
                 args, rng, row0=0, rows=per_host, stride=per_host
             )
         else:
-            batches = _load_batches(
+            batches = loader(
                 args, rng, row0=jax.process_index() * per_host, rows=per_host
             )
 
         def feed(arr):
             return shard_host_batch(arr, mesh)
     else:
-        batches = _load_batches(args, rng)
+        batches = loader(args, rng)
         feed = jnp.asarray
 
     # checkpoint/log/export are coordinator-only under jax.distributed:
@@ -263,8 +405,7 @@ def main(argv=None) -> None:
     # multihost path — out of scope for the DP train CLI)
     start = int(state.step)
     for step in range(start, args.steps):
-        images, targets = next(batches)
-        state, metrics = step_fn(state, feed(images), feed(targets))
+        state, metrics = step_fn(state, *(feed(a) for a in next(batches)))
         if singleton and ((step + 1) % args.log_every == 0 or step + 1 == args.steps):
             m = {k: round(float(v), 4) for k, v in metrics.items()}
             print(f"step {step + 1}/{args.steps} {m}")
@@ -279,17 +420,15 @@ def main(argv=None) -> None:
     if args.export:
         from triton_client_tpu.runtime.disk_repository import export_model
 
-        doc = {
-            "family": "yolov5",
-            "model": {
-                "variant": args.variant,
-                "num_classes": args.classes,
-                "input_hw": [args.input_size, args.input_size],
-            },
-        }
         # gather sharded leaves to host before serialization
         host_vars = jax.tree.map(np.asarray, state.variables)
-        entry = export_model(args.export, args.model_name, doc, variables=host_vars)
+        entry = export_model(
+            args.export, args.model_name, export_doc, variables=host_vars
+        )
+        if args.family == "pointpillars" and args.config:
+            import shutil
+
+            shutil.copy(args.config, entry / "dataset.yaml")
         print(f"exported {entry} (serve with: serve -r {args.export})")
 
 

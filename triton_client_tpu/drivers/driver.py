@@ -289,11 +289,9 @@ class InferenceDriver:
         if self.evaluator is not None and self.gt_lookup is not None:
             gts = self.gt_lookup(frame)
             if gts is not None:
-                self.evaluator.add_frame(
-                    np.asarray(per["detections"]),
-                    np.asarray(per["valid"]) if "valid" in per else None,
-                    gts,
-                )
+                # the evaluator's adapter owns the output contract
+                # (2D packed detections vs 3D pred_boxes dict)
+                self.evaluator.add_frame_from(per, gts)
 
 
 def detect2d_infer(pipeline) -> InferFn:
@@ -384,9 +382,10 @@ def channel_infer3d(
         )
 
     # rows are [box7, extras..., score, label]; velocity presence comes
-    # from the served metadata flag when the server publishes one (this
-    # repo's servers always do); third-party KServe servers that don't
-    # fall back to the classic CenterPoint row width of 11
+    # from the served metadata flag when the server publishes one
+    # (every _detect3d_spec does, True or False); third-party KServe
+    # servers that publish nothing fall back to the classic CenterPoint
+    # row width of 11
     has_velocity = spec.extra.get("with_velocity")
     if has_velocity is None:
         has_velocity = det_w == 11

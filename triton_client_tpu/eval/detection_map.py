@@ -103,28 +103,19 @@ def ap_per_class(
     return p[:, best], r[:, best], ap, f1[:, best], unique_classes.astype(np.int32)
 
 
-def match_predictions(
-    pred_boxes: np.ndarray,
-    pred_cls: np.ndarray,
-    gt_boxes: np.ndarray,
+def greedy_match(
+    iou: np.ndarray,  # (n_gt, n_pred)
     gt_cls: np.ndarray,
-    iou_thresholds: np.ndarray = IOU_THRESHOLDS,
+    pred_cls: np.ndarray,
+    iou_thresholds: np.ndarray,
 ) -> np.ndarray:
-    """Greedy unique matching of one frame's predictions to GT.
-
-    Parity with evaluate_inference.py:400-446: a (gt, det) pair is a
-    candidate when IoU >= iou_thresholds[0] and classes match; pairs are
-    greedily assigned best-IoU-first, one detection per gt and one gt
-    per detection; matched detections are TP at every threshold their
-    IoU clears.
-
-    Returns: (n_pred, n_iou) bool TP matrix.
-    """
-    n_pred, n_iou = pred_boxes.shape[0], len(iou_thresholds)
+    """Greedy unique TP matrix from a precomputed IoU matrix — the
+    matching core shared by the 2D (axis-aligned) and 3D (rotated BEV)
+    evaluators. Candidates need IoU >= thresholds[0] and matching
+    class; pairs assign best-IoU-first, one det per gt and one gt per
+    det; a matched det is TP at every threshold its IoU clears."""
+    n_pred, n_iou = iou.shape[1], len(iou_thresholds)
     correct = np.zeros((n_pred, n_iou), dtype=bool)
-    if n_pred == 0 or gt_boxes.shape[0] == 0:
-        return correct
-    iou = box_iou_np(gt_boxes[:, :4], pred_boxes[:, :4])
     candidate = (iou >= iou_thresholds[0]) & (
         np.asarray(gt_cls)[:, None] == np.asarray(pred_cls)[None, :]
     )
@@ -139,6 +130,25 @@ def match_predictions(
     det = matches[:, 1].astype(int)
     correct[det] = matches[:, 2:3] >= iou_thresholds[None, :]
     return correct
+
+
+def match_predictions(
+    pred_boxes: np.ndarray,
+    pred_cls: np.ndarray,
+    gt_boxes: np.ndarray,
+    gt_cls: np.ndarray,
+    iou_thresholds: np.ndarray = IOU_THRESHOLDS,
+) -> np.ndarray:
+    """Greedy unique matching of one frame's predictions to GT.
+
+    Parity with evaluate_inference.py:400-446 (see greedy_match).
+    Returns: (n_pred, n_iou) bool TP matrix.
+    """
+    n_pred, n_iou = pred_boxes.shape[0], len(iou_thresholds)
+    if n_pred == 0 or gt_boxes.shape[0] == 0:
+        return np.zeros((n_pred, n_iou), dtype=bool)
+    iou = box_iou_np(gt_boxes[:, :4], pred_boxes[:, :4])
+    return greedy_match(iou, gt_cls, pred_cls, iou_thresholds)
 
 
 @dataclasses.dataclass
@@ -224,8 +234,145 @@ class DetectionEvaluator:
             },
         }
 
+    def add_frame_from(self, outputs, ground_truths) -> FrameStats:
+        """Driver-facing adapter: score one frame from the infer fn's
+        output mapping (the 2D contract: packed detections + valid)."""
+        return self.add_frame(
+            np.asarray(outputs["detections"]),
+            np.asarray(outputs["valid"]) if "valid" in outputs else None,
+            ground_truths,
+        )
+
     def per_frame_summaries(self):
         """Yield (p, r, ap, f1, classes) per frame — what the reference
         observes into its Prometheus Summaries frame by frame."""
         for f in self.frames:
             yield ap_per_class(f.correct, f.conf, f.pred_cls, f.target_cls)
+
+
+# --------------------------------------------------------------------------
+# 3D (BEV rotated-IoU) evaluation
+# --------------------------------------------------------------------------
+
+def _rect_corners_np(boxes: np.ndarray) -> np.ndarray:
+    """(N, 5) [cx, cy, dx, dy, yaw] -> (N, 4, 2) CCW corners."""
+    c, s = np.cos(boxes[:, 4]), np.sin(boxes[:, 4])
+    hx, hy = boxes[:, 2] / 2, boxes[:, 3] / 2
+    local = np.stack(
+        [
+            np.stack([hx, hy], -1),
+            np.stack([-hx, hy], -1),
+            np.stack([-hx, -hy], -1),
+            np.stack([hx, -hy], -1),
+        ],
+        axis=1,
+    )  # (N, 4, 2)
+    rot = np.stack(
+        [np.stack([c, -s], -1), np.stack([s, c], -1)], axis=1
+    )  # (N, 2, 2)
+    return np.einsum("nij,nkj->nki", rot, local) + boxes[:, None, :2]
+
+
+def _clip_polygon_np(poly: np.ndarray, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Sutherland-Hodgman: keep the half-plane left of edge a->b."""
+    if len(poly) == 0:
+        return poly
+    edge = b - a
+    rel = poly - a
+    side = edge[0] * rel[:, 1] - edge[1] * rel[:, 0]
+    out = []
+    n = len(poly)
+    for i in range(n):
+        j = (i + 1) % n
+        if side[i] >= 0:
+            out.append(poly[i])
+            if side[j] < 0:
+                t = side[i] / (side[i] - side[j])
+                out.append(poly[i] + t * (poly[j] - poly[i]))
+        elif side[j] >= 0:
+            t = side[i] / (side[i] - side[j])
+            out.append(poly[i] + t * (poly[j] - poly[i]))
+    return np.asarray(out) if out else np.zeros((0, 2))
+
+
+def _polygon_area_np(poly: np.ndarray) -> float:
+    if len(poly) < 3:
+        return 0.0
+    x, y = poly[:, 0], poly[:, 1]
+    return 0.5 * abs(
+        float(np.dot(x, np.roll(y, -1)) - np.dot(y, np.roll(x, -1)))
+    )
+
+
+def rotated_bev_iou_np(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Pairwise rotated BEV IoU of (N, 5) x (M, 5) [cx, cy, dx, dy,
+    yaw] boxes -> (N, M). Host-side eval oracle, numpy-only — kept
+    independent of the jax kernel (ops/boxes3d.rotated_iou_bev) so the
+    evaluator can cross-check the compiled path."""
+    a, b = np.asarray(a, np.float64), np.asarray(b, np.float64)
+    ca, cb = _rect_corners_np(a), _rect_corners_np(b)
+    area_a = a[:, 2] * a[:, 3]
+    area_b = b[:, 2] * b[:, 3]
+    out = np.zeros((len(a), len(b)))
+    for i in range(len(a)):
+        for j in range(len(b)):
+            # cheap reject: circumscribed circles disjoint
+            if np.hypot(*(a[i, :2] - b[j, :2])) > (
+                np.hypot(a[i, 2], a[i, 3]) + np.hypot(b[j, 2], b[j, 3])
+            ) / 2:
+                continue
+            poly = ca[i]
+            for k in range(4):
+                poly = _clip_polygon_np(poly, cb[j][k], cb[j][(k + 1) % 4])
+            inter = _polygon_area_np(poly)
+            union = area_a[i] + area_b[j] - inter
+            if union > 0:
+                out[i, j] = inter / union
+    return out
+
+
+class Detection3DEvaluator(DetectionEvaluator):
+    """mAP for 7-dof boxes matched by rotated BEV IoU — the 3D accuracy
+    loop the reference runs only for 2D (its 3D path has no evaluator;
+    this closes that gap with the same P/R/AP/F1 protocol). Ground
+    truths are (n_gt, 8) [cx, cy, cz, dx, dy, dz, yaw, cls]."""
+
+    def add_frame3d(
+        self,
+        pred_boxes: np.ndarray,   # (n, 7)
+        pred_scores: np.ndarray,  # (n,)
+        pred_labels: np.ndarray,  # (n,) 1-indexed (OpenPCDet contract)
+        ground_truths: np.ndarray,  # (m, 8), cls 0-indexed
+    ) -> FrameStats:
+        pred_boxes = np.asarray(pred_boxes, np.float64).reshape(-1, 7)
+        gts = np.asarray(ground_truths, np.float64).reshape(-1, 8)
+        pred_cls = np.asarray(pred_labels, np.int64) - 1
+        if len(pred_boxes) and len(gts):
+            iou = rotated_bev_iou_np(
+                gts[:, [0, 1, 3, 4, 6]], pred_boxes[:, [0, 1, 3, 4, 6]]
+            )
+            correct = greedy_match(
+                iou, gts[:, 7].astype(np.int64), pred_cls, self.iou_thresholds
+            )
+        else:
+            correct = np.zeros(
+                (len(pred_boxes), len(self.iou_thresholds)), dtype=bool
+            )
+        stats = FrameStats(
+            correct=correct,
+            conf=np.asarray(pred_scores, np.float64),
+            pred_cls=pred_cls,
+            target_cls=gts[:, 7].astype(np.int64),
+        )
+        self.frames.append(stats)
+        return stats
+
+    def add_frame_from(self, outputs, ground_truths) -> FrameStats:
+        """Driver-facing adapter over the 3D infer contract
+        (pred_boxes/pred_scores/pred_labels)."""
+        return self.add_frame3d(
+            outputs["pred_boxes"],
+            outputs["pred_scores"],
+            outputs["pred_labels"],
+            ground_truths,
+        )

@@ -186,7 +186,7 @@ def write_detection_dataset(
 
 def synth_scene_frame(
     rng: np.random.Generator,
-    pc_range: tuple = (0.0, -40.0, -3.0, 70.4, 40.0, 1.0),
+    pc_range: tuple = (0.0, -39.68, -3.0, 69.12, 39.68, 1.0),
     n_objects: int = 8,
     n_clutter: int = 16_000,
     class_names: tuple[str, ...] = ("Car", "Pedestrian", "Cyclist"),

@@ -431,6 +431,29 @@ class PointPillars(nn.Module):
         canvas = scatter_max_canvas(x, vid, valid, (ny, nx))
         return self._heads(canvas[None], train)
 
+    def from_points_batch(
+        self,
+        points: jnp.ndarray,  # (B, P, F>=4) padded clouds
+        counts: jnp.ndarray,  # (B,) real rows per cloud
+        train: bool = False,
+    ) -> dict[str, jnp.ndarray]:
+        """Batched sort-free path for TRAINING: per-sample pillar
+        assignment (pure vmap), one flat VFE encode over all B*P rows
+        (so BatchNorm sees the whole batch's point population — a
+        per-sample vmap would trip flax's broadcast-state mutation),
+        then per-sample canvas scatter."""
+        require_pillar_grid(self.cfg.voxel.grid_size)
+        nx, ny, _ = self.cfg.voxel.grid_size
+        feats, vid, valid, _cnt = jax.vmap(
+            lambda p, c: augment_points(p, c, self.cfg.voxel)
+        )(points, counts)
+        b, n, f = feats.shape
+        x = self.vfe.encode(feats.reshape(b * n, f), train).reshape(b, n, -1)
+        canvas = jax.vmap(
+            lambda xx, vv, va: scatter_max_canvas(xx, vv, va, (ny, nx))
+        )(x, vid, valid)
+        return self._heads(canvas, train)
+
     def _heads(self, canvas: jnp.ndarray, train: bool) -> dict[str, jnp.ndarray]:
         cfg = self.cfg
         spatial = self.backbone(canvas, train)

@@ -243,6 +243,9 @@ def _detect3d_spec(
         extra={
             "score_thresh": cfg.score_thresh,
             "iou_thresh": cfg.iou_thresh,
+            # every in-repo 3D spec states velocity presence explicitly
+            # so remote clients never have to sniff the row width
+            "with_velocity": n_extra > 0,
             "class_names": list(cfg.class_names),
             "max_voxels": model_cfg.voxel.max_voxels,
             # Remote clients self-configure host-side prep from the
