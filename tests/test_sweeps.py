@@ -193,3 +193,131 @@ def test_detect3d_cli_rejects_live_multi_sweep():
 
     with pytest.raises(SystemExit, match="replay-only"):
         main(["-i", "ros:/points", "--sweeps", "2", "--sink", "null"])
+
+
+# --- ego-motion compensation ----------------------------------------------
+
+
+def _yaw_quat(yaw):
+    return [0.0, 0.0, np.sin(yaw / 2), np.cos(yaw / 2)]
+
+
+def test_pose_to_matrix_basic():
+    from triton_client_tpu.ops.sweeps import pose_to_matrix
+
+    eye = pose_to_matrix([0, 0, 0], [0, 0, 0, 1])
+    np.testing.assert_allclose(eye, np.eye(4))
+    # 90 deg about z + translation
+    tf = pose_to_matrix([1, 2, 3], _yaw_quat(np.pi / 2))
+    np.testing.assert_allclose(
+        tf[:3, :3] @ [1, 0, 0], [0, 1, 0], atol=1e-12
+    )
+    np.testing.assert_allclose(tf[:3, 3], [1, 2, 3])
+
+
+def test_relative_transforms_keyframe_identity():
+    from triton_client_tpu.ops.sweeps import pose_to_matrix, relative_transforms
+
+    key = pose_to_matrix([5, 0, 0], _yaw_quat(0.3))
+    old = pose_to_matrix([3, 0, 0], _yaw_quat(0.3))
+    rel = relative_transforms([key, old])
+    np.testing.assert_allclose(rel[0], np.eye(4), atol=1e-12)
+    # same heading, 2 m behind along world x -> in the keyframe's frame
+    # the old origin sits at rotation^-1 @ (-2, 0, 0)
+    expect = np.array([-2 * np.cos(0.3), 2 * np.sin(0.3), 0.0])
+    np.testing.assert_allclose(rel[1][:3, 3], expect, atol=1e-12)
+
+
+def test_moving_platform_aggregates_only_with_poses():
+    """A static world landmark seen from a moving sensor must stack to
+    ONE point with ego poses and smear without them (VERDICT r2 #7)."""
+    from triton_client_tpu.ops.sweeps import SweepBuffer, pose_to_matrix
+
+    landmark = np.array([10.0, 4.0, 0.5])
+    poses = [
+        pose_to_matrix([2.0 * i, 0.1 * i, 0.0], _yaw_quat(0.05 * i))
+        for i in range(3)
+    ]
+
+    def sensor_view(pose):
+        rel = np.linalg.inv(pose) @ [*landmark, 1.0]
+        return np.array([[*rel[:3], 0.7]], np.float32)
+
+    posed = SweepBuffer(3)
+    static = SweepBuffer(3)
+    for i, pose in enumerate(poses):
+        agg = posed.push(sensor_view(pose), float(i), pose)
+        agg_static = static.push(sensor_view(pose), float(i))
+
+    # with poses: all three sweeps land on the keyframe-frame landmark
+    key_view = sensor_view(poses[-1])[0, :3]
+    assert agg.shape == (3, 5)
+    np.testing.assert_allclose(agg[:, :3], np.tile(key_view, (3, 1)), atol=1e-5)
+    # without: the oldest sweep is meters off
+    spread = np.linalg.norm(agg_static[:, :3] - key_view, axis=1)
+    assert spread.max() > 2.0
+
+
+def test_sweepbuffer_mixed_pose_raises():
+    from triton_client_tpu.ops.sweeps import SweepBuffer
+
+    buf = SweepBuffer(2)
+    buf.push(np.zeros((1, 4), np.float32), 0.0, np.eye(4))
+    with pytest.raises(ValueError, match="mixes posed and poseless"):
+        buf.push(np.zeros((1, 4), np.float32), 1.0)
+
+
+def test_bag_pose_lookup_interpolates(tmp_path):
+    from triton_client_tpu.io import rosbag as rb
+    from triton_client_tpu.io.bag_io import bag_pose_lookup
+    from triton_client_tpu.io.sources import Frame
+
+    path = str(tmp_path / "odom.bag")
+    with rb.BagWriter(path) as w:
+        for i, x in enumerate([0.0, 4.0]):
+            msg = rb.make("nav_msgs/Odometry")
+            msg.header.stamp = (i, 0)
+            msg.pose.pose.position.x = x
+            msg.pose.pose.orientation.w = 1.0
+            w.write("/odom", msg, t=float(i))
+
+    lookup = bag_pose_lookup(path)
+    mid = lookup(Frame(np.zeros((1, 4)), 0, 0.5))
+    np.testing.assert_allclose(mid[:3, 3], [2.0, 0.0, 0.0], atol=1e-9)
+    # clamped at the ends
+    np.testing.assert_allclose(
+        lookup(Frame(np.zeros((1, 4)), 0, -5.0))[:3, 3], [0, 0, 0]
+    )
+    np.testing.assert_allclose(
+        lookup(Frame(np.zeros((1, 4)), 0, 99.0))[:3, 3], [4, 0, 0]
+    )
+
+
+def test_pose_lookup_from_jsonl(tmp_path):
+    import json
+
+    from triton_client_tpu.io.bag_io import pose_lookup_from_jsonl
+    from triton_client_tpu.io.sources import Frame
+
+    p = tmp_path / "poses.jsonl"
+    with open(p, "w") as f:
+        f.write(json.dumps({"frame_id": 0, "pose": [1, 2, 3, 0, 0, 0, 1]}) + "\n")
+    lookup = pose_lookup_from_jsonl(str(p))
+    np.testing.assert_allclose(
+        lookup(Frame(np.zeros((1, 4)), 0, 0.0))[:3, 3], [1, 2, 3]
+    )
+    assert lookup(Frame(np.zeros((1, 4)), 7, 0.0)) is None
+
+
+def test_detect3d_poses_guards(tmp_path):
+    from triton_client_tpu.cli.detect3d import main
+
+    poses = tmp_path / "p.jsonl"
+    poses.write_text("")
+    # explicit --sweeps 1 with --poses: caught before any model build
+    with pytest.raises(SystemExit, match="--sweeps"):
+        main(["-i", "synthetic:2", "--poses", str(poses), "--sweeps", "1"])
+    with pytest.raises(SystemExit, match="no such pose file"):
+        main(["-i", "synthetic:2", "--poses", "missing.jsonl", "--sweeps", "3"])
+    with pytest.raises(SystemExit, match="must be a .bag"):
+        main(["-i", "synthetic:2", "--poses", "odom", "--sweeps", "3"])

@@ -56,6 +56,16 @@ def parse_args(argv=None) -> argparse.Namespace:
         "default: the config's nsweeps (1)",
     )
     parser.add_argument(
+        "--poses",
+        default="",
+        help="ego-pose source for --sweeps > 1: 'odom[:topic]' (read "
+        "the input bag's nav_msgs/Odometry topic) or a pose JSONL "
+        "({frame_id, pose:[x,y,z,qx,qy,qz,qw]}); older sweeps are then "
+        "transformed into the keyframe's sensor frame (ego-motion "
+        "compensation). Without it sweeps stack untransformed — exact "
+        "only for a stationary platform",
+    )
+    parser.add_argument(
         "--vfe",
         default=None,
         choices=("auto", "grouped"),
@@ -82,6 +92,25 @@ def main(argv=None) -> None:
 
     if args.async_set:
         _check_async_flags(args)
+
+    if args.poses:
+        # everything decidable from args fails here, BEFORE the
+        # expensive model build (the full nsweeps-aware guard runs in
+        # _run_3d once the config's nsweeps is known)
+        import os
+
+        if args.sweeps is not None and args.sweeps <= 1:
+            raise SystemExit(
+                "--poses only affects multi-sweep aggregation; add --sweeps N"
+            )
+        if args.poses == "odom" or args.poses.startswith("odom:"):
+            if not args.input.endswith(".bag"):
+                raise SystemExit(
+                    "--poses odom[:topic] reads the INPUT bag's odometry "
+                    "topic; the input must be a .bag"
+                )
+        elif not os.path.exists(args.poses):
+            raise SystemExit(f"--poses: no such pose file {args.poses!r}")
 
     from triton_client_tpu.drivers.driver import (
         InferenceDriver,
@@ -203,10 +232,30 @@ def _run_3d(args, infer, model_name: str, nsweeps: int = 1) -> None:
     from triton_client_tpu.io.sources import open_source
 
     source = open_source(args.input, args.limit, kind="pointcloud")
+    if args.poses and nsweeps <= 1:
+        raise SystemExit(
+            "--poses only affects multi-sweep aggregation; add --sweeps N"
+        )
     if nsweeps > 1:
         from triton_client_tpu.ops.sweeps import sweep_source
 
-        source = sweep_source(source, nsweeps)
+        pose_lookup = None
+        if args.poses:
+            if args.poses == "odom" or args.poses.startswith("odom:"):
+                if not args.input.endswith(".bag"):
+                    raise SystemExit(
+                        "--poses odom[:topic] reads the INPUT bag's odometry "
+                        "topic; the input must be a .bag"
+                    )
+                from triton_client_tpu.io.bag_io import bag_pose_lookup
+
+                _, _, topic = args.poses.partition(":")
+                pose_lookup = bag_pose_lookup(args.input, topic or None)
+            else:
+                from triton_client_tpu.io.bag_io import pose_lookup_from_jsonl
+
+                pose_lookup = pose_lookup_from_jsonl(args.poses)
+        source = sweep_source(source, nsweeps, pose_lookup)
     evaluator = gt_lookup = None
     if args.gt:
         from triton_client_tpu.eval.detection_map import Detection3DEvaluator

@@ -22,6 +22,18 @@ from triton_client_tpu.io.sources import Frame
 _IMAGE_TYPES = ("sensor_msgs/CompressedImage", "sensor_msgs/Image")
 
 
+def _msg_time(msg, record_t: float) -> float:
+    """header.stamp (capture time) when set, else the bag record time —
+    sweeps and pose interpolation align on capture time so per-topic
+    transport latency doesn't skew the compensation."""
+    try:
+        secs, nsecs = msg.header.stamp
+    except (AttributeError, TypeError, ValueError):
+        return record_t
+    stamp = float(secs) + float(nsecs) * 1e-9
+    return stamp if stamp > 0 else record_t
+
+
 def _pick_topic(path: str, wanted_types: tuple[str, ...]) -> str:
     with rb.BagReader(path) as r:
         topics = r.topics()
@@ -106,7 +118,10 @@ class BagPointCloudSource(_BagSourceBase):
                 msg = bm.msg
                 pts = rb.pointcloud2_to_xyzi(msg)
                 seq = int(msg.header.seq) if msg.header.seq else i
-                yield Frame(pts, seq, t, self.path, meta=bm)
+                # prefer the sensor's own header.stamp over the bag
+                # record time: sweep Δt and ego-pose interpolation must
+                # use capture time, not transport/record latency
+                yield Frame(pts, seq, _msg_time(msg, t), self.path, meta=bm)
 
 
 def default_output_bag(in_bag: str) -> str:
@@ -183,3 +198,84 @@ def _unpack_boxes(result: Mapping[str, Any]):
         valid = np.asarray(result["valid"]).reshape(-1).astype(bool)
         dets = dets[: valid.size][valid[: dets.shape[0]]]
     return dets[:, :7], dets[:, 7], dets[:, 8].astype(np.int64)
+
+
+# ---------------------------------------------------------------------------
+# Ego-pose sources for multi-sweep aggregation (ops/sweeps.py)
+# ---------------------------------------------------------------------------
+
+def bag_pose_lookup(path: str, topic: str | None = None):
+    """Odometry topic of a bag -> pose_lookup callback for
+    ``sweep_source``: frame -> (4, 4) world_T_sensor interpolated at
+    the frame's timestamp (linear translation + normalized-lerp
+    rotation between the bracketing odometry samples; clamped at the
+    ends). The reference compensates ego motion from dataset pose
+    records (clients/preprocess/voxelize.py:13-24); a live/replay
+    stream's equivalent pose source is its odometry topic."""
+    from triton_client_tpu.ops.sweeps import pose_to_matrix
+
+    if topic is None:
+        topic = _pick_topic(path, ("nav_msgs/Odometry",))
+    stamps: list[float] = []
+    trans: list[list[float]] = []
+    quats: list[list[float]] = []
+    with rb.BagReader(path) as r:
+        for _, bm, t in r.read_messages(topics=[topic], raw=True):
+            p = bm.msg.pose.pose
+            stamps.append(_msg_time(bm.msg, t))
+            trans.append([p.position.x, p.position.y, p.position.z])
+            quats.append(
+                [p.orientation.x, p.orientation.y, p.orientation.z,
+                 p.orientation.w]
+            )
+    if not stamps:
+        raise ValueError(f"{path}: no messages on odometry topic {topic!r}")
+    order = np.argsort(stamps)
+    t_arr = np.asarray(stamps, np.float64)[order]
+    tr_arr = np.asarray(trans, np.float64)[order]
+    q_arr = np.asarray(quats, np.float64)[order]
+
+    def lookup(frame) -> np.ndarray:
+        t = float(frame.timestamp)
+        i = int(np.searchsorted(t_arr, t))
+        if i <= 0:
+            return pose_to_matrix(tr_arr[0], q_arr[0])
+        if i >= len(t_arr):
+            return pose_to_matrix(tr_arr[-1], q_arr[-1])
+        w = (t - t_arr[i - 1]) / max(t_arr[i] - t_arr[i - 1], 1e-12)
+        tr = (1 - w) * tr_arr[i - 1] + w * tr_arr[i]
+        qa, qb = q_arr[i - 1], q_arr[i]
+        if np.dot(qa, qb) < 0:  # shorter arc
+            qb = -qb
+        q = (1 - w) * qa + w * qb
+        return pose_to_matrix(tr, q)
+
+    return lookup
+
+
+def pose_lookup_from_jsonl(path: str):
+    """Pose JSONL ({"frame_id": int, "pose": [x, y, z, qx, qy, qz,
+    qw]}) -> pose_lookup callback keyed by frame_id — the file-based
+    pose source for .npy replay streams."""
+    import json
+
+    from triton_client_tpu.ops.sweeps import pose_to_matrix
+
+    table: dict[int, np.ndarray] = {}
+    with open(path) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            row = json.loads(line)
+            v = row["pose"]
+            if len(v) != 7:
+                raise ValueError(
+                    f"{path}: pose must be [x, y, z, qx, qy, qz, qw], "
+                    f"got {len(v)} values"
+                )
+            table[int(row["frame_id"])] = pose_to_matrix(v[:3], v[3:])
+
+    def lookup(frame):
+        return table.get(frame.frame_id)
+
+    return lookup

@@ -185,6 +185,20 @@ register(
     "geometry_msgs/Pose pose\nfloat64[36] covariance",
 )
 register(
+    "geometry_msgs/Twist",
+    "geometry_msgs/Vector3 linear\ngeometry_msgs/Vector3 angular",
+)
+register(
+    "geometry_msgs/TwistWithCovariance",
+    "geometry_msgs/Twist twist\nfloat64[36] covariance",
+)
+register(
+    "nav_msgs/Odometry",
+    "Header header\nstring child_frame_id\n"
+    "geometry_msgs/PoseWithCovariance pose\n"
+    "geometry_msgs/TwistWithCovariance twist",
+)
+register(
     "sensor_msgs/PointField",
     "uint8 INT8=1\nuint8 UINT8=2\nuint8 INT16=3\nuint8 UINT16=4\n"
     "uint8 INT32=5\nuint8 UINT32=6\nuint8 FLOAT32=7\nuint8 FLOAT64=8\n"

@@ -72,15 +72,57 @@ def aggregate_sweeps(
     return np.concatenate(parts, axis=0)
 
 
+def pose_to_matrix(
+    translation: Sequence[float], quaternion: Sequence[float]
+) -> np.ndarray:
+    """(x, y, z) + (qx, qy, qz, qw) -> (4, 4) homogeneous world_T_sensor
+    (the ROS nav_msgs/Odometry pose convention)."""
+    x, y, z, w = (float(v) for v in quaternion)
+    n = np.sqrt(x * x + y * y + z * z + w * w)
+    if n < 1e-12:
+        raise ValueError("zero-norm quaternion")
+    x, y, z, w = x / n, y / n, z / n, w / n
+    tf = np.eye(4, dtype=np.float64)
+    tf[:3, :3] = [
+        [1 - 2 * (y * y + z * z), 2 * (x * y - z * w), 2 * (x * z + y * w)],
+        [2 * (x * y + z * w), 1 - 2 * (x * x + z * z), 2 * (y * z - x * w)],
+        [2 * (x * z - y * w), 2 * (y * z + x * w), 1 - 2 * (x * x + y * y)],
+    ]
+    tf[:3, 3] = translation
+    return tf
+
+
+def _rigid_inverse(tf: np.ndarray) -> np.ndarray:
+    out = np.eye(4, dtype=np.float64)
+    r = tf[:3, :3].T
+    out[:3, :3] = r
+    out[:3, 3] = -r @ tf[:3, 3]
+    return out
+
+
+def relative_transforms(poses: Sequence[np.ndarray]) -> list[np.ndarray]:
+    """Per-sweep world_T_sensor poses (keyframe FIRST) -> transforms
+    mapping each sweep's sensor frame into the KEYFRAME's frame:
+    T_i = inv(pose_key) @ pose_i (identity for the keyframe) — the
+    det3d ego-motion compensation the reference applies from dataset
+    sweep records (clients/preprocess/voxelize.py:13-24)."""
+    inv_key = _rigid_inverse(np.asarray(poses[0], np.float64))
+    return [inv_key @ np.asarray(p, np.float64) for p in poses]
+
+
 class SweepBuffer:
     """Rolling window of the last ``nsweeps`` scans for a live/replay
-    stream: push the newest scan (+ timestamp), get the aggregated
-    (N, 5) cloud with the newest scan as keyframe.
+    stream: push the newest scan (+ timestamp and, on a moving
+    platform, its world_T_sensor pose), get the aggregated (N, 5)
+    cloud with the newest scan as keyframe.
 
-    Without ego poses in the stream (rosbags carry none on the
-    reference's topics) the platform is assumed static — sweeps stack
-    untransformed, which is exact for a stationary sensor and an
-    explicit, documented approximation otherwise."""
+    With poses, older sweeps are transformed into the keyframe's
+    sensor frame before stacking (ego-motion compensation — without it
+    a moving vehicle smears static structure across sweeps and
+    corrupts the velocity head's input). Without poses the platform is
+    assumed static — exact for a stationary sensor and an explicit,
+    documented approximation otherwise. Mixing posed and poseless
+    pushes in one window is refused loudly."""
 
     def __init__(self, nsweeps: int = 10):
         if nsweeps < 1:
@@ -88,23 +130,45 @@ class SweepBuffer:
         self.nsweeps = nsweeps
         self._window: collections.deque = collections.deque(maxlen=nsweeps)
 
-    def push(self, points: np.ndarray, timestamp: float) -> np.ndarray:
+    def push(
+        self,
+        points: np.ndarray,
+        timestamp: float,
+        pose: np.ndarray | None = None,
+    ) -> np.ndarray:
         """Add the newest scan; returns the aggregated cloud (newest
         first, Δt relative to it)."""
-        self._window.appendleft((np.asarray(points, np.float32), float(timestamp)))
-        sweeps = [p for p, _ in self._window]
-        times = [t for _, t in self._window]
-        return aggregate_sweeps(sweeps, times)
+        self._window.appendleft(
+            (
+                np.asarray(points, np.float32),
+                float(timestamp),
+                None if pose is None else np.asarray(pose, np.float64),
+            )
+        )
+        sweeps = [p for p, _, _ in self._window]
+        times = [t for _, t, _ in self._window]
+        poses = [q for _, _, q in self._window]
+        have = [q is not None for q in poses]
+        if any(have) and not all(have):
+            raise ValueError(
+                "SweepBuffer window mixes posed and poseless scans; "
+                "supply a pose for every push or none"
+            )
+        transforms = relative_transforms(poses) if all(have) and poses else None
+        return aggregate_sweeps(sweeps, times, transforms)
 
     def __len__(self) -> int:
         return len(self._window)
 
 
-def sweep_source(source, nsweeps: int):
+def sweep_source(source, nsweeps: int, pose_lookup=None):
     """Wrap a pull-driven FrameSource so each yielded frame's data is
     the aggregation of the last ``nsweeps`` scans (Δt from the frames'
-    own timestamps). Identity when nsweeps == 1 — single sweeps still
-    gain their zero Δt column from the pipeline's column pad."""
+    own timestamps). ``pose_lookup(frame) -> (4, 4) world_T_sensor or
+    None`` supplies ego poses (io/bag_io.bag_pose_lookup for a bag's
+    odometry topic, or any callback). Identity when nsweeps == 1 —
+    single sweeps still gain their zero Δt column from the pipeline's
+    column pad."""
     import dataclasses
 
     if nsweeps <= 1:
@@ -112,5 +176,16 @@ def sweep_source(source, nsweeps: int):
         return
     buf = SweepBuffer(nsweeps)
     for frame in source:
-        agg = buf.push(np.asarray(frame.data), frame.timestamp)
+        pose = None
+        if pose_lookup is not None:
+            pose = pose_lookup(frame)
+            if pose is None:
+                # a total key mismatch would otherwise degrade to the
+                # very uncompensated stacking --poses exists to fix
+                raise ValueError(
+                    f"pose source has no pose for frame_id "
+                    f"{frame.frame_id} (t={frame.timestamp}); check the "
+                    "pose file's frame_id keying / odometry coverage"
+                )
+        agg = buf.push(np.asarray(frame.data), frame.timestamp, pose)
         yield dataclasses.replace(frame, data=agg)
