@@ -136,7 +136,7 @@ def run_2d(args) -> dict:
 
 
 def run_3d(args) -> dict:
-    work = RUNS / "3d"
+    work = RUNS / f"3d_n{args.n_train}x{args.n_hold}"
     work.mkdir(parents=True, exist_ok=True)
     log = work / "log.txt"
     train_dir, hold_dir = work / "train", work / "hold"
@@ -158,6 +158,7 @@ def run_3d(args) -> dict:
         f"['--family', 'pointpillars',"
         f" '-i', r'{train_dir / 'clouds'}', '--gt', r'{train_dir / 'gt3d.jsonl'}',"
         f" '-b', '{args.batch}', '--steps', '{args.steps}', '--lr', '{args.lr}',"
+        f" '--lr-final', '{args.lr_final}', '--points', '22000',"
         f" '--checkpoint-dir', r'{work / 'ckpts'}', '--save-every', '500',"
         f" '--export', r'{repo}', '-m', 'loop3d', '--log-every', '50'])",
         args.device, log,

@@ -1,0 +1,263 @@
+"""Submanifold sparse conv stack vs dense oracles (ops/sparse_conv.py).
+
+The sparse middle encoder's claim is value-parity with the dense conv
+at occupied sites per layer, and full equality on an all-occupied grid
+(where submanifold == dense by construction). Reference being
+replaced: spconv CUDA stack (examples/second_iou/1/model.py:96-157).
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+from flax import linen as nn
+
+from triton_client_tpu.ops import sparse_conv as sp
+from triton_client_tpu.ops.voxelize import VoxelConfig
+
+GRID = (4, 6, 8)  # (nz, ny, nx)
+
+
+def _random_voxelset(rng, n_occ, c=5, budget=64):
+    nz, ny, nx = GRID
+    cells = rng.choice(nz * ny * nx, size=n_occ, replace=False)
+    ijk = np.stack([cells // (ny * nx), (cells // nx) % ny, cells % nx], 1)
+    feats = np.zeros((budget, c), np.float32)
+    feats[:n_occ] = rng.normal(size=(n_occ, c))
+    ijk_pad = np.zeros((budget, 3), np.int64)
+    ijk_pad[:n_occ] = ijk
+    valid = np.arange(budget) < n_occ
+    return sp.VoxelSet(
+        jnp.asarray(ijk_pad, jnp.int32),
+        jnp.asarray(feats),
+        jnp.asarray(valid),
+        GRID,
+    )
+
+
+def _densify(vs):
+    nz, ny, nx = vs.grid
+    c = vs.feats.shape[-1]
+    vol = np.zeros((nz, ny, nx, c), np.float32)
+    ijk = np.asarray(vs.ijk)
+    for i in range(vs.ijk.shape[0]):
+        if bool(vs.valid[i]):
+            z, y, x = ijk[i]
+            vol[z, y, x] = np.asarray(vs.feats[i])
+    return vol
+
+
+def _dense_conv(vol, w27, cout, stride=1):
+    """lax 3D conv oracle with the sparse (27, cin, cout) weights."""
+    k = np.zeros((3, 3, 3, vol.shape[-1], cout), np.float32)
+    for ki, (dz, dy, dx) in enumerate(sp.kernel_offsets(3)):
+        k[dz + 1, dy + 1, dx + 1] = np.asarray(w27[ki])
+    out = jax.lax.conv_general_dilated(
+        jnp.asarray(vol)[None],
+        jnp.asarray(k),
+        window_strides=(stride, stride, stride),
+        padding=[(1, 1)] * 3,
+        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
+    )
+    return np.asarray(out[0])
+
+
+def test_slot_table_roundtrip():
+    rng = np.random.default_rng(0)
+    vs = _random_voxelset(rng, 10)
+    table = sp.slot_table(vs)
+    ids = np.asarray(sp.linear_ids(vs.ijk, vs.valid, vs.grid))
+    for i in range(10):
+        assert int(table[ids[i]]) == i
+    assert int(table[-1]) == -1
+    occupied = set(ids[:10].tolist())
+    free = [c for c in range(np.prod(GRID)) if c not in occupied][:5]
+    for c in free:
+        assert int(table[c]) == -1
+
+
+def test_subm_conv_matches_dense_at_occupied_sites():
+    rng = np.random.default_rng(1)
+    vs = _random_voxelset(rng, 20)
+    w = jnp.asarray(rng.normal(size=(27, 5, 7)).astype(np.float32))
+    out = sp.subm_conv(vs, sp.slot_table(vs), w)
+    dense = _dense_conv(_densify(vs), w, 7)
+    ijk = np.asarray(vs.ijk)
+    for i in range(20):
+        z, y, x = ijk[i]
+        np.testing.assert_allclose(
+            np.asarray(out[i]), dense[z, y, x], rtol=1e-4, atol=1e-5
+        )
+    # padding rows stay zero
+    np.testing.assert_array_equal(np.asarray(out[20:]), 0.0)
+
+
+def test_strided_conv_matches_dense_at_sites():
+    rng = np.random.default_rng(2)
+    vs = _random_voxelset(rng, 24)
+    w = jnp.asarray(rng.normal(size=(27, 5, 6)).astype(np.float32))
+    out = sp.sparse_strided_conv(vs, sp.slot_table(vs), w, budget=64)
+    dense = _dense_conv(_densify(vs), w, 6, stride=2)
+    # every output site = floor(input/2); values match the dense
+    # stride-2 conv there
+    in_sites = {tuple(r // 2) for r in np.asarray(vs.ijk)[:24]}
+    out_sites = set()
+    o_ijk = np.asarray(out.ijk)
+    for i in range(out.ijk.shape[0]):
+        if bool(out.valid[i]):
+            z, y, x = o_ijk[i]
+            out_sites.add((z, y, x))
+            np.testing.assert_allclose(
+                np.asarray(out.feats[i]), dense[z, y, x], rtol=1e-4, atol=1e-5
+            )
+    assert out_sites == in_sites
+    assert out.grid == (2, 3, 4)
+
+
+def test_downsample_budget_overflow_caps():
+    rng = np.random.default_rng(3)
+    vs = _random_voxelset(rng, 40, budget=64)
+    small = sp.downsample_sites(vs, budget=4)
+    assert int(small.valid.sum()) == 4
+
+
+def test_points_to_voxelset_mean_oracle():
+    cfg = VoxelConfig(
+        point_cloud_range=(0.0, -4.0, -2.0, 8.0, 4.0, 2.0),
+        voxel_size=(1.0, 1.0, 1.0),
+        max_voxels=64,
+        max_points_per_voxel=8,
+    )
+    rng = np.random.default_rng(4)
+    n = 40
+    pts = np.zeros((256, 4), np.float32)
+    pts[:n, 0] = rng.uniform(0, 8, n)
+    pts[:n, 1] = rng.uniform(-4, 4, n)
+    pts[:n, 2] = rng.uniform(-2, 2, n)
+    pts[:n, 3] = rng.uniform(0, 1, n)
+    vs = sp.points_to_voxelset(jnp.asarray(pts), jnp.asarray(n), cfg, 64)
+
+    # numpy oracle: group points by cell, compare means
+    ijk = np.floor(
+        (pts[:n, :3] - [0.0, -4.0, -2.0]) / [1.0, 1.0, 1.0]
+    ).astype(int)
+    table = {}
+    for p, (x, y, z) in zip(pts[:n], ijk):
+        table.setdefault((z, y, x), []).append(p)
+    got = {
+        tuple(np.asarray(vs.ijk[i])): np.asarray(vs.feats[i])
+        for i in range(64)
+        if bool(vs.valid[i])
+    }
+    assert set(got) == set(table)
+    for cell, rows in table.items():
+        np.testing.assert_allclose(
+            got[cell], np.mean(rows, axis=0), rtol=1e-5, atol=1e-6
+        )
+
+
+def test_sparse_second_all_occupied_matches_dense():
+    """On an all-occupied tiny grid submanifold == dense everywhere, so
+    the two SECOND middle encoders must produce identical heads once
+    the dense kernels are mapped onto the sparse (27, cin, cout)
+    layout."""
+    from triton_client_tpu.models.second import SECONDConfig, SECONDIoU
+
+    voxel = VoxelConfig(
+        point_cloud_range=(0.0, -8.0, -2.0, 16.0, 8.0, 2.0),
+        voxel_size=(1.0, 1.0, 1.0),
+        max_voxels=1024,
+        max_points_per_voxel=4,
+    )
+    base = dict(
+        voxel=voxel,
+        middle_filters=(8, 8),
+        backbone_layers=(1,),
+        backbone_strides=(1,),
+        backbone_filters=(16,),
+        upsample_strides=(1,),
+        upsample_filters=(16,),
+    )
+    dense_cfg = SECONDConfig(**base)
+    sparse_cfg = SECONDConfig(**base, middle="sparse")
+    nz, ny, nx = 4, 16, 16  # grid_size reordered
+
+    # one point in EVERY cell -> all-occupied
+    zs, ys, xs = np.meshgrid(
+        np.arange(nz), np.arange(ny), np.arange(nx), indexing="ij"
+    )
+    pts = np.stack(
+        [
+            xs.ravel() + 0.5,
+            ys.ravel() - 8 + 0.5,
+            zs.ravel() - 2 + 0.5,
+            np.linspace(0, 1, nz * ny * nx),
+        ],
+        axis=1,
+    ).astype(np.float32)
+    count = jnp.asarray(pts.shape[0])
+
+    dense_model = SECONDIoU(dense_cfg)
+    sparse_model = SECONDIoU(sparse_cfg)
+    dv = dense_model.init(
+        jax.random.PRNGKey(0), jnp.asarray(pts), count,
+        method=SECONDIoU.from_points,
+    )
+    svars = sparse_model.init(
+        jax.random.PRNGKey(0), jnp.asarray(pts), count,
+        method=SECONDIoU.from_points,
+    )
+
+    # graft: identical backbone/head params; dense middle kernels
+    # (3,3,3,cin,cout) -> sparse (27,cin,cout); keep the sparse BN
+    # params/stats (init-identical to dense's)
+    dp = dv["params"]
+    spar = {k: v for k, v in svars["params"].items()}
+    for k in dp:
+        if k != "middle":
+            spar[k] = dp[k]
+    mid = dict(svars["params"]["middle"])
+    for si in range(2):
+        kern = np.asarray(dp["middle"][f"conv{si}"]["kernel"])
+        w27 = np.zeros((27, kern.shape[3], kern.shape[4]), np.float32)
+        for ki, (dz, dy, dx) in enumerate(sp.kernel_offsets(3)):
+            w27[ki] = kern[dz + 1, dy + 1, dx + 1]
+        mid[f"conv{si}"] = jnp.asarray(w27)
+    spar["middle"] = mid
+    svars = {"params": spar, "batch_stats": svars["batch_stats"]}
+
+    dense_out = dense_model.apply(
+        dv, jnp.asarray(pts), count, method=SECONDIoU.from_points
+    )
+    sparse_out = sparse_model.apply(
+        svars, jnp.asarray(pts), count, method=SECONDIoU.from_points
+    )
+    for k in ("cls", "box", "dir", "iou"):
+        np.testing.assert_allclose(
+            np.asarray(dense_out[k]), np.asarray(sparse_out[k]),
+            rtol=2e-3, atol=2e-3,
+        )
+
+
+def test_downsample_odd_extent_keeps_top_plane():
+    """ceil(n/2) coarse extents: an odd-sized level must keep voxels
+    whose floor(ijk/2) lands in the last plane (the dense stride-2
+    padding-1 output is ceil(n/2) — parity would silently drop the top
+    0.4 m slab otherwise)."""
+    nz, ny, nx = 5, 6, 8
+    ijk = np.array([[4, 5, 7], [0, 0, 0]], np.int32)  # z=4 -> coarse z=2
+    vs = sp.VoxelSet(
+        jnp.asarray(ijk),
+        jnp.zeros((2, 3)),
+        jnp.ones((2,), bool),
+        (nz, ny, nx),
+    )
+    out = sp.downsample_sites(vs, budget=8)
+    assert out.grid == (3, 3, 4)
+    sites = {
+        tuple(np.asarray(out.ijk[i]))
+        for i in range(8)
+        if bool(out.valid[i])
+    }
+    assert sites == {(2, 2, 3), (0, 0, 0)}

@@ -82,6 +82,44 @@ def parse_args(argv=None) -> argparse.Namespace:
     return args
 
 
+def _check_poses_args(args, nsweeps: int | None = None) -> None:
+    """--poses usage guards, cheap and decidable from args (+ the
+    resolved nsweeps when known). Called twice: early in main (before
+    the expensive model build) and in _run_3d (with real nsweeps)."""
+    if not args.poses:
+        return
+    import os
+
+    if nsweeps is not None:
+        too_few = nsweeps <= 1
+    else:
+        too_few = args.sweeps is not None and args.sweeps <= 1
+    if too_few:
+        raise SystemExit(
+            "--poses only affects multi-sweep aggregation; add --sweeps N"
+        )
+    if args.poses == "odom" or args.poses.startswith("odom:"):
+        if not args.input.endswith(".bag"):
+            raise SystemExit(
+                "--poses odom[:topic] reads the INPUT bag's odometry "
+                "topic; the input must be a .bag"
+            )
+    elif not os.path.exists(args.poses):
+        raise SystemExit(f"--poses: no such pose file {args.poses!r}")
+
+
+def _build_pose_lookup(args):
+    """args.poses (already validated) -> pose_lookup callback."""
+    if args.poses == "odom" or args.poses.startswith("odom:"):
+        from triton_client_tpu.io.bag_io import bag_pose_lookup
+
+        _, _, topic = args.poses.partition(":")
+        return bag_pose_lookup(args.input, topic or None)
+    from triton_client_tpu.io.bag_io import pose_lookup_from_jsonl
+
+    return pose_lookup_from_jsonl(args.poses)
+
+
 def main(argv=None) -> None:
     args = parse_args(argv)
     if args.sink == "images":
@@ -93,24 +131,7 @@ def main(argv=None) -> None:
     if args.async_set:
         _check_async_flags(args)
 
-    if args.poses:
-        # everything decidable from args fails here, BEFORE the
-        # expensive model build (the full nsweeps-aware guard runs in
-        # _run_3d once the config's nsweeps is known)
-        import os
-
-        if args.sweeps is not None and args.sweeps <= 1:
-            raise SystemExit(
-                "--poses only affects multi-sweep aggregation; add --sweeps N"
-            )
-        if args.poses == "odom" or args.poses.startswith("odom:"):
-            if not args.input.endswith(".bag"):
-                raise SystemExit(
-                    "--poses odom[:topic] reads the INPUT bag's odometry "
-                    "topic; the input must be a .bag"
-                )
-        elif not os.path.exists(args.poses):
-            raise SystemExit(f"--poses: no such pose file {args.poses!r}")
+    _check_poses_args(args)
 
     from triton_client_tpu.drivers.driver import (
         InferenceDriver,
@@ -232,29 +253,11 @@ def _run_3d(args, infer, model_name: str, nsweeps: int = 1) -> None:
     from triton_client_tpu.io.sources import open_source
 
     source = open_source(args.input, args.limit, kind="pointcloud")
-    if args.poses and nsweeps <= 1:
-        raise SystemExit(
-            "--poses only affects multi-sweep aggregation; add --sweeps N"
-        )
+    _check_poses_args(args, nsweeps)
     if nsweeps > 1:
         from triton_client_tpu.ops.sweeps import sweep_source
 
-        pose_lookup = None
-        if args.poses:
-            if args.poses == "odom" or args.poses.startswith("odom:"):
-                if not args.input.endswith(".bag"):
-                    raise SystemExit(
-                        "--poses odom[:topic] reads the INPUT bag's odometry "
-                        "topic; the input must be a .bag"
-                    )
-                from triton_client_tpu.io.bag_io import bag_pose_lookup
-
-                _, _, topic = args.poses.partition(":")
-                pose_lookup = bag_pose_lookup(args.input, topic or None)
-            else:
-                from triton_client_tpu.io.bag_io import pose_lookup_from_jsonl
-
-                pose_lookup = pose_lookup_from_jsonl(args.poses)
+        pose_lookup = _build_pose_lookup(args) if args.poses else None
         source = sweep_source(source, nsweeps, pose_lookup)
     evaluator = gt_lookup = None
     if args.gt:

@@ -1,0 +1,254 @@
+"""Submanifold sparse 3D convolution, TPU-style (fixed occupancy budget).
+
+The reference's SECOND-IoU runs spconv CUDA sparse convolutions at
+0.05 m voxels (examples/second_iou/1/model.py:96-157; built at
+docker/server_3d/Dockerfile:41-55). The dense emulation tops out at
+0.1 m (the 0.05 m volume is 5.4 GB — BASELINE.md grid sweep), while
+occupancy is only ~60k voxels of 90M cells, so this module implements
+the sparse stack the TPU way: static shapes everywhere, gathers +
+per-offset MXU matmuls instead of hash-table rulebooks.
+
+Representation per level — a fixed-budget voxel set:
+  * ``ijk (V, 3)`` int32 cell coords [z, y, x] (padding rows anything),
+  * ``feats (V, C)``,
+  * ``valid (V,)`` bool.
+
+Neighbor lookup is a dense int32 slot table over the full cell grid
+(built once per level per scan): 90M cells x int32 = 360 MB HBM at the
+reference 0.05 m grid — affordable transient state on a 16 GB chip,
+and each submanifold layer at that level reuses it. Convs then are,
+per kernel offset, a row gather + a (V, Cin) x (Cin, Cout) matmul —
+exactly the shape the MXU wants.
+
+Operators (MinkowskiEngine semantics, the standard TPU-friendly
+variant of spconv):
+  * ``subm_conv``  — outputs only at input sites (spconv SubMConv3d);
+  * ``sparse_strided_conv`` — stride-2 downsample whose output sites
+    are unique(floor(ijk / 2)) (Minkowski strided conv; spconv's
+    SparseConv3d generates a slightly larger site set — up to one
+    extra cell along odd borders — an accepted, documented departure).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class VoxelSet(NamedTuple):
+    """One sparse level: fixed-budget voxel coords + features."""
+
+    ijk: jnp.ndarray    # (V, 3) int32 [z, y, x]
+    feats: jnp.ndarray  # (V, C)
+    valid: jnp.ndarray  # (V,) bool
+    grid: tuple[int, int, int]  # (nz, ny, nx) cell extents
+
+
+def linear_ids(ijk: jnp.ndarray, valid: jnp.ndarray, grid) -> jnp.ndarray:
+    """(V,) linearized (z * ny + y) * nx + x; invalid rows -> n_cells
+    (the dump slot)."""
+    nz, ny, nx = grid
+    inb = (
+        valid
+        & (ijk[:, 0] >= 0) & (ijk[:, 0] < nz)
+        & (ijk[:, 1] >= 0) & (ijk[:, 1] < ny)
+        & (ijk[:, 2] >= 0) & (ijk[:, 2] < nx)
+    )
+    flat = (ijk[:, 0] * ny + ijk[:, 1]) * nx + ijk[:, 2]
+    return jnp.where(inb, flat, nz * ny * nx)
+
+
+def slot_table(vs: VoxelSet) -> jnp.ndarray:
+    """Dense (n_cells + 1,) int32 table: cell id -> row in the voxel
+    set, -1 where unoccupied. The +1 dump slot absorbs invalid rows."""
+    nz, ny, nx = vs.grid
+    ids = linear_ids(vs.ijk, vs.valid, vs.grid)
+    table = jnp.full((nz * ny * nx + 1,), -1, jnp.int32)
+    table = table.at[ids].set(
+        jnp.arange(vs.ijk.shape[0], dtype=jnp.int32),
+        mode="drop",
+    )
+    # invalid rows all landed on the dump entry — restore its -1 so an
+    # out-of-range neighbor never resolves to a real-looking row
+    return table.at[-1].set(-1)
+
+
+def kernel_offsets(k: int = 3) -> np.ndarray:
+    """(k^3, 3) [dz, dy, dx] offsets, center-ordered last dim fastest."""
+    r = np.arange(k) - (k - 1) // 2
+    return np.stack(np.meshgrid(r, r, r, indexing="ij"), -1).reshape(-1, 3)
+
+
+def gather_neighbor_slots(
+    table: jnp.ndarray,
+    vs: VoxelSet,
+    offsets: np.ndarray,
+    base_scale: int = 1,
+) -> jnp.ndarray:
+    """(K, V) int32 neighbor rows (-1 = missing). ``base_scale`` maps
+    output coords to the finer input lattice (2 for stride-2 convs):
+    neighbor of output site o is input cell base_scale*o + offset."""
+    nz, ny, nx = vs.grid
+
+    def one(off):
+        n_ijk = vs.ijk * base_scale + jnp.asarray(off, jnp.int32)[None]
+        ids = linear_ids(n_ijk, vs.valid, (nz, ny, nx))
+        return table[ids]
+
+    return jnp.stack([one(off) for off in offsets])
+
+
+def offset_matmul_sum(
+    in_feats: jnp.ndarray,    # (V_in, Cin)
+    nbr_slots: jnp.ndarray,   # (K, V_out)
+    weights: jnp.ndarray,     # (K, Cin, Cout)
+) -> jnp.ndarray:
+    """sum_k gather(in_feats, nbr_slots[k]) @ weights[k] — the sparse
+    conv compute core. Missing neighbors (-1) read a zero row, exactly
+    the zeros a dense conv sees at unoccupied cells."""
+    v_in, cin = in_feats.shape
+    padded = jnp.concatenate(
+        [in_feats, jnp.zeros((1, cin), in_feats.dtype)], axis=0
+    )
+    slots = jnp.where(nbr_slots < 0, v_in, nbr_slots)  # -1 -> zero row
+
+    def body(acc, kw):
+        slot_k, w_k = kw
+        return acc + padded[slot_k] @ w_k, None
+
+    out0 = jnp.zeros((nbr_slots.shape[1], weights.shape[2]), in_feats.dtype)
+    out, _ = jax.lax.scan(body, out0, (slots, weights))
+    return out
+
+
+def _compact_unique(ids: jnp.ndarray, budget: int, dump: int):
+    """Sorted unique-compaction shared by the downsampler and the
+    sparse VFE: ``ids`` with ``dump`` marking invalid -> (out_ids
+    (budget,) int32 padded with dump, valid (budget,), order, s_ids,
+    first, rank) where rank is each sorted row's unique-cell index."""
+    order = jnp.argsort(ids)
+    s_ids = ids[order]
+    first = jnp.concatenate(
+        [jnp.ones((1,), bool), s_ids[1:] != s_ids[:-1]]
+    ) & (s_ids < dump)
+    rank = jnp.cumsum(first) - 1
+    out_ids = jnp.full((budget,), dump, jnp.int32)
+    out_ids = out_ids.at[jnp.where(first, rank, budget)].set(
+        s_ids, mode="drop"
+    )
+    return out_ids, out_ids < dump, order, s_ids, first, rank
+
+
+def _unflatten(ids: jnp.ndarray, valid: jnp.ndarray, grid) -> jnp.ndarray:
+    """(V,) linear ids -> (V, 3) [z, y, x] (invalid rows zeroed)."""
+    nz, ny, nx = grid
+    safe = jnp.where(valid, ids, 0)
+    z = safe // (ny * nx)
+    y = (safe // nx) % ny
+    x = safe % nx
+    return jnp.stack([z, y, x], axis=1).astype(jnp.int32)
+
+
+def downsample_sites(vs: VoxelSet, budget: int) -> VoxelSet:
+    """Unique(floor(ijk / 2)) output sites of a stride-2 conv, compacted
+    into a fixed ``budget``. The coarse extent is ceil(n/2) per axis —
+    the dense stride-2 padding-1 output size — so odd-extent levels
+    keep their top plane. Features are left empty — the strided conv
+    fills them."""
+    nz, ny, nx = vs.grid
+    cgrid = ((nz + 1) // 2, (ny + 1) // 2, (nx + 1) // 2)
+    coarse = vs.ijk // 2
+    ids = linear_ids(coarse, vs.valid, cgrid)  # invalid -> dump id
+    dump = cgrid[0] * cgrid[1] * cgrid[2]
+    out_ids, o_valid, _, _, _, _ = _compact_unique(ids, budget, dump)
+    o_ijk = _unflatten(out_ids, o_valid, cgrid)
+    return VoxelSet(o_ijk, jnp.zeros((budget, 0)), o_valid, cgrid)
+
+
+def subm_conv(
+    vs: VoxelSet,
+    table: jnp.ndarray,
+    weights: jnp.ndarray,  # (27, Cin, Cout)
+) -> jnp.ndarray:
+    """Submanifold 3x3x3 conv: (V, Cout) at the SAME sites. At every
+    occupied site the result equals a dense conv's (unoccupied
+    neighbors contribute the same zeros), and no new sites appear —
+    spconv SubMConv3d semantics."""
+    nbr = gather_neighbor_slots(table, vs, kernel_offsets(3))
+    out = offset_matmul_sum(vs.feats, nbr, weights)
+    return jnp.where(vs.valid[:, None], out, 0.0)
+
+
+def sparse_strided_conv(
+    vs: VoxelSet,
+    table: jnp.ndarray,
+    weights: jnp.ndarray,  # (27, Cin, Cout)
+    budget: int,
+) -> VoxelSet:
+    """Stride-2 3x3x3 sparse conv (padding 1): output sites are the
+    stride-2 lattice cells floor(ijk/2); out[o] = sum_d w[d] *
+    in[2o + d], d in [-1, 1]^3 — value-identical to the dense stride-2
+    conv at those sites."""
+    out_sites = downsample_sites(vs, budget)
+    scaled = VoxelSet(out_sites.ijk, out_sites.feats, out_sites.valid, vs.grid)
+    nbr = gather_neighbor_slots(table, scaled, kernel_offsets(3), base_scale=2)
+    out = offset_matmul_sum(vs.feats, nbr, weights)
+    out = jnp.where(out_sites.valid[:, None], out, 0.0)
+    return VoxelSet(out_sites.ijk, out, out_sites.valid, out_sites.grid)
+
+
+def scatter_bev(vs: VoxelSet) -> jnp.ndarray:
+    """Final z-fold: scatter (V, C) into the dense (ny, nx, nz * C)
+    BEV the 2D backbone consumes (the dense path's transpose+reshape,
+    sparse-side)."""
+    nz, ny, nx = vs.grid
+    c = vs.feats.shape[-1]
+    ids = linear_ids(vs.ijk, vs.valid, vs.grid)
+    canvas = jnp.zeros((nz * ny * nx + 1, c), vs.feats.dtype)
+    canvas = canvas.at[ids].set(vs.feats, mode="drop")
+    vol = canvas[:-1].reshape(nz, ny, nx, c)
+    return jnp.transpose(vol, (1, 2, 0, 3)).reshape(ny, nx, nz * c)
+
+
+def points_to_voxelset(
+    points: jnp.ndarray,  # (N, F) padded cloud
+    count: jnp.ndarray,   # () real rows
+    voxel_cfg,
+    budget: int,
+) -> VoxelSet:
+    """Sparse MeanVFE: unique occupied cells (sorted compaction, capped
+    at ``budget``) with per-cell mean features — the sparse-side
+    replacement for scattering means into the 90M-cell dense volume."""
+    from triton_client_tpu.ops.voxelize import assign_cells
+
+    nx, ny, nz = voxel_cfg.grid_size
+    ijk_xyz, valid = assign_cells(points, count, voxel_cfg)
+    # assign_cells gives [x, y, z] order; flip to [z, y, x]
+    ijk = jnp.stack([ijk_xyz[:, 2], ijk_xyz[:, 1], ijk_xyz[:, 0]], axis=1)
+    ids = linear_ids(ijk, valid, (nz, ny, nx))
+    dump = nz * ny * nx
+    n = points.shape[0]
+    out_ids, o_valid, order, s_ids, first, rank = _compact_unique(
+        ids, budget, dump
+    )
+    # voxel row per original point (points beyond budget -> dropped)
+    slot_sorted = jnp.where(s_ids < dump, rank, budget)
+    slot_sorted = jnp.where(slot_sorted < budget, slot_sorted, budget)
+    slot = jnp.zeros((n,), jnp.int32).at[order].set(
+        slot_sorted.astype(jnp.int32)
+    )
+
+    f = points.shape[1]
+    acc = jnp.zeros((budget + 1, f + 1), points.dtype)
+    w = valid.astype(points.dtype)[:, None]
+    acc = acc.at[slot].add(
+        jnp.concatenate([points, jnp.ones_like(w)], axis=1) * w
+    )
+    feats = acc[:budget, :f] / jnp.maximum(acc[:budget, f:], 1.0)
+    v_ijk = _unflatten(out_ids, o_valid, (nz, ny, nx))
+    return VoxelSet(
+        v_ijk, jnp.where(o_valid[:, None], feats, 0.0), o_valid, (nz, ny, nx)
+    )

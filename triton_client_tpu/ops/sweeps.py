@@ -138,6 +138,14 @@ class SweepBuffer:
     ) -> np.ndarray:
         """Add the newest scan; returns the aggregated cloud (newest
         first, Δt relative to it)."""
+        # validate BEFORE appending: a rejected push must not poison
+        # the window for the following (correct) pushes
+        window_posed = [q is not None for _, _, q in self._window]
+        if window_posed and (pose is not None) != window_posed[0]:
+            raise ValueError(
+                "SweepBuffer window mixes posed and poseless scans; "
+                "supply a pose for every push or none"
+            )
         self._window.appendleft(
             (
                 np.asarray(points, np.float32),
@@ -149,11 +157,6 @@ class SweepBuffer:
         times = [t for _, t, _ in self._window]
         poses = [q for _, _, q in self._window]
         have = [q is not None for q in poses]
-        if any(have) and not all(have):
-            raise ValueError(
-                "SweepBuffer window mixes posed and poseless scans; "
-                "supply a pose for every push or none"
-            )
         transforms = relative_transforms(poses) if all(have) and poses else None
         return aggregate_sweeps(sweeps, times, transforms)
 
