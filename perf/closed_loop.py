@@ -136,17 +136,23 @@ def run_2d(args) -> dict:
 
 
 def run_3d(args) -> dict:
-    work = RUNS / f"3d_n{args.n_train}x{args.n_hold}"
+    # workdir encodes the dataset recipe (incl. yaw distribution) so a
+    # recipe change can never silently reuse a stale cached dataset
+    work = RUNS / f"3d_n{args.n_train}x{args.n_hold}_road"
     work.mkdir(parents=True, exist_ok=True)
     log = work / "log.txt"
     train_dir, hold_dir = work / "train", work / "hold"
 
     if not (train_dir / "gt3d.jsonl").exists():
         print(f"generating {args.n_train}+{args.n_hold} scenes ...", flush=True)
+        # road-like yaw: the distribution the reference's axis-aligned
+        # anchor config is designed for (KITTI traffic)
         _python(
             "from triton_client_tpu.io.synthdata import write_scene_dataset;"
-            f"write_scene_dataset(r'{train_dir}', {args.n_train}, seed=0);"
-            f"write_scene_dataset(r'{hold_dir}', {args.n_hold}, seed=1)",
+            f"write_scene_dataset(r'{train_dir}', {args.n_train}, seed=0,"
+            " yaw_mode='road');"
+            f"write_scene_dataset(r'{hold_dir}', {args.n_hold}, seed=1,"
+            " yaw_mode='road')",
             "cpu", log,
         )
 

@@ -191,6 +191,7 @@ def synth_scene_frame(
     n_clutter: int = 16_000,
     class_names: tuple[str, ...] = ("Car", "Pedestrian", "Cyclist"),
     yaw: bool = True,
+    yaw_mode: str = "uniform",
     min_points: int = 20,
 ) -> tuple[np.ndarray, np.ndarray]:
     """One labeled scan: (points (N, 4) [x, y, z, intensity] float32,
@@ -200,7 +201,14 @@ def synth_scene_frame(
     falls ~1/r^2 with range (perf/profile_second_grid.py's scene model,
     plus per-object yaw so the rotated-IoU eval path is exercised);
     objects closer than `min_points` returns are rejected so every GT
-    box is actually observable."""
+    box is actually observable.
+
+    ``yaw_mode``: 'uniform' draws headings uniformly (the hard,
+    rotation-agnostic case); 'road' draws 80% near an axis (N(axis,
+    0.15), axis in {0, pi/2, pi, -pi/2}) + 20% uniform — KITTI-like
+    traffic, the distribution the reference's axis-aligned anchor
+    config (data/pointpillar.yaml:118-142 rotations [0, 1.57]) is
+    designed for."""
     x0, y0, _z0, x1, y1, _z1 = pc_range
     ground = np.stack(
         [
@@ -220,7 +228,13 @@ def synth_scene_frame(
             cx = float(rng.uniform(x0 + 5, min(x1 - 3, 60)))
             cy = float(rng.uniform(y0 + 5, y1 - 5))
             cz = bz + dz / 2
-            ry = float(rng.uniform(-np.pi, np.pi)) if yaw else 0.0
+            if not yaw:
+                ry = 0.0
+            elif yaw_mode == "road" and rng.uniform() < 0.8:
+                axis = rng.choice([0.0, np.pi / 2, np.pi, -np.pi / 2])
+                ry = float(axis + rng.normal(0.0, 0.15))
+            else:
+                ry = float(rng.uniform(-np.pi, np.pi))
             r = float(np.hypot(cx, cy))
             n_pts = int(60_000 / max(r, 5) ** 2)
             if n_pts < min_points:
