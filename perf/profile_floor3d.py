@@ -1,0 +1,118 @@
+"""3D non-scatter floor breakdown (VERDICT r2 #5).
+
+r2's ablation put the PointPillars pipeline at ~14.8 ms/scan with a
+~7.4 ms non-scatter floor (backbone + heads + decode) that never got a
+breakdown. Whole-pipeline A/B variants (stage isolation is confounded
+by XLA hoisting):
+
+  * base      — shipping scatter-VFE pipeline, structured scene;
+  * no_post   — heads only (no decode_topk/NMS): the decode+NMS slab;
+  * pre256    — decode_topk pre_max 512 -> 256 (earlier, narrower
+                top-k);
+  * up64      — upsample_filters (128,128,128) -> (64,64,64): halves
+                the concat width feeding the heads (the biggest
+                activation in the BEV stack);
+  * thin_bb   — backbone_filters (64,128,256) -> (32,64,128);
+  * up64+thin — both (the cheap-BEV frontier).
+
+Architecture variants change the MODEL (quality unmeasured here) —
+they are perf probes locating where the floor's milliseconds live,
+not shippable configs by themselves.
+"""
+
+import _harness  # noqa: F401
+
+import dataclasses
+import sys
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from _harness import compile_looped, run_trials
+
+from triton_client_tpu.io.synthdata import synth_scene_frame
+from triton_client_tpu.models.pointpillars import (
+    PointPillarsConfig,
+    init_pointpillars,
+)
+from triton_client_tpu.ops.detect3d_postprocess import nms_pack_3d
+from triton_client_tpu.ops.voxelize import pad_points
+
+BUDGET = 131_072
+
+
+def scene():
+    rng = np.random.default_rng(0)
+    pts, _ = synth_scene_frame(
+        rng, n_objects=10, n_clutter=108_000,
+    )
+    padded, m = pad_points(pts[:BUDGET], BUDGET)
+    return jnp.asarray(padded), jnp.asarray(m)
+
+
+def make_case(cfg_kw=None, with_post=True, pre_max=512):
+    cfg = PointPillarsConfig(**(cfg_kw or {}))
+    model, variables = init_pointpillars(jax.random.PRNGKey(0), cfg)
+    pts, m = scene()
+
+    def step(tok):
+        # mirrors Detect3DPipeline._pipeline's shipping sequence
+        heads = model.apply(
+            variables, pts + tok * 0.0, m, train=False,
+            method=type(model).from_points,
+        )
+        if not with_post:
+            return tok * 0.5 + sum(
+                jnp.sum(h) for h in heads.values()
+            ).astype(jnp.float32) * 1e-9
+        cand = model.decode_topk(heads, pre_max=pre_max, score_thresh=0.1)
+        dets, valid = nms_pack_3d(
+            cand["boxes"], cand["scores"], cand["labels"],
+            iou_thresh=0.01, max_det=128,
+        )
+        return (
+            tok * 0.5
+            + jnp.sum(valid).astype(jnp.float32)
+            + jnp.sum(dets) * 1e-9
+        )
+
+    return step
+
+
+def main():
+    inner = 20
+    wanted = sys.argv[1:] or [
+        "base", "no_post", "pre256", "up64", "thin_bb", "up64_thin",
+    ]
+    factories = {
+        "base": lambda: make_case(),
+        "no_post": lambda: make_case(with_post=False),
+        "pre256": lambda: make_case(pre_max=256),
+        "up64": lambda: make_case(
+            {"upsample_filters": (64, 64, 64)}
+        ),
+        "thin_bb": lambda: make_case(
+            {"backbone_filters": (32, 64, 128)}
+        ),
+        "up64_thin": lambda: make_case(
+            {
+                "upsample_filters": (64, 64, 64),
+                "backbone_filters": (32, 64, 128),
+            }
+        ),
+    }
+    cases = []
+    for name in wanted:
+        print(f"compiling {name} ...", flush=True)
+        cases.append((name, compile_looped(factories[name](), inner)))
+    out = run_trials(cases, inner=inner, trials=8)
+    print("\n== results ==")
+    for name, ms in out.items():
+        print(f"{name:10s} {ms:7.3f} ms/scan  {1000.0/ms:7.1f} scans/s",
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()

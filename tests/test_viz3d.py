@@ -1,0 +1,118 @@
+"""Interactive 3D viewer (io/viz3d.py) against a fake open3d —
+geometry construction is what we own; the window itself is open3d's.
+Reference: clients/postprocess/visualize_open3d.py:38-117."""
+
+import sys
+import types
+
+import numpy as np
+import pytest
+
+
+class _Vec:
+    def __init__(self, data):
+        self.data = np.asarray(data)
+
+
+class _LineSet:
+    def __init__(self):
+        self.points = None
+        self.lines = None
+        self.colors = None
+
+
+class _PointCloud:
+    def __init__(self):
+        self.points = None
+        self.color = None
+
+    def paint_uniform_color(self, c):
+        self.color = c
+
+
+def _fake_open3d(drawn):
+    o3d = types.ModuleType("open3d")
+    geometry = types.SimpleNamespace(
+        LineSet=_LineSet,
+        PointCloud=_PointCloud,
+        TriangleMesh=types.SimpleNamespace(
+            create_coordinate_frame=lambda size=1.0: ("frame", size)
+        ),
+    )
+    utility = types.SimpleNamespace(
+        Vector3dVector=_Vec, Vector2iVector=_Vec
+    )
+    visualization = types.SimpleNamespace(
+        draw_geometries=lambda geoms, window_name="": drawn.append(
+            (geoms, window_name)
+        )
+    )
+    o3d.geometry = geometry
+    o3d.utility = utility
+    o3d.visualization = visualization
+    return o3d
+
+
+@pytest.fixture
+def fake_o3d(monkeypatch):
+    drawn = []
+    monkeypatch.setitem(sys.modules, "open3d", _fake_open3d(drawn))
+    return drawn
+
+
+def test_missing_open3d_raises_actionable(monkeypatch):
+    monkeypatch.setitem(sys.modules, "open3d", None)
+    from triton_client_tpu.io import viz3d
+
+    with pytest.raises(ImportError, match="open3d"):
+        viz3d.draw_detections_3d(np.zeros((5, 4)))
+
+
+def test_scene_geometries_structure(fake_o3d):
+    from triton_client_tpu.io import viz3d
+
+    points = np.random.default_rng(0).uniform(-5, 5, (50, 4))
+    preds = np.array([[0.0, 0.0, 0.0, 4.0, 2.0, 1.5, 0.3]])
+    gts = np.array(
+        [
+            [1.0, 1.0, 0.0, 4.0, 2.0, 1.5, 0.0],
+            [5.0, 5.0, 0.0, 1.0, 1.0, 2.0, 0.7],
+        ]
+    )
+    geoms = viz3d.scene_geometries(points, preds, gts)
+    # frame + cloud + 1 pred lineset + 2 gt linesets
+    assert len(geoms) == 5
+    cloud = geoms[1]
+    assert cloud.points.data.shape == (50, 3)
+    pred_ls = geoms[2]
+    assert pred_ls.points.data.shape == (8, 3)
+    assert pred_ls.lines.data.shape == (14, 2)  # 12 edges + heading cross
+    np.testing.assert_allclose(pred_ls.colors.data[0], viz3d.PRED_COLOR)
+    np.testing.assert_allclose(geoms[3].colors.data[0], viz3d.GT_COLOR)
+
+
+def test_show_sink_draws_per_frame(fake_o3d):
+    from triton_client_tpu.io.sources import Frame
+    from triton_client_tpu.io.viz3d import ShowSink3D
+
+    gt = np.array([[1.0, 1.0, 0.0, 4.0, 2.0, 1.5, 0.0, 0.0]])
+    sink = ShowSink3D(gt_lookup=lambda frame: gt)
+    frame = Frame(np.zeros((10, 4), np.float32), 3, 0.0)
+    sink.write(
+        frame,
+        {"pred_boxes": np.array([[0.0, 0, 0, 1, 1, 1, 0]]),
+         "pred_scores": np.array([0.9])},
+    )
+    sink.close()
+    assert len(fake_o3d) == 1
+    geoms, window = fake_o3d[0]
+    assert window == "frame 3"
+    assert len(geoms) == 4  # frame + cloud + 1 pred + 1 gt
+
+
+def test_detect3d_show_without_open3d_exits(monkeypatch):
+    monkeypatch.setitem(sys.modules, "open3d", None)
+    from triton_client_tpu.cli.detect3d import main
+
+    with pytest.raises(SystemExit, match="open3d"):
+        main(["-i", "synthetic:1", "--show", "--limit", "1"])

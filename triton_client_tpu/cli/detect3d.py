@@ -56,6 +56,12 @@ def parse_args(argv=None) -> argparse.Namespace:
         "default: the config's nsweeps (1)",
     )
     parser.add_argument(
+        "--show", action="store_true",
+        help="open an interactive Open3D window per frame (close it to "
+        "advance; the reference's visualize_open3d draw_scenes loop). "
+        "Needs open3d installed; --sink keeps working without it",
+    )
+    parser.add_argument(
         "--poses",
         default="",
         help="ego-pose source for --sweeps > 1: 'odom[:topic]' (read "
@@ -132,6 +138,14 @@ def main(argv=None) -> None:
         _check_async_flags(args)
 
     _check_poses_args(args)
+    if args.show:
+        # fail before the expensive model build, not after
+        try:
+            from triton_client_tpu.io.viz3d import _require_open3d
+
+            _require_open3d()
+        except ImportError as e:
+            raise SystemExit(str(e))
 
     from triton_client_tpu.drivers.driver import (
         InferenceDriver,
@@ -232,6 +246,11 @@ def _run_3d(args, infer, model_name: str, nsweeps: int = 1) -> None:
     """Shared driver tail for local (TPUChannel) and remote (gRPC)
     modes: ROS subscriber or pull-driven file/bag source."""
     if args.input.startswith("ros:"):
+        if args.show:
+            raise SystemExit(
+                "--show is replay-only (the live ROS path publishes box "
+                "arrays for rviz instead); drop --show for ros: inputs"
+            )
         if nsweeps > 1:
             # live aggregation needs per-message stamps + ego poses the
             # subscribed topics don't carry; replay sources support it
@@ -266,11 +285,20 @@ def _run_3d(args, infer, model_name: str, nsweeps: int = 1) -> None:
 
         evaluator = Detection3DEvaluator()
         gt_lookup = load_gt3d_lookup(args.gt)
+    if args.show:
+        from triton_client_tpu.io.viz3d import ShowSink3D
+
+        try:
+            sink = ShowSink3D(gt_lookup)
+        except ImportError as e:
+            raise SystemExit(str(e))
+    else:
+        sink = make_sink(args)
     profiler = make_profiler(args)
     driver = InferenceDriver(
         infer,
         source,
-        sink=make_sink(args),
+        sink=sink,
         prefetch=args.prefetch,
         warmup=args.warmup,
         evaluator=evaluator,
