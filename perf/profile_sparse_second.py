@@ -108,12 +108,73 @@ def main():
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
     if which in ("probe", "all"):
         probe_gather()
+    if which in ("probe2",):
+        probe_lookup_alternatives()
     if which in ("sparse", "all"):
         bench_pipeline(
             "data/kitti_second_sparse005.yaml", "sparse SECOND 0.05 m"
         )
     if which in ("dense", "all"):
         bench_pipeline("data/kitti_second_dense01.yaml", "dense SECOND 0.10 m")
+
+
+
+
+def probe_lookup_alternatives():
+    """Neighbor-lookup reformulations: 90M-table gather vs searchsorted
+    over the 65k sorted id array (cache-resident)."""
+    print("== neighbor-lookup alternatives ==", flush=True)
+    rng = np.random.default_rng(0)
+    v = 65_536
+    n_cells = 90_000_000
+    ids = jnp.asarray(
+        np.sort(rng.choice(n_cells, v, replace=False)), jnp.int32
+    )
+    queries = jnp.asarray(
+        (np.asarray(ids)[None, :] + rng.integers(-2000, 2000, (27, 1)))
+        .clip(0, n_cells - 1)
+        .astype(np.int32)
+    )  # (27, V) — offset-shifted sorted queries, like real neighbors
+
+    def table_lookup(tok):
+        # table built INSIDE the jit — the real encoder rebuilds it per
+        # scan, and a 360 MB materialized constant cannot ship over the
+        # tunnel's compile request anyway
+        table = jnp.full((n_cells + 1,), -1, jnp.int32).at[ids].set(
+            jnp.arange(v, dtype=jnp.int32)
+        )
+        q = (queries + tok.astype(jnp.int32) % 3).clip(0, n_cells - 1)
+        return tok * 0.5 + jnp.sum(table[q]).astype(jnp.float32) * 1e-9
+
+    def search_lookup(tok):
+        q = (queries + tok.astype(jnp.int32) % 3).clip(0, n_cells - 1)
+        pos = jnp.searchsorted(ids, q.reshape(-1)).reshape(q.shape)
+        hit = ids[jnp.clip(pos, 0, v - 1)] == q
+        slot = jnp.where(hit, pos, -1)
+        return tok * 0.5 + jnp.sum(slot).astype(jnp.float32) * 1e-9
+
+    for name, fn in (("90M-table", table_lookup), ("searchsorted", search_lookup)):
+        ms = timed(f"lookup {name}", fn, inner=8, trials=5)
+        print(f"  27x65k neighbor lookup via {name}: {ms:7.3f} ms", flush=True)
+
+    # feature gather batching: 27 sequential (65k, 64) gathers vs one
+    # flat (27*65k, 64) gather
+    feats = jnp.zeros((v + 1, 64), jnp.float32)
+    slots = jnp.asarray(rng.integers(0, v, (27, v)), jnp.int32)
+
+    def seq_gather(tok):
+        def body(acc, s):
+            return acc + jnp.sum(feats[s]), None
+        out, _ = jax.lax.scan(body, jnp.float32(0.0), (slots + tok.astype(jnp.int32) % 2))
+        return tok * 0.5 + out * 1e-9
+
+    def flat_gather(tok):
+        g = feats[(slots + tok.astype(jnp.int32) % 2).reshape(-1)]
+        return tok * 0.5 + jnp.sum(g) * 1e-9
+
+    for name, fn in (("27-seq", seq_gather), ("flat", flat_gather)):
+        ms = timed(f"featgather {name}", fn, inner=8, trials=5)
+        print(f"  27x(65k,64) feature gather {name}: {ms:7.3f} ms", flush=True)
 
 
 if __name__ == "__main__":

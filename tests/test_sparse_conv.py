@@ -48,16 +48,18 @@ def _densify(vs):
     return vol
 
 
-def _dense_conv(vol, w27, cout, stride=1):
-    """lax 3D conv oracle with the sparse (27, cin, cout) weights."""
-    k = np.zeros((3, 3, 3, vol.shape[-1], cout), np.float32)
-    for ki, (dz, dy, dx) in enumerate(sp.kernel_offsets(3)):
-        k[dz + 1, dy + 1, dx + 1] = np.asarray(w27[ki])
+def _dense_conv(vol, wk, cout, stride=1, ksize=3):
+    """lax 3D conv oracle with the sparse (k^3, cin, cout) weights."""
+    k = np.zeros((ksize, ksize, ksize, vol.shape[-1], cout), np.float32)
+    off = (ksize - 1) // 2
+    for ki, (dz, dy, dx) in enumerate(sp.kernel_offsets(ksize)):
+        k[dz + off, dy + off, dx + off] = np.asarray(wk[ki])
+    pad = (1, 1) if ksize == 3 else (0, 0)
     out = jax.lax.conv_general_dilated(
         jnp.asarray(vol)[None],
         jnp.asarray(k),
         window_strides=(stride, stride, stride),
-        padding=[(1, 1)] * 3,
+        padding=[pad] * 3,
         dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
     )
     return np.asarray(out[0])
@@ -113,6 +115,26 @@ def test_strided_conv_matches_dense_at_sites():
             )
     assert out_sites == in_sites
     assert out.grid == (2, 3, 4)
+
+
+def test_strided_conv_k2_matches_dense():
+    """2^3-kernel stride-2 (the perf default): value parity with the
+    dense kernel-2 stride-2 pad-0 conv at the floor(ijk/2) sites."""
+    rng = np.random.default_rng(5)
+    vs = _random_voxelset(rng, 24)
+    w = jnp.asarray(rng.normal(size=(8, 5, 6)).astype(np.float32))
+    out = sp.sparse_strided_conv(vs, sp.slot_table(vs), w, budget=64)
+    dense = _dense_conv(_densify(vs), w, 6, stride=2, ksize=2)
+    o_ijk = np.asarray(out.ijk)
+    checked = 0
+    for i in range(out.ijk.shape[0]):
+        if bool(out.valid[i]):
+            z, y, x = o_ijk[i]
+            np.testing.assert_allclose(
+                np.asarray(out.feats[i]), dense[z, y, x], rtol=1e-4, atol=1e-5
+            )
+            checked += 1
+    assert checked >= 10
 
 
 def test_downsample_budget_overflow_caps():
@@ -180,7 +202,9 @@ def test_sparse_second_all_occupied_matches_dense():
         upsample_filters=(16,),
     )
     dense_cfg = SECONDConfig(**base)
-    sparse_cfg = SECONDConfig(**base, middle="sparse")
+    sparse_cfg = SECONDConfig(
+        **base, middle="sparse", sparse_stride_kernel=3
+    )
     nz, ny, nx = 4, 16, 16  # grid_size reordered
 
     # one point in EVERY cell -> all-occupied
@@ -261,3 +285,84 @@ def test_downsample_odd_extent_keeps_top_plane():
         if bool(out.valid[i])
     }
     assert sites == {(2, 2, 3), (0, 0, 0)}
+
+
+def test_sparse_dense_tail_all_occupied_matches_dense():
+    """3-stage encoder with the dense tail engaged for the last stage:
+    still identical to the all-dense encoder on an all-occupied grid."""
+    from triton_client_tpu.models.second import SECONDConfig, SECONDIoU
+
+    voxel = VoxelConfig(
+        point_cloud_range=(0.0, -8.0, -2.0, 16.0, 8.0, 2.0),
+        voxel_size=(0.5, 0.5, 0.5),
+        max_voxels=8192,
+        max_points_per_voxel=4,
+    )
+    base = dict(
+        voxel=voxel,
+        middle_filters=(8, 8, 8),
+        backbone_layers=(1,),
+        backbone_strides=(1,),
+        backbone_filters=(16,),
+        upsample_strides=(1,),
+        upsample_filters=(16,),
+    )
+    dense_cfg = SECONDConfig(**base)
+    sparse_cfg = SECONDConfig(
+        **base, middle="sparse", sparse_stride_kernel=3,
+        sparse_dense_tail_from=2,
+    )
+    nz, ny, nx = 8, 32, 32
+
+    zs, ys, xs = np.meshgrid(
+        np.arange(nz), np.arange(ny), np.arange(nx), indexing="ij"
+    )
+    pts = np.stack(
+        [
+            xs.ravel() * 0.5 + 0.25,
+            ys.ravel() * 0.5 - 8 + 0.25,
+            zs.ravel() * 0.5 - 2 + 0.25,
+            np.linspace(0, 1, nz * ny * nx),
+        ],
+        axis=1,
+    ).astype(np.float32)
+    count = jnp.asarray(pts.shape[0])
+
+    dense_model = SECONDIoU(dense_cfg)
+    sparse_model = SECONDIoU(sparse_cfg)
+    dv = dense_model.init(
+        jax.random.PRNGKey(0), jnp.asarray(pts), count,
+        method=SECONDIoU.from_points,
+    )
+    svars = sparse_model.init(
+        jax.random.PRNGKey(0), jnp.asarray(pts), count,
+        method=SECONDIoU.from_points,
+    )
+    dp = dv["params"]
+    spar = {k: v for k, v in svars["params"].items()}
+    for k in dp:
+        if k != "middle":
+            spar[k] = dp[k]
+    mid = dict(svars["params"]["middle"])
+    for si in range(2):  # sparse stages: kernel -> (27, cin, cout)
+        kern = np.asarray(dp["middle"][f"conv{si}"]["kernel"])
+        w27 = np.zeros((27, kern.shape[3], kern.shape[4]), np.float32)
+        for ki, (dz, dy, dx) in enumerate(sp.kernel_offsets(3)):
+            w27[ki] = kern[dz + 1, dy + 1, dx + 1]
+        mid[f"conv{si}"] = jnp.asarray(w27)
+    # tail stage: both sides are plain dense convs — graft verbatim
+    mid["conv2"] = dp["middle"]["conv2"]
+    spar["middle"] = mid
+    svars = {"params": spar, "batch_stats": svars["batch_stats"]}
+
+    dense_out = dense_model.apply(
+        dv, jnp.asarray(pts), count, method=SECONDIoU.from_points
+    )
+    sparse_out = sparse_model.apply(
+        svars, jnp.asarray(pts), count, method=SECONDIoU.from_points
+    )
+    for k in ("cls", "box", "dir", "iou"):
+        np.testing.assert_allclose(
+            np.asarray(dense_out[k]), np.asarray(sparse_out[k]),
+            rtol=2e-3, atol=2e-3,
+        )

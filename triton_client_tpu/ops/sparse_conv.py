@@ -185,19 +185,42 @@ def subm_conv(
 def sparse_strided_conv(
     vs: VoxelSet,
     table: jnp.ndarray,
-    weights: jnp.ndarray,  # (27, Cin, Cout)
+    weights: jnp.ndarray,  # (k^3, Cin, Cout)
     budget: int,
 ) -> VoxelSet:
-    """Stride-2 3x3x3 sparse conv (padding 1): output sites are the
-    stride-2 lattice cells floor(ijk/2); out[o] = sum_d w[d] *
-    in[2o + d], d in [-1, 1]^3 — value-identical to the dense stride-2
-    conv at those sites."""
+    """Stride-2 sparse conv: output sites are the stride-2 lattice
+    cells floor(ijk/2). Kernel size comes from the weights' leading
+    dim: 27 -> 3x3x3 padding 1 (out[o] = sum_d w[d] in[2o + d],
+    d in [-1, 1]^3 — value-identical to the dense stride-2 conv at
+    those sites); 8 -> 2x2x2 padding 0 (d in {0, 1}^3 — each input
+    feeds exactly one output, so the 8-offset kernel does a third of
+    the 27-offset one's gather work; Minkowski/TorchSparse's standard
+    downsample shape, and the perf default here: neighbor lookups are
+    the sparse stack's dominant cost on TPU)."""
+    k3 = weights.shape[0]
+    k = {8: 2, 27: 3}.get(k3)
+    if k is None:
+        raise ValueError(f"strided conv kernel must be 2^3 or 3^3, got {k3}")
     out_sites = downsample_sites(vs, budget)
     scaled = VoxelSet(out_sites.ijk, out_sites.feats, out_sites.valid, vs.grid)
-    nbr = gather_neighbor_slots(table, scaled, kernel_offsets(3), base_scale=2)
+    # k=3: offsets [-1, 1] around 2o (padding 1); k=2: {0, 1} (pad 0)
+    nbr = gather_neighbor_slots(table, scaled, kernel_offsets(k), base_scale=2)
     out = offset_matmul_sum(vs.feats, nbr, weights)
     out = jnp.where(out_sites.valid[:, None], out, 0.0)
     return VoxelSet(out_sites.ijk, out, out_sites.valid, out_sites.grid)
+
+
+def densify(vs: VoxelSet) -> jnp.ndarray:
+    """(nz, ny, nx, C) dense volume from a voxel set — the
+    sparse->dense handoff for tail levels whose grids are small enough
+    for real MXU convs (a 352x400x10 level is ~0.2 GB; the gathers a
+    sparse conv would do there cost more than the dense FLOPs)."""
+    nz, ny, nx = vs.grid
+    c = vs.feats.shape[-1]
+    ids = linear_ids(vs.ijk, vs.valid, vs.grid)
+    canvas = jnp.zeros((nz * ny * nx + 1, c), vs.feats.dtype)
+    canvas = canvas.at[ids].set(vs.feats, mode="drop")
+    return canvas[:-1].reshape(nz, ny, nx, c)
 
 
 def scatter_bev(vs: VoxelSet) -> jnp.ndarray:
@@ -205,12 +228,10 @@ def scatter_bev(vs: VoxelSet) -> jnp.ndarray:
     BEV the 2D backbone consumes (the dense path's transpose+reshape,
     sparse-side)."""
     nz, ny, nx = vs.grid
-    c = vs.feats.shape[-1]
-    ids = linear_ids(vs.ijk, vs.valid, vs.grid)
-    canvas = jnp.zeros((nz * ny * nx + 1, c), vs.feats.dtype)
-    canvas = canvas.at[ids].set(vs.feats, mode="drop")
-    vol = canvas[:-1].reshape(nz, ny, nx, c)
-    return jnp.transpose(vol, (1, 2, 0, 3)).reshape(ny, nx, nz * c)
+    vol = densify(vs)
+    return jnp.transpose(vol, (1, 2, 0, 3)).reshape(
+        ny, nx, nz * vs.feats.shape[-1]
+    )
 
 
 def points_to_voxelset(
