@@ -154,7 +154,7 @@ class Config:
         return out
 
 
-def make_yolov5(dtype=None, batch=BATCH) -> Config:
+def make_yolov5(dtype=None, batch=BATCH, mxu=False) -> Config:
     from triton_client_tpu.models.yolov5 import init_yolov5
     from triton_client_tpu.ops.detect_postprocess import extract_boxes
     from triton_client_tpu.ops.preprocess import normalize_image
@@ -163,6 +163,7 @@ def make_yolov5(dtype=None, batch=BATCH) -> Config:
     model, variables = init_yolov5(
         jax.random.PRNGKey(0), num_classes=2, variant="n", input_hw=input_hw,
         dtype=dtype or jnp.float32,
+        s2d=mxu, ch_floor=32 if mxu else 0,
     )
     rng = np.random.default_rng(0)
     frames = jnp.asarray(
@@ -176,8 +177,10 @@ def make_yolov5(dtype=None, batch=BATCH) -> Config:
         # token depends on every output row -> readback fences the call
         return (jnp.sum(valid) + jnp.sum(dets) * 1e-12).astype(jnp.float32)
 
-    suffix = ("_bf16" if dtype == jnp.bfloat16 else "") + (
-        f"_b{batch}" if batch != BATCH else ""
+    suffix = (
+        ("_bf16" if dtype == jnp.bfloat16 else "")
+        + ("_mxu" if mxu else "")
+        + (f"_b{batch}" if batch != BATCH else "")
     )
     return Config(
         f"yolov5n{suffix}",
@@ -524,6 +527,9 @@ def main() -> None:
     configs = [make_yolov5()]
     for label, factory in (
         ("yolov5n_bf16", lambda: make_yolov5(dtype=jnp.bfloat16)),
+        # MXU-shaped layout (s2d stem + 32ch floor): same detection
+        # function, losslessly imported weights, measured +16% at b8
+        ("yolov5n_mxu", lambda: make_yolov5(mxu=True)),
         # max-throughput config: batch amortizes the small-channel
         # convs' fixed overhead (b8 ~800 -> b64 ~3200 fps measured);
         # b8 stays primary for round-over-round continuity

@@ -4,13 +4,10 @@ the YOLOv5n b8 pipeline on the live chip.
 r2 established the b8 primary is fixed-overhead-bound (1.4% MFU,
 batch amortizes 4x, NMS formulation irrelevant). The untried levers:
 
-  * s2d      — space-to-depth the 512x512x3 input to 256x256x12 and
-               run the stem as an equivalent 3x3 stride-1 conv: the
-               6x6 s2 conv over 3 channels is the worst MXU shape in
-               the net (Cin=3 of 128 lanes);
-  * minch32  — pad every conv width to >= 32 channels (the n-variant's
-               16-wide stages leave 7/8 of the MXU's 128 lanes idle;
-               costs real FLOPs — the A/B decides if lanes were free);
+  * s2d      — space-to-depth stem (now the model's own s2d option);
+  * minch32  — >= 32-channel width floor (the model's ch_floor option);
+  measured: s2d -8%, minch32 -13%, together -16% at b8 — shipped as
+  YoloV5(s2d=..., ch_floor=...) / detect2d --mxu-opt;
   * headless — backbone only (no decode/NMS): the head+decode share of
                the 7.8 ms;
   * b1/b16   — the batch curve endpoints for context.
@@ -31,85 +28,12 @@ from flax import linen as nn
 
 from _harness import compile_looped, run_trials
 
-from triton_client_tpu.models.yolov5 import (
-    DEFAULT_ANCHORS,
-    STRIDES,
-    YOLOV5_VARIANTS,
-    YoloV5,
-)
-from triton_client_tpu.models.layers import (
-    C3,
-    SPPF,
-    ConvBnAct,
-    make_divisible,
-    upsample2x,
-)
+from triton_client_tpu.models.yolov5 import YoloV5
 from triton_client_tpu.ops.detect_postprocess import extract_boxes
 from triton_client_tpu.ops.preprocess import normalize_image
 
 BATCH = 8
 HW = (512, 512)
-
-
-class YoloS2D(YoloV5):
-    """Space-to-depth stem variant: identical architecture below the
-    stem; the 6x6 s2 conv over 3 channels becomes a 3x3 s1 conv over
-    the 12-channel blocked input (same receptive field / output grid,
-    4x the input channel occupancy on the MXU lanes)."""
-
-    @nn.compact
-    def __call__(self, x: jnp.ndarray, train: bool = False):
-        c, d, dt = self._c, self._d, self.dtype
-        na = len(self.anchors[0])
-        no = 5 + self.num_classes
-
-        x = x.astype(dt)
-        b, h, w, ch = x.shape
-        x = x.reshape(b, h // 2, 2, w // 2, 2, ch)
-        x = jnp.transpose(x, (0, 1, 3, 2, 4, 5)).reshape(
-            b, h // 2, w // 2, 4 * ch
-        )
-        x = ConvBnAct(c(64), 3, 1, dtype=dt, name="stem")(x, train)
-        x = ConvBnAct(c(128), 3, 2, dtype=dt, name="down2")(x, train)
-        x = C3(c(128), d(3), dtype=dt, name="c3_2")(x, train)
-        x = ConvBnAct(c(256), 3, 2, dtype=dt, name="down3")(x, train)
-        p3 = C3(c(256), d(6), dtype=dt, name="c3_3")(x, train)
-        x = ConvBnAct(c(512), 3, 2, dtype=dt, name="down4")(p3, train)
-        p4 = C3(c(512), d(9), dtype=dt, name="c3_4")(x, train)
-        x = ConvBnAct(c(1024), 3, 2, dtype=dt, name="down5")(p4, train)
-        x = C3(c(1024), d(3), dtype=dt, name="c3_5")(x, train)
-        p5 = SPPF(c(1024), 5, dtype=dt, name="sppf")(x, train)
-        t5 = ConvBnAct(c(512), 1, dtype=dt, name="lat5")(p5, train)
-        x = jnp.concatenate([upsample2x(t5), p4], axis=-1)
-        n4 = C3(c(512), d(3), shortcut=False, dtype=dt, name="c3_up4")(x, train)
-        t4 = ConvBnAct(c(256), 1, dtype=dt, name="lat4")(n4, train)
-        x = jnp.concatenate([upsample2x(t4), p3], axis=-1)
-        out3 = C3(c(256), d(3), shortcut=False, dtype=dt, name="c3_up3")(x, train)
-        x = ConvBnAct(c(256), 3, 2, dtype=dt, name="pan3")(out3, train)
-        x = jnp.concatenate([x, t4], axis=-1)
-        out4 = C3(c(512), d(3), shortcut=False, dtype=dt, name="c3_pan4")(x, train)
-        x = ConvBnAct(c(512), 3, 2, dtype=dt, name="pan4")(out4, train)
-        x = jnp.concatenate([x, t5], axis=-1)
-        out5 = C3(c(1024), d(3), shortcut=False, dtype=dt, name="c3_pan5")(x, train)
-        heads = []
-        for i, feat in enumerate((out3, out4, out5)):
-            hd = nn.Conv(na * no, (1, 1), dtype=jnp.float32, name=f"detect{i}")(
-                feat.astype(jnp.float32)
-            )
-            bb, hh, ww, _ = hd.shape
-            heads.append(hd.reshape(bb, hh, ww, na, no))
-        return heads
-
-
-class YoloMinCh(YoloV5):
-    """Channel floor variant: every stage width padded to >= minch."""
-
-    minch: int = 32
-
-    def _c(self, ch: int) -> int:
-        return max(
-            make_divisible(ch * YOLOV5_VARIANTS[self.variant][1]), self.minch
-        )
 
 
 def make_case(model_cls, batch=BATCH, with_post=True, **model_kw):
@@ -142,8 +66,9 @@ def main():
     ]
     factories = {
         "base": lambda: make_case(YoloV5),
-        "s2d": lambda: make_case(YoloS2D),
-        "minch32": lambda: make_case(YoloMinCh),
+        "s2d": lambda: make_case(YoloV5, s2d=True),
+        "minch32": lambda: make_case(YoloV5, ch_floor=32),
+        "s2d_minch32": lambda: make_case(YoloV5, s2d=True, ch_floor=32),
         "headless": lambda: make_case(YoloV5, with_post=False),
         "b1": lambda: make_case(YoloV5, batch=1),
         "b16": lambda: make_case(YoloV5, batch=16),

@@ -442,3 +442,66 @@ def test_onnx_import_forward_parity_vs_numpy():
     bn = (conv - bn_m) / np.sqrt(bn_v + 1e-3) * bn_w + bn_b
     ref = bn / (1.0 + np.exp(-bn))  # silu
     np.testing.assert_allclose(out, ref, atol=2e-5)
+
+
+def test_yolov5_mxu_import_exact_function_parity():
+    """The MXU-shape options (s2d stem + ch_floor padding) import the
+    SAME upstream checkpoint losslessly: the optimized model's heads
+    must match the vanilla import's heads to numerical tolerance —
+    identical detection function, faster chip layout."""
+    from triton_client_tpu.models.yolov5 import init_yolov5
+
+    nc = 3
+    tmodel = TYoloV5N(nc).eval()
+    _randomize(tmodel, 4)
+    state = _state(tmodel)
+
+    rng = np.random.default_rng(1)
+    x = rng.uniform(0, 1, (2, 64, 64, 3)).astype(np.float32)
+
+    vmodel, vvars = init_yolov5(
+        jax.random.PRNGKey(0), num_classes=nc, variant="n", input_hw=(64, 64)
+    )
+    vanilla = importers.load_yolov5(state, vvars, strict=True)
+    vheads = vmodel.apply(vanilla, jnp.asarray(x), train=False)
+
+    omodel, ovars = init_yolov5(
+        jax.random.PRNGKey(0), num_classes=nc, variant="n", input_hw=(64, 64),
+        s2d=True, ch_floor=32,
+    )
+    # sanity: the optimized template really is a different layout
+    assert ovars["params"]["stem"]["conv"]["kernel"].shape[:3] == (3, 3, 12)
+    optimized = importers.load_yolov5(state, ovars, strict=True)
+    oheads = omodel.apply(optimized, jnp.asarray(x), train=False)
+
+    for i, (vh, oh) in enumerate(zip(vheads, oheads)):
+        np.testing.assert_allclose(
+            np.asarray(oh), np.asarray(vh), atol=5e-4, rtol=1e-4,
+            err_msg=f"head {i}: mxu-optimized import diverges",
+        )
+
+
+def test_yolov5_import_shape_mismatch_still_raises():
+    """The MXU adaptation hook must NOT weaken strictness: a wrong
+    num_classes template and an unsafe (concat-padding) ch_floor both
+    refuse loudly instead of silently zero-padding."""
+    from triton_client_tpu.models.yolov5 import init_yolov5
+
+    tmodel = TYoloV5N(2).eval()
+    _randomize(tmodel, 7)
+    state = _state(tmodel)
+
+    _, wrong_nc = init_yolov5(
+        jax.random.PRNGKey(0), num_classes=5, variant="n", input_hw=(64, 64)
+    )
+    with pytest.raises(ValueError, match="does not fit the template"):
+        importers.load_yolov5(state, wrong_nc, strict=True)
+
+    # ch_floor=64 pads stages that feed concats (C3 segment layouts
+    # shift) — provably-unsafe, must raise, not "import"
+    _, unsafe = init_yolov5(
+        jax.random.PRNGKey(0), num_classes=2, variant="n", input_hw=(64, 64),
+        ch_floor=64,
+    )
+    with pytest.raises(ValueError, match="concatenated stages|does not fit"):
+        importers.load_yolov5(state, unsafe, strict=True)

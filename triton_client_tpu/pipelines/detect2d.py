@@ -155,14 +155,21 @@ def build_yolov5_pipeline(
     variables=None,
     dtype: jnp.dtype = jnp.float32,
     config: Detect2DConfig | None = None,
+    s2d: bool = False,
+    ch_floor: int = 0,
 ) -> tuple[Detect2DPipeline, ModelSpec, dict]:
     """Construct model + pipeline + serving spec in one call.
 
     The spec mirrors the reference's served contract
     (examples/YOLOv5/config.pbtxt: images in, [1, N, 5+nc] out) plus the
     packed-detections outputs unique to the fused pipeline.
+    ``s2d``/``ch_floor`` are the MXU-shape options (models/yolov5.py) —
+    identical detection function, faster chip layout.
     """
-    model = YoloV5(num_classes=num_classes, variant=variant, dtype=dtype)
+    model = YoloV5(
+        num_classes=num_classes, variant=variant, dtype=dtype,
+        s2d=s2d, ch_floor=ch_floor,
+    )
     if variables is None:
         if rng is None:
             rng = jax.random.PRNGKey(0)

@@ -179,12 +179,26 @@ def default_name_map(path: tuple[str, ...]) -> str:
     return ".".join([*mods, _DEFAULT_LEAF_MAP.get(leaf, leaf)])
 
 
+def _natural_flax_shape(leaf_name: str, value) -> tuple:
+    """The flax shape a torch tensor lands on BEFORE any template
+    adaptation (kernel transposes only)."""
+    shape = tuple(value.shape)
+    if leaf_name == "kernel" and len(shape) == 4:
+        return (shape[2], shape[3], shape[1], shape[0])
+    if leaf_name == "kernel" and len(shape) == 5:
+        return (shape[2], shape[3], shape[4], shape[1], shape[0])
+    if leaf_name == "kernel" and len(shape) == 2:
+        return (shape[1], shape[0])
+    return shape
+
+
 def convert_state_dict(
     state_dict: Mapping[str, Any],
     variables: Mapping,
     name_map: Callable[[tuple[str, ...]], str] = default_name_map,
     strict: bool = True,
     transposed_conv: Callable[[tuple[str, ...]], bool] | None = None,
+    leaf_transform: Callable[[tuple, Any, Any], Any] | None = None,
 ) -> dict:
     """torch state_dict -> flax variables with the target's structure.
 
@@ -193,7 +207,11 @@ def convert_state_dict(
     and returns a new tree. With strict=False, missing torch keys keep
     the template's (random-init) leaf and are logged.
     ``transposed_conv`` marks flax paths whose torch source is a
-    ConvTranspose (different kernel axis order).
+    ConvTranspose (different kernel axis order). ``leaf_transform(
+    key_path, natural, template_leaf)`` lets a caller adapt each
+    layout-converted tensor onto a template whose shapes deliberately
+    differ (e.g. the yolov5 MXU layouts); without it any shape mismatch
+    raises as before.
     """
     missing = []
     used = set()
@@ -203,10 +221,19 @@ def convert_state_dict(
         torch_key = name_map(key_path)
         if torch_key in state_dict:
             used.add(torch_key)
-            return torch_to_flax_leaf(
-                torch_key, state_dict[torch_key], leaf.shape,
+            value = state_dict[torch_key]
+            target = (
+                leaf.shape
+                if leaf_transform is None
+                else _natural_flax_shape(key_path[-1], value)
+            )
+            nat = torch_to_flax_leaf(
+                torch_key, value, target,
                 leaf_name=key_path[-1],
                 transposed_conv=bool(transposed_conv and transposed_conv(key_path)),
+            )
+            return nat if leaf_transform is None else leaf_transform(
+                key_path, nat, leaf
             )
         missing.append(torch_key)
         return leaf

@@ -17,6 +17,8 @@ import logging
 import re
 from typing import Any, Mapping
 
+import numpy as np
+
 from triton_client_tpu.runtime.checkpoint import (
     convert_state_dict,
     default_name_map,
@@ -74,14 +76,97 @@ def yolov5_torch_key(path: tuple[str, ...]) -> str:
     return ".".join([f"model.{idx}", *mapped, default_name_map((rest[-1],))])
 
 
+def _stem_s2d_kernel(natural: np.ndarray) -> np.ndarray:
+    """Vanilla (6, 6, cin, out) stride-2 stem kernel -> the exactly
+    equivalent (3, 3, 4*cin, out) kernel for the space-to-depth stem
+    (models/yolov5.py s2d): output row 2o+ky reads s2d block
+    bi = ky//2, within-block row a = ky%2, and the blocked channel
+    order is (a*2 + b)*cin + c — the same order the forward's
+    reshape/transpose produces."""
+    kh, kw, cin, out = natural.shape
+    if (kh, kw) != (6, 6):
+        raise ValueError(f"s2d stem expects a 6x6 source kernel, got {natural.shape}")
+    w = natural.reshape(3, 2, 3, 2, cin, out)   # (bi, a, bj, b, c, o)
+    w = w.transpose(0, 2, 1, 3, 4, 5)           # (bi, bj, a, b, c, o)
+    return np.ascontiguousarray(w.reshape(3, 3, 4 * cin, out))
+
+
+def _embed_padded(natural: np.ndarray, target_shape, leaf_name: str) -> np.ndarray:
+    """Zero/neutral-pad a vanilla leaf into a ch_floor-padded template
+    shape. Padded channels stay EXACTLY zero through the net: kernel
+    columns/rows zero, BN scale/var one + bias/mean zero -> BN output 0
+    -> SiLU(0) = 0 -> next layer's padded input columns are zero too."""
+    target_shape = tuple(target_shape)
+    if natural.shape == target_shape:
+        return natural
+    if len(natural.shape) != len(target_shape) or any(
+        n > t for n, t in zip(natural.shape, target_shape)
+    ):
+        raise ValueError(
+            f"cannot embed {leaf_name} {natural.shape} into {target_shape}"
+        )
+    fill = 1.0 if leaf_name in ("scale", "var") else 0.0
+    out = np.full(target_shape, fill, natural.dtype)
+    out[tuple(slice(0, s) for s in natural.shape)] = natural
+    return out
+
+
 def load_yolov5(path_or_state: Any, variables: Mapping, strict: bool = True) -> dict:
     """Ultralytics YOLOv5 checkpoint (.pt path or state_dict) -> flax
-    variables shaped like ``variables`` (from init_yolov5)."""
+    variables shaped like ``variables`` (from init_yolov5).
+
+    MXU-optimized templates import LOSSLESSLY: an s2d stem template
+    ((3, 3, 4*cin, out)) gets the reshaped 6x6 kernel, and a padded
+    stem stage gets zero kernels + neutral BN rows for the padded
+    channels — the optimized model computes the identical detection
+    function (verified end-to-end in tests/test_import_fidelity.py).
+    Adaptation is deliberately restricted to the STEM-LOCAL cases whose
+    exactness is provable (the stem's own leaves + down2's input rows):
+    padding a stage that feeds a concat would silently misalign the
+    concat segments, so any other shape mismatch — wrong num_classes,
+    wrong variant, a too-aggressive ch_floor — raises."""
     state = _as_state_dict(path_or_state)
     # Ultralytics .pt stores the full pickled model; its state_dict keys
     # may carry a 'model.' prefix already ('model.model.0...').
     state = _strip_prefix(state, "model.model.", "model.")
-    return convert_state_dict(state, variables, name_map=yolov5_torch_key, strict=strict)
+
+    def transform(key_path, nat, leaf):
+        parts = tuple(p for p in key_path if p not in ("params", "batch_stats"))
+        leaf_name = key_path[-1]
+        target = tuple(leaf.shape)
+        if nat.shape == target:
+            return nat
+        if parts[0] == "stem":
+            if (
+                leaf_name == "kernel"
+                and nat.shape[:2] == (6, 6)
+                and target[:2] == (3, 3)
+            ):
+                nat = _stem_s2d_kernel(nat)
+            return _embed_padded(nat, target, leaf_name)
+        if (
+            parts[:2] == ("down2", "conv")
+            and leaf_name == "kernel"
+            and nat.shape[:2] == target[:2]
+            and nat.shape[3] == target[3]
+            and nat.shape[2] < target[2]
+        ):
+            # extra input rows read the stem's padded (all-zero)
+            # channels: zero rows keep the function identical
+            return _embed_padded(nat, target, leaf_name)
+        raise ValueError(
+            f"yolov5 import: {'.'.join(parts)} {nat.shape} does not fit "
+            f"the template {target}. Only stem-local MXU adaptations "
+            "(s2d; ch_floor that pads the stem stage alone, e.g. 32 on "
+            "variant n) are exactness-preserving — this mismatch means "
+            "wrong num_classes/variant, or a ch_floor that pads "
+            "concatenated stages (segment layouts would silently shift)"
+        )
+
+    return convert_state_dict(
+        state, variables, name_map=yolov5_torch_key, strict=strict,
+        leaf_transform=transform,
+    )
 
 
 # --- PointPillars (OpenPCDet naming, tools/cfgs/kitti_models/pointpillar.yaml) ---
