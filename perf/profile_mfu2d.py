@@ -24,8 +24,6 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from flax import linen as nn
-
 from _harness import compile_looped, run_trials
 
 from triton_client_tpu.models.yolov5 import YoloV5

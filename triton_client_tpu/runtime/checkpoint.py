@@ -179,11 +179,14 @@ def default_name_map(path: tuple[str, ...]) -> str:
     return ".".join([*mods, _DEFAULT_LEAF_MAP.get(leaf, leaf)])
 
 
-def _natural_flax_shape(leaf_name: str, value) -> tuple:
+def _natural_flax_shape(leaf_name: str, value, transposed_conv: bool = False) -> tuple:
     """The flax shape a torch tensor lands on BEFORE any template
-    adaptation (kernel transposes only)."""
+    adaptation (kernel transposes only). ConvTranspose kernels use
+    torch's (in, out, kH, kW) layout -> (kH, kW, in, out)."""
     shape = tuple(value.shape)
     if leaf_name == "kernel" and len(shape) == 4:
+        if transposed_conv:
+            return (shape[2], shape[3], shape[0], shape[1])
         return (shape[2], shape[3], shape[1], shape[0])
     if leaf_name == "kernel" and len(shape) == 5:
         return (shape[2], shape[3], shape[4], shape[1], shape[0])
@@ -222,15 +225,16 @@ def convert_state_dict(
         if torch_key in state_dict:
             used.add(torch_key)
             value = state_dict[torch_key]
+            is_tc = bool(transposed_conv and transposed_conv(key_path))
             target = (
                 leaf.shape
                 if leaf_transform is None
-                else _natural_flax_shape(key_path[-1], value)
+                else _natural_flax_shape(key_path[-1], value, is_tc)
             )
             nat = torch_to_flax_leaf(
                 torch_key, value, target,
                 leaf_name=key_path[-1],
-                transposed_conv=bool(transposed_conv and transposed_conv(key_path)),
+                transposed_conv=is_tc,
             )
             return nat if leaf_transform is None else leaf_transform(
                 key_path, nat, leaf

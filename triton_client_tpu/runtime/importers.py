@@ -143,7 +143,11 @@ def load_yolov5(path_or_state: Any, variables: Mapping, strict: bool = True) -> 
                 and target[:2] == (3, 3)
             ):
                 nat = _stem_s2d_kernel(nat)
-            return _embed_padded(nat, target, leaf_name)
+            # only the OUT-channel axis may grow (ch_floor): a spatial
+            # or cin mismatch (e.g. a grayscale fork's 1-channel stem)
+            # is a different model and must still raise
+            if nat.shape[:-1] == target[:-1] and nat.shape[-1] <= target[-1]:
+                return _embed_padded(nat, target, leaf_name)
         if (
             parts[:2] == ("down2", "conv")
             and leaf_name == "kernel"
