@@ -339,7 +339,7 @@ def make_second_sparse() -> Config:
 def measure_serving(
     rtt_ms: float,
     duration_s: float = 20.0,
-    clients: int = 48,
+    clients: int = 16,
     max_batch: int = 8,
     input_hw: tuple = (512, 512),
 ) -> dict:
@@ -382,7 +382,12 @@ def measure_serving(
     inner.do_inference = tapped
 
     rng = np.random.default_rng(0)
-    frame = rng.integers(0, 255, (1, *input_hw, 3)).astype(np.float32)
+    # uint8 wire frames: the pipeline normalizes on device, so shipping
+    # raw bytes quarters the wire + host->device upload vs the
+    # reference's float32 tensors (its clients convert BEFORE the wire,
+    # utils/preprocess.py image_adjust) — on this rig upload bandwidth
+    # IS the serving ceiling (see upload_mbps in the result)
+    frame = rng.integers(0, 255, (1, *input_hw, 3)).astype(np.uint8)
     # pre-compile every merge size the batcher can produce (the 2D
     # pipeline re-traces per batch size; over the tunnel each compile
     # is tens of seconds and must not land inside the timed window)
@@ -393,6 +398,17 @@ def measure_serving(
                 inputs={"images": np.repeat(frame, k, axis=0)},
             )
         )
+
+    # reference device-path cost for the SAME work: one b-max batch
+    # through the pipeline from host memory (pays the upload the
+    # in-process configs don't) — the gap between this and the served
+    # rate is the wire/codec/host-CPU stack
+    direct = np.repeat(frame, max_batch, axis=0)
+    pipe.infer(direct)  # warm
+    t0 = time.perf_counter()
+    for _ in range(3):
+        pipe.infer(direct)
+    direct_batch_ms = (time.perf_counter() - t0) / 3 * 1e3
 
     batching = BatchingChannel(inner, max_batch=max_batch, timeout_us=3000)
     server = InferenceServer(
@@ -414,7 +430,9 @@ def measure_serving(
         n, lats = 0, []
         chan = req = None
         try:
-            chan = GRPCChannel(addr)
+            # generous per-request deadline: 48 queued clients behind a
+            # ~100 ms-per-dispatch tunnel can legitimately wait seconds
+            chan = GRPCChannel(addr, timeout_s=120.0)
             req = InferRequest(model_name=spec.name, inputs={"images": frame})
             chan.do_inference(req)  # connection + server path warm
         except Exception as e:
@@ -461,7 +479,24 @@ def measure_serving(
     if errors:
         print(f"serving bench client errors: {errors[:5]}", file=sys.stderr)
 
+    # host->device upload bandwidth probe: the per-request transfer the
+    # in-process configs never pay (device-resident inputs); over this
+    # tunnel it is the serving bottleneck, on a real TPU-VM it is PCIe
+    blob = np.zeros((8, *input_hw, 3), np.uint8)
+    jnp.asarray(blob).block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(3):
+        jnp.asarray(blob).block_until_ready()
+        blob[0, 0, 0, 0] += 1  # defeat any caching
+    up_s = (time.perf_counter() - t0) / 3
+    upload_mbps = blob.nbytes / 1e6 / up_s
+
     total = sum(served)
+    if not latencies:
+        raise RuntimeError(
+            f"serving bench: no request completed in the window "
+            f"({len(errors)} client errors, first: {errors[:1]})"
+        )
     fps = total / wall
     d_req = stats.get("batched_requests", 0) - stats0.get("batched_requests", 0)
     d_bat = stats.get("batches", 0) - stats0.get("batches", 0)
@@ -476,6 +511,8 @@ def measure_serving(
         "request_p50_ms": round(float(np.percentile(latencies, 50)), 2),
         "request_p99_ms": round(float(np.percentile(latencies, 99)), 2),
         "tunnel_rtt_ms": round(rtt_ms, 3),
+        "upload_mbps": round(upload_mbps, 1),
+        "direct_batch_ms": round(direct_batch_ms, 1),
         "client_errors": len(errors),
         "mean_batch": round(float(mean_batch), 2),
         "batch_occupancy": {
