@@ -193,3 +193,69 @@ def test_3d_loop_train_export_eval(tmp_path, capsys):
     assert report["model"] == "loop3d"
     assert report["eval"]["frames"] == 2
     assert 0.0 <= report["eval"]["map50"] <= 1.0
+
+
+TINY_SECOND_YAML = """\
+model: second_iou
+voxel:
+  point_cloud_range: [0.0, -8.0, -2.0, 16.0, 8.0, 2.0]
+  voxel_size: [0.5, 0.5, 0.5]
+  max_voxels: 1024
+  max_points_per_voxel: 4
+middle_filters: [8, 8]
+backbone_layers: [1]
+backbone_strides: [1]
+backbone_filters: [16]
+upsample_strides: [1]
+upsample_filters: [16]
+"""
+
+
+def test_second_loop_train_export_eval(tmp_path, capsys):
+    """SECOND-IoU trains through the same loop as PointPillars (the
+    anchor-head loss + the IoU-quality term) and serves from the
+    exported entry."""
+    from triton_client_tpu.cli.detect3d import main as detect_main
+    from triton_client_tpu.cli.train import main as train_main
+    from triton_client_tpu.io.synthdata import write_scene_dataset
+
+    cfg_path = tmp_path / "tiny_second.yaml"
+    cfg_path.write_text(TINY_SECOND_YAML)
+    kw = dict(
+        pc_range=(0.0, -8.0, -2.0, 16.0, 8.0, 2.0),
+        n_objects=2,
+        n_clutter=500,
+        min_points=10,
+    )
+    clouds, gt = write_scene_dataset(str(tmp_path / "train"), 2, seed=0, **kw)
+    hold_clouds, hold_gt = write_scene_dataset(
+        str(tmp_path / "hold"), 2, seed=9, **kw
+    )
+    repo = tmp_path / "repo"
+    train_main(
+        [
+            "--family", "second_iou",
+            "--config", str(cfg_path),
+            "-i", clouds,
+            "--gt", gt,
+            "-b", "1",
+            "--mesh", "data=1",
+            "--points", "4096",
+            "--max-boxes", "8",
+            "--steps", "2",
+            "--export", str(repo),
+            "-m", "loop_second",
+        ]
+    )
+    capsys.readouterr()
+    detect_main(
+        [
+            "-m", "loop_second",
+            "--repo", str(repo),
+            "-i", hold_clouds,
+            "--gt", hold_gt,
+        ]
+    )
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert report["model"] == "loop_second"
+    assert report["eval"]["frames"] == 2

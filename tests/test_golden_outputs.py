@@ -162,3 +162,89 @@ def test_nms_kept_sequence_golden(rng):
         kept, np.asarray(json.loads(path.read_text())["kept"]),
         err_msg="NMS kept-index sequence changed — deliberate? regen + review",
     )
+
+
+def test_sparse_second_pipeline_golden(rng):
+    """Seeded sparse-encoder SECOND (tiny grid, k2 strided + dense
+    tail) on a fixed cloud — pins the round-3 sparse stack end to end:
+    sparse mean-VFE compaction, slot-table subm conv, strided conv,
+    densified tail, BEV fold, anchor decode, rotated NMS."""
+    from triton_client_tpu.models.second import SECONDConfig
+    from triton_client_tpu.ops.voxelize import VoxelConfig
+    from triton_client_tpu.pipelines.detect3d import (
+        Detect3DConfig,
+        build_second_pipeline,
+    )
+
+    cfg = SECONDConfig(
+        voxel=VoxelConfig(
+            point_cloud_range=(0.0, -12.8, -2.0, 25.6, 12.8, 2.0),
+            voxel_size=(0.4, 0.4, 0.5),
+            max_voxels=2048,
+            max_points_per_voxel=8,
+        ),
+        middle="sparse",
+        sparse_budget=2048,
+        sparse_dense_tail_from=2,
+        middle_filters=(8, 8, 8),
+        backbone_layers=(1,),
+        backbone_strides=(1,),
+        backbone_filters=(16,),
+        upsample_strides=(1,),
+        upsample_filters=(16,),
+    )
+    pcfg = Detect3DConfig(
+        model_name="second_iou", point_buckets=(8192,), max_det=16, pre_max=64
+    )
+    pipe, _, _ = build_second_pipeline(
+        jax.random.PRNGKey(0), model_cfg=cfg, config=pcfg
+    )
+    pts = np.stack(
+        [
+            rng.uniform(0, 25.6, 3000),
+            rng.uniform(-12.8, 12.8, 3000),
+            rng.uniform(-1.8, 1.8, 3000),
+            rng.uniform(0, 1, 3000),
+        ],
+        axis=1,
+    ).astype(np.float32)
+    out = pipe.infer(pts)
+    _check(
+        "second_sparse_tiny",
+        {
+            "n_det": [float(len(out["pred_boxes"]))],
+            "boxes": out["pred_boxes"][:4],
+            "scores": out["pred_scores"][:4],
+        },
+    )
+
+
+def test_yolov5_mxu_pipeline_golden(rng):
+    """Seeded MXU-layout yolov5n (s2d stem + 32ch floor) — pins the
+    optimized forward so a layout/importer refactor can't silently
+    change what --mxu-opt serves."""
+    from triton_client_tpu.pipelines.detect2d import (
+        Detect2DConfig,
+        build_yolov5_pipeline,
+    )
+
+    cfg = Detect2DConfig(
+        num_classes=2, input_hw=(128, 128), conf_thresh=0.05, max_det=64
+    )
+    pipe, _, _ = build_yolov5_pipeline(
+        jax.random.PRNGKey(0), variant="n", num_classes=2,
+        input_hw=(128, 128), config=cfg, s2d=True, ch_floor=32,
+    )
+    frame = (
+        np.linspace(0, 255, 128 * 128 * 3).reshape(128, 128, 3)
+        + rng.uniform(0, 30, (128, 128, 3))
+    ).astype(np.float32)
+    dets, valid = pipe.infer(frame[None])
+    dets, valid = np.asarray(dets)[0], np.asarray(valid)[0].astype(bool)
+    _check(
+        "yolov5n_mxu_128",
+        {
+            "n_det": [float(valid.sum())],
+            "top5_rows": dets[valid][:5],
+        },
+    )

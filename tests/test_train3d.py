@@ -206,3 +206,106 @@ def test_train3d_step_loss_decreases():
         losses.append(float(metrics["loss"]))
     assert losses[-1] < losses[0]
     assert np.isfinite(losses).all()
+
+
+TINY_SECOND_KW = dict(
+    middle_filters=(8, 8),
+    backbone_layers=(1,),
+    backbone_strides=(1,),
+    backbone_filters=(16,),
+    upsample_strides=(1,),
+    upsample_filters=(16,),
+)
+
+
+def _tiny_second_cfg():
+    from triton_client_tpu.models.second import SECONDConfig
+
+    return SECONDConfig(
+        voxel=VoxelConfig(
+            point_cloud_range=(0.0, -8.0, -2.0, 16.0, 8.0, 2.0),
+            voxel_size=(0.5, 0.5, 0.5),
+            max_voxels=1024,
+            max_points_per_voxel=4,
+        ),
+        **TINY_SECOND_KW,
+    )
+
+
+def test_second_loss_iou_head_perfect_prediction():
+    """With perfect box predictions, the IoU head's target is ~1, so an
+    iou logit of +1 (= 2*1 - 1) zeroes the term; a wrong logit raises it."""
+    cfg = _tiny_second_cfg()
+    h, w = cfg.head_hw
+    a = cfg.anchors_per_loc
+    n = h * w * a
+    anchors = generate_anchors(cfg).reshape(n, 7)
+    target_anchor = (h // 2 * w + w // 2) * a
+    box = np.asarray(anchors[target_anchor]).copy()
+    gt = np.full((1, 4, 8), -1, np.float32)
+    gt[0, 0, :7] = box
+    gt[0, 0, 7] = 0.0
+
+    enc = encode_boxes(jnp.asarray(box)[None], anchors)
+    flat_idx = np.unravel_index(target_anchor, (h, w, a))
+    cls = np.full((1, h, w, a, cfg.num_classes), -12.0, np.float32)
+    cls[(0, *flat_idx, 0)] = 12.0
+    heads = {
+        "cls": jnp.asarray(cls),
+        "box": jnp.asarray(np.asarray(enc).reshape(1, h, w, a, 7)),
+        "dir": jnp.zeros((1, h, w, a, 2), jnp.float32)
+        .at[(0, *flat_idx, 0)]
+        .set(12.0),
+        "iou": jnp.ones((1, h, w, a), jnp.float32),  # 2*iou-1 with iou=1
+    }
+    _, good = train3d.detection3d_loss(
+        heads, jnp.asarray(gt), cfg, train3d.Loss3DConfig()
+    )
+    assert float(good["iou"]) < 1e-4
+    bad_heads = {**heads, "iou": heads["iou"] * -1.0}
+    _, bad = train3d.detection3d_loss(
+        bad_heads, jnp.asarray(gt), cfg, train3d.Loss3DConfig()
+    )
+    assert float(bad["iou"]) > float(good["iou"]) + 0.5
+
+
+def test_second_train_step_loss_decreases():
+    import optax
+
+    from triton_client_tpu.io.synthdata import synth_scene_frame
+    from triton_client_tpu.models.second import init_second
+    from triton_client_tpu.parallel.mesh import MeshConfig, make_mesh
+
+    cfg = _tiny_second_cfg()
+    model, variables = init_second(jax.random.PRNGKey(0), cfg)
+    mesh = make_mesh(MeshConfig(data=1))
+    optimizer = optax.adam(3e-3)
+    state = train3d.init_train3d_state(model, variables, optimizer, mesh)
+    step = train3d.make_train3d_step(
+        model, optimizer, train3d.Loss3DConfig(), mesh
+    )
+
+    rng = np.random.default_rng(4)
+    points, boxes = synth_scene_frame(
+        rng,
+        pc_range=(0.0, -8.0, -2.0, 16.0, 8.0, 2.0),
+        n_objects=2,
+        n_clutter=300,
+        min_points=10,
+    )
+    pts = np.zeros((1, 2048, 4), np.float32)
+    m = min(len(points), 2048)
+    pts[0, :m] = points[:m]
+    tgt = np.full((1, 8, 8), -1, np.float32)
+    tgt[0, : len(boxes)] = boxes
+
+    losses = []
+    for _ in range(8):
+        state, metrics = step(
+            state, jnp.asarray(pts), jnp.asarray(np.asarray([m], np.int32)),
+            jnp.asarray(tgt),
+        )
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0]
+    assert np.isfinite(losses).all()
+    assert "iou" in metrics

@@ -23,9 +23,10 @@ import numpy as np
 def parse_args(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--family", default="yolov5",
-                   choices=("yolov5", "pointpillars"),
+                   choices=("yolov5", "pointpillars", "second_iou"),
                    help="model family: yolov5 (2D, image sources) or "
-                   "pointpillars (3D, .npy cloud sources + gt3d JSONL)")
+                   "pointpillars / second_iou (3D anchor-head "
+                   "detectors, .npy cloud sources + gt3d JSONL)")
     p.add_argument("-i", "--input", default="synthetic:64",
                    help="image dir | synthetic[:N[:HxW]] (2D); .npy cloud "
                    "dir (3D)")
@@ -39,6 +40,10 @@ def parse_args(argv=None):
                    help="3D: dataset/model yaml (detect3d --config schema); "
                    "copied into the exported entry as its dataset.yaml")
     p.add_argument("--variant", default="n", help="yolov5 variant (n/s/m/l/x)")
+    p.add_argument("--mxu-opt", action="store_true",
+                   help="yolov5: train the MXU-shaped layout (s2d stem + "
+                   "32-channel floor, +16%% serving throughput at b8); "
+                   "the exported entry serves it directly")
     p.add_argument("-c", "--classes", type=int, default=2)
     p.add_argument("--input-size", type=int, default=512)
     p.add_argument("-b", "--batch-size", type=int, default=8)
@@ -284,8 +289,10 @@ def main(argv=None) -> None:
         optimizer = optax.adam(schedule)
     else:
         optimizer = optax.adam(args.lr)
-    if args.family == "pointpillars":
-        from triton_client_tpu.models.pointpillars import init_pointpillars
+    family3d = args.family in ("pointpillars", "second_iou")
+    if family3d and args.mxu_opt:
+        raise SystemExit("--mxu-opt is yolov5-only")
+    if family3d:
         from triton_client_tpu.parallel.train3d import (
             Loss3DConfig,
             init_train3d_state,
@@ -297,12 +304,26 @@ def main(argv=None) -> None:
             from triton_client_tpu.dataset_config import detect3d_from_yaml
 
             fam, model_cfg, _ = detect3d_from_yaml(args.config)
-            if fam != "pointpillars":
+            if fam != args.family:
                 raise SystemExit(
-                    f"--config model {fam!r}: only the pointpillars family "
-                    "is trainable (anchor-head loss, parallel/train3d.py)"
+                    f"--config model {fam!r} != --family {args.family!r}"
                 )
-        model, variables = init_pointpillars(jax.random.PRNGKey(0), model_cfg)
+        if args.family == "second_iou":
+            from triton_client_tpu.models.second import init_second
+
+            if model_cfg is not None and model_cfg.middle == "sparse":
+                raise SystemExit(
+                    "training runs the dense middle encoder; train at a "
+                    "dense-capable grid (middle: dense) and serve the "
+                    "sparse config after import"
+                )
+            model, variables = init_second(jax.random.PRNGKey(0), model_cfg)
+        else:
+            from triton_client_tpu.models.pointpillars import init_pointpillars
+
+            model, variables = init_pointpillars(
+                jax.random.PRNGKey(0), model_cfg
+            )
 
         def init_state(vars_):
             return init_train3d_state(model, vars_, optimizer, mesh)
@@ -311,7 +332,7 @@ def main(argv=None) -> None:
         loader = functools.partial(
             _load_batches3d, pc_range=model.cfg.voxel.point_cloud_range
         )
-        export_doc = {"family": "pointpillars"}
+        export_doc = {"family": args.family}
         if args.config:
             export_doc["dataset"] = "dataset.yaml"
     else:
@@ -332,6 +353,8 @@ def main(argv=None) -> None:
             num_classes=args.classes,
             variant=args.variant,
             input_hw=(args.input_size, args.input_size),
+            s2d=args.mxu_opt,
+            ch_floor=32 if args.mxu_opt else 0,
         )
         loss_cfg = LossConfig(num_classes=args.classes, anchors=DEFAULT_ANCHORS)
 
@@ -348,6 +371,9 @@ def main(argv=None) -> None:
                 "input_hw": [args.input_size, args.input_size],
             },
         }
+        if args.mxu_opt:
+            export_doc["model"]["s2d"] = True
+            export_doc["model"]["ch_floor"] = 32
     state = init_state(variables)
 
     manager = None
@@ -425,7 +451,7 @@ def main(argv=None) -> None:
         entry = export_model(
             args.export, args.model_name, export_doc, variables=host_vars
         )
-        if args.family == "pointpillars" and args.config:
+        if family3d and args.config:
             import shutil
 
             shutil.copy(args.config, entry / "dataset.yaml")

@@ -117,6 +117,28 @@ class SECONDConfig:
         )
 
 
+def _scatter_mean_volume(points: jnp.ndarray, count: jnp.ndarray, voxel) -> jnp.ndarray:
+    """(N, F) padded cloud -> dense (nz, ny, nx, F) per-cell mean
+    volume. ONE fused scatter-add carries feature sums AND counts (last
+    column is the per-point weight) — a 131k-row TPU scatter costs
+    ~5 ms, so halving the passes is directly measurable. Shared by the
+    serving (from_points) and training (from_points_batch) paths so
+    their VFE numerics can never diverge."""
+    from triton_client_tpu.ops.voxelize import assign_cells, linearize_zyx
+
+    nx, ny, nz = voxel.grid_size
+    ijk, valid = assign_cells(points, count, voxel)
+    vid, n_cells = linearize_zyx(ijk, valid, voxel)
+    w = valid.astype(points.dtype)[:, None]
+    f = points.shape[-1]
+    acc = jnp.zeros((n_cells + 1, f + 1), points.dtype)
+    acc = acc.at[vid].add(
+        jnp.concatenate([points, jnp.ones_like(w)], axis=1) * w
+    )
+    volume = acc[:n_cells, :f] / jnp.maximum(acc[:n_cells, f:], 1.0)
+    return volume.reshape(nz, ny, nx, f)
+
+
 def scatter_to_volume(
     voxel_feats: jnp.ndarray,  # (V, C)
     coords: jnp.ndarray,       # (V, 3) [z, y, x], -1 invalid
@@ -166,6 +188,9 @@ class DenseMiddleEncoder(nn.Module):
                 dtype=self.dtype, name=f"bn{si}",
             )(x)
             x = nn.relu(x)
+        if x.ndim == 5:  # batched (training path): (B, d, h, w, c)
+            bsz, d, h, w, c = x.shape
+            return jnp.transpose(x, (0, 2, 3, 1, 4)).reshape(bsz, h, w, d * c)
         d, h, w, c = x.shape
         return jnp.transpose(x, (1, 2, 0, 3)).reshape(h, w, d * c)
 
@@ -326,9 +351,6 @@ class SECONDIoU(nn.Module):
         """Sort-free scatter path: per-cell mean via scatter-add (batch
         1). Bit-exact vs the grouped path (up to fp addition order)
         while the voxel budgets are not hit."""
-        from triton_client_tpu.ops.voxelize import assign_cells, linearize_zyx
-
-        nx, ny, nz = self.cfg.voxel.grid_size
         if self.cfg.middle == "sparse":
             from triton_client_tpu.ops.sparse_conv import points_to_voxelset
 
@@ -338,20 +360,32 @@ class SECONDIoU(nn.Module):
             )
             bev = self.middle(vs.ijk, vs.feats, vs.valid, train)
             return self._heads_from_bev(bev[None], train)
-        ijk, valid = assign_cells(points, count, self.cfg.voxel)
-        vid, n_cells = linearize_zyx(ijk, valid, self.cfg.voxel)
-        w = valid.astype(points.dtype)[:, None]
-        f = points.shape[-1]
-        # one fused scatter-add for feature sums AND counts (last
-        # column is the per-point weight) — a 131k-row TPU scatter
-        # costs ~5 ms, so halving the passes is directly measurable
-        acc = jnp.zeros((n_cells + 1, f + 1), points.dtype)
-        acc = acc.at[vid].add(
-            jnp.concatenate([points, jnp.ones_like(w)], axis=1) * w
-        )
-        volume = acc[:n_cells, :f] / jnp.maximum(acc[:n_cells, f:], 1.0)
-        volume = volume.reshape(1, nz, ny, nx, f)
-        return self._heads(volume, train)
+        volume = _scatter_mean_volume(points, count, self.cfg.voxel)
+        return self._heads(volume[None], train)
+
+    def from_points_batch(
+        self,
+        points: jnp.ndarray,  # (B, P, F>=4) padded clouds
+        counts: jnp.ndarray,  # (B,) real rows
+        train: bool = False,
+    ) -> dict[str, jnp.ndarray]:
+        """Batched TRAINING path (dense middle only): per-sample mean
+        volume via pure scatter (vmap-safe — MeanVFE has no params),
+        then the middle encoder runs on the rank-5 batch directly so
+        its BatchNorm sees the whole batch (a vmapped BN would trip
+        flax's broadcast-state mutation, the same constraint as
+        PointPillars.from_points_batch)."""
+        if self.cfg.middle == "sparse":
+            raise NotImplementedError(
+                "training runs the dense middle encoder; train at a "
+                "dense-capable grid (e.g. the 0.2 m default) and serve "
+                "sparse after import"
+            )
+        volume = jax.vmap(
+            lambda p, c: _scatter_mean_volume(p, c, self.cfg.voxel)
+        )(points, counts)  # (B, nz, ny, nx, F)
+        bev = self.middle(volume, train)  # rank-5 aware
+        return self._heads_from_bev(bev, train)
 
     def _heads(self, volume: jnp.ndarray, train: bool) -> dict[str, jnp.ndarray]:
         bev = jax.vmap(lambda v: self.middle(v, train))(volume)  # (B, h, w, C)

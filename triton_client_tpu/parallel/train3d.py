@@ -45,6 +45,11 @@ class Loss3DConfig:
     cls_w: float = 1.0
     loc_w: float = 2.0
     dir_w: float = 0.2
+    # IoU-quality head weight (SECOND-IoU): regression of the decoded
+    # box's IoU with its matched GT, encoded 2*iou - 1 (the score
+    # calibration signal decode rectifies with). 0 disables — models
+    # without an 'iou' head (PointPillars) ignore it.
+    iou_w: float = 1.0
     focal_alpha: float = 0.25
     focal_gamma: float = 2.0
     smooth_l1_beta: float = 1.0 / 9.0
@@ -64,6 +69,23 @@ def nearest_bev_halfdims(dims_xy: jnp.ndarray, yaw: jnp.ndarray) -> jnp.ndarray:
     hx = jnp.where(swap, dy, dx) / 2
     hy = jnp.where(swap, dx, dy) / 2
     return jnp.stack([hx, hy], axis=-1)
+
+
+def nearest_bev_iou_rowwise(
+    a: jnp.ndarray,  # (..., 7)
+    b: jnp.ndarray,  # (..., 7)
+) -> jnp.ndarray:
+    """Elementwise nearest-axis BEV IoU between matched box rows (the
+    IoU-quality head's regression target)."""
+    ah = nearest_bev_halfdims(a[..., 3:5], a[..., 6])
+    bh = nearest_bev_halfdims(b[..., 3:5], b[..., 6])
+    lo = jnp.maximum(a[..., :2] - ah, b[..., :2] - bh)
+    hi = jnp.minimum(a[..., :2] + ah, b[..., :2] + bh)
+    wh = jnp.clip(hi - lo, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = 4 * ah[..., 0] * ah[..., 1]
+    area_b = 4 * bh[..., 0] * bh[..., 1]
+    return inter / jnp.maximum(area_a + area_b - inter, 1e-9)
 
 
 def nearest_bev_iou_vs_gt(
@@ -240,13 +262,32 @@ def detection3d_loss(
     dir_loss = (dir_ce * pos_f).sum() / n_pos
 
     loss = cfg.cls_w * cls_loss + cfg.loc_w * box_loss + cfg.dir_w * dir_loss
-    return loss, {
-        "loss": loss,
+    metrics = {
         "cls": cls_loss,
         "box": box_loss,
         "dir": dir_loss,
         "n_pos": n_pos,
     }
+
+    # ---- IoU-quality head (SECOND-IoU): smooth-L1 toward 2*iou - 1 of
+    # the DECODED prediction vs its matched GT at positives
+    if "iou" in heads and cfg.iou_w > 0:
+        from triton_client_tpu.models.pointpillars import decode_boxes
+
+        iou_pred = heads["iou"].reshape(b, n)
+        decoded = decode_boxes(box_pred, anchors[None])  # (B, N, 7)
+        t_iou = jax.lax.stop_gradient(
+            nearest_bev_iou_rowwise(decoded, gt_boxes)
+        )
+        iou_loss = (
+            _smooth_l1(iou_pred - (2.0 * t_iou - 1.0), cfg.smooth_l1_beta)
+            * pos_f
+        ).sum() / n_pos
+        loss = loss + cfg.iou_w * iou_loss
+        metrics["iou"] = iou_loss
+
+    metrics["loss"] = loss
+    return loss, metrics
 
 
 def make_train3d_step(
@@ -267,7 +308,7 @@ def make_train3d_step(
                 counts,
                 train=True,
                 mutable=["batch_stats"],
-                method=PointPillars.from_points_batch,
+                method=type(model).from_points_batch,
             )
             loss, metrics = detection3d_loss(
                 heads, targets, model.cfg, loss_cfg
