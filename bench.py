@@ -602,8 +602,16 @@ def main() -> None:
         try:
             c.warmup()
         except Exception as e:
-            drop(c, "warmup", e)
-            continue
+            # one retry: the tunnel's remote-compile intermittently
+            # closes the response body mid-read; a fresh attempt
+            # usually lands and a transient hiccup should not cost a
+            # secondary its row
+            print(f"{c.name} warmup retry after: {e}", file=sys.stderr)
+            try:
+                c.warmup()
+            except Exception as e2:
+                drop(c, "warmup", e2)
+                continue
         print(
             f"warmup {c.name}: {time.perf_counter() - t0:.1f}s "
             f"(flops/call={c.flops_per_call})",
