@@ -1,0 +1,292 @@
+"""System shared-memory extension: regions, registry, codec, live RPC.
+
+The reference's Triton deployment ships this extension (tritonclient
+exposes it as tritonclient.utils.shared_memory); here the same wire
+contract — SystemSharedMemory{Register,Status,Unregister} RPCs plus
+shared_memory_* input/output parameters — is served in-tree, so a
+same-host client can hand 786 KB camera frames to the server through
+one memcpy instead of a protobuf round-trip."""
+
+import os
+
+import numpy as np
+import pytest
+
+from triton_client_tpu.channel.base import InferRequest
+from triton_client_tpu.channel.grpc_channel import GRPCChannel
+from triton_client_tpu.channel.kserve import codec, pb
+from triton_client_tpu.channel.tpu_channel import TPUChannel
+from triton_client_tpu.config import ModelSpec, TensorSpec
+from triton_client_tpu.runtime.repository import ModelRepository
+from triton_client_tpu.runtime.server import InferenceServer
+from triton_client_tpu.runtime.shared_memory import (
+    SharedMemoryRegion,
+    SystemSharedMemoryRegistry,
+    _shm_path,
+)
+
+
+def _spec():
+    return ModelSpec(
+        name="addone",
+        version="1",
+        platform="jax",
+        inputs=(TensorSpec("x", (-1, 4), "FP32"),),
+        outputs=(TensorSpec("y", (-1, 4), "FP32"),),
+        max_batch_size=8,
+    )
+
+
+def _repo():
+    repo = ModelRepository()
+    repo.register(_spec(), lambda inputs: {"y": np.asarray(inputs["x"]) + 1.0})
+    return repo
+
+
+class TestRegion:
+    def test_create_write_read_unlink(self):
+        key = f"/tct_test_{os.getpid()}_rw"
+        arr = np.arange(24, dtype=np.float32).reshape(4, 6)
+        with SharedMemoryRegion.create(key, arr.nbytes) as region:
+            assert region.write(arr) == arr.nbytes
+            view = region.read(0, arr.nbytes)
+            back = np.frombuffer(view, np.float32).reshape(4, 6)
+            np.testing.assert_array_equal(back, arr)
+            assert os.path.exists(_shm_path(key))
+        assert not os.path.exists(_shm_path(key))  # owner unlinks
+
+    def test_attach_sees_writer_bytes(self):
+        key = f"/tct_test_{os.getpid()}_attach"
+        with SharedMemoryRegion.create(key, 64) as owner:
+            owner.write(np.full(16, 3.5, np.float32))
+            reader = SharedMemoryRegion.attach(key)
+            got = np.frombuffer(reader.read(0, 64), np.float32)
+            np.testing.assert_array_equal(got, np.full(16, 3.5, np.float32))
+            reader.close()
+            # non-owner close must NOT unlink
+            assert os.path.exists(_shm_path(key))
+
+    def test_bounds_and_key_validation(self):
+        key = f"/tct_test_{os.getpid()}_bounds"
+        with SharedMemoryRegion.create(key, 16) as region:
+            with pytest.raises(ValueError):
+                region.write(np.zeros(5, np.float32))  # 20 > 16
+            with pytest.raises(ValueError):
+                region.read(8, 16)
+        for bad in ("", "/", "a/b", "/../etc", ".hidden"):
+            with pytest.raises(ValueError):
+                _shm_path(bad)
+
+
+class TestRegistry:
+    def test_register_status_unregister(self):
+        key = f"/tct_test_{os.getpid()}_reg"
+        with SharedMemoryRegion.create(key, 128) as region:
+            region.write(np.arange(32, dtype=np.float32))
+            reg = SystemSharedMemoryRegistry()
+            reg.register("r0", key, 0, 128)
+            with pytest.raises(ValueError):
+                reg.register("r0", key, 0, 128)  # duplicate name
+            assert reg.status()["r0"].byte_size == 128
+            got = np.frombuffer(reg.read("r0", 0, 128), np.float32)
+            np.testing.assert_array_equal(got, np.arange(32, dtype=np.float32))
+            with pytest.raises(ValueError):
+                reg.read("r0", 64, 128)  # beyond registered window
+            reg.unregister("r0")
+            with pytest.raises(ValueError):
+                reg.read("r0", 0, 4)
+            with pytest.raises(KeyError):
+                reg.status("r0")
+
+    def test_attach_missing_key_fails(self):
+        reg = SystemSharedMemoryRegistry()
+        with pytest.raises(OSError):
+            reg.register("nope", f"/tct_test_{os.getpid()}_missing", 0, 8)
+
+    def test_registered_window_respects_offset(self):
+        key = f"/tct_test_{os.getpid()}_off"
+        with SharedMemoryRegion.create(key, 64) as region:
+            region.write(np.arange(16, dtype=np.float32))
+            reg = SystemSharedMemoryRegistry()
+            reg.register("w", key, offset=32, byte_size=32)
+            got = np.frombuffer(reg.read("w", 0, 32), np.float32)
+            np.testing.assert_array_equal(
+                got, np.arange(8, 16, dtype=np.float32)
+            )
+            reg.unregister_all()
+
+
+class TestCodecShm:
+    def test_mixed_wire_and_shm_inputs(self):
+        key = f"/tct_test_{os.getpid()}_codec"
+        imgs = np.random.default_rng(0).random((2, 4, 4, 3)).astype(np.float32)
+        count = np.array([7], np.int32)
+        with SharedMemoryRegion.create(key, imgs.nbytes) as region:
+            region.write(imgs)
+            reg = SystemSharedMemoryRegistry()
+            reg.register("imgs_r", key, 0, imgs.nbytes)
+            req = codec.build_infer_request_shm(
+                "m",
+                {"images": imgs, "count": count},
+                shm_inputs={"images": ("imgs_r", 0, imgs.nbytes)},
+            )
+            # only the wire input consumes a raw slot
+            assert len(req.raw_input_contents) == 1
+            wire = pb.ModelInferRequest.FromString(req.SerializeToString())
+            parsed = codec.parse_infer_request(wire, shm=reg)
+            np.testing.assert_array_equal(parsed["images"], imgs)
+            np.testing.assert_array_equal(parsed["count"], count)
+            reg.unregister_all()
+
+    def test_negative_offset_rejected(self):
+        """int64_param is signed: a negative offset must not reach
+        python slice semantics (it would silently read from the END of
+        the segment, outside the registered window)."""
+        key = f"/tct_test_{os.getpid()}_neg"
+        with SharedMemoryRegion.create(key, 64) as region:
+            reg = SystemSharedMemoryRegistry()
+            reg.register("neg", key, offset=32, byte_size=32)
+            with pytest.raises(ValueError):
+                reg.read("neg", -32, 32)
+            with pytest.raises(ValueError):
+                reg.write("neg", -32, np.zeros(4, np.float32))
+            with pytest.raises(ValueError):
+                region.read(-8, 8)
+            req = pb.ModelInferRequest(model_name="m")
+            t = req.inputs.add(name="x", datatype="FP32", shape=[8])
+            codec.set_shm_params(t, "neg", 0, 32)
+            t.parameters["shared_memory_offset"].int64_param = -32
+            with pytest.raises(ValueError):
+                codec.parse_infer_request(req, shm=reg)
+            reg.unregister_all()
+
+    def test_shm_input_without_registry_rejected(self):
+        req = codec.build_infer_request_shm(
+            "m",
+            {"x": np.zeros((1, 4), np.float32)},
+            shm_inputs={"x": ("r", 0, 16)},
+        )
+        with pytest.raises(ValueError):
+            codec.parse_infer_request(req, shm=None)
+
+    def test_response_through_shm(self):
+        key = f"/tct_test_{os.getpid()}_out"
+        y = np.arange(12, dtype=np.float32).reshape(3, 4)
+        with SharedMemoryRegion.create(key, 256) as client_region:
+            reg = SystemSharedMemoryRegistry()
+            reg.register("out_r", key, 0, 256)
+            resp = codec.build_infer_response(
+                "m",
+                {"y": y},
+                shm_outputs={"y": ("out_r", 0, 256)},
+                shm=reg,
+            )
+            assert not resp.raw_output_contents  # travelled via shm
+            wire = pb.ModelInferResponse.FromString(resp.SerializeToString())
+            parsed = codec.parse_infer_response(
+                wire, regions={"out_r": client_region}
+            )
+            np.testing.assert_array_equal(parsed["y"], y)
+            reg.unregister_all()
+
+    def test_oversize_output_rejected(self):
+        key = f"/tct_test_{os.getpid()}_small"
+        with SharedMemoryRegion.create(key, 8):
+            reg = SystemSharedMemoryRegistry()
+            reg.register("small", key, 0, 8)
+            with pytest.raises(ValueError):
+                codec.build_infer_response(
+                    "m",
+                    {"y": np.zeros(16, np.float32)},
+                    shm_outputs={"y": ("small", 0, 8)},
+                    shm=reg,
+                )
+            reg.unregister_all()
+
+
+class TestLiveShmServer:
+    @pytest.fixture()
+    def server(self):
+        repo = _repo()
+        server = InferenceServer(
+            repo, TPUChannel(repo), address="127.0.0.1:0", max_workers=4
+        )
+        server.start()
+        yield server
+        server.stop()
+
+    def test_shm_channel_matches_wire_channel(self, server):
+        addr = f"127.0.0.1:{server.port}"
+        wire = GRPCChannel(addr, timeout_s=10.0)
+        shm = GRPCChannel(addr, timeout_s=10.0, use_shared_memory=True)
+        x = np.random.default_rng(1).random((3, 4)).astype(np.float32)
+        req = InferRequest(model_name="addone", inputs={"x": x})
+        try:
+            a = wire.do_inference(req).outputs["y"]
+            b = shm.do_inference(req).outputs["y"]
+            np.testing.assert_array_equal(a, b)
+            np.testing.assert_allclose(b, x + 1.0)
+            # one registered region, one backing segment
+            assert len(server.shm_registry.status()) == 1
+        finally:
+            shm.close()
+            wire.close()
+        # channel close unregisters server-side and unlinks the segment
+        assert server.shm_registry.status() == {}
+
+    def test_region_grows_with_input(self, server):
+        addr = f"127.0.0.1:{server.port}"
+        shm = GRPCChannel(addr, timeout_s=10.0, use_shared_memory=True)
+        try:
+            for batch in (1, 4, 2):  # grow then reuse-larger
+                x = np.full((batch, 4), float(batch), np.float32)
+                out = shm.do_inference(
+                    InferRequest(model_name="addone", inputs={"x": x})
+                ).outputs["y"]
+                np.testing.assert_allclose(out, x + 1.0)
+            assert len(server.shm_registry.status()) == 1
+        finally:
+            shm.close()
+
+    def test_unregistered_region_is_invalid_argument(self, server):
+        import grpc
+
+        addr = f"127.0.0.1:{server.port}"
+        chan = GRPCChannel(addr, timeout_s=10.0)
+        req = codec.build_infer_request_shm(
+            "addone",
+            {"x": np.zeros((1, 4), np.float32)},
+            shm_inputs={"x": ("ghost", 0, 16)},
+        )
+        try:
+            with pytest.raises(grpc.RpcError) as exc:
+                chan._stub.ModelInfer(req, timeout=10.0)
+            assert exc.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+        finally:
+            chan.close()
+
+    def test_status_and_unregister_rpcs(self, server):
+        addr = f"127.0.0.1:{server.port}"
+        chan = GRPCChannel(addr, timeout_s=10.0)
+        key = f"/tct_test_{os.getpid()}_rpc"
+        with SharedMemoryRegion.create(key, 64):
+            chan._stub.SystemSharedMemoryRegister(
+                pb.SystemSharedMemoryRegisterRequest(
+                    name="rpc_r", key=key, byte_size=64
+                ),
+                timeout=10.0,
+            )
+            status = chan._stub.SystemSharedMemoryStatus(
+                pb.SystemSharedMemoryStatusRequest(), timeout=10.0
+            )
+            assert status.regions["rpc_r"].key == key
+            assert status.regions["rpc_r"].byte_size == 64
+            chan._stub.SystemSharedMemoryUnregister(
+                pb.SystemSharedMemoryUnregisterRequest(name="rpc_r"),
+                timeout=10.0,
+            )
+            status = chan._stub.SystemSharedMemoryStatus(
+                pb.SystemSharedMemoryStatusRequest(), timeout=10.0
+            )
+            assert not status.regions
+        chan.close()

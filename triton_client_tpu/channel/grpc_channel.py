@@ -21,6 +21,7 @@ TPUChannel implements. Departures from the reference:
 from __future__ import annotations
 
 import logging
+import os
 import time
 
 import grpc
@@ -54,7 +55,21 @@ class GRPCChannel(BaseChannel):
         timeout_s: float = 30.0,
         retries: int = 3,
         backoff_s: float = 0.1,
+        use_shared_memory: bool = False,
     ) -> None:
+        """``use_shared_memory``: same-host transport — inputs are
+        written into client-owned POSIX shm segments and requests carry
+        only region coordinates (Triton system-shared-memory
+        extension), skipping the protobuf serialize/copy/deserialize of
+        the tensor payload in both processes. Regions are created and
+        registered lazily per input name and sized to the largest array
+        seen. The shm path serializes do_inference calls on this
+        channel (a region must stay untouched until its response
+        arrives); use one channel per concurrent client. Only the
+        synchronous do_inference path uses shm — do_inference_async and
+        infer_stream fall back to the wire (a region may not be reused
+        while a request is in flight, which is exactly what pipelined
+        calls do; a warning is logged once)."""
         self._endpoint = endpoint
         self._max_message_bytes = max_message_bytes
         self._timeout_s = timeout_s
@@ -63,6 +78,15 @@ class GRPCChannel(BaseChannel):
         self._channel: grpc.Channel | None = None
         self._stub: service.GRPCInferenceServiceStub | None = None
         self._retired: list[grpc.Channel] = []
+        self._use_shm = use_shared_memory
+        self._shm_regions: dict = {}  # input name -> SharedMemoryRegion
+        self._shm_gen: dict = {}      # input name -> segment generation
+        self._shm_lock = None
+        self._shm_async_warned = False
+        if use_shared_memory:
+            import threading
+
+            self._shm_lock = threading.Lock()
         self.register_channel()
 
     # -- BaseChannel protocol -------------------------------------------------
@@ -116,6 +140,8 @@ class GRPCChannel(BaseChannel):
         return spec
 
     def do_inference(self, request: InferRequest) -> InferResponse:
+        if self._use_shm:
+            return self._do_inference_shm(request)
         wire = codec.build_infer_request(
             model_name=request.model_name,
             inputs=request.inputs,
@@ -132,12 +158,104 @@ class GRPCChannel(BaseChannel):
             latency_s=time.perf_counter() - t0,
         )
 
+    # -- shared-memory transport ----------------------------------------------
+
+    def _shm_region_for(self, name: str, nbytes: int):
+        """Client-owned region for one input, grown when outsized.
+        Region/segment names are unique per channel instance so many
+        clients can share a server. Growth generation-tags the segment
+        name (the registry rejects duplicate names) and replaces the
+        old registration only AFTER the new one succeeds, so a failed
+        register RPC leaks nothing and leaves the old region usable."""
+        from triton_client_tpu.runtime.shared_memory import SharedMemoryRegion
+
+        region = self._shm_regions.get(name)
+        if region is not None and region.size >= nbytes:
+            return region
+        # every attempt burns a generation so a failed register (which
+        # may have executed server-side) never reuses its segment name
+        gen = self._shm_gen.get(name, 0)
+        self._shm_gen[name] = gen + 1
+        rname = f"tct_{os.getpid()}_{id(self)}_{name}_{gen}"
+        new = SharedMemoryRegion.create(f"/{rname}", max(nbytes, 1))
+        try:
+            # no retry: register is not idempotent (duplicate names are
+            # rejected), and it is a fast metadata RPC — a transient
+            # failure surfaces to the caller, who may simply call again
+            self._call(
+                self._stub.SystemSharedMemoryRegister,
+                pb.SystemSharedMemoryRegisterRequest(
+                    name=rname, key=new.key, offset=0, byte_size=new.size
+                ),
+                retryable=(),
+            )
+        except Exception:
+            new.close()  # unlinks; the server maps the file by its own
+            # fd if it did register, so unlinking is safe either way
+            raise
+        if region is not None:
+            old_name = region.key.lstrip("/")
+            try:
+                self._call(
+                    self._stub.SystemSharedMemoryUnregister,
+                    pb.SystemSharedMemoryUnregisterRequest(name=old_name),
+                    retryable=(),
+                )
+            except grpc.RpcError:
+                log.warning(
+                    "could not unregister outgrown region %s", old_name
+                )
+            region.close()
+        self._shm_regions[name] = new
+        self._shm_gen[name] = gen + 1
+        return new
+
+    def _do_inference_shm(self, request: InferRequest) -> InferResponse:
+        import numpy as np
+
+        with self._shm_lock:
+            shm_inputs = {}
+            arrays = {}
+            for name, value in request.inputs.items():
+                arr = np.ascontiguousarray(np.asarray(value))
+                arrays[name] = arr
+                region = self._shm_region_for(name, arr.nbytes)
+                region.write(arr)
+                rname = region.key.lstrip("/")
+                shm_inputs[name] = (rname, 0, arr.nbytes)
+            wire = codec.build_infer_request_shm(
+                model_name=request.model_name,
+                inputs=arrays,
+                shm_inputs=shm_inputs,
+                model_version=request.model_version,
+                request_id=request.request_id,
+            )
+            t0 = time.perf_counter()
+            # UNAVAILABLE-only retry, same contract as the wire path
+            resp = self._call(
+                self._stub.ModelInfer, wire, retryable=_INFER_RETRYABLE
+            )
+            return InferResponse(
+                model_name=resp.model_name,
+                model_version=resp.model_version,
+                outputs=codec.parse_infer_response(resp),
+                request_id=resp.id,
+                latency_s=time.perf_counter() - t0,
+            )
+
     def do_inference_async(self, request: InferRequest) -> InferFuture:
         """Non-blocking ModelInfer via a gRPC call future (the --async
         path): the RPC is on the wire when this returns; result() parses
         the response. A connection-level failure (UNAVAILABLE — the only
         code safe to re-issue, see _call) falls back to the sync retry
         ladder at resolution time; all other errors surface at result()."""
+        if self._use_shm and not self._shm_async_warned:
+            self._shm_async_warned = True
+            log.warning(
+                "use_shared_memory only covers synchronous do_inference; "
+                "async/stream requests travel over the wire (pipelined "
+                "calls would reuse a region while it is still in flight)"
+            )
         try:
             wire = codec.build_infer_request(
                 model_name=request.model_name,
@@ -235,6 +353,27 @@ class GRPCChannel(BaseChannel):
             )
 
     def close(self) -> None:
+        # client owns the shm segments: unregister server-side (best
+        # effort — the server may already be gone), then unlink. Taken
+        # under the shm lock so an in-flight do_inference finishes
+        # before its regions are torn down.
+        import contextlib
+
+        with self._shm_lock or contextlib.nullcontext():
+            for name, region in self._shm_regions.items():
+                try:
+                    # no retry ladder: cleanup against a dead server
+                    # must not stall shutdown for the backoff budget
+                    self._stub.SystemSharedMemoryUnregister(
+                        pb.SystemSharedMemoryUnregisterRequest(
+                            name=region.key.lstrip("/")
+                        ),
+                        timeout=min(self._timeout_s, 2.0),
+                    )
+                except grpc.RpcError:
+                    pass
+                region.close()
+            self._shm_regions.clear()
         if self._channel is not None:
             self._channel.close()
         for ch in self._retired:

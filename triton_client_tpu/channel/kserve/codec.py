@@ -93,16 +93,93 @@ def build_infer_request(
     return req
 
 
-def parse_infer_request(req: pb.ModelInferRequest) -> dict[str, np.ndarray]:
-    if len(req.raw_input_contents) != len(req.inputs):
+def build_infer_request_shm(
+    model_name: str,
+    inputs: dict[str, np.ndarray],
+    shm_inputs: dict[str, tuple[str, int, int]],
+    model_version: str = "",
+    request_id: str = "",
+) -> pb.ModelInferRequest:
+    """Like build_infer_request, but inputs named in ``shm_inputs``
+    (name -> (region, offset, byte_size)) travel as metadata + shared-
+    memory parameters with no raw content; the caller has already
+    written their bytes into the region."""
+    req = pb.ModelInferRequest(
+        model_name=model_name, model_version=model_version, id=request_id
+    )
+    for name in sorted(inputs):
+        arr = np.asarray(inputs[name])
+        t = req.inputs.add(
+            name=name, datatype=datatype_of(arr), shape=arr.shape
+        )
+        target = shm_inputs.get(name)
+        if target is None:
+            req.raw_input_contents.append(serialize_tensor(arr))
+        else:
+            set_shm_params(t, *target)
+    return req
+
+
+def shm_params(tensor) -> tuple[str, int, int] | None:
+    """(region, offset, byte_size) when a tensor's parameters request
+    shared-memory transport (Triton system-shared-memory extension);
+    None for plain wire tensors."""
+    p = tensor.parameters
+    if "shared_memory_region" not in p:
+        return None
+    region = p["shared_memory_region"].string_param
+    byte_size = int(p["shared_memory_byte_size"].int64_param)
+    offset = (
+        int(p["shared_memory_offset"].int64_param)
+        if "shared_memory_offset" in p
+        else 0
+    )
+    if not region or byte_size <= 0 or offset < 0:
         raise ValueError(
-            f"{len(req.inputs)} input tensors but "
+            "shared-memory tensor parameters need a region name, a "
+            "positive byte_size, and a non-negative offset "
+            f"(got {region!r}, {byte_size}, {offset})"
+        )
+    return region, offset, byte_size
+
+
+def set_shm_params(tensor, region: str, offset: int, byte_size: int) -> None:
+    tensor.parameters["shared_memory_region"].string_param = region
+    tensor.parameters["shared_memory_byte_size"].int64_param = byte_size
+    if offset:
+        tensor.parameters["shared_memory_offset"].int64_param = offset
+
+
+def parse_infer_request(
+    req: pb.ModelInferRequest, shm=None
+) -> dict[str, np.ndarray]:
+    """Wire -> arrays. Inputs carrying shared-memory parameters are
+    read from ``shm`` (a SystemSharedMemoryRegistry) and consume NO
+    raw_input_contents slot — the wire pairs raw buffers positionally
+    with the non-shm inputs only (Triton semantics)."""
+    wire_inputs = [t for t in req.inputs if shm_params(t) is None]
+    if len(req.raw_input_contents) != len(wire_inputs):
+        raise ValueError(
+            f"{len(wire_inputs)} wire input tensors but "
             f"{len(req.raw_input_contents)} raw buffers"
         )
-    return {
-        t.name: deserialize_tensor(raw, t.datatype, t.shape)
-        for t, raw in zip(req.inputs, req.raw_input_contents)
-    }
+    raws = iter(req.raw_input_contents)
+    out = {}
+    for t in req.inputs:
+        region = shm_params(t)
+        if region is None:
+            out[t.name] = deserialize_tensor(next(raws), t.datatype, t.shape)
+            continue
+        if shm is None:
+            raise ValueError(
+                f"input {t.name!r} requests shared-memory transport but "
+                "this server has no shared-memory registry"
+            )
+        name, offset, byte_size = region
+        out[t.name] = deserialize_tensor(
+            shm.read(name, offset, byte_size), t.datatype, t.shape
+        )
+    return out
 
 
 def build_infer_response(
@@ -110,19 +187,63 @@ def build_infer_response(
     outputs: dict[str, np.ndarray],
     model_version: str = "",
     request_id: str = "",
+    shm_outputs: dict[str, tuple[str, int, int]] | None = None,
+    shm=None,
 ) -> pb.ModelInferResponse:
+    """``shm_outputs`` maps output name -> (region, offset, byte_size):
+    those tensors are written into the registry's region and travel as
+    metadata + shared-memory parameters with no raw content (Triton
+    system-shared-memory extension, response side)."""
     resp = pb.ModelInferResponse(
         model_name=model_name, model_version=model_version, id=request_id
     )
     for name in sorted(outputs):
         arr = np.asarray(outputs[name])
-        resp.outputs.add(name=name, datatype=datatype_of(arr), shape=arr.shape)
-        resp.raw_output_contents.append(serialize_tensor(arr))
+        t = resp.outputs.add(
+            name=name, datatype=datatype_of(arr), shape=arr.shape
+        )
+        target = (shm_outputs or {}).get(name)
+        if target is None:
+            resp.raw_output_contents.append(serialize_tensor(arr))
+            continue
+        region, offset, byte_size = target
+        if arr.nbytes > byte_size:
+            raise ValueError(
+                f"output {name!r} is {arr.nbytes} bytes but the requested "
+                f"shared-memory window is {byte_size}"
+            )
+        shm.write(region, offset, np.ascontiguousarray(arr))
+        set_shm_params(t, region, offset, arr.nbytes)
     return resp
 
 
-def parse_infer_response(resp: pb.ModelInferResponse) -> dict[str, np.ndarray]:
-    return {
-        t.name: deserialize_tensor(raw, t.datatype, t.shape)
-        for t, raw in zip(resp.outputs, resp.raw_output_contents)
-    }
+def parse_infer_response(
+    resp: pb.ModelInferResponse, regions=None
+) -> dict[str, np.ndarray]:
+    """Wire -> arrays. Outputs whose parameters carry shared-memory
+    coordinates are read from ``regions`` (output name or region name
+    -> client-owned SharedMemoryRegion) instead of raw content."""
+    wire_outputs = [t for t in resp.outputs if shm_params(t) is None]
+    if len(resp.raw_output_contents) != len(wire_outputs):
+        raise ValueError(
+            f"{len(wire_outputs)} wire output tensors but "
+            f"{len(resp.raw_output_contents)} raw buffers"
+        )
+    raws = iter(resp.raw_output_contents)
+    out = {}
+    for t in resp.outputs:
+        target = shm_params(t)
+        if target is None:
+            out[t.name] = deserialize_tensor(next(raws), t.datatype, t.shape)
+            continue
+        name, offset, byte_size = target
+        region = (regions or {}).get(name) or (regions or {}).get(t.name)
+        if region is None:
+            raise ValueError(
+                f"response output {t.name!r} lives in shared-memory region "
+                f"{name!r} but no matching client region was provided"
+            )
+        out[t.name] = deserialize_tensor(
+            region.read(offset, byte_size), t.datatype, t.shape
+        )
+    return out

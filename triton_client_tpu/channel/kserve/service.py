@@ -27,6 +27,21 @@ _METHODS = {
     "ModelStreamInfer": (pb.ModelInferRequest, pb.ModelStreamInferResponse, True),
     "ModelConfig": (pb.ModelConfigRequest, pb.ModelConfigResponse, False),
     "RepositoryIndex": (pb.RepositoryIndexRequest, pb.RepositoryIndexResponse, False),
+    "SystemSharedMemoryStatus": (
+        pb.SystemSharedMemoryStatusRequest,
+        pb.SystemSharedMemoryStatusResponse,
+        False,
+    ),
+    "SystemSharedMemoryRegister": (
+        pb.SystemSharedMemoryRegisterRequest,
+        pb.SystemSharedMemoryRegisterResponse,
+        False,
+    ),
+    "SystemSharedMemoryUnregister": (
+        pb.SystemSharedMemoryUnregisterRequest,
+        pb.SystemSharedMemoryUnregisterResponse,
+        False,
+    ),
 }
 
 
@@ -82,6 +97,15 @@ class GRPCInferenceServiceServicer:
         self._unimplemented(context)
 
     def RepositoryIndex(self, request, context):
+        self._unimplemented(context)
+
+    def SystemSharedMemoryStatus(self, request, context):
+        self._unimplemented(context)
+
+    def SystemSharedMemoryRegister(self, request, context):
+        self._unimplemented(context)
+
+    def SystemSharedMemoryUnregister(self, request, context):
         self._unimplemented(context)
 
 

@@ -62,10 +62,12 @@ class _Servicer(service.GRPCInferenceServiceServicer):
         repository: ModelRepository,
         channel: BaseChannel,
         profiler=None,
+        shm_registry=None,
     ) -> None:
         self._repo = repository
         self._channel = channel
         self._profiler = profiler
+        self._shm = shm_registry
 
     # -- health ---------------------------------------------------------------
 
@@ -89,7 +91,11 @@ class _Servicer(service.GRPCInferenceServiceServicer):
         return pb.ServerMetadataResponse(
             name="triton_client_tpu",
             version=__version__,
-            extensions=["model_repository", "binary_tensor_data"],
+            extensions=[
+                "model_repository",
+                "binary_tensor_data",
+                "system_shared_memory",
+            ],
         )
 
     def _spec_or_abort(self, name, version, context):
@@ -147,11 +153,42 @@ class _Servicer(service.GRPCInferenceServiceServicer):
                 resp.models.add(name=name, version=version, state="READY")
         return resp
 
+    # -- shared memory (Triton system-shared-memory extension) ----------------
+
+    def SystemSharedMemoryRegister(self, request, context):
+        try:
+            self._shm.register(
+                request.name, request.key, request.offset, request.byte_size
+            )
+        except (ValueError, OSError) as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        return pb.SystemSharedMemoryRegisterResponse()
+
+    def SystemSharedMemoryUnregister(self, request, context):
+        if request.name:
+            self._shm.unregister(request.name)
+        else:
+            self._shm.unregister_all()
+        return pb.SystemSharedMemoryUnregisterResponse()
+
+    def SystemSharedMemoryStatus(self, request, context):
+        resp = pb.SystemSharedMemoryStatusResponse()
+        try:
+            regions = self._shm.status(request.name)
+        except KeyError as e:
+            context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+        for name, reg in regions.items():
+            resp.regions[name].name = name
+            resp.regions[name].key = reg.key
+            resp.regions[name].offset = reg.offset
+            resp.regions[name].byte_size = reg.byte_size
+        return resp
+
     # -- inference ------------------------------------------------------------
 
     def _infer(self, request):
         t0 = time.perf_counter()
-        inputs = codec.parse_infer_request(request)
+        inputs = codec.parse_infer_request(request, shm=self._shm)
         result = self._channel.do_inference(
             InferRequest(
                 model_name=request.model_name,
@@ -166,11 +203,18 @@ class _Servicer(service.GRPCInferenceServiceServicer):
             self._profiler.record(
                 f"infer_{request.model_name}", time.perf_counter() - t0
             )
+        shm_outputs = {
+            t.name: params
+            for t in request.outputs
+            if (params := codec.shm_params(t)) is not None
+        }
         return codec.build_infer_response(
             model_name=result.model_name,
             model_version=result.model_version,
             outputs=result.outputs,
             request_id=result.request_id,
+            shm_outputs=shm_outputs,
+            shm=self._shm,
         )
 
     def ModelInfer(self, request, context):
@@ -244,8 +288,19 @@ class InferenceServer:
                 ("grpc.max_receive_message_length", limit),
             ],
         )
+        from triton_client_tpu.runtime.shared_memory import (
+            SystemSharedMemoryRegistry,
+        )
+
+        self.shm_registry = SystemSharedMemoryRegistry()
         service.add_servicer_to_server(
-            _Servicer(repository, channel, profiler=profiler), self._server
+            _Servicer(
+                repository,
+                channel,
+                profiler=profiler,
+                shm_registry=self.shm_registry,
+            ),
+            self._server,
         )
         self._port = self._server.add_insecure_port(address)
         if self._port == 0:
@@ -265,3 +320,5 @@ class InferenceServer:
 
     def stop(self, grace: float = 1.0) -> None:
         self._server.stop(grace).wait()
+        # detach (never unlink — the segments are client-owned)
+        self.shm_registry.unregister_all()

@@ -1,0 +1,216 @@
+"""System shared-memory regions for the KServe serving path.
+
+Triton's system-shared-memory extension lets a same-host client hand
+tensors to the server through a POSIX shm segment instead of the gRPC
+wire: the client registers a region (name -> shm key + byte range),
+then sends infer requests whose input tensors carry
+``shared_memory_region`` / ``shared_memory_offset`` /
+``shared_memory_byte_size`` parameters and NO raw content. The
+reference deploys stock Triton which ships this extension (the
+tritonclient package the reference pulls in exposes it as
+``tritonclient.utils.shared_memory``); for a 512x512 camera frame the
+wire path serializes ~786 KB into protobuf, copies it through HTTP/2
+framing, and deserializes it server side — per request, per direction.
+The shm path replaces all of that with one memcpy into a mapped page.
+
+POSIX ``shm_open(key)`` maps to ``/dev/shm/<key>`` on Linux, so
+regions are implemented as plain mmaps over files there — byte-for-
+byte the same segments tritonclient's ``create_shared_memory_region``
+creates, without python's ``multiprocessing.shared_memory`` resource-
+tracker (which unlinks attached segments at interpreter exit on
+< 3.13).
+
+Lifecycle contract (same as Triton's):
+  * the CLIENT creates the segment, writes tensors, and eventually
+    unlinks it;
+  * the SERVER only registers (attaches) and unregisters (detaches) —
+    it never unlinks the backing file.
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import threading
+from dataclasses import dataclass
+
+import numpy as np
+
+_SHM_DIR = "/dev/shm"
+
+
+def _shm_path(key: str) -> str:
+    # POSIX keys conventionally start with "/"; shm_open("/foo") is
+    # /dev/shm/foo. Reject path traversal — keys are wire-controlled.
+    name = key[1:] if key.startswith("/") else key
+    if not name or "/" in name or name.startswith("."):
+        raise ValueError(f"invalid shared-memory key {key!r}")
+    return os.path.join(_SHM_DIR, name)
+
+
+class SharedMemoryRegion:
+    """One mapped shm segment. ``create`` (client side) makes and owns
+    the backing file; ``attach`` (server side) maps an existing one."""
+
+    def __init__(self, key: str, mm: mmap.mmap, size: int, owns: bool):
+        self.key = key
+        self._mm = mm
+        self.size = size
+        self._owns = owns
+        self._closed = False
+
+    @classmethod
+    def create(cls, key: str, byte_size: int) -> "SharedMemoryRegion":
+        path = _shm_path(key)
+        fd = os.open(path, os.O_CREAT | os.O_RDWR, 0o600)
+        try:
+            os.ftruncate(fd, byte_size)
+            mm = mmap.mmap(fd, byte_size)
+        finally:
+            os.close(fd)
+        return cls(key, mm, byte_size, owns=True)
+
+    @classmethod
+    def attach(cls, key: str, byte_size: int = 0) -> "SharedMemoryRegion":
+        path = _shm_path(key)
+        fd = os.open(path, os.O_RDWR)
+        try:
+            actual = os.fstat(fd).st_size
+            if byte_size and byte_size > actual:
+                raise ValueError(
+                    f"shared-memory region {key!r} is {actual} bytes; "
+                    f"{byte_size} requested"
+                )
+            mm = mmap.mmap(fd, actual)
+        finally:
+            os.close(fd)
+        return cls(key, mm, actual, owns=False)
+
+    # -- tensor IO ------------------------------------------------------------
+
+    def write(self, arr: np.ndarray, offset: int = 0) -> int:
+        """Copy ``arr``'s bytes into the region; returns bytes written."""
+        arr = np.ascontiguousarray(arr)
+        n = arr.nbytes
+        if offset < 0 or offset + n > self.size:
+            raise ValueError(
+                f"write of {n} bytes at offset {offset} exceeds region "
+                f"{self.key!r} ({self.size} bytes)"
+            )
+        self._mm[offset : offset + n] = arr.view(np.uint8).reshape(-1).data
+        return n
+
+    def read(self, offset: int, byte_size: int) -> memoryview:
+        """Zero-copy view of a byte range (valid until close())."""
+        if offset < 0 or offset + byte_size > self.size:
+            raise ValueError(
+                f"read of {byte_size} bytes at offset {offset} exceeds "
+                f"region {self.key!r} ({self.size} bytes)"
+            )
+        return memoryview(self._mm)[offset : offset + byte_size]
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._mm.close()
+        except BufferError:
+            # zero-copy views handed out by read() are still alive
+            # (e.g. a batched request not yet dispatched): leave the
+            # mapping to the GC rather than invalidating live tensors.
+            pass
+        if self._owns:
+            try:
+                os.unlink(_shm_path(self.key))
+            except FileNotFoundError:
+                pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+@dataclass(frozen=True)
+class _Registered:
+    region: SharedMemoryRegion
+    key: str
+    offset: int
+    byte_size: int
+
+
+class SystemSharedMemoryRegistry:
+    """Server-side name -> attached region map behind the
+    SystemSharedMemory{Register,Status,Unregister} RPCs."""
+
+    def __init__(self) -> None:
+        self._regions: dict[str, _Registered] = {}
+        self._lock = threading.Lock()
+
+    def register(
+        self, name: str, key: str, offset: int = 0, byte_size: int = 0
+    ) -> None:
+        with self._lock:
+            if name in self._regions:
+                raise ValueError(
+                    f"shared-memory region {name!r} is already registered"
+                )
+            region = SharedMemoryRegion.attach(key, offset + byte_size)
+            self._regions[name] = _Registered(
+                region, key, offset, byte_size or (region.size - offset)
+            )
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            reg = self._regions.pop(name, None)
+        if reg is not None:
+            reg.region.close()
+
+    def unregister_all(self) -> None:
+        with self._lock:
+            regs, self._regions = list(self._regions.values()), {}
+        for reg in regs:
+            reg.region.close()
+
+    def status(self, name: str = "") -> dict[str, _Registered]:
+        with self._lock:
+            if name:
+                if name not in self._regions:
+                    raise KeyError(f"shared-memory region {name!r} not registered")
+                return {name: self._regions[name]}
+            return dict(self._regions)
+
+    # -- codec hooks ----------------------------------------------------------
+
+    def read(self, name: str, offset: int, byte_size: int) -> memoryview:
+        """Bytes of a registered region; ``offset`` is relative to the
+        region's registered base offset (Triton semantics)."""
+        with self._lock:
+            if name not in self._regions:
+                raise ValueError(
+                    f"shared-memory region {name!r} is not registered"
+                )
+            reg = self._regions[name]
+        if offset < 0 or byte_size > reg.byte_size - offset:
+            raise ValueError(
+                f"request for {byte_size} bytes at offset {offset} exceeds "
+                f"registered window of {name!r} ({reg.byte_size} bytes)"
+            )
+        return reg.region.read(reg.offset + offset, byte_size)
+
+    def write(self, name: str, offset: int, arr: np.ndarray) -> int:
+        with self._lock:
+            if name not in self._regions:
+                raise ValueError(
+                    f"shared-memory region {name!r} is not registered"
+                )
+            reg = self._regions[name]
+        arr = np.ascontiguousarray(arr)
+        if offset < 0 or arr.nbytes > reg.byte_size - offset:
+            raise ValueError(
+                f"output of {arr.nbytes} bytes at offset {offset} exceeds "
+                f"registered window of {name!r} ({reg.byte_size} bytes)"
+            )
+        return reg.region.write(arr, reg.offset + offset)
