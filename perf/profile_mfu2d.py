@@ -34,8 +34,9 @@ BATCH = 8
 HW = (512, 512)
 
 
-def make_case(model_cls, batch=BATCH, with_post=True, **model_kw):
-    model = model_cls(num_classes=2, variant="n", **model_kw)
+def make_case(model_cls, batch=BATCH, with_post=True, variant="n",
+              **model_kw):
+    model = model_cls(num_classes=2, variant=variant, **model_kw)
     rng = np.random.default_rng(0)
     frames = jnp.asarray(
         rng.integers(0, 255, (batch, *HW, 3)).astype(np.float32)
@@ -70,19 +71,42 @@ def main():
         "headless": lambda: make_case(YoloV5, with_post=False),
         "b1": lambda: make_case(YoloV5, batch=1),
         "b16": lambda: make_case(YoloV5, batch=16),
+        # model-size MFU scaling (the "95% idle" diagnosis): the n
+        # variant is 21 GFLOP/b8-call against a 197 TFLOP/s MXU — if
+        # MFU rises with s/m/l at the same batch, the idle time is the
+        # MODEL's arithmetic intensity, not the framework's dispatch
+        "v5s": lambda: make_case(YoloV5, variant="s", dtype=jnp.bfloat16),
+        "v5m": lambda: make_case(YoloV5, variant="m", dtype=jnp.bfloat16),
+        "v5l": lambda: make_case(YoloV5, variant="l", dtype=jnp.bfloat16),
+        "v5m_b32": lambda: make_case(
+            YoloV5, variant="m", batch=32, dtype=jnp.bfloat16
+        ),
     }
     cases = []
     units = {}
+    flops = {}
     for name in wanted:
         step, batch = factories[name]()
         print(f"compiling {name} ...", flush=True)
-        cases.append((name, compile_looped(step, inner)))
+        looped = compile_looped(step, inner)
+        cases.append((name, looped))
         units[name] = batch
+        try:
+            cost = looped.lower(jnp.float32(0.0)).compile().cost_analysis()
+            flops[name] = float(cost.get("flops", 0.0)) / inner
+        except Exception:
+            flops[name] = 0.0
     out = run_trials(cases, inner=inner, trials=8)
+    peak = 197e12  # v5e bf16 MXU peak (fp32 runs the MXU at bf16 rate
+    # under jax's default precision)
     print("\n== results ==")
     for name, ms in out.items():
         fps = units[name] / (ms / 1e3)
-        print(f"{name:10s} {ms:7.3f} ms/call  {fps:8.1f} fps", flush=True)
+        mfu = flops[name] / (ms / 1e3) / peak if flops.get(name) else 0.0
+        print(
+            f"{name:10s} {ms:7.3f} ms/call  {fps:8.1f} fps  mfu={mfu:.3f}",
+            flush=True,
+        )
 
 
 if __name__ == "__main__":

@@ -1,0 +1,118 @@
+"""Serving-path decomposition: where do the seconds go between the
+~1 s/b8 device leg and the ~3+ s/batch served rate?
+
+The r3 serving rows (bench.measure_serving) show the batcher merging
+full b8 batches and zero client errors, yet the served rate is ~10x
+below what the measured direct_batch_ms alone would support — and the
+shared-memory transport (which removes the 786 KB payload codec in
+both processes) only buys ~20%. So the payload codec is NOT the cost.
+Prime suspect on this 1-core host: thread thrash — 16 client threads
++ a (clients+8)-worker server pool + grpc event loops all contending
+with the device tunnel's own IO thread.
+
+This harness builds ONE warmed pipeline (the expensive part: 8 merge-
+size compiles over the tunnel), then sweeps (server workers, clients,
+transport) over short windows, reusing the warm repo. Usage:
+
+    python perf/profile_serving.py            # default sweep
+    python perf/profile_serving.py 8 4 shm    # one combo
+"""
+
+import sys
+import time
+
+import _harness  # noqa: F401  (sys.path bootstrap)
+import numpy as np
+
+import jax
+
+from triton_client_tpu.channel.base import InferRequest
+from triton_client_tpu.channel.tpu_channel import TPUChannel
+from triton_client_tpu.pipelines.detect2d import build_yolov5_pipeline
+from triton_client_tpu.runtime.batching import BatchingChannel
+from triton_client_tpu.runtime.repository import ModelRepository
+from triton_client_tpu.runtime.server import InferenceServer
+
+HW = (512, 512)
+MAX_BATCH = 8
+
+
+def build_warm():
+    pipe, spec, _ = build_yolov5_pipeline(
+        jax.random.PRNGKey(0), variant="n", num_classes=2, input_hw=HW
+    )
+    repo = ModelRepository()
+    repo.register(spec, pipe.infer_fn())
+    inner = TPUChannel(repo)
+    rng = np.random.default_rng(0)
+    frame = rng.integers(0, 255, (1, *HW, 3)).astype(np.uint8)
+    for k in range(1, MAX_BATCH + 1):
+        print(f"precompile b{k}", file=sys.stderr, flush=True)
+        inner.do_inference(
+            InferRequest(
+                model_name=spec.name,
+                inputs={"images": np.repeat(frame, k, axis=0)},
+            )
+        )
+    # device leg for one b8 batch from host memory
+    direct = np.repeat(frame, MAX_BATCH, axis=0)
+    pipe.infer(direct)
+    t0 = time.perf_counter()
+    for _ in range(3):
+        pipe.infer(direct)
+    direct_ms = (time.perf_counter() - t0) / 3 * 1e3
+    return repo, inner, spec, frame, direct_ms
+
+
+def run_combo(repo, inner, spec, frame, workers, clients, use_shm,
+              duration_s=8.0):
+    from triton_client_tpu.utils.loadgen import run_pool
+
+    batching = BatchingChannel(inner, max_batch=MAX_BATCH, timeout_us=3000)
+    server = InferenceServer(
+        repo, batching, address="127.0.0.1:0", max_workers=workers
+    )
+    server.start()
+    res = run_pool(
+        f"127.0.0.1:{server.port}",
+        spec.name,
+        {"images": frame},
+        clients=clients,
+        duration_s=duration_s,
+        deadline_s=300.0,
+        use_shared_memory=use_shm,
+        stagger_s=0.1,
+    )
+    stats = batching.stats()
+    server.stop()
+    batching.close()
+    p50 = (
+        float(np.percentile(res.latencies_ms, 50))
+        if res.latencies_ms else float("nan")
+    )
+    mode = "shm " if use_shm else "wire"
+    print(
+        f"workers={workers:2d} clients={clients:2d} {mode}: "
+        f"{res.fps:6.2f} fps  p50={p50:8.1f} ms  frames={res.served_frames}  "
+        f"errors={len(res.errors)}  batches={stats.get('batches')}",
+        flush=True,
+    )
+    return res.fps
+
+
+def main():
+    repo, inner, spec, frame, direct_ms = build_warm()
+    print(f"direct b8 batch: {direct_ms:.0f} ms "
+          f"(device-leg ceiling {MAX_BATCH / direct_ms * 1e3:.1f} fps)",
+          flush=True)
+    if len(sys.argv) > 3:
+        w, c, mode = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+        run_combo(repo, inner, spec, frame, w, c, mode == "shm")
+        return
+    for workers, clients in ((2, 2), (4, 4), (8, 8), (24, 16)):
+        for use_shm in (False, True):
+            run_combo(repo, inner, spec, frame, workers, clients, use_shm)
+
+
+if __name__ == "__main__":
+    main()

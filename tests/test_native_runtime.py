@@ -226,3 +226,119 @@ def test_batching_channel_mixed_shapes_not_merged():
     channel.close()
     assert out["a"].outputs["y"].shape == (1, 4)
     assert out["b"].outputs["y"].shape == (1, 6)
+
+
+class _SlowEchoChannel(_EchoChannel):
+    """Echo with a fixed per-dispatch latency and an in-flight counter
+    — models the tunnel's ~1 s un-amortized dispatch."""
+
+    def __init__(self, delay_s=0.15):
+        super().__init__()
+        self.delay_s = delay_s
+        self._active = 0
+        self.max_concurrent = 0
+        self._lk = threading.Lock()
+
+    def do_inference(self, request):
+        with self._lk:
+            self._active += 1
+            self.max_concurrent = max(self.max_concurrent, self._active)
+        try:
+            time.sleep(self.delay_s)
+            return super().do_inference(request)
+        finally:
+            with self._lk:
+                self._active -= 1
+
+
+@pytest.mark.parametrize("use_native", [True, False])
+def test_pipelined_batches_overlap(use_native):
+    """pipeline_depth=2: two formed batches execute concurrently
+    against the inner channel, so N batches of fixed-latency dispatch
+    take ~N/2 wall — and every response still matches its request."""
+    inner = _SlowEchoChannel(delay_s=0.15)
+    channel = BatchingChannel(
+        inner, max_batch=1, timeout_us=500, use_native=use_native,
+        pipeline_depth=2,
+    )
+    n = 8
+    frames = [np.full((1, 4), float(i), np.float32) for i in range(n)]
+    results = [None] * n
+
+    def call(i):
+        results[i] = channel.do_inference(
+            InferRequest(model_name="m", inputs={"x": frames[i]},
+                         request_id=str(i))
+        )
+
+    threads = [threading.Thread(target=call, args=(i,)) for i in range(n)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=20.0)
+    wall = time.perf_counter() - t0
+    channel.close()
+    for i, r in enumerate(results):
+        np.testing.assert_array_equal(r.outputs["y"], frames[i] + 1.0)
+    assert inner.max_concurrent == 2          # overlap really happened
+    # serial would be n*delay = 1.2 s; pipelined ~0.6 s + overheads
+    assert wall < inner.delay_s * n * 0.75, wall
+
+
+def test_pipeline_depth_one_is_serial():
+    inner = _SlowEchoChannel(delay_s=0.05)
+    channel = BatchingChannel(
+        inner, max_batch=1, timeout_us=500, use_native=False,
+        pipeline_depth=1,
+    )
+    n = 4
+    results = [None] * n
+
+    def call(i):
+        results[i] = channel.do_inference(
+            InferRequest(model_name="m",
+                         inputs={"x": np.full((1, 4), float(i), np.float32)})
+        )
+
+    threads = [threading.Thread(target=call, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10.0)
+    channel.close()
+    assert inner.max_concurrent == 1
+    assert all(r is not None for r in results)
+
+
+def test_close_drains_inflight_batches():
+    """close() must not strand admitted requests: every future
+    resolves (result or exception) before close returns."""
+    inner = _SlowEchoChannel(delay_s=0.2)
+    channel = BatchingChannel(
+        inner, max_batch=1, timeout_us=500, use_native=False,
+        pipeline_depth=2,
+    )
+    results = []
+
+    def call(i):
+        try:
+            results.append(
+                channel.do_inference(
+                    InferRequest(
+                        model_name="m",
+                        inputs={"x": np.full((1, 4), float(i), np.float32)},
+                    )
+                )
+            )
+        except Exception as e:
+            results.append(e)
+
+    threads = [threading.Thread(target=call, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.15)  # let some batches get in flight
+    channel.close()
+    for t in threads:
+        t.join(timeout=10.0)
+    assert len(results) == 4  # nobody hangs

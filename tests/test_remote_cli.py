@@ -54,6 +54,30 @@ def test_detect2d_cli_remote_channel(yolo_server, tmp_path, capsys):
     assert (tmp_path / "detections.jsonl").exists()
 
 
+def test_detect2d_cli_remote_shm_transport(yolo_server, tmp_path, capsys):
+    """--shm: same CLI run but frames travel through POSIX shared
+    memory (system-shared-memory extension); regions must be gone from
+    the server registry after the run."""
+    server, model_name = yolo_server
+    from triton_client_tpu.cli.detect2d import main
+
+    main(
+        [
+            "-u", f"grpc:127.0.0.1:{server.port}",
+            "-m", model_name,
+            "-i", "synthetic:3:64x64",
+            "--shm",
+            "--sink", "jsonl",
+            "-o", str(tmp_path),
+            "--limit", "3",
+        ]
+    )
+    out = capsys.readouterr().out
+    assert "frames" in out
+    assert (tmp_path / "detections.jsonl").exists()
+    assert server.shm_registry.status() == {}
+
+
 def test_detect2d_cli_remote_requires_model_name(yolo_server):
     server, _ = yolo_server
     from triton_client_tpu.cli.detect2d import main

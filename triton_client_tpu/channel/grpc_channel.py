@@ -207,7 +207,6 @@ class GRPCChannel(BaseChannel):
                 )
             region.close()
         self._shm_regions[name] = new
-        self._shm_gen[name] = gen + 1
         return new
 
     def _do_inference_shm(self, request: InferRequest) -> InferResponse:
@@ -231,10 +230,49 @@ class GRPCChannel(BaseChannel):
                 request_id=request.request_id,
             )
             t0 = time.perf_counter()
-            # UNAVAILABLE-only retry, same contract as the wire path
-            resp = self._call(
-                self._stub.ModelInfer, wire, retryable=_INFER_RETRYABLE
-            )
+            try:
+                # UNAVAILABLE-only retry, same contract as the wire path
+                resp = self._call(
+                    self._stub.ModelInfer, wire, retryable=_INFER_RETRYABLE
+                )
+            except grpc.RpcError as e:
+                # a restarted server has an empty registry: its
+                # INVALID_ARGUMENT 'not registered' is recoverable by
+                # re-registering our cached segments and re-issuing
+                # once — the wire path recovers from restarts via the
+                # UNAVAILABLE ladder, the shm path must not be worse
+                if not (
+                    e.code() == grpc.StatusCode.INVALID_ARGUMENT
+                    and "not registered" in (e.details() or "")
+                ):
+                    raise
+                log.warning(
+                    "server lost shared-memory registrations (%s); "
+                    "re-registering %d region(s)",
+                    e.details(), len(self._shm_regions),
+                )
+                for region in self._shm_regions.values():
+                    rname = region.key.lstrip("/")
+                    # unregister first: if only SOME regions were lost,
+                    # a blind re-register would hit the duplicate-name
+                    # rejection (unknown-name unregister is a no-op)
+                    self._stub.SystemSharedMemoryUnregister(
+                        pb.SystemSharedMemoryUnregisterRequest(name=rname),
+                        timeout=self._timeout_s,
+                    )
+                    self._call(
+                        self._stub.SystemSharedMemoryRegister,
+                        pb.SystemSharedMemoryRegisterRequest(
+                            name=rname,
+                            key=region.key,
+                            offset=0,
+                            byte_size=region.size,
+                        ),
+                        retryable=(),
+                    )
+                resp = self._call(
+                    self._stub.ModelInfer, wire, retryable=_INFER_RETRYABLE
+                )
             return InferResponse(
                 model_name=resp.model_name,
                 model_version=resp.model_version,
@@ -329,6 +367,13 @@ class GRPCChannel(BaseChannel):
         forever — the unary path gets the same protection from
         ``timeout_s`` per request. Pass None for an unbounded session
         (long-lived live streams)."""
+        if self._use_shm and not self._shm_async_warned:
+            self._shm_async_warned = True
+            log.warning(
+                "use_shared_memory only covers synchronous do_inference; "
+                "streamed requests travel over the wire (pipelined calls "
+                "would reuse a region while it is still in flight)"
+            )
 
         def wire_iter():
             for r in requests:
@@ -379,6 +424,15 @@ class GRPCChannel(BaseChannel):
         for ch in self._retired:
             ch.close()
         self._retired.clear()
+
+    def __del__(self):
+        # best-effort: a dropped channel (the CLIs let main()'s locals
+        # go out of scope) must still unregister + unlink its shm
+        # segments — /dev/shm files outlive the process otherwise
+        try:
+            self.close()
+        except Exception:
+            pass
 
     # -- internals ------------------------------------------------------------
 

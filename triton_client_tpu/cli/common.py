@@ -27,6 +27,12 @@ def add_common_flags(parser: argparse.ArgumentParser) -> None:
         "-u server URL, main.py:51-113)",
     )
     parser.add_argument(
+        "--shm", action="store_true", dest="use_shared_memory",
+        help="with a grpc: channel on the same host as the server, pass "
+        "input tensors through POSIX shared memory instead of the wire "
+        "(Triton system-shared-memory extension)",
+    )
+    parser.add_argument(
         "--profile", action="store_true",
         help="print a per-stage latency table (source/infer/sink) after "
         "the run",

@@ -364,7 +364,10 @@ def main(argv=None) -> None:
             )
         from triton_client_tpu.channel.grpc_channel import GRPCChannel
 
-        channel = GRPCChannel(args.channel[len("grpc:"):])
+        channel = GRPCChannel(
+            args.channel[len("grpc:"):],
+            use_shared_memory=args.use_shared_memory,
+        )
         spec = channel.get_metadata(args.model_name, args.model_version)
         class_names = load_names(args.names) or tuple(
             spec.extra.get("class_names", ())

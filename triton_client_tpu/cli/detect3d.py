@@ -176,7 +176,10 @@ def main(argv=None) -> None:
             )
         from triton_client_tpu.channel.grpc_channel import GRPCChannel
 
-        channel = GRPCChannel(args.channel[len("grpc:"):])
+        channel = GRPCChannel(
+            args.channel[len("grpc:"):],
+            use_shared_memory=args.use_shared_memory,
+        )
         infer = channel_infer3d(
             channel,
             args.model_name,

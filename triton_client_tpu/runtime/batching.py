@@ -50,13 +50,28 @@ class BatchingChannel(BaseChannel):
         timeout_us: int = 2000,
         capacity: int = 256,
         use_native: bool = True,
+        pipeline_depth: int = 2,
     ) -> None:
+        """``pipeline_depth``: formed batches executing concurrently
+        against the inner channel. At the default 2, batch N+1's
+        host->device transfer overlaps batch N's execution (the role
+        Triton's per-instance CUDA streams play) — on a dispatch-bound
+        path this nearly doubles batch rate; jax queues the dispatches
+        and the device serializes execution. While ``pipeline_depth``
+        batches are in flight the batcher thread blocks, so incoming
+        requests coalesce into FULLER batches rather than piling up as
+        fragments. Depth 1 restores strictly serial execution."""
         self._inner = inner
         self._pending: dict[int, tuple[InferRequest, concurrent.futures.Future]] = {}
         self._lock = threading.Lock()
         self._ids = itertools.count(1)
         self._impl = None
         self._py = None
+        self._inflight = threading.Semaphore(max(1, pipeline_depth))
+        self._exec = concurrent.futures.ThreadPoolExecutor(
+            max_workers=max(1, pipeline_depth),
+            thread_name_prefix="batch-exec",
+        )
         if use_native:
             try:
                 from triton_client_tpu.native import NativeBatchServer
@@ -120,12 +135,27 @@ class BatchingChannel(BaseChannel):
                 key = ("__solo__", rid)
             groups.setdefault(key, []).append((rid, request, future))
         for group in groups.values():
+            # bounded handoff: at most pipeline_depth groups run
+            # concurrently; when full, THIS (batcher) thread blocks,
+            # which is what lets the queue coalesce larger batches
+            self._inflight.acquire()
+
+            def run(g=group):
+                try:
+                    self._run_group(g)
+                except Exception as e:
+                    # No exception may escape: an unresolved future
+                    # hangs its caller forever.
+                    for _, _, future in g:
+                        if not future.done():
+                            future.set_exception(e)
+                finally:
+                    self._inflight.release()
+
             try:
-                self._run_group(group)
-            except Exception as e:
-                # No exception may escape: an unresolved future hangs its
-                # caller forever, and on the _PyBatcher path it would also
-                # kill the batcher thread.
+                self._exec.submit(run)
+            except RuntimeError as e:  # executor shut down mid-close
+                self._inflight.release()
                 for _, _, future in group:
                     if not future.done():
                         future.set_exception(e)
@@ -197,6 +227,9 @@ class BatchingChannel(BaseChannel):
             self._impl.close()
         if self._py is not None:
             self._py.close()
+        # after the batcher thread stops, drain in-flight groups so
+        # every admitted future resolves before close() returns
+        self._exec.shutdown(wait=True)
 
 
 class _PyBatcher:

@@ -155,7 +155,24 @@ class _Servicer(service.GRPCInferenceServiceServicer):
 
     # -- shared memory (Triton system-shared-memory extension) ----------------
 
+    @staticmethod
+    def _require_local(context) -> None:
+        """Shared memory is a SAME-HOST transport: registration maps a
+        /dev/shm file into the server and infer requests can read/write
+        it, so a remote peer must never reach it (a remote client could
+        otherwise attach any flat-named segment on the server host and
+        exfiltrate or corrupt it through model IO). Loopback and unix
+        sockets only."""
+        peer = context.peer()
+        if not peer.startswith(("ipv4:127.", "ipv6:[::1]", "unix:")):
+            context.abort(
+                grpc.StatusCode.PERMISSION_DENIED,
+                f"shared-memory extension is restricted to same-host "
+                f"clients (peer {peer})",
+            )
+
     def SystemSharedMemoryRegister(self, request, context):
+        self._require_local(context)
         try:
             self._shm.register(
                 request.name, request.key, request.offset, request.byte_size
@@ -165,6 +182,7 @@ class _Servicer(service.GRPCInferenceServiceServicer):
         return pb.SystemSharedMemoryRegisterResponse()
 
     def SystemSharedMemoryUnregister(self, request, context):
+        self._require_local(context)
         if request.name:
             self._shm.unregister(request.name)
         else:
@@ -172,6 +190,7 @@ class _Servicer(service.GRPCInferenceServiceServicer):
         return pb.SystemSharedMemoryUnregisterResponse()
 
     def SystemSharedMemoryStatus(self, request, context):
+        self._require_local(context)
         resp = pb.SystemSharedMemoryStatusResponse()
         try:
             regions = self._shm.status(request.name)
@@ -217,7 +236,15 @@ class _Servicer(service.GRPCInferenceServiceServicer):
             shm=self._shm,
         )
 
+    def _uses_shm(self, request) -> bool:
+        return any(
+            "shared_memory_region" in t.parameters
+            for t in list(request.inputs) + list(request.outputs)
+        )
+
     def ModelInfer(self, request, context):
+        if self._uses_shm(request):
+            self._require_local(context)
         try:
             return self._infer(request)
         except KeyError as e:
@@ -227,6 +254,8 @@ class _Servicer(service.GRPCInferenceServiceServicer):
 
     def ModelStreamInfer(self, request_iterator, context):
         for request in request_iterator:
+            if self._uses_shm(request):
+                self._require_local(context)
             try:
                 yield pb.ModelStreamInferResponse(
                     infer_response=self._infer(request)

@@ -62,7 +62,15 @@ class SharedMemoryRegion:
     @classmethod
     def create(cls, key: str, byte_size: int) -> "SharedMemoryRegion":
         path = _shm_path(key)
-        fd = os.open(path, os.O_CREAT | os.O_RDWR, 0o600)
+        try:
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
+        except FileExistsError:
+            # a stale segment from a crashed run (same pid after a
+            # container restart): reclaim it. O_EXCL on the retry keeps
+            # the window race-free; a symlink planted at the name fails
+            # both opens rather than being followed.
+            os.unlink(path)
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
         try:
             os.ftruncate(fd, byte_size)
             mm = mmap.mmap(fd, byte_size)
@@ -97,7 +105,11 @@ class SharedMemoryRegion:
                 f"write of {n} bytes at offset {offset} exceeds region "
                 f"{self.key!r} ({self.size} bytes)"
             )
-        self._mm[offset : offset + n] = arr.view(np.uint8).reshape(-1).data
+        # numpy-to-numpy copy releases the GIL (a plain mmap slice
+        # assignment holds it) — concurrent serving clients on a small
+        # host overlap their memcpys
+        dst = np.frombuffer(self._mm, np.uint8, count=n, offset=offset)
+        np.copyto(dst, arr.view(np.uint8).reshape(-1))
         return n
 
     def read(self, offset: int, byte_size: int) -> memoryview:

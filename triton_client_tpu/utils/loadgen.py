@@ -1,0 +1,142 @@
+"""Closed-loop gRPC load generator for serving benchmarks.
+
+The role Triton's ``perf_analyzer`` plays in the reference's ecosystem
+(its README benchmarks the server with concurrent closed-loop clients):
+N threads, each with its own channel, issuing one synchronous
+ModelInfer after another against a KServe v2 endpoint, with a
+warm-before-measure barrier so neither thread ramp nor first-request
+compiles bias the measured window. Used by ``bench.measure_serving``
+and ``perf/profile_serving.py`` so both measure the SAME protocol.
+
+Client lifecycle per thread:
+  1. staggered connect + one warm request (staggering avoids N
+     simultaneous payload uploads blowing deadlines on a small host);
+  2. barrier — every thread arrives, warmed or failed;
+  3. closed loop until ``stop`` is set, per-request latency recorded;
+  4. channel closed (unregisters any shared-memory regions), counts
+     merged under a lock.
+
+``run_pool`` returns after EVERY client thread has fully exited — a
+straggler blocked on a slow request is waited out (bounded by the
+request deadline), never left running into a subsequent measurement.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class PoolResult:
+    served_frames: int
+    wall_s: float
+    latencies_ms: list = field(default_factory=list)
+    errors: list = field(default_factory=list)
+
+    @property
+    def fps(self) -> float:
+        return self.served_frames / self.wall_s if self.wall_s > 0 else 0.0
+
+
+def run_pool(
+    address: str,
+    model_name: str,
+    inputs: dict,
+    clients: int,
+    duration_s: float,
+    deadline_s: float = 300.0,
+    use_shared_memory: bool = False,
+    stagger_s: float = 0.25,
+    on_window_start=None,
+) -> PoolResult:
+    """Drive ``clients`` closed-loop threads for ``duration_s`` and
+    return counts/latencies. ``on_window_start`` fires after the warm
+    barrier, immediately before the timed window — the hook for
+    clearing server-side accounting (batcher stats, occupancy taps)."""
+    from triton_client_tpu.channel.base import InferRequest
+    from triton_client_tpu.channel.grpc_channel import GRPCChannel
+
+    served: list = []
+    latencies: list = []
+    errors: list = []
+    lock = threading.Lock()
+    stop = threading.Event()
+    ready = threading.Barrier(clients + 1)
+
+    def client_loop(idx: int):
+        n, mine = 0, []  # n counts only completions INSIDE the window
+        chan = req = None
+        try:
+            time.sleep(stagger_s * (idx % 4))
+            chan = GRPCChannel(
+                address,
+                timeout_s=deadline_s,
+                use_shared_memory=use_shared_memory,
+            )
+            req = InferRequest(model_name=model_name, inputs=inputs)
+            chan.do_inference(req)  # connection + server path warm
+        except Exception as e:
+            with lock:
+                errors.append(repr(e))
+            chan = None
+        try:
+            # EVERY thread reaches the barrier, warm or not — a failed
+            # warm must not strand the caller's wait
+            ready.wait(timeout=300)
+        except threading.BrokenBarrierError:
+            pass
+        try:
+            if chan is not None:
+                while not stop.is_set():
+                    t0 = time.perf_counter()
+                    chan.do_inference(req)
+                    mine.append((time.perf_counter() - t0) * 1e3)
+                    # a completion racing the window close (the final
+                    # in-flight request) is drained but NOT counted —
+                    # fps must be completions-in-window / window, not
+                    # diluted by the post-stop drain time
+                    if not stop.is_set():
+                        n += 1
+        except Exception as e:  # a dying client must still report
+            with lock:
+                errors.append(repr(e))
+        finally:
+            if chan is not None:
+                try:
+                    chan.close()
+                except Exception:
+                    pass
+            with lock:
+                served.append(n)
+                latencies.extend(mine)
+
+    threads = [
+        threading.Thread(target=client_loop, args=(i,)) for i in range(clients)
+    ]
+    for t in threads:
+        t.start()
+    ready.wait(timeout=300)
+    if on_window_start is not None:
+        on_window_start()
+    t_start = time.perf_counter()
+    time.sleep(duration_s)
+    stop.set()
+    # the measured window closes HERE: stragglers are drained below so
+    # nothing survives into the caller's next measurement, but their
+    # drain time must not dilute the reported rate
+    wall = time.perf_counter() - t_start
+    # wait stragglers OUT: an in-flight request is bounded by the gRPC
+    # deadline, so this join always terminates
+    for t in threads:
+        t.join(timeout=deadline_s + 60.0)
+    alive = [t for t in threads if t.is_alive()]
+    if alive:
+        errors.append(f"{len(alive)} client threads still alive after join")
+    return PoolResult(
+        served_frames=sum(served),
+        wall_s=wall,
+        latencies_ms=latencies,
+        errors=errors,
+    )
