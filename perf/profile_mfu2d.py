@@ -93,7 +93,10 @@ def main():
         units[name] = batch
         try:
             cost = looped.lower(jnp.float32(0.0)).compile().cost_analysis()
-            flops[name] = float(cost.get("flops", 0.0)) / inner
+            # XLA's cost model counts the fori_loop BODY once (verified
+            # against the bench's single-step flops for the base
+            # config), so no division by the trip count
+            flops[name] = float(cost.get("flops", 0.0))
         except Exception:
             flops[name] = 0.0
     out = run_trials(cases, inner=inner, trials=8)
@@ -104,7 +107,7 @@ def main():
         fps = units[name] / (ms / 1e3)
         mfu = flops[name] / (ms / 1e3) / peak if flops.get(name) else 0.0
         print(
-            f"{name:10s} {ms:7.3f} ms/call  {fps:8.1f} fps  mfu={mfu:.3f}",
+            f"{name:10s} {ms:7.3f} ms/call  {fps:8.1f} fps  mfu={mfu:.4f}",
             flush=True,
         )
 

@@ -282,8 +282,10 @@ def test_pipelined_batches_overlap(use_native):
     for i, r in enumerate(results):
         np.testing.assert_array_equal(r.outputs["y"], frames[i] + 1.0)
     assert inner.max_concurrent == 2          # overlap really happened
-    # serial would be n*delay = 1.2 s; pipelined ~0.6 s + overheads
-    assert wall < inner.delay_s * n * 0.75, wall
+    # serial would be n*delay = 1.2 s; pipelined ~0.6 s. Generous slack
+    # (0.9x serial) keeps a loaded 1-core CI host from flaking — the
+    # max_concurrent assert above is the real overlap proof
+    assert wall < inner.delay_s * n * 0.9, wall
 
 
 def test_pipeline_depth_one_is_serial():

@@ -160,6 +160,15 @@ class GRPCChannel(BaseChannel):
 
     # -- shared-memory transport ----------------------------------------------
 
+    def _warn_shm_wire_fallback(self) -> None:
+        if self._use_shm and not self._shm_async_warned:
+            self._shm_async_warned = True
+            log.warning(
+                "use_shared_memory only covers synchronous do_inference; "
+                "async/streamed requests travel over the wire (pipelined "
+                "calls would reuse a region while it is still in flight)"
+            )
+
     def _shm_region_for(self, name: str, nbytes: int):
         """Client-owned region for one input, grown when outsized.
         Region/segment names are unique per channel instance so many
@@ -287,13 +296,7 @@ class GRPCChannel(BaseChannel):
         the response. A connection-level failure (UNAVAILABLE — the only
         code safe to re-issue, see _call) falls back to the sync retry
         ladder at resolution time; all other errors surface at result()."""
-        if self._use_shm and not self._shm_async_warned:
-            self._shm_async_warned = True
-            log.warning(
-                "use_shared_memory only covers synchronous do_inference; "
-                "async/stream requests travel over the wire (pipelined "
-                "calls would reuse a region while it is still in flight)"
-            )
+        self._warn_shm_wire_fallback()
         try:
             wire = codec.build_infer_request(
                 model_name=request.model_name,
@@ -367,13 +370,7 @@ class GRPCChannel(BaseChannel):
         forever — the unary path gets the same protection from
         ``timeout_s`` per request. Pass None for an unbounded session
         (long-lived live streams)."""
-        if self._use_shm and not self._shm_async_warned:
-            self._shm_async_warned = True
-            log.warning(
-                "use_shared_memory only covers synchronous do_inference; "
-                "streamed requests travel over the wire (pipelined calls "
-                "would reuse a region while it is still in flight)"
-            )
+        self._warn_shm_wire_fallback()
 
         def wire_iter():
             for r in requests:

@@ -38,6 +38,12 @@ def main(argv=None) -> None:
         help="max time a request waits for batch-mates",
     )
     p.add_argument(
+        "--pipeline-depth", type=int, default=2,
+        help="formed batches executing concurrently: batch N+1's "
+        "host->device transfer overlaps batch N's compute (Triton's "
+        "per-instance CUDA-stream role); 1 = strictly serial",
+    )
+    p.add_argument(
         "--metrics-port", type=int, default=8002,
         help="Prometheus per-model latency metrics (Triton :8002 parity; "
         "0 disables)",
@@ -49,7 +55,23 @@ def main(argv=None) -> None:
     p.add_argument("-v", "--verbose", action="store_true")
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.DEBUG if args.verbose else logging.INFO)
+    server = build_server(args)
+    server.start()
+    # flush=True: supervisors/drives parse this line through a pipe,
+    # where block buffering would hold it until exit.
+    print(f"KServe v2 gRPC server listening on port {server.port}", flush=True)
+    if server.metrics_enabled:
+        print(f"Prometheus metrics on :{args.metrics_port}", flush=True)
+    try:
+        server.wait()
+    except KeyboardInterrupt:
+        server.stop()
 
+
+def build_server(args):
+    """Repository scan + channel stack + InferenceServer (not started)
+    from parsed ``main`` args — split out so tests and embedders can
+    stand the server up on a loopback port without blocking in wait()."""
     from triton_client_tpu.channel.tpu_channel import TPUChannel
     from triton_client_tpu.cli.common import parse_mesh
     from triton_client_tpu.runtime.disk_repository import scan_disk
@@ -70,28 +92,20 @@ def main(argv=None) -> None:
             channel,
             max_batch=args.max_batch,
             timeout_us=args.batch_timeout_us,
+            pipeline_depth=args.pipeline_depth,
         )
         print(
             f"micro-batching: max_batch={args.max_batch} "
-            f"timeout={args.batch_timeout_us}us", flush=True,
+            f"timeout={args.batch_timeout_us}us "
+            f"pipeline_depth={args.pipeline_depth}", flush=True,
         )
-    server = InferenceServer(
+    return InferenceServer(
         repo,
         channel,
         address=args.address,
         max_workers=args.max_workers,
         metrics_port=args.metrics_port,
     )
-    server.start()
-    # flush=True: supervisors/drives parse this line through a pipe,
-    # where block buffering would hold it until exit.
-    print(f"KServe v2 gRPC server listening on port {server.port}", flush=True)
-    if server.metrics_enabled:
-        print(f"Prometheus metrics on :{args.metrics_port}", flush=True)
-    try:
-        server.wait()
-    except KeyboardInterrupt:
-        server.stop()
 
 
 if __name__ == "__main__":

@@ -164,7 +164,11 @@ class _Servicer(service.GRPCInferenceServiceServicer):
         exfiltrate or corrupt it through model IO). Loopback and unix
         sockets only."""
         peer = context.peer()
-        if not peer.startswith(("ipv4:127.", "ipv6:[::1]", "unix:")):
+        # ipv6:[::ffff:127.*] is the v4-mapped loopback a dual-stack
+        # bind reports for a 127.0.0.1 dial
+        if not peer.startswith(
+            ("ipv4:127.", "ipv6:[::1]", "ipv6:[::ffff:127.", "unix:")
+        ):
             context.abort(
                 grpc.StatusCode.PERMISSION_DENIED,
                 f"shared-memory extension is restricted to same-host "
