@@ -589,6 +589,28 @@ def validate_pallas_nms() -> dict:
     return {"pallas_nms_on_tpu": f"identical to XLA loop ({checked} cases)"}
 
 
+def warmup_with_retries(c, drop, attempts: int = 3, backoff_s: float = 5.0):
+    """True if the config warmed; False if it was dropped. The
+    tunnel's remote-compile intermittently closes the response body
+    mid-read; a fresh attempt usually lands and a transient hiccup
+    must not cost a secondary its row (TWO consecutive hiccups were
+    observed dropping the b64 row — hence attempts=3)."""
+    for attempt in range(attempts):
+        try:
+            c.warmup()
+            return True
+        except Exception as e:
+            if attempt == attempts - 1:
+                drop(c, "warmup", e)
+                return False
+            print(
+                f"{c.name} warmup retry {attempt + 1} after: {e}",
+                file=sys.stderr,
+            )
+            time.sleep(backoff_s)
+    return False  # pragma: no cover
+
+
 def main() -> None:
     nms_check = validate_pallas_nms()
     print(json.dumps(nms_check), file=sys.stderr)
@@ -634,19 +656,8 @@ def main() -> None:
 
     for c in list(configs):
         t0 = time.perf_counter()
-        try:
-            c.warmup()
-        except Exception as e:
-            # one retry: the tunnel's remote-compile intermittently
-            # closes the response body mid-read; a fresh attempt
-            # usually lands and a transient hiccup should not cost a
-            # secondary its row
-            print(f"{c.name} warmup retry after: {e}", file=sys.stderr)
-            try:
-                c.warmup()
-            except Exception as e2:
-                drop(c, "warmup", e2)
-                continue
+        if not warmup_with_retries(c, drop):
+            continue
         print(
             f"warmup {c.name}: {time.perf_counter() - t0:.1f}s "
             f"(flops/call={c.flops_per_call})",

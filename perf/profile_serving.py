@@ -100,7 +100,48 @@ def run_combo(repo, inner, spec, frame, workers, clients, use_shm,
     return res.fps
 
 
+def build_null():
+    """Serving-STACK-only rig: a null model (numpy passthrough of a
+    tiny output) behind the same repo/channel/server path, fed the
+    same 786 KB uint8 frames. No device leg at all — wire-vs-shm here
+    is the codec/copy cost in isolation, the number the 512x512
+    tunnel-bound sweep cannot show (there the ~1 s/dispatch device leg
+    hides everything)."""
+    from triton_client_tpu.config import ModelSpec, TensorSpec
+
+    spec = ModelSpec(
+        name="null512",
+        version="1",
+        platform="jax",
+        inputs=(TensorSpec("images", (-1, *HW, 3), "UINT8"),),
+        outputs=(TensorSpec("sum", (-1,), "FP32"),),
+        max_batch_size=MAX_BATCH,
+    )
+    repo = ModelRepository()
+    repo.register(
+        spec,
+        lambda inputs: {
+            # touch one row per image so the input bytes are really
+            # consumed (a pure constant could hide a broken transport)
+            "sum": np.asarray(inputs["images"][:, 0, 0, 0], np.float32)
+        },
+    )
+    inner = TPUChannel(repo)
+    rng = np.random.default_rng(0)
+    frame = rng.integers(0, 255, (1, *HW, 3)).astype(np.uint8)
+    return repo, inner, spec, frame
+
+
 def main():
+    if sys.argv[1:2] == ["null"]:
+        repo, inner, spec, frame = build_null()
+        print("null model (no device leg): pure serving-stack rates",
+              flush=True)
+        for workers, clients in ((4, 4), (8, 8)):
+            for use_shm in (False, True):
+                run_combo(repo, inner, spec, frame, workers, clients,
+                          use_shm, duration_s=6.0)
+        return
     repo, inner, spec, frame, direct_ms = build_warm()
     print(f"direct b8 batch: {direct_ms:.0f} ms "
           f"(device-leg ceiling {MAX_BATCH / direct_ms * 1e3:.1f} fps)",

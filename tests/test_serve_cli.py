@@ -76,5 +76,5 @@ def test_serve_builds_and_answers_over_grpc(tmp_path):
 
 
 def test_serve_rejects_missing_repository(tmp_path):
-    with pytest.raises(Exception):
+    with pytest.raises(FileNotFoundError):
         serve.build_server(_args(model_repository=str(tmp_path / "nope")))
