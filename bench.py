@@ -702,19 +702,35 @@ def main() -> None:
         except Exception as e:
             drop(c, "result", e)
 
-    try:
-        results.extend(measure_serving(rtt))
-        print("serving bench done", file=sys.stderr)
-    except Exception as e:
-        print(f"serving bench failed: {e}", file=sys.stderr)
-    try:  # best-effort: the one-line stdout contract must survive
-        with open("BENCH_LOCAL.json", "w") as f:
-            json.dump({"nms_check": nms_check, "results": results}, f, indent=2)
-    except OSError as e:
-        print(f"could not write BENCH_LOCAL.json: {e}", file=sys.stderr)
+    def write_local():
+        try:  # best-effort: the stdout contract must survive
+            with open("BENCH_LOCAL.json", "w") as f:
+                json.dump(
+                    {"nms_check": nms_check, "results": results}, f, indent=2
+                )
+        except OSError as e:
+            print(f"could not write BENCH_LOCAL.json: {e}", file=sys.stderr)
+
+    # emit the contract OUTPUT before the serving stage: warmups can
+    # run 30-40 min in a slow tunnel phase and the serving stage costs
+    # another 10-20 — a driver-side timeout landing there must not cost
+    # the round its headline rows (observed: a 70-min cap killed a full
+    # run mid-serving with every row unprinted)
     for secondary in results[1:]:
         print(json.dumps(secondary), file=sys.stderr)
-    print(json.dumps(results[0]))
+    print(json.dumps(results[0]), flush=True)
+    write_local()
+
+    try:
+        serving_rows = measure_serving(rtt)
+        print("serving bench done", file=sys.stderr)
+    except Exception as e:
+        serving_rows = []
+        print(f"serving bench failed: {e}", file=sys.stderr)
+    for row in serving_rows:
+        results.append(row)
+        print(json.dumps(row), file=sys.stderr)
+    write_local()
 
 
 if __name__ == "__main__":

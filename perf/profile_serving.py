@@ -1,21 +1,29 @@
 """Serving-path decomposition: where do the seconds go between the
-~1 s/b8 device leg and the ~3+ s/batch served rate?
+~1 s/b8 device leg and the served rate?
 
-The r3 serving rows (bench.measure_serving) show the batcher merging
-full b8 batches and zero client errors, yet the served rate is ~10x
-below what the measured direct_batch_ms alone would support — and the
-shared-memory transport (which removes the 786 KB payload codec in
-both processes) only buys ~20%. So the payload codec is NOT the cost.
-Prime suspect on this 1-core host: thread thrash — 16 client threads
-+ a (clients+8)-worker server pool + grpc event loops all contending
-with the device tunnel's own IO thread.
+Two instruments, two findings (both recorded in BASELINE.md):
+
+  * the DEVICE-PATH sweep (default mode) showed the served rate flat
+    across an 8x client range — the batcher's serial tunnel dispatch
+    is the rig's ceiling. The responses shipped (pipeline_depth=2
+    dispatch overlap + the shared-memory transport) lifted the final
+    serving rows to shm 2.0x wire (1.13 -> 2.27 fps, p50 halved):
+    shm's win is CONTENTION RELIEF — it frees the 1-core host for the
+    dispatch thread while batches are in flight;
+  * the NULL-MODEL control (`null` mode) removes the device leg
+    entirely (host-only channel — NOT TPUChannel, whose device_put
+    would silently re-add an upload) and shows the pure stack serving
+    399-459 fps wire vs 627-1,412 fps shm at full 786 KB payloads on
+    one core: the payload codec is the dominant per-request stack
+    cost, and shm deletes it.
 
 This harness builds ONE warmed pipeline (the expensive part: 8 merge-
 size compiles over the tunnel), then sweeps (server workers, clients,
 transport) over short windows, reusing the warm repo. Usage:
 
-    python perf/profile_serving.py            # default sweep
+    python perf/profile_serving.py            # device-path sweep
     python perf/profile_serving.py 8 4 shm    # one combo
+    python perf/profile_serving.py null       # stack-only control
 """
 
 import sys
@@ -100,13 +108,32 @@ def run_combo(repo, inner, spec, frame, workers, clients, use_shm,
     return res.fps
 
 
+class _HostChannel(TPUChannel):
+    """TPUChannel minus the device: dispatches straight to the
+    registered numpy function. The null control's guarantee ('no
+    device leg at all') must hold on ANY backend — the base channel
+    device_puts each batch, which on the tunnel rig would silently
+    add a per-request upload and invalidate the control."""
+
+    def do_inference(self, request):
+        from triton_client_tpu.channel.base import InferResponse
+
+        model = self._repository.get(request.model_name, request.model_version)
+        return InferResponse(
+            model_name=request.model_name,
+            model_version=request.model_version or "1",
+            outputs=model.infer_fn(request.inputs),
+            request_id=request.request_id,
+        )
+
+
 def build_null():
     """Serving-STACK-only rig: a null model (numpy passthrough of a
-    tiny output) behind the same repo/channel/server path, fed the
-    same 786 KB uint8 frames. No device leg at all — wire-vs-shm here
-    is the codec/copy cost in isolation, the number the 512x512
-    tunnel-bound sweep cannot show (there the ~1 s/dispatch device leg
-    hides everything)."""
+    tiny output) behind the same repo/server path but a host-only
+    channel — no device leg on any backend. Wire-vs-shm here is the
+    codec/copy/handoff cost in isolation, the number the 512x512
+    tunnel-bound sweep cannot show (there the ~1 s/dispatch device
+    leg hides everything)."""
     from triton_client_tpu.config import ModelSpec, TensorSpec
 
     spec = ModelSpec(
@@ -126,7 +153,7 @@ def build_null():
             "sum": np.asarray(inputs["images"][:, 0, 0, 0], np.float32)
         },
     )
-    inner = TPUChannel(repo)
+    inner = _HostChannel(repo)
     rng = np.random.default_rng(0)
     frame = rng.integers(0, 255, (1, *HW, 3)).astype(np.uint8)
     return repo, inner, spec, frame
