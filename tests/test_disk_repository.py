@@ -147,7 +147,7 @@ def test_examples_tree_parses():
 
     root = pathlib.Path("examples")
     dirs = sorted(p for p in root.iterdir() if (p / "config.yaml").exists())
-    assert len(dirs) == 8
+    assert len(dirs) == 9
     for d in dirs:
         doc = load_yaml(str(d / "config.yaml"))
         if doc["family"] == "ensemble":
@@ -168,6 +168,18 @@ def test_examples_yolov5_builds_and_infers():
     assert rm.spec.max_batch_size == 8
     out = rm.infer_fn({"images": np.zeros((1, 64, 64, 3), np.float32)})
     assert out["detections"].shape[-1] == 6
+
+
+def test_examples_yolov5_mxu_entry_serves_optimized_layout():
+    """The MXU-shaped serving entry (s2d + ch_floor + bf16 via plain
+    config.yaml model keys) builds and serves the same contract as the
+    vanilla entry — the fastest measured b8 layout is reachable from
+    the model repository, not just the CLI's --mxu-opt."""
+    rm = dr.build_model("examples/yolov5_crop_mxu", version="1")
+    assert rm.spec.name == "yolov5_crop_mxu"
+    out = rm.infer_fn({"images": np.zeros((1, 64, 64, 3), np.uint8)})
+    assert out["detections"].shape[-1] == 6
+    assert np.isfinite(np.asarray(out["detections"], np.float32)).all()
 
 
 def test_version_dir_without_weights_fails_loudly(tmp_path):
