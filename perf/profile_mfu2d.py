@@ -81,6 +81,12 @@ def main():
         "v5m_b32": lambda: make_case(
             YoloV5, variant="m", batch=32, dtype=jnp.bfloat16
         ),
+        # the peak-per-chip A/B (BASELINE.md: 15.80 -> 14.26 ms,
+        # 4,050 -> 4,490 fps): run `... b64 b64_mxu_bf16`
+        "b64": lambda: make_case(YoloV5, batch=64),
+        "b64_mxu_bf16": lambda: make_case(
+            YoloV5, batch=64, s2d=True, ch_floor=32, dtype=jnp.bfloat16
+        ),
     }
     cases = []
     units = {}

@@ -168,6 +168,19 @@ def test_channel_infer3d_over_grpc(yolo_server):
         out = infer(np.zeros((10, 4), np.float32))
         assert out["pred_boxes"][0, 0] == 10  # num_points made it across
         assert seen["shape"] == (64, 4)  # served bucket applied remotely
+
+        # the same 3D adapter over the shared-memory transport: BOTH
+        # request tensors (points f32 + num_points scalar i32) travel
+        # as shm regions, and results bit-match the wire path
+        shm_chan = GRPCChannel(
+            f"127.0.0.1:{srv.port}", timeout_s=10.0, use_shared_memory=True
+        )
+        shm_infer = channel_infer3d(shm_chan, "pp3d")
+        out2 = shm_infer(np.zeros((10, 4), np.float32))
+        np.testing.assert_array_equal(out2["pred_boxes"], out["pred_boxes"])
+        assert len(srv.shm_registry.status()) == 2  # one region per input
+        shm_chan.close()
+        assert srv.shm_registry.status() == {}
         channel.close()
     finally:
         srv.stop()
