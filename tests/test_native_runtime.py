@@ -344,3 +344,57 @@ def test_close_drains_inflight_batches():
     for t in threads:
         t.join(timeout=10.0)
     assert len(results) == 4  # nobody hangs
+
+
+@pytest.mark.parametrize("use_native", [True, False])
+def test_two_models_never_cross_merge(use_native):
+    """Concurrent requests to TWO models through one batcher: merge
+    keys isolate them — every response comes from its own model even
+    when the queue interleaves them (the Triton dynamic batcher's
+    per-model grouping contract)."""
+
+    class _TwoModelChannel(_EchoChannel):
+        def do_inference(self, request):
+            x = np.asarray(request.inputs["x"])
+            self.batch_sizes.append(x.shape[0])
+            delta = 1.0 if request.model_name == "plus1" else 100.0
+            return InferResponse(
+                model_name=request.model_name,
+                outputs={"y": x + delta},
+                request_id=request.request_id,
+            )
+
+    inner = _TwoModelChannel()
+    channel = BatchingChannel(
+        inner, max_batch=8, timeout_us=20_000, use_native=use_native,
+        pipeline_depth=2,
+    )
+    n = 12
+    results = [None] * n
+
+    def call(i):
+        model = "plus1" if i % 2 == 0 else "plus100"
+        results[i] = (
+            model,
+            channel.do_inference(
+                InferRequest(
+                    model_name=model,
+                    inputs={"x": np.full((1, 4), float(i), np.float32)},
+                )
+            ),
+        )
+
+    threads = [threading.Thread(target=call, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=20.0)
+    channel.close()
+    assert all(r is not None for r in results)  # no worker died/hung
+    for i, (model, resp) in enumerate(results):
+        want = i + (1.0 if model == "plus1" else 100.0)
+        np.testing.assert_array_equal(
+            resp.outputs["y"], np.full((1, 4), want, np.float32)
+        )
+        assert resp.model_name == model
+    assert sum(inner.batch_sizes) == n

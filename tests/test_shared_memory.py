@@ -422,3 +422,36 @@ class TestSecurityAndRecovery:
         finally:
             chan.close()
             server.stop()
+
+
+def test_stream_infer_shm_gated_for_remote_peers():
+    """ModelStreamInfer must apply the same loopback gate as unary
+    ModelInfer when a streamed request carries shm parameters."""
+    import grpc as grpc_mod
+
+    from triton_client_tpu.runtime.server import _Servicer
+
+    class _Aborted(Exception):
+        def __init__(self, code, details):
+            self.code = code
+            super().__init__(details)
+
+    class _RemoteCtx:
+        def peer(self):
+            return "ipv4:198.51.100.7:4242"
+
+        def abort(self, code, details):
+            raise _Aborted(code, details)
+
+    repo = _repo()
+    servicer = _Servicer(
+        repo, TPUChannel(repo), shm_registry=SystemSharedMemoryRegistry()
+    )
+    req = codec.build_infer_request_shm(
+        "addone",
+        {"x": np.zeros((1, 4), np.float32)},
+        shm_inputs={"x": ("r", 0, 16)},
+    )
+    with pytest.raises(_Aborted) as e:
+        list(servicer.ModelStreamInfer(iter([req]), _RemoteCtx()))
+    assert e.value.code == grpc_mod.StatusCode.PERMISSION_DENIED
