@@ -4,11 +4,16 @@ Triton ensembles (``platform: "ensemble"`` + ``ensemble_scheduling``
 steps with input_map/output_map) are the reference's acknowledged gap —
 "Ensemble mode for Triton server" sits unchecked in its TODO list
 (README.md:119) and nothing in its tree implements it. This module is
-the TPU-native version, and it is *better* placed here than in Triton:
-member models are jit-compiled JAX functions over device arrays, so
-intermediate tensors flow step-to-step **without leaving HBM** — Triton
-ensembles shuttle tensors through host memory between backends unless
-both sides opt into GPU tensors.
+the TPU-native version.
+
+Data-movement honesty: members are composed through their
+repository-facing ``infer_fn``s, which emit the WIRE contract (numpy
+on host) — so a chained DAG's intermediates round-trip through host
+memory between steps, the same cost Triton's default (non-GPU-tensor)
+ensembles pay. For detection-sized intermediates (a few hundred boxes)
+that is microseconds; fusing the DAG device-side (jit of the composed
+member fns, intermediates staying in HBM) is the TPU-first upgrade
+path and would slot in here behind the same config surface.
 
 An ensemble is declared in the model repository like any other entry::
 
