@@ -455,3 +455,29 @@ def test_stream_infer_shm_gated_for_remote_peers():
     with pytest.raises(_Aborted) as e:
         list(servicer.ModelStreamInfer(iter([req]), _RemoteCtx()))
     assert e.value.code == grpc_mod.StatusCode.PERMISSION_DENIED
+
+
+def test_bf16_tensor_through_shm_region():
+    """BF16 is the codec's one special-cased dtype (no stock-numpy
+    dtype; travels as ml_dtypes.bfloat16 words): it must survive the
+    shared-memory path bit-exactly like it does the wire."""
+    import ml_dtypes
+
+    bf16 = np.dtype(ml_dtypes.bfloat16)
+    arr = np.arange(16, dtype=np.float32).astype(bf16).reshape(4, 4)
+    key = f"/tct_test_{os.getpid()}_bf16"
+    with SharedMemoryRegion.create(key, arr.nbytes) as region:
+        region.write(arr)
+        reg = SystemSharedMemoryRegistry()
+        reg.register("bf16_r", key, 0, arr.nbytes)
+        req = codec.build_infer_request_shm(
+            "m", {"x": arr}, shm_inputs={"x": ("bf16_r", 0, arr.nbytes)}
+        )
+        assert req.inputs[0].datatype == "BF16"
+        wire = pb.ModelInferRequest.FromString(req.SerializeToString())
+        parsed = codec.parse_infer_request(wire, shm=reg)
+        assert parsed["x"].dtype == bf16
+        np.testing.assert_array_equal(
+            parsed["x"].view(np.uint16), arr.view(np.uint16)
+        )
+        reg.unregister_all()
