@@ -19,6 +19,10 @@ import time
 # axon TPU plugin discovery — see .claude/skills/verify/SKILL.md).
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from triton_client_tpu.utils.compilation_cache import enable_persistent_cache
+
+enable_persistent_cache()  # perf/bench/entry share one compile bill
+
 import jax
 import jax.numpy as jnp
 
