@@ -20,6 +20,7 @@ TPUChannel implements. Departures from the reference:
 
 from __future__ import annotations
 
+import itertools
 import logging
 import os
 import time
@@ -45,6 +46,10 @@ _RETRYABLE = (
 # ModelInfer may have executed server-side when the deadline fires, so
 # only connection-level failures are safe to re-issue automatically.
 _INFER_RETRYABLE = (grpc.StatusCode.UNAVAILABLE,)
+
+# shared-memory region-name tag: process-wide monotonic so no two
+# channel instances (live or dead) ever share a name prefix
+_SHM_CHANNEL_SEQ = itertools.count()
 
 
 class GRPCChannel(BaseChannel):
@@ -81,6 +86,12 @@ class GRPCChannel(BaseChannel):
         self._use_shm = use_shared_memory
         self._shm_regions: dict = {}  # input name -> SharedMemoryRegion
         self._shm_gen: dict = {}      # input name -> segment generation
+        # region names were keyed on id(self), which CPython reuses
+        # after GC: a dead channel whose close() failed to unregister
+        # server-side left a stale registry entry that a NEW channel
+        # reusing the id would collide with forever. A process-wide
+        # monotonic tag can never recur within the process.
+        self._shm_tag = next(_SHM_CHANNEL_SEQ)
         self._shm_lock = None
         self._shm_async_warned = False
         if use_shared_memory:
@@ -185,7 +196,7 @@ class GRPCChannel(BaseChannel):
         # may have executed server-side) never reuses its segment name
         gen = self._shm_gen.get(name, 0)
         self._shm_gen[name] = gen + 1
-        rname = f"tct_{os.getpid()}_{id(self)}_{name}_{gen}"
+        rname = f"tct_{os.getpid()}_{self._shm_tag}_{name}_{gen}"
         new = SharedMemoryRegion.create(f"/{rname}", max(nbytes, 1))
         try:
             # no retry: register is not idempotent (duplicate names are
@@ -262,13 +273,29 @@ class GRPCChannel(BaseChannel):
                 )
                 for region in self._shm_regions.values():
                     rname = region.key.lstrip("/")
-                    # unregister first: if only SOME regions were lost,
-                    # a blind re-register would hit the duplicate-name
-                    # rejection (unknown-name unregister is a no-op)
-                    self._stub.SystemSharedMemoryUnregister(
-                        pb.SystemSharedMemoryUnregisterRequest(name=rname),
-                        timeout=self._timeout_s,
-                    )
+                    try:
+                        # unregister first: if only SOME regions were
+                        # lost, a blind re-register would hit the
+                        # duplicate-name rejection (unknown-name
+                        # unregister is a no-op). It is ONLY that
+                        # guard — a transient failure here must not
+                        # abort the recovery mid-loop and mask the
+                        # original 'not registered' with an unrelated
+                        # error while _shm_regions sits half-recovered
+                        self._stub.SystemSharedMemoryUnregister(
+                            pb.SystemSharedMemoryUnregisterRequest(
+                                name=rname
+                            ),
+                            timeout=self._timeout_s,
+                        )
+                    except grpc.RpcError as ue:
+                        log.warning(
+                            "duplicate-name guard unregister of %s "
+                            "failed (%s); attempting register anyway",
+                            rname, ue,
+                        )
+                    # a failed register surfaces here with the
+                    # recovery context still in the log above
                     self._call(
                         self._stub.SystemSharedMemoryRegister,
                         pb.SystemSharedMemoryRegisterRequest(

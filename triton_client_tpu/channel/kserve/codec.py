@@ -127,8 +127,16 @@ def shm_params(tensor) -> tuple[str, int, int] | None:
     p = tensor.parameters
     if "shared_memory_region" not in p:
         return None
+    # presence-check before EVERY subscript: bracket access on a
+    # protobuf map inserts a default entry, silently mutating the
+    # message being parsed — surprising for any later re-serialization
+    # or logging of the request/response
     region = p["shared_memory_region"].string_param
-    byte_size = int(p["shared_memory_byte_size"].int64_param)
+    byte_size = (
+        int(p["shared_memory_byte_size"].int64_param)
+        if "shared_memory_byte_size" in p
+        else 0
+    )
     offset = (
         int(p["shared_memory_offset"].int64_param)
         if "shared_memory_offset" in p

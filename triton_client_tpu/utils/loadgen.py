@@ -64,6 +64,13 @@ def run_pool(
     lock = threading.Lock()
     stop = threading.Event()
     ready = threading.Barrier(clients + 1)
+    # the warm phase is bounded by one request deadline plus the
+    # connect stagger: a hard-coded barrier timeout shorter than
+    # deadline_s (bench sizes that from measured device time — 320 s+
+    # on a ~1 s/dispatch rig) broke the barrier while a slow warm was
+    # still legitimate, and the pool leaked running clients into the
+    # next transport's measurement
+    barrier_timeout_s = deadline_s + stagger_s * clients + 60.0
 
     def client_loop(idx: int):
         n, mine = 0, []  # n counts only completions INSIDE the window
@@ -84,7 +91,7 @@ def run_pool(
         try:
             # EVERY thread reaches the barrier, warm or not — a failed
             # warm must not strand the caller's wait
-            ready.wait(timeout=300)
+            ready.wait(timeout=barrier_timeout_s)
         except threading.BrokenBarrierError:
             pass
         try:
@@ -117,23 +124,39 @@ def run_pool(
     ]
     for t in threads:
         t.start()
-    ready.wait(timeout=300)
-    if on_window_start is not None:
-        on_window_start()
-    t_start = time.perf_counter()
-    time.sleep(duration_s)
-    stop.set()
-    # the measured window closes HERE: stragglers are drained below so
-    # nothing survives into the caller's next measurement, but their
-    # drain time must not dilute the reported rate
-    wall = time.perf_counter() - t_start
-    # wait stragglers OUT: an in-flight request is bounded by the gRPC
-    # deadline, so this join always terminates
-    for t in threads:
-        t.join(timeout=deadline_s + 60.0)
-    alive = [t for t in threads if t.is_alive()]
-    if alive:
-        errors.append(f"{len(alive)} client threads still alive after join")
+    wall = 0.0
+    try:
+        try:
+            ready.wait(timeout=barrier_timeout_s)
+        except threading.BrokenBarrierError as e:
+            # a broken barrier aborts the window but must NOT skip the
+            # stop/join in the finally — clients swallow
+            # BrokenBarrierError and enter their request loop, so
+            # without stop.set() they would keep issuing requests into
+            # the caller's next measurement until server teardown
+            with lock:
+                errors.append(f"warm barrier broke: {e!r}")
+        else:
+            if on_window_start is not None:
+                on_window_start()
+            t_start = time.perf_counter()
+            time.sleep(duration_s)
+            # the measured window closes HERE: stragglers are drained
+            # in the finally so nothing survives into the caller's
+            # next measurement, but their drain time must not dilute
+            # the reported rate
+            wall = time.perf_counter() - t_start
+    finally:
+        stop.set()
+        # wait stragglers OUT: an in-flight request is bounded by the
+        # gRPC deadline, so this join always terminates
+        for t in threads:
+            t.join(timeout=deadline_s + 60.0)
+        alive = [t for t in threads if t.is_alive()]
+        if alive:
+            errors.append(
+                f"{len(alive)} client threads still alive after join"
+            )
     return PoolResult(
         served_frames=sum(served),
         wall_s=wall,
