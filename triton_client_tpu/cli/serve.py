@@ -44,6 +44,18 @@ def main(argv=None) -> None:
         "per-instance CUDA-stream role); 1 = strictly serial",
     )
     p.add_argument(
+        "--max-merge", type=int, default=None,
+        help="frame cap for one device batch formed at dispatch time "
+        "(default: --max-batch). Higher values fuse several admission "
+        "windows into one device call, amortizing per-dispatch cost "
+        "(Triton preferred_batch_size role)",
+    )
+    p.add_argument(
+        "--pad-buckets", action="store_true",
+        help="pad each device batch to the next power of two so XLA "
+        "compiles log2(max-merge)+1 batch shapes instead of every size",
+    )
+    p.add_argument(
         "--metrics-port", type=int, default=8002,
         help="Prometheus per-model latency metrics (Triton :8002 parity; "
         "0 disables)",
@@ -93,11 +105,17 @@ def build_server(args):
             max_batch=args.max_batch,
             timeout_us=args.batch_timeout_us,
             pipeline_depth=args.pipeline_depth,
+            # getattr: embedders build the args Namespace by hand
+            # (tests/test_serve_cli.py) and may predate these knobs
+            max_merge=getattr(args, "max_merge", None),
+            pad_to_buckets=getattr(args, "pad_buckets", False),
         )
         print(
             f"micro-batching: max_batch={args.max_batch} "
             f"timeout={args.batch_timeout_us}us "
-            f"pipeline_depth={args.pipeline_depth}", flush=True,
+            f"pipeline_depth={args.pipeline_depth} "
+            f"max_merge={getattr(args, 'max_merge', None) or args.max_batch} "
+            f"pad_buckets={getattr(args, 'pad_buckets', False)}", flush=True,
         )
     return InferenceServer(
         repo,

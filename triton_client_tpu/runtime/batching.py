@@ -8,6 +8,21 @@ batch is executed as ONE inference over the wrapped channel with the
 per-request arrays concatenated on the batch axis — bigger batches keep
 the MXU busy and amortize dispatch overhead.
 
+Batch formation is two-stage (round 4, VERDICT r3 #2). The admission
+window (native C++ or the Python fallback) only signals arrival; the
+DISPATCHER forms the device batch at the moment an execution slot
+frees, merging every compatible request queued by then. A fixed
+window had to guess how long a client burst takes to arrive — it
+guessed wrong under load (r3 serving rows: occupancy 4/8 with 16
+closed-loop clients and a 3 ms window, device idle ~60% of it) —
+whereas slot-time formation is self-clocking: while ``pipeline_depth``
+batches execute, arrivals pool, and the next batch takes them all.
+Optional ``pad_to_buckets`` pads each merge to the next power of two
+so the inner channel sees a handful of precompiled shapes instead of
+every batch size (the role Triton's preferred_batch_size plays), and
+``max_merge`` lets the device batch grow past the admission size —
+the measured b8->b64 dispatch-amortization win, applied to serving.
+
 BatchingChannel is itself a BaseChannel, so it stacks under the gRPC
 façade or above TPUChannel unchanged. Requests are only merged when
 model, version and non-batch input shapes match; mismatches run solo.
@@ -17,6 +32,7 @@ environments without the native toolchain.
 
 from __future__ import annotations
 
+import collections
 import concurrent.futures
 import itertools
 import logging
@@ -42,6 +58,14 @@ def _merge_key(request: InferRequest):
     )
 
 
+def _bucket(n: int) -> int:
+    """Smallest power of two >= n (the padded device batch size)."""
+    b = 1
+    while b < n:
+        b *= 2
+    return b
+
+
 class BatchingChannel(BaseChannel):
     def __init__(
         self,
@@ -51,27 +75,48 @@ class BatchingChannel(BaseChannel):
         capacity: int = 256,
         use_native: bool = True,
         pipeline_depth: int = 2,
+        max_merge: int | None = None,
+        pad_to_buckets: bool = False,
     ) -> None:
         """``pipeline_depth``: formed batches executing concurrently
         against the inner channel. At the default 2, batch N+1's
         host->device transfer overlaps batch N's execution (the role
-        Triton's per-instance CUDA streams play) — on a dispatch-bound
-        path this nearly doubles batch rate; jax queues the dispatches
-        and the device serializes execution. While ``pipeline_depth``
-        batches are in flight the batcher thread blocks, so incoming
-        requests coalesce into FULLER batches rather than piling up as
-        fragments. Depth 1 restores strictly serial execution."""
+        Triton's per-instance CUDA streams play); jax queues the
+        dispatches and the device serializes execution. Depth 1
+        restores strictly serial execution.
+
+        ``max_merge``: frame cap for one device batch (default: same
+        as ``max_batch``). Setting it higher lets the dispatcher fuse
+        several admission windows into one device call — on a
+        dispatch-bound path the per-call fixed cost then amortizes
+        over max_merge frames instead of max_batch.
+
+        ``pad_to_buckets``: pad each merged batch to the next power of
+        two with replicated rows (outputs for the pad rows are
+        discarded). Keeps the set of batch shapes the inner channel —
+        and therefore XLA — ever sees to log2(max_merge)+1 sizes."""
         self._inner = inner
         self._pending: dict[int, tuple[InferRequest, concurrent.futures.Future]] = {}
         self._lock = threading.Lock()
         self._ids = itertools.count(1)
         self._impl = None
         self._py = None
+        self._max_merge = int(max_merge if max_merge is not None else max_batch)
+        self._pad_to_buckets = bool(pad_to_buckets)
         self._inflight = threading.Semaphore(max(1, pipeline_depth))
         self._exec = concurrent.futures.ThreadPoolExecutor(
             max_workers=max(1, pipeline_depth),
             thread_name_prefix="batch-exec",
         )
+        # dispatch-time merge state: requests the admission stage has
+        # released, waiting for an execution slot
+        self._ready: collections.deque = collections.deque()
+        self._ready_cv = threading.Condition()
+        self._dispatch_stop = False
+        self._merge_stats = {
+            "merges": 0, "merged_frames": 0, "padded_frames": 0,
+        }
+        self._merge_occupancy: collections.Counter = collections.Counter()
         if use_native:
             try:
                 from triton_client_tpu.native import NativeBatchServer
@@ -89,6 +134,10 @@ class BatchingChannel(BaseChannel):
         if self._impl is None:
             self._py = _PyBatcher(self._on_batch, max_batch, timeout_us, capacity)
             self._py.start()
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, daemon=True, name="batch-dispatch"
+        )
+        self._dispatcher.start()
 
     # -- BaseChannel ----------------------------------------------------------
 
@@ -122,31 +171,55 @@ class BatchingChannel(BaseChannel):
             raise RuntimeError("inference queue full")
         return future.result()
 
-    # -- batch execution (runs on the batcher thread) -------------------------
+    # -- admission release (runs on the batcher thread) -----------------------
 
     def _on_batch(self, ids) -> None:
+        """The admission stage released a window of requests: stage
+        them for the dispatcher. Merging happens THERE, at slot time —
+        fragments from separate windows re-coalesce."""
         with self._lock:
             work = [(rid, *self._pending.pop(rid)) for rid in ids if rid in self._pending]
-        groups: dict = {}
+        staged = []
         for rid, request, future in work:
             try:
                 key = _merge_key(request)
+                size = next(
+                    iter(int(np.asarray(a).shape[0]) for a in request.inputs.values())
+                )
             except Exception:
-                key = ("__solo__", rid)
-            groups.setdefault(key, []).append((rid, request, future))
-        for group in groups.values():
-            # bounded handoff: at most pipeline_depth groups run
-            # concurrently; when full, THIS (batcher) thread blocks,
-            # which is what lets the queue coalesce larger batches
+                key, size = ("__solo__", rid), 1
+            staged.append((key, size, request, future))
+        if not staged:
+            return
+        with self._ready_cv:
+            self._ready.extend(staged)
+            self._ready_cv.notify()
+
+    # -- dispatch (forms the device batch when a slot frees) ------------------
+
+    def _dispatch_loop(self) -> None:
+        while True:
             self._inflight.acquire()
+            group = None
+            with self._ready_cv:
+                while not self._ready and not self._dispatch_stop:
+                    self._ready_cv.wait(timeout=0.1)
+                if self._ready:
+                    group = self._form_group_locked()
+                elif self._dispatch_stop:
+                    self._inflight.release()
+                    return
+            if group is None:
+                self._inflight.release()
+                continue
 
             def run(g=group):
                 try:
-                    self._run_group(g)
+                    self._run_group([(None, it[2], it[3]) for it in g])
                 except Exception as e:
                     # No exception may escape: an unresolved future
                     # hangs its caller forever.
-                    for _, _, future in g:
+                    for _, _, _, future in g:
                         if not future.done():
                             future.set_exception(e)
                 finally:
@@ -156,12 +229,35 @@ class BatchingChannel(BaseChannel):
                 self._exec.submit(run)
             except RuntimeError as e:  # executor shut down mid-close
                 self._inflight.release()
-                for _, _, future in group:
+                for _, _, _, future in group:
                     if not future.done():
                         future.set_exception(e)
 
+    def _form_group_locked(self):
+        """Pop the head item plus every queued same-key item that fits
+        under max_merge frames (caller holds _ready_cv). Items of other
+        keys keep their relative order for the next slot."""
+        first = self._ready.popleft()
+        group = [first]
+        frames = first[1]
+        skipped = []
+        while self._ready and frames < self._max_merge:
+            item = self._ready.popleft()
+            if item[0] == first[0] and frames + item[1] <= self._max_merge:
+                group.append(item)
+                frames += item[1]
+            else:
+                skipped.append(item)
+        self._ready.extendleft(reversed(skipped))
+        self._merge_stats["merges"] += 1
+        self._merge_stats["merged_frames"] += frames
+        self._merge_occupancy[frames] += 1
+        return group
+
+    # -- batch execution (runs on the executor threads) -----------------------
+
     def _run_group(self, group) -> None:
-        if len(group) == 1:
+        if len(group) == 1 and not self._pad_to_buckets:
             _, request, future = group[0]
             self._run_solo(request, future)
             return
@@ -172,10 +268,26 @@ class BatchingChannel(BaseChannel):
                 next(iter(np.asarray(a).shape[0] for a in r.inputs.values()))
                 for r in requests
             ]
-            merged = {
-                name: np.concatenate([np.asarray(r.inputs[name]) for r in requests])
-                for name in requests[0].inputs
-            }
+            total = sum(sizes)
+            # pad only when the ROUNDED size still fits max_merge: a
+            # non-power-of-two max_merge (e.g. 6) must not round a
+            # total of 6 up to 8 — past the cap and past any size the
+            # inner channel precompiled. Oversized single requests
+            # (> max_merge) pass through unpadded for the same reason.
+            bucket = _bucket(total)
+            pad = (
+                bucket - total
+                if self._pad_to_buckets and bucket <= self._max_merge
+                else 0
+            )
+            merged = {}
+            for name in requests[0].inputs:
+                parts = [np.asarray(r.inputs[name]) for r in requests]
+                if pad:
+                    # replicate a real row: zeros can steer a model
+                    # down numerically different paths, a copy cannot
+                    parts.append(np.repeat(parts[0][:1], pad, axis=0))
+                merged[name] = np.concatenate(parts)
             resp = self._inner.do_inference(
                 InferRequest(
                     model_name=requests[0].model_name,
@@ -183,18 +295,26 @@ class BatchingChannel(BaseChannel):
                     inputs=merged,
                 )
             )
+            if pad:
+                # counted only for a padded call that actually ran,
+                # under the same lock stats() reads through (executor
+                # threads race here at pipeline_depth >= 2)
+                with self._ready_cv:
+                    self._merge_stats["padded_frames"] += pad
         except Exception:
             # A merged failure must not take down unrelated requests:
             # fall back to per-request execution.
             for request, future in zip(requests, futures):
                 self._run_solo(request, future)
             return
-        total = sum(sizes)
+        total_padded = total + pad
         splits = np.cumsum(sizes)[:-1]
         per_output = {}
         for name, arr in resp.outputs.items():
             arr = np.asarray(arr)
-            if arr.ndim >= 1 and arr.shape[0] == total:
+            if arr.ndim >= 1 and arr.shape[0] == total_padded:
+                per_output[name] = np.split(arr[:total], splits)
+            elif arr.ndim >= 1 and arr.shape[0] == total:
                 per_output[name] = np.split(arr, splits)
             else:  # non-batched output — replicate
                 per_output[name] = [arr] * len(requests)
@@ -218,17 +338,30 @@ class BatchingChannel(BaseChannel):
     # -- stats / lifecycle ----------------------------------------------------
 
     def stats(self) -> dict:
-        if self._impl is not None:
-            return self._impl.stats()
-        return self._py.stats()
+        out = self._impl.stats() if self._impl is not None else self._py.stats()
+        with self._ready_cv:
+            out.update(self._merge_stats)
+            out["merge_occupancy"] = dict(
+                sorted(self._merge_occupancy.items())
+            )
+            out["ready_depth"] = len(self._ready)
+        return out
 
     def close(self) -> None:
+        # admission first: its close() drains every admitted id into
+        # _on_batch, so by the time it returns all work is staged
         if self._impl is not None:
             self._impl.close()
         if self._py is not None:
             self._py.close()
-        # after the batcher thread stops, drain in-flight groups so
-        # every admitted future resolves before close() returns
+        # the dispatcher keeps forming batches until the staging deque
+        # is empty, THEN exits — no admitted future is stranded
+        with self._ready_cv:
+            self._dispatch_stop = True
+            self._ready_cv.notify_all()
+        self._dispatcher.join(timeout=30.0)
+        # after the dispatcher stops, drain in-flight groups so every
+        # admitted future resolves before close() returns
         self._exec.shutdown(wait=True)
 
 
