@@ -147,7 +147,7 @@ def test_examples_tree_parses():
 
     root = pathlib.Path("examples")
     dirs = sorted(p for p in root.iterdir() if (p / "config.yaml").exists())
-    assert len(dirs) == 9
+    assert len(dirs) == 13
     for d in dirs:
         doc = load_yaml(str(d / "config.yaml"))
         if doc["family"] == "ensemble":
@@ -163,11 +163,31 @@ def test_examples_tree_parses():
 
 
 def test_examples_yolov5_builds_and_infers():
+    """The default entry serves the measured-fastest layout (round 4:
+    s2d + ch_floor + bf16 is the default, not a secondary)."""
     rm = dr.build_model("examples/yolov5_crop", version="1")
     assert rm.spec.name == "yolov5_crop"
     assert rm.spec.max_batch_size == 8
     out = rm.infer_fn({"images": np.zeros((1, 64, 64, 3), np.float32)})
     assert out["detections"].shape[-1] == 6
+
+
+def test_examples_yolov5_base_keeps_continuity_layout():
+    rm = dr.build_model("examples/yolov5_crop_base", version="1")
+    assert rm.spec.name == "yolov5_crop_base"
+    out = rm.infer_fn({"images": np.zeros((1, 64, 64, 3), np.float32)})
+    assert out["detections"].shape[-1] == 6
+
+
+def test_examples_yolov5l_capacity_entry_builds():
+    """The capacity-is-free recommendation (v5l at 35% MFU, ~1,000 fps
+    b8 — BASELINE.md MFU study) is servable out of the box, not just
+    prose: the repo entry builds and serves the same contract."""
+    rm = dr.build_model("examples/yolov5l_crop", version="1")
+    assert rm.spec.name == "yolov5l_crop"
+    out = rm.infer_fn({"images": np.zeros((1, 64, 64, 3), np.uint8)})
+    assert out["detections"].shape[-1] == 6
+    assert np.isfinite(np.asarray(out["detections"], np.float32)).all()
 
 
 def test_examples_yolov5_mxu_entry_serves_optimized_layout():

@@ -435,3 +435,180 @@ class TestDocParsing:
     def test_doc_missing_sections(self, repo):
         with pytest.raises(KeyError, match="needs 'steps'"):
             build_ensemble_doc(repo, "e", {"family": "ensemble"})
+
+
+class TestDeviceFusion:
+    """Round-4 device-fused DAGs (VERDICT r3 #4): members exposing a
+    jit-traceable device_fn compose under ONE jit — intermediates stay
+    in HBM — and the fused path is numerically identical to the host
+    path on the same DAG."""
+
+    @staticmethod
+    def _register_device(repo, name, in_specs, out_specs, host_fn, dev_fn):
+        repo.register(
+            ModelSpec(
+                name=name,
+                version="1",
+                platform="jax",
+                inputs=tuple(TensorSpec(n, s, d) for n, s, d in in_specs),
+                outputs=tuple(TensorSpec(n, s, d) for n, s, d in out_specs),
+            ),
+            host_fn,
+            device_fn=dev_fn,
+        )
+
+    @pytest.fixture
+    def dev_repo(self):
+        import jax.numpy as jnp
+
+        r = ModelRepository()
+        self._register_device(
+            r, "scale",
+            [("x", (-1, 4), "FP32")], [("scaled", (-1, 4), "FP32")],
+            lambda i: {"scaled": np.asarray(i["x"]) * 2.0},
+            lambda i: {"scaled": i["x"] * jnp.float32(2.0)},
+        )
+        self._register_device(
+            r, "shift",
+            [("x", (-1, 4), "FP32")], [("shifted", (-1, 4), "FP32")],
+            lambda i: {"shifted": np.asarray(i["x"]) + 1.0},
+            lambda i: {"shifted": i["x"] + jnp.float32(1.0)},
+        )
+        return r
+
+    def _chain(self, repo, fuse):
+        return build_ensemble(
+            repo, "chain",
+            [
+                EnsembleStep("scale", {"x": "raw"}, {"scaled": "mid"}),
+                EnsembleStep("shift", {"x": "mid"}, {"shifted": "out"}),
+            ],
+            outputs=["out"],
+            fuse=fuse,
+        )
+
+    def test_fused_matches_host_path(self, dev_repo):
+        x = np.arange(8, dtype=np.float32).reshape(2, 4)
+        fused = self._chain(dev_repo, "auto")
+        host = self._chain(dev_repo, "never")
+        assert fused.spec.extra["fused"] is True
+        assert host.spec.extra["fused"] is False
+        np.testing.assert_allclose(
+            fused.infer_fn({"raw": x})["out"],
+            host.infer_fn({"raw": x})["out"],
+        )
+        np.testing.assert_allclose(
+            host.infer_fn({"raw": x})["out"], x * 2.0 + 1.0
+        )
+
+    def test_always_rejects_host_only_member(self, repo):
+        with pytest.raises(ValueError, match="no device_fn"):
+            build_ensemble(
+                repo, "chain",
+                [EnsembleStep("scale", {"x": "raw"}, {"scaled": "out"})],
+                outputs=["out"],
+                fuse="always",
+            )
+
+    def test_auto_falls_back_to_host_members(self, repo):
+        rm = build_ensemble(
+            repo, "chain",
+            [EnsembleStep("scale", {"x": "raw"}, {"scaled": "out"})],
+            outputs=["out"],
+            fuse="auto",
+        )
+        assert rm.spec.extra["fused"] is False
+        out = rm.infer_fn({"raw": np.ones((1, 4), np.float32)})
+        np.testing.assert_allclose(out["out"], 2.0)
+
+    def test_doc_fuse_bool_coerces(self, dev_repo):
+        rm = build_ensemble_doc(
+            dev_repo, "chain",
+            {
+                "family": "ensemble",
+                "fuse": True,
+                "steps": [
+                    {"model": "scale", "input_map": {"x": "raw"},
+                     "output_map": {"scaled": "out"}},
+                ],
+                "outputs": ["out"],
+            },
+        )
+        assert rm.spec.extra["fused"] is True
+
+    def test_invalid_fuse_value(self, dev_repo):
+        with pytest.raises(ValueError, match="auto/always/never"):
+            build_ensemble(
+                dev_repo, "chain",
+                [EnsembleStep("scale", {"x": "raw"}, {"scaled": "out"})],
+                outputs=["out"],
+                fuse="maybe",
+            )
+
+    def test_examples_fused_entry_serves(self):
+        """The shipped preprocess->detector entry loads from disk with
+        fuse: always (every member has a device form) and detects."""
+        from triton_client_tpu.runtime import disk_repository as dr
+
+        repo = dr.scan_disk("examples")
+        rm = repo.get("ensemble_fused_pipeline")
+        assert rm.spec.extra["fused"] is True
+        frame = np.zeros((1, 96, 128, 3), np.uint8)
+        out = rm.infer_fn({"camera_raw": frame})
+        assert out["boxes"].shape[-1] == 6
+        assert np.isfinite(np.asarray(out["boxes"], np.float32)).all()
+
+    def test_nested_fusion_composes_device_fns(self, dev_repo):
+        """A fused ensemble exposes its own device form, so a PARENT
+        ensemble can fuse over it — the nesting boundary stays in HBM
+        (scan_disk's fixpoint supports nested ensembles; fusion must
+        not stop at one level)."""
+        child = self._chain(dev_repo, "always")
+        assert child.device_fn is not None
+        dev_repo.register(
+            child.spec, child.infer_fn, warmup=child.warmup,
+            device_fn=child.device_fn,
+        )
+        parent = build_ensemble(
+            dev_repo, "parent",
+            [
+                EnsembleStep("chain", {"raw": "x0"}, {"out": "mid"}),
+                EnsembleStep("scale", {"x": "mid"}, {"scaled": "final"}),
+            ],
+            outputs=["final"],
+            fuse="always",
+        )
+        assert parent.spec.extra["fused"] is True
+        x = np.ones((2, 4), np.float32)
+        np.testing.assert_allclose(
+            parent.infer_fn({"x0": x})["final"], (x * 2 + 1) * 2
+        )
+
+    def test_fused_output_cast_to_spec_dtype(self):
+        """Device traces run with x64 disabled, so an INT64 wire
+        contract comes back int32 from the DAG; the fused boundary
+        casts outputs to the declared spec dtype so fused == host on
+        dtype too (the scored-head classes case)."""
+        import jax.numpy as jnp
+
+        r = ModelRepository()
+        self._register_device(
+            r, "ids",
+            [("x", (-1, 4), "FP32")], [("classes", (-1,), "INT64")],
+            lambda i: {"classes": np.zeros(len(i["x"]), np.int64)},
+            lambda i: {"classes": jnp.zeros(i["x"].shape[0], jnp.int32)},
+        )
+        rm = build_ensemble(
+            r, "e",
+            [EnsembleStep("ids", {"x": "raw"}, {"classes": "out"})],
+            outputs=["out"], fuse="always",
+        )
+        out = rm.infer_fn({"raw": np.zeros((3, 4), np.float32)})
+        assert out["out"].dtype == np.int64
+
+    def test_fused_warmup_compiles_the_dag(self, dev_repo):
+        """warmup() on a fused ensemble must exercise the FUSED path
+        (member warmups compile standalone programs the fused path
+        never runs)."""
+        rm = self._chain(dev_repo, "always")
+        rm.warmup()  # no member warmups registered -> must not raise

@@ -139,6 +139,38 @@ class Detect2DPipeline:
 
         return fn
 
+    def device_fn(self):
+        """Jit-traceable form of infer_fn: same tensor names, device
+        arrays end to end, no host boundary — the member contract
+        device-fused ensembles compose through (runtime/ensemble.py;
+        intermediates stay in HBM instead of round-tripping host
+        memory between steps). orig_hw comes off the traced shape, so
+        per-resolution retracing matches the wire path's behavior."""
+        if self.config.head_style == "scored":
+
+            def fn(inputs):
+                frames = inputs["images"]
+                dets, valid = self._pipeline(
+                    frames, (frames.shape[1], frames.shape[2])
+                )
+                return {
+                    "boxes": dets[..., :4],
+                    "scores": dets[..., 4],
+                    "classes": dets[..., 5].astype(jnp.int32),
+                    "dims": valid.sum(axis=-1).astype(jnp.int32),
+                }
+
+        else:
+
+            def fn(inputs):
+                frames = inputs["images"]
+                dets, valid = self._pipeline(
+                    frames, (frames.shape[1], frames.shape[2])
+                )
+                return {"detections": dets, "valid": valid}
+
+        return fn
+
 
 def load_class_names(path: str) -> tuple[str, ...]:
     """data/*.names loader (one class per line; reference
@@ -364,6 +396,16 @@ def _detectron_spec(cfg: Detect2DConfig) -> ModelSpec:
     )
 
 
+def _build_preprocess(**kwargs):
+    # lazy import: preprocess2d imports nothing heavy, but keeping the
+    # table entries uniform (callable indirection) avoids import cycles
+    from triton_client_tpu.pipelines.preprocess2d import (
+        build_preprocess_pipeline,
+    )
+
+    return build_preprocess_pipeline(**kwargs)
+
+
 # family name -> builder; the single dispatch table shared by the CLI
 # entry points and the disk model repository.
 BUILDERS_2D = {
@@ -371,4 +413,5 @@ BUILDERS_2D = {
     "yolov4": build_yolov4_pipeline,
     "retinanet": build_retinanet_pipeline,
     "fcos": build_fcos_pipeline,
+    "preprocess": _build_preprocess,
 }

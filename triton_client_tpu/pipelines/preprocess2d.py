@@ -1,0 +1,108 @@
+"""Standalone image-preprocess model: the classic first ensemble step.
+
+Triton deployments routinely front a detector with a preprocess model
+(DALI/python backend) chained via an ensemble — resize + dtype on the
+server so clients ship raw camera bytes. The reference does this work
+client-side instead (utils/preprocess.py image_adjust: resize + /255
+before the wire). This family moves it server-side as a repository
+entry, which is also the canonical IMAGE-SIZED-intermediate producer
+for device-fused ensembles: preprocess -> detector chained host-side
+round-trips a full float frame through host memory per step, fused it
+stays in HBM (runtime/ensemble.py; A/B in perf/profile_ensemble.py).
+
+Repository entry::
+
+    <root>/preprocess/config.yaml
+        family: preprocess
+        model: {input_hw: [512, 512]}   # output resolution
+
+No weights: the entry registers without version dirs. Contract:
+``images`` (B, H, W, 3) uint8/float RGB in, ``preprocessed``
+(B, out_h, out_w, 3) float32 out — raw pixel scale (detectors
+normalize internally, so chaining never double-normalizes).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from triton_client_tpu.config import ModelSpec, TensorSpec
+
+
+@dataclasses.dataclass(frozen=True)
+class Preprocess2DConfig:
+    model_name: str = "preprocess"
+    # named input_hw (not out_hw) so the disk repository's shared 2D
+    # plumbing (config.yaml model.input_hw override, warmup shape)
+    # applies unchanged; semantically it is the OUTPUT resolution
+    input_hw: tuple[int, int] = (512, 512)
+    class_names: tuple[str, ...] = ()
+
+
+class Preprocess2DPipeline:
+    """Resize-to-target as a servable model (no parameters)."""
+
+    def __init__(self, config: Preprocess2DConfig) -> None:
+        self.config = config
+        self._jit = jax.jit(self._fn)
+
+    def _fn(self, frames: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.config
+        x = frames.astype(jnp.float32)
+        if (x.shape[1], x.shape[2]) != cfg.input_hw:
+            x = jax.image.resize(
+                x,
+                (x.shape[0], cfg.input_hw[0], cfg.input_hw[1], 3),
+                method="bilinear",
+            )
+        return x
+
+    def infer(self, frames: np.ndarray) -> np.ndarray:
+        if frames.ndim == 3:
+            frames = frames[None]
+        return np.asarray(self._jit(jnp.asarray(frames)))
+
+    def infer_fn(self) -> Callable:
+        def fn(inputs):
+            return {"preprocessed": self.infer(np.asarray(inputs["images"]))}
+
+        return fn
+
+    def device_fn(self) -> Callable:
+        def fn(inputs):
+            return {"preprocessed": self._fn(inputs["images"])}
+
+        return fn
+
+
+def build_preprocess_pipeline(
+    rng=None,
+    variables=None,
+    config: Preprocess2DConfig | None = None,
+    input_hw: tuple[int, int] = (512, 512),
+):
+    """Builder with the BUILDERS_2D signature; ``variables`` is
+    accepted (and ignored — no parameters) so the disk repository's
+    probe/registered flow applies unchanged."""
+    cfg = config or Preprocess2DConfig(input_hw=tuple(input_hw))
+    pipeline = Preprocess2DPipeline(cfg)
+    spec = ModelSpec(
+        name=cfg.model_name,
+        version="1",
+        platform="jax",
+        inputs=(TensorSpec("images", (-1, -1, -1, 3), "FP32", "NHWC"),),
+        outputs=(
+            TensorSpec(
+                "preprocessed", (-1, cfg.input_hw[0], cfg.input_hw[1], 3),
+                "FP32", "NHWC",
+            ),
+        ),
+        max_batch_size=8,
+        extra={"out_hw": list(cfg.input_hw)},
+    )
+    return pipeline, spec, {}

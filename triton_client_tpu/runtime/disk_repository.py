@@ -263,7 +263,17 @@ class _Entry:
                 p.infer(np.zeros((16, 4), np.float32))
 
         return RegisteredModel(
-            spec=spec, infer_fn=pipeline.infer_fn(), warmup=warmup
+            spec=spec,
+            infer_fn=pipeline.infer_fn(),
+            warmup=warmup,
+            # pipelines that expose a jit-traceable form make their
+            # models fusable as ensemble members (intermediates stay
+            # in HBM); host-only pipelines still serve the wire path
+            device_fn=(
+                pipeline.device_fn()
+                if hasattr(pipeline, "device_fn")
+                else None
+            ),
         )
 
 
@@ -398,7 +408,10 @@ def scan_disk(
         )
         for version, weights in pairs:
             rm = entry.registered(version, weights)
-            repo.register(rm.spec, rm.infer_fn, warmup=rm.warmup)
+            repo.register(
+                rm.spec, rm.infer_fn, warmup=rm.warmup,
+                device_fn=rm.device_fn,
+            )
             if entry.doc.get("warmup"):
                 rm.warmup()
     if ensembles:
@@ -423,7 +436,12 @@ def scan_disk(
             for name in ready:
                 model_dir, doc = pending.pop(name)
                 rm = build_ensemble_doc(repo, name, doc)
-                repo.register(rm.spec, rm.infer_fn, warmup=rm.warmup)
+                # device_fn travels along so a fused ensemble can be a
+                # member of a PARENT fused ensemble (nested fusion)
+                repo.register(
+                    rm.spec, rm.infer_fn, warmup=rm.warmup,
+                    device_fn=rm.device_fn,
+                )
                 if doc.get("warmup"):
                     rm.warmup()
     return repo

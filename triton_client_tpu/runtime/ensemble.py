@@ -6,14 +6,17 @@ steps with input_map/output_map) are the reference's acknowledged gap —
 (README.md:119) and nothing in its tree implements it. This module is
 the TPU-native version.
 
-Data-movement honesty: members are composed through their
-repository-facing ``infer_fn``s, which emit the WIRE contract (numpy
-on host) — so a chained DAG's intermediates round-trip through host
-memory between steps, the same cost Triton's default (non-GPU-tensor)
-ensembles pay. For detection-sized intermediates (a few hundred boxes)
-that is microseconds; fusing the DAG device-side (jit of the composed
-member fns, intermediates staying in HBM) is the TPU-first upgrade
-path and would slot in here behind the same config surface.
+Data movement (round 4): when every member exposes a jit-traceable
+``device_fn`` (RegisteredModel.device_fn), the DAG is composed under
+ONE jit — intermediates stay in HBM and XLA fuses across member
+boundaries — the TPU-first answer to Triton's GPU-tensor ensembles.
+Members without a device form fall back to composition through their
+wire-facing ``infer_fn``s (numpy on host between steps, the cost
+Triton's default non-GPU-tensor ensembles pay; fine for box-sized
+intermediates, measured against the fused path for image-sized ones
+in perf/profile_ensemble.py). ``fuse`` selects: "auto" (default —
+fuse when possible), "always" (error if a member is host-only),
+"never" (host path, the pre-round-4 behavior).
 
 An ensemble is declared in the model repository like any other entry::
 
@@ -119,6 +122,7 @@ def build_ensemble(
     outputs: Sequence[str],
     version: str = "1",
     max_batch_size: int = 1,
+    fuse: str = "auto",
 ) -> RegisteredModel:
     """Compose registered models into one RegisteredModel.
 
@@ -175,6 +179,22 @@ def build_ensemble(
             f"by any step (produced: {sorted(produced)})"
         )
 
+    step_list = list(zip(steps, members))
+    output_names = tuple(outputs)
+
+    if fuse not in ("auto", "always", "never"):
+        raise ValueError(
+            f"ensemble '{name}': fuse must be auto/always/never, "
+            f"got {fuse!r}"
+        )
+    host_only = [s.model for s, m in step_list if m.device_fn is None]
+    if fuse == "always" and host_only:
+        raise ValueError(
+            f"ensemble '{name}': fuse: always, but members {host_only} "
+            f"expose no device_fn (host-only)"
+        )
+    fused = fuse != "never" and not host_only
+
     spec = ModelSpec(
         name=name,
         version=version,
@@ -182,13 +202,12 @@ def build_ensemble(
         inputs=tuple(needed.values()),
         outputs=tuple(produced[o] for o in outputs),
         max_batch_size=max_batch_size,
-        extra={"steps": [s.model for s in steps]},
+        # "fused" surfaces which data path this ensemble serves
+        # (tests/operators read it via model metadata)
+        extra={"steps": [s.model for s in steps], "fused": fused},
     )
 
-    step_list = list(zip(steps, members))
-    output_names = tuple(outputs)
-
-    def infer_fn(inputs: Mapping) -> dict:
+    def host_infer_fn(inputs: Mapping) -> dict:
         pool = dict(inputs)
         for step, member in step_list:
             step_inputs = {
@@ -200,25 +219,106 @@ def build_ensemble(
                 pool[pool_name] = result[step_out]
         return {o: pool[o] for o in output_names}
 
-    def warmup() -> None:
-        for _, member in step_list:
-            if member.warmup is not None:
-                member.warmup()
+    ensemble_device_fn = None
+    if fused:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
 
-    return RegisteredModel(spec=spec, infer_fn=infer_fn, warmup=warmup)
+        from triton_client_tpu.config import config_dtypes
+
+        def _compose(pool_in):
+            # the whole DAG is ONE XLA program: step outputs feed the
+            # next step as device values, XLA fuses across members,
+            # and the only host transfers are the ensemble's own
+            # inputs in / declared outputs out. Unjitted form so a
+            # PARENT ensemble can compose this ensemble as a member
+            # (nested fusion) under its own jit.
+            pool = dict(pool_in)
+            for step, member in step_list:
+                result = member.device_fn(
+                    {
+                        step_in: pool[pool_name]
+                        for step_in, pool_name in step.input_map.items()
+                    }
+                )
+                for step_out, pool_name in step.output_map.items():
+                    pool[pool_name] = result[step_out]
+            return {o: pool[o] for o in output_names}
+
+        ensemble_device_fn = _compose
+        _device_dag = jax.jit(_compose)
+        # wire-contract dtypes for each declared output: device traces
+        # run with x64 disabled, so e.g. a scored head's INT64 classes
+        # come back int32 from the DAG — the boundary cast keeps the
+        # fused path's outputs identical to the host path's
+        out_np_dtype = {
+            o: config_dtypes().get(produced[o].dtype) for o in output_names
+        }
+
+        def infer_fn(inputs: Mapping) -> dict:
+            out = _device_dag(
+                {k: jnp.asarray(v) for k, v in inputs.items()}
+            )
+            return {
+                k: np.asarray(v, dtype=out_np_dtype[k] or None)
+                for k, v in out.items()
+            }
+    else:
+        infer_fn = host_infer_fn
+
+    if fused:
+        import numpy as np
+
+        from triton_client_tpu.config import config_dtypes
+
+        def warmup() -> None:
+            # member warmups compile the members' STANDALONE wire
+            # programs, which the fused path never executes — warm the
+            # fused DAG itself instead, on a nominal spec-shaped batch
+            # (wildcard dims -> 64, batch -> 1; like the member
+            # pipelines, a new input resolution retraces at request
+            # time — warmup covers the whole-DAG compile cost once)
+            zeros = {}
+            for t in spec.inputs:
+                shape = [1] + [
+                    (64 if d < 0 else int(d)) for d in t.shape[1:]
+                ]
+                zeros[t.name] = np.zeros(
+                    shape, config_dtypes().get(t.dtype) or np.float32
+                )
+            infer_fn(zeros)
+    else:
+
+        def warmup() -> None:
+            for _, member in step_list:
+                if member.warmup is not None:
+                    member.warmup()
+
+    return RegisteredModel(
+        spec=spec,
+        infer_fn=infer_fn,
+        warmup=warmup,
+        device_fn=ensemble_device_fn,
+    )
 
 
 def build_ensemble_doc(
     repository: ModelRepository, name: str, doc: Mapping, version: str = "1"
 ) -> RegisteredModel:
     """config.yaml dict -> RegisteredModel (the disk-repository hook)."""
-    unknown = set(doc) - {"family", "steps", "outputs", "max_batch_size", "warmup"}
+    unknown = set(doc) - {
+        "family", "steps", "outputs", "max_batch_size", "warmup", "fuse",
+    }
     if unknown:
         raise KeyError(
             f"ensemble '{name}': unknown config keys {sorted(unknown)}"
         )
     if "steps" not in doc or "outputs" not in doc:
         raise KeyError(f"ensemble '{name}': config needs 'steps' and 'outputs'")
+    fuse = doc.get("fuse", "auto")
+    if isinstance(fuse, bool):  # yaml `fuse: true` reads as a bool
+        fuse = "always" if fuse else "never"
     return build_ensemble(
         repository,
         name,
@@ -226,4 +326,5 @@ def build_ensemble_doc(
         outputs=list(doc["outputs"]),
         version=version,
         max_batch_size=int(doc.get("max_batch_size", 1)),
+        fuse=str(fuse),
     )

@@ -32,6 +32,12 @@ class RegisteredModel:
     infer_fn: InferFn
     # Optional warmup callable (compile-ahead on register)
     warmup: Callable[[], None] | None = None
+    # Optional jit-traceable form of the model: {name: jax.Array} ->
+    # {name: jax.Array} with the SAME tensor names as the wire spec but
+    # device arrays end to end. Ensembles compose members through this
+    # under ONE jit so intermediates stay in HBM (runtime/ensemble.py);
+    # None means the model is host-only (wire path still works).
+    device_fn: InferFn | None = None
 
 
 class ModelRepository:
@@ -46,10 +52,11 @@ class ModelRepository:
         spec: ModelSpec,
         infer_fn: InferFn,
         warmup: Callable[[], None] | None = None,
+        device_fn: InferFn | None = None,
     ) -> None:
         with self._lock:
             self._models.setdefault(spec.name, {})[spec.version] = RegisteredModel(
-                spec, infer_fn, warmup
+                spec, infer_fn, warmup, device_fn
             )
 
     def unregister(self, name: str, version: str = "") -> None:
