@@ -73,17 +73,42 @@ REPS = 25            # chained dispatches per trial
 LAT_CALLS = 30       # single-call latency samples (readback per call)
 
 # Wall-clock budget (VERDICT r3 #1): BENCH_r03.json shows the driver's
-# clock ran out ~960 s in (902 s of warmups + 8 trial rounds), rc=124,
-# zero rows. Everything after setup is scheduled against this budget:
+# clock ran out with 902 s of warmups + 8 trial rounds + a setup phase
+# (10 config builds + NMS gate) on the books — i.e. the external cap
+# is at least ~1,050 s but its exact value is unknown. 1,020 stays
+# BELOW that observed floor while still fitting the full warm-cache
+# run with shortened serving windows; every headline row is out by
+# ~T+700 regardless, and the SIGTERM flush covers a cap landing in
+# the serving tail. Everything after setup is scheduled against it:
 # warmups are ordered by value-per-second and skipped (with a stderr
 # note) when they no longer fit, trials stop early at >= MIN_TRIALS,
 # and rows are emitted the moment they exist.
-BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "960"))
+BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "1020"))
 T_START = time.perf_counter()
 
 
 def _remaining() -> float:
     return BUDGET_S - (time.perf_counter() - T_START)
+
+
+def _load_flops_sidecar() -> dict:
+    try:
+        with open("BENCH_FLOPS.json") as f:
+            return dict(json.load(f))
+    except Exception:
+        return {}
+
+
+# metric -> flops/call, persisted across runs (see Config.warmup)
+_FLOPS_SIDEBAR = _load_flops_sidecar()
+
+
+def _save_flops_sidecar() -> None:
+    try:
+        with open("BENCH_FLOPS.json", "w") as f:
+            json.dump(_FLOPS_SIDEBAR, f, indent=1, sort_keys=True)
+    except OSError as e:
+        print(f"could not write BENCH_FLOPS.json: {e}", file=sys.stderr)
 CAMERA_FPS_BASELINE = 30.0
 LIDAR_HZ_BASELINE = 10.0  # KITTI/nuScenes lidar scan rate
 V5E_PEAK_FLOPS = 197e12   # bf16 MXU peak; fp32 runs the MXU at the same
@@ -134,14 +159,26 @@ class Config:
 
     def warmup(self):
         tok = jnp.float32(0.0)
-        for _ in range(2):
-            tok = self.looped(tok)
-        float(tok)
+        float(self.looped(tok))
         float(self.step(jnp.float32(0.0)))
+        # FLOP count: the sidecar (BENCH_FLOPS.json, keyed by metric)
+        # spares the cost_analysis retrace+compile (~10-30 s/config of
+        # pure warmup bill) on every run after the first; a config
+        # whose flops change (model edit) just needs the sidecar entry
+        # deleted — or delete the file to re-derive everything
+        cached = _FLOPS_SIDEBAR.get(self.metric)
+        if cached:
+            self.flops_per_call = float(cached)
+            return
         try:
             cost = self.step.lower(jnp.float32(0.0)).compile().cost_analysis()
             if cost and cost.get("flops"):
                 self.flops_per_call = float(cost["flops"])
+                _FLOPS_SIDEBAR[self.metric] = self.flops_per_call
+                # persist per-config: a timeout mid-warmup (the exact
+                # failure this cache targets) must not lose the
+                # entries already derived
+                _save_flops_sidecar()
         except Exception:
             pass  # cost analysis is best-effort over the tunnel
 
@@ -394,9 +431,9 @@ def make_second_sparse() -> Config:
 def measure_serving(
     rtt_ms: float,
     duration_s: float = 60.0,
-    clients: int = 32,
+    clients: int = 16,
     max_batch: int = 8,
-    max_merge: int = 32,
+    max_merge: int = 16,
     input_hw: tuple = (512, 512),
 ) -> list:
     """Serving-path benchmark (VERDICT r2 #3): N concurrent gRPC
@@ -506,6 +543,9 @@ def measure_serving(
     batching = BatchingChannel(
         inner, max_batch=max_batch, timeout_us=3000,
         max_merge=max_merge, pad_to_buckets=True,
+        # ~4% of a measured ~0.6 s batch: converts the closed-loop
+        # clients' staggered-arrival fragments into full merges
+        merge_hold_us=25_000,
     )
     server = InferenceServer(
         repo, batching, address="127.0.0.1:0", max_workers=clients + 8
@@ -887,6 +927,7 @@ def main() -> None:
 
     _emit_row(configs[0].result(rtt), primary=True)
     _write_local()
+    _save_flops_sidecar()
 
     # serving stage is strictly best-effort after the contract rows:
     # fresh it precompiles every merge size (minutes over the tunnel),

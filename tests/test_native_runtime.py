@@ -487,3 +487,40 @@ def test_oversized_request_passes_through_unpadded():
     channel.close()
     assert resp.outputs["y"].shape == (5, 4)
     assert inner.batch_sizes == [5]
+
+
+def test_merge_hold_coalesces_staggered_burst():
+    """merge_hold_us: a burst whose arrivals straggle past the first
+    dispatch opportunity coalesces into one device batch instead of
+    shipping a fragment (the hold re-waits its remaining window after
+    each arrival notify, so early wakeups don't end it)."""
+    inner = _SlowEchoChannel(delay_s=0.05)
+    channel = BatchingChannel(
+        inner, max_batch=1, timeout_us=100, use_native=False,
+        pipeline_depth=1, max_merge=8, merge_hold_us=150_000,
+    )
+    n = 6
+    results = [None] * n
+
+    def call(i):
+        time.sleep(0.01 * i)  # staggered arrivals, ~50 ms span
+        results[i] = channel.do_inference(
+            InferRequest(model_name="m",
+                         inputs={"x": np.full((1, 4), float(i), np.float32)})
+        )
+
+    threads = [threading.Thread(target=call, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10.0)
+    channel.close()
+    for i, r in enumerate(results):
+        np.testing.assert_array_equal(r.outputs["y"],
+                                      np.full((1, 4), i + 1.0, np.float32))
+    # admission released them one-by-one (max_batch=1, 100 us window);
+    # without the hold the first dispatch ships b1 — with it, the
+    # whole stagger span fits in one batch (2 allowed for scheduling
+    # slop on a loaded CI host)
+    assert len(inner.batch_sizes) <= 2, inner.batch_sizes
+    assert max(inner.batch_sizes) >= n - 1, inner.batch_sizes

@@ -87,7 +87,11 @@ def test_prometheus_exporter_serves_histograms():
     body = urllib.request.urlopen(
         f"http://127.0.0.1:{port}/metrics", timeout=10
     ).read().decode()
-    assert "test_ns_infer_yolo_latency_seconds_count 2.0" in body
+    # one family, stage as a LABEL (groupable in PromQL after rate())
+    assert (
+        'test_ns_stage_latency_seconds_count{stage="infer_yolo"} 2.0'
+        in body
+    )
     assert 'le="0.005"' in body
 
 
@@ -127,7 +131,10 @@ def test_server_metrics_port_records_model_latency():
         body = urllib.request.urlopen(
             f"http://127.0.0.1:{mport}/metrics", timeout=10
         ).read().decode()
-        assert "tpu_serving_infer_addone_latency_seconds_count 1.0" in body
+        assert (
+            'tpu_serving_stage_latency_seconds_count'
+            '{stage="infer_addone"} 1.0' in body
+        )
     finally:
         server.stop()
 

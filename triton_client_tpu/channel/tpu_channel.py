@@ -117,14 +117,22 @@ class TPUChannel(BaseChannel):
             # Shard batch-leading arrays over the data axis when the
             # batch divides; otherwise replicate (single-frame path).
             arr = np.asarray(arr)
-            # Cast to the declared wire dtype unconditionally (not gated
-            # on validate): a stray float64/int64 would otherwise
-            # silently trigger one retrace per dtype.
+            # Dtype policy (round 4 — this line was the serving-path
+            # bottleneck): a stray float64/int64 must still be cast so
+            # it can't trigger one retrace per dtype, but casting a
+            # NARROWER wire dtype up to the spec on the HOST inflates
+            # the host->device transfer (uint8 camera frames -> FP32 is
+            # 4x the bytes; on the r4 rig that one cast tripled serving
+            # batch latency). Narrow inputs upload as-is — every
+            # in-tree pipeline widens on device, where the cast fuses
+            # into the program for free.
             try:
                 want = model.spec.input_by_name(name).np_dtype()
-                if arr.dtype != want:
+                if arr.dtype != want and (
+                    np.dtype(want).itemsize <= arr.dtype.itemsize
+                ):
                     arr = arr.astype(want)
-            except (KeyError, ValueError):
+            except (KeyError, ValueError, TypeError):
                 pass  # undeclared/BF16 inputs pass through as-is
             use = (
                 sharding

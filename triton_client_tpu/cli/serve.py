@@ -51,6 +51,12 @@ def main(argv=None) -> None:
         "(Triton preferred_batch_size role)",
     )
     p.add_argument(
+        "--merge-hold-us", type=int, default=0,
+        help="hold a dispatch up to this long when the queue is "
+        "shallow, letting a client burst coalesce instead of shipping "
+        "a fragment (0 = strictly eager)",
+    )
+    p.add_argument(
         "--pad-buckets", action="store_true",
         help="pad each device batch to the next power of two so XLA "
         "compiles log2(max-merge)+1 batch shapes instead of every size",
@@ -109,6 +115,7 @@ def build_server(args):
             # (tests/test_serve_cli.py) and may predate these knobs
             max_merge=getattr(args, "max_merge", None),
             pad_to_buckets=getattr(args, "pad_buckets", False),
+            merge_hold_us=getattr(args, "merge_hold_us", 0),
         )
         print(
             f"micro-batching: max_batch={args.max_batch} "

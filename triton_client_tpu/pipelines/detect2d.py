@@ -103,9 +103,15 @@ class Detect2DPipeline:
         dets = jnp.where(valid[..., None], dets, 0.0)
         return dets, valid
 
-    def infer(self, frames: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
-        """frames: (B, H, W, 3) or (H, W, 3) uint8/float RGB. Returns
-        ((B, max_det, 6), (B, max_det)) numpy; batch dim added if absent."""
+    def infer(self, frames) -> tuple[np.ndarray, np.ndarray]:
+        """frames: (B, H, W, 3) or (H, W, 3) uint8/float RGB — numpy OR
+        an already-device jax array (TPUChannel stages inputs on the
+        mesh; jnp.asarray below is then a no-op, so the serving path
+        pays ONE upload, not a device->host->device bounce). Returns
+        ((B, max_det, 6), (B, max_det)) numpy; batch dim added if
+        absent."""
+        if not hasattr(frames, "ndim"):  # lists from host callers
+            frames = np.asarray(frames)
         squeeze = frames.ndim == 3
         if squeeze:
             frames = frames[None]
@@ -123,7 +129,10 @@ class Detect2DPipeline:
         if self.config.head_style == "scored":
 
             def fn(inputs):
-                dets, valid = self.infer(np.asarray(inputs["images"]))
+                # no np.asarray on the input: a device array from
+                # TPUChannel must flow through without the
+                # device->host->device bounce (see infer)
+                dets, valid = self.infer(inputs["images"])
                 return {
                     "boxes": dets[..., :4],
                     "scores": dets[..., 4],
@@ -134,7 +143,7 @@ class Detect2DPipeline:
         else:
 
             def fn(inputs):
-                dets, valid = self.infer(np.asarray(inputs["images"]))
+                dets, valid = self.infer(inputs["images"])
                 return {"detections": dets, "valid": valid}
 
         return fn

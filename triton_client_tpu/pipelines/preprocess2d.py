@@ -62,14 +62,17 @@ class Preprocess2DPipeline:
             )
         return x
 
-    def infer(self, frames: np.ndarray) -> np.ndarray:
+    def infer(self, frames) -> np.ndarray:
+        if not hasattr(frames, "ndim"):
+            frames = np.asarray(frames)
         if frames.ndim == 3:
             frames = frames[None]
         return np.asarray(self._jit(jnp.asarray(frames)))
 
     def infer_fn(self) -> Callable:
         def fn(inputs):
-            return {"preprocessed": self.infer(np.asarray(inputs["images"]))}
+            # device arrays flow through uncoerced (no host bounce)
+            return {"preprocessed": self.infer(inputs["images"])}
 
         return fn
 

@@ -157,43 +157,47 @@ class PrometheusStageExporter:
     reference scrapes on :8002 (README.md:88-95, data/prometheus.yml).
     Import-gated like the reference's degraded-feature pattern
     (communicator/__init__.py:5-8).
+
+    One histogram FAMILY with a ``stage`` label (round 4; was one
+    metric name per stage): rate()/histogram_quantile() drop
+    ``__name__``, so name-encoded stages could not be grouped in
+    PromQL without recording rules — the label design is also how
+    Triton's own nv_inference_* metrics carry the model. The serving
+    stage label is ``infer_<model>``, matching the profiler's stage
+    naming (runtime/server.py _infer).
     """
 
     def __init__(self, port: int = 8002, namespace: str = "tpu_serving") -> None:
         import prometheus_client
 
-        self._histograms: dict[str, object] = {}
         self._lock = threading.Lock()
-        self._namespace = namespace
-        self._histogram_cls = prometheus_client.Histogram
+        try:
+            self._family = prometheus_client.Histogram(
+                f"{namespace}_stage_latency_seconds",
+                "wall-clock latency per pipeline/serving stage",
+                labelnames=("stage",),
+                buckets=_BUCKETS,
+            )
+        except ValueError:
+            # registry collision (a second exporter in-process): export
+            # nothing rather than poison the record path
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "metric family %s_stage_latency_seconds already "
+                "registered; this exporter records nothing", namespace,
+            )
+            self._family = None
         if port:
             prometheus_client.start_http_server(port)
 
     def observe(self, stage: str, seconds: float) -> None:
+        if self._family is None:
+            return
+        safe = "".join(c if c.isalnum() else "_" for c in stage)
         with self._lock:
-            h = self._histograms.get(stage)
-            if h is None:
-                safe = "".join(c if c.isalnum() else "_" for c in stage)
-                try:
-                    h = self._histogram_cls(
-                        f"{self._namespace}_{safe}_latency_seconds",
-                        f"wall-clock latency of stage '{stage}'",
-                        buckets=_BUCKETS,
-                    )
-                except ValueError:
-                    # Registry collision (two stages sanitize to one
-                    # name, or a second exporter in-process): drop this
-                    # stage's export rather than poison the record path.
-                    import logging
-
-                    logging.getLogger(__name__).warning(
-                        "metric name collision for stage %r; not exported",
-                        stage,
-                    )
-                    h = False
-                self._histograms[stage] = h
-        if h:
-            h.observe(seconds)
+            child = self._family.labels(stage=safe)
+        child.observe(seconds)
 
     def attach(self, profiler: StageProfiler) -> "PrometheusStageExporter":
         profiler.add_listener(self.observe)
