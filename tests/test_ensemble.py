@@ -612,3 +612,55 @@ class TestDeviceFusion:
         never runs)."""
         rm = self._chain(dev_repo, "always")
         rm.warmup()  # no member warmups registered -> must not raise
+
+    def test_3d_pipeline_exposes_device_fn(self):
+        """3D members are fusable too: the detect3d pipeline's device
+        form matches its wire adapter on the same padded cloud."""
+        import jax
+
+        from triton_client_tpu.models.pointpillars import PointPillarsConfig
+        from triton_client_tpu.ops.voxelize import VoxelConfig, pad_points
+        from triton_client_tpu.pipelines.detect3d import (
+            Detect3DConfig,
+            build_pointpillars_pipeline,
+        )
+
+        # tiny grid, same shape as test_pointpillars.TINY: equivalence
+        # holds at any size and the full KITTI graph costs ~26 s of CI
+        # compile for no extra coverage
+        model_cfg = PointPillarsConfig(
+            voxel=VoxelConfig(
+                point_cloud_range=(0.0, -6.4, -3.0, 12.8, 6.4, 1.0),
+                voxel_size=(0.2, 0.2, 4.0),
+                max_voxels=512,
+                max_points_per_voxel=8,
+            ),
+            backbone_layers=(1, 1, 1),
+        )
+        pipe_cfg = Detect3DConfig(
+            point_buckets=(512,), max_det=16, pre_max=64
+        )
+        pipeline, _, _ = build_pointpillars_pipeline(
+            jax.random.PRNGKey(0), model_cfg=model_cfg, config=pipe_cfg
+        )
+        rng = np.random.default_rng(0)
+        pts = np.stack(
+            [
+                rng.uniform(0, 12.8, 512), rng.uniform(-6.4, 6.4, 512),
+                rng.uniform(-2, 0.5, 512), rng.uniform(0, 1, 512),
+            ],
+            axis=1,
+        ).astype(np.float32)
+        # SMALLEST bucket: equivalence holds at any size, and the
+        # full 131k-point graph costs ~26 s of CI compile for nothing
+        padded, m = pad_points(pts, min(pipe_cfg.point_buckets))
+        inputs = {"points": padded, "num_points": m}
+        wire = pipeline.infer_fn()(inputs)
+        dev = jax.jit(pipeline.device_fn())(inputs)
+        np.testing.assert_allclose(
+            np.asarray(wire["detections"], np.float32),
+            np.asarray(dev["detections"], np.float32), rtol=1e-5, atol=1e-5,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(wire["valid"]), np.asarray(dev["valid"])
+        )

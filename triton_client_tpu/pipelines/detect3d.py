@@ -223,6 +223,21 @@ class Detect3DPipeline:
 
         return fn
 
+    def device_fn(self):
+        """Jit-traceable form (runtime/ensemble.py fused DAGs): same
+        padded static contract as infer_fn, composed via the unjitted
+        pipeline so a parent ensemble's single XLA program inlines it —
+        e.g. an aggregation/compensation step chained into a 3D
+        detector keeps the padded cloud in HBM between members."""
+
+        def fn(inputs):
+            dets, valid = self._pipeline(
+                inputs["points"], inputs["num_points"]
+            )
+            return {"detections": dets, "valid": valid}
+
+        return fn
+
 
 def _detect3d_spec(
     cfg: Detect3DConfig, model_cfg, extra: dict | None = None
