@@ -493,11 +493,26 @@ def measure_serving(
     occ_lock = threading.Lock()
     inner_infer = inner.do_inference
 
+    device_call_s = []  # per-device-call wall (stall forensics)
+    window_t0 = [0.0]   # calls STARTED before the current window are
+                        # not its forensics: a wire-mode stall that
+                        # finishes inside the shm window must not be
+                        # attributed to shm (run_pool's straggler join
+                        # means in-window stalls do land before the
+                        # row is built; only a stall outliving the
+                        # join deadline escapes the row entirely)
+
     def tapped(req):
         b = int(np.shape(req.inputs["images"])[0])
         with occ_lock:
             occupancy[b] += 1
-        return inner_infer(req)
+        t0 = time.perf_counter()
+        try:
+            return inner_infer(req)
+        finally:
+            with occ_lock:
+                if t0 >= window_t0[0]:
+                    device_call_s.append(time.perf_counter() - t0)
 
     inner.do_inference = tapped
 
@@ -574,6 +589,8 @@ def measure_serving(
             # timed window starts here: drop warm-phase accounting
             with occ_lock:
                 occupancy.clear()
+                device_call_s.clear()
+                window_t0[0] = time.perf_counter()
             stats0.update(batching.stats())
 
         res = run_pool(
@@ -636,6 +653,17 @@ def measure_serving(
             "batch_occupancy": {
                 str(k): occupancy[k] for k in sorted(occupancy)
             },
+            # stall forensics: the tunnel intermittently freezes a
+            # device call for minutes (r3: 200-550 s warmups in bad
+            # phases); a window with max >> median is environment-
+            # stalled and its fps is not a framework number
+            "max_device_call_s": (
+                round(max(device_call_s), 2) if device_call_s else None
+            ),
+            "p50_device_call_s": (
+                round(float(np.percentile(device_call_s, 50)), 2)
+                if device_call_s else None
+            ),
         }
         if total == 0:
             row["degraded"] = (
