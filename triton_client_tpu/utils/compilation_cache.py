@@ -24,17 +24,31 @@ _DEFAULT_DIR = pathlib.Path(__file__).resolve().parents[2] / ".jax_cache"
 def enable_persistent_cache(cache_dir: str | os.PathLike | None = None) -> str:
     """Point jax at the repo-local persistent compilation cache.
 
-    Safe to call more than once and before or after backend init; honors
-    an explicit ``JAX_COMPILATION_CACHE_DIR`` from the environment over
-    the repo default. Returns the directory used.
+    Safe to call more than once and before or after backend init;
+    honors an explicit ``JAX_COMPILATION_CACHE_DIR`` from the
+    environment over the repo default. Returns the directory used —
+    or ``""`` when skipped: on CPU-selected platforms the default
+    cache is NOT enabled (compiles are seconds there, and XLA:CPU AOT
+    reloading is picky about machine-feature flags — observed
+    'prefer-no-gather not supported ... could lead to SIGILL'
+    warnings reloading this same box's own artifacts). An explicit
+    ``cache_dir`` argument or env var is an opt-in and wins anyway.
     """
     import jax
 
-    path = str(
-        cache_dir
-        or os.environ.get("JAX_COMPILATION_CACHE_DIR")
-        or _DEFAULT_DIR
+    explicit = cache_dir or os.environ.get("JAX_COMPILATION_CACHE_DIR")
+    # platform read WITHOUT initializing the backend (default_backend()
+    # would commit the platform choice and break callers that select
+    # cpu after this returns)
+    selected = (
+        getattr(jax.config, "jax_platforms", None)
+        or os.environ.get("JAX_PLATFORMS")
+        or ""
     )
+    if not explicit and selected.split(",")[0] == "cpu":
+        return ""
+
+    path = str(explicit or _DEFAULT_DIR)
     pathlib.Path(path).mkdir(parents=True, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", path)
     # default thresholds skip sub-second / small entries; over the
