@@ -765,6 +765,7 @@ def warmup_with_retries(c, drop, attempts: int = 3, backoff_s: float = 5.0):
 WARMUP_EST_S = {
     "yolov5n": 90.0, "yolov5n_bf16": 69.0, "yolov5n_mxu": 79.0,
     "yolov5n_mxu_bf16": 82.0, "yolov5n_b64": 244.0,
+    "yolov5n_b64_mxu_bf16": 250.0,
     "pointpillars": 50.0, "pointpillars_uniform": 48.0,
     "second_iou": 46.0, "second_sparse005": 154.0, "centerpoint": 44.0,
 }
@@ -772,8 +773,8 @@ WARMUP_EST_S = {
 # shared with the SIGTERM flush: rows already emitted, live configs,
 # measured rtt, accumulated results for BENCH_LOCAL.json
 _STATE = {
-    "configs": [], "emitted": set(), "rtt": 0.0, "results": [],
-    "nms_check": None,
+    "configs": [], "provisional": [], "emitted": set(), "rtt": 0.0,
+    "results": [], "nms_check": None,
 }
 
 
@@ -806,7 +807,7 @@ def _flush_rows_on_term(signum, frame):
     against the interrupted main thread) and exit."""
     try:
         configs = _STATE["configs"]
-        for c in configs:
+        for c in configs + _STATE["provisional"]:
             if c.metric in _STATE["emitted"] or len(c.trial_ms) < 3:
                 continue
             try:
@@ -851,10 +852,19 @@ def main() -> None:
         ("pointpillars_uniform",
          lambda: make_pointpillars(structured=False)),
         ("second_sparse005", make_second_sparse),
-        # max-throughput config: batch amortizes the small-channel
-        # convs' fixed overhead; b8 stays primary for continuity
+        # max-throughput configs: batch amortizes the small-channel
+        # convs' fixed overhead; b8 stays primary for continuity. The
+        # mxu+bf16 b64 is the peak-per-chip claim (README): it must be
+        # driver-captured, so when the budget cannot fit the full
+        # protocol it degrades to a shortened provisional block
+        # (VERDICT r4 Weak #1) instead of shedding silently.
+        ("yolov5n_b64_mxu_bf16",
+         lambda: make_yolov5(batch=64, mxu=True, dtype=jnp.bfloat16)),
         ("yolov5n_b64", lambda: make_yolov5(batch=64)),
     ]
+    # configs whose row may be emitted from a shortened trial block
+    # when the full protocol no longer fits the budget
+    PROVISIONAL_OK = {"yolov5n_b64_mxu_bf16", "yolov5n_b64"}
 
     configs = _STATE["configs"]
 
@@ -873,7 +883,7 @@ def main() -> None:
     # recalibrates from observed actuals so a cache-warm run (compiles
     # ~20x cheaper) keeps everything.
     est_ratio = 1.0
-    for i, (label, factory) in enumerate(factories):
+    for label, factory in factories:
         planned = len(configs) + 1
         # what the rest of the run needs if this config joins: trials
         # (~1 s chip work each + tunnel jitter), latency profiles,
@@ -882,10 +892,48 @@ def main() -> None:
         # slow tunnel phase the b64 row is the right thing to shed,
         # not the serving rows
         need_after = TRIALS * planned * 1.4 + 3.0 * planned + 45.0 + 30.0
-        if i == len(factories) - 1:
+        if label in PROVISIONAL_OK:
+            # both b64 tails: admitting one must still leave the
+            # serving stage its reserve (r4's reverse-trade lesson)
             need_after += SERVING_RESERVE_S
         est = WARMUP_EST_S.get(label, 90.0) * est_ratio
         if configs and _remaining() < est + need_after:
+            # Provisional path: a config whose row matters more than
+            # protocol uniformity (the b64 peak claims) runs a
+            # SHORTENED block — warmup + 3 trials + immediate emission
+            # — if at least that fits; the row is labeled provisional
+            # so readers know it skipped the interleaved regime.
+            # the serving rows outrank BOTH b64 tails: a provisional
+            # block is admitted only when the serving reserve survives
+            short_need = est + 3 * 1.6 + 8.0 + SERVING_RESERVE_S
+            if label in PROVISIONAL_OK and _remaining() >= short_need:
+                try:
+                    c = factory()
+                    # visible to the SIGTERM flush (it runs exactly in
+                    # the budget-exhausted regime this block lives in)
+                    # but NOT in configs — the main trial loop must not
+                    # re-run a provisional config
+                    _STATE["provisional"].append(c)
+                    t0 = time.perf_counter()
+                    c.warmup()
+                    est_ratio = max(
+                        0.05,
+                        0.5 * est_ratio
+                        + 0.5 * ((time.perf_counter() - t0)
+                                 / WARMUP_EST_S.get(label, 90.0)),
+                    )
+                    for _ in range(3):
+                        c.run_trial()
+                    row = c.result(rtt, with_latency=False)
+                    row["provisional"] = (
+                        "shortened 3-trial block (budget); not "
+                        "interleaved with the other configs"
+                    )
+                    _emit_row(row, primary=False)
+                except Exception as e:
+                    print(f"{label} provisional block failed: {e}",
+                          file=sys.stderr)
+                continue
             print(
                 f"{label} warmup skipped: {_remaining():.0f}s left < "
                 f"{est:.0f}s est warmup + {need_after:.0f}s to finish",

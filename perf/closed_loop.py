@@ -136,9 +136,13 @@ def run_2d(args) -> dict:
 
 
 def run_3d(args) -> dict:
-    # workdir encodes the dataset recipe (incl. yaw distribution) so a
-    # recipe change can never silently reuse a stale cached dataset
-    work = RUNS / f"3d_n{args.n_train}x{args.n_hold}_road"
+    # workdir encodes the dataset recipe (incl. yaw distribution and
+    # sweep mode) so a recipe change can never silently reuse a stale
+    # cached dataset
+    family = args.family
+    sweeps = family == "centerpoint"
+    tag = "_sweeps" if sweeps else ""
+    work = RUNS / f"3d_{family}_n{args.n_train}x{args.n_hold}_road{tag}"
     work.mkdir(parents=True, exist_ok=True)
     log = work / "log.txt"
     train_dir, hold_dir = work / "train", work / "hold"
@@ -146,27 +150,36 @@ def run_3d(args) -> dict:
     if not (train_dir / "gt3d.jsonl").exists():
         print(f"generating {args.n_train}+{args.n_hold} scenes ...", flush=True)
         # road-like yaw: the distribution the reference's axis-aligned
-        # anchor config is designed for (KITTI traffic)
+        # anchor config is designed for (KITTI traffic). The
+        # centerpoint loop adds 5-sweep clouds with moving objects so
+        # the velocity head has observable motion to learn from.
+        extra = (
+            ", n_sweeps=5, velocity_max=3.0" if sweeps else ""
+        )
         _python(
             "from triton_client_tpu.io.synthdata import write_scene_dataset;"
             f"write_scene_dataset(r'{train_dir}', {args.n_train}, seed=0,"
-            " yaw_mode='road');"
+            f" yaw_mode='road'{extra});"
             f"write_scene_dataset(r'{hold_dir}', {args.n_hold}, seed=1,"
-            " yaw_mode='road')",
+            f" yaw_mode='road'{extra})",
             "cpu", log,
         )
 
     repo = work / "repo"
-    print(f"training pointpillars {args.steps} steps b{args.batch} "
+    config_arg = ""
+    if family == "centerpoint":
+        config_arg = ", '--config', r'data/kitti_centerpoint.yaml'"
+    print(f"training {family} {args.steps} steps b{args.batch} "
           f"on {args.device} ...", flush=True)
     _python(
         "from triton_client_tpu.cli.train import main; main("
-        f"['--family', 'pointpillars',"
+        f"['--family', '{family}',"
         f" '-i', r'{train_dir / 'clouds'}', '--gt', r'{train_dir / 'gt3d.jsonl'}',"
         f" '-b', '{args.batch}', '--steps', '{args.steps}', '--lr', '{args.lr}',"
         f" '--lr-final', '{args.lr_final}', '--points', '22000',"
         f" '--checkpoint-dir', r'{work / 'ckpts'}', '--save-every', '500',"
-        f" '--export', r'{repo}', '-m', 'loop3d', '--log-every', '50'])",
+        f" '--export', r'{repo}', '-m', 'loop3d', '--log-every', '50'"
+        f"{config_arg}])",
         args.device, log,
     )
 
@@ -179,9 +192,9 @@ def run_3d(args) -> dict:
         + "])",
         args.device, log,
     )
-    return {
+    out = {
         "loop": "3d",
-        "model": "pointpillars",
+        "model": family,
         "steps": args.steps,
         "vfe": args.vfe or "default",
         "holdout_frames": report["eval"]["frames"],
@@ -193,6 +206,20 @@ def run_3d(args) -> dict:
         "target_map50": 0.7,
         "pass": report["eval"]["map50"] >= 0.7,
     }
+    if sweeps:
+        # END-TO-END velocity proof: decode the served model over the
+        # holdout sweeps, match peaks to GT centers, compare |v_err|
+        # against the predict-zero baseline |v_gt|
+        vel = _python_json(
+            "from perf.velocity_probe import main; main("
+            f"[r'{repo}', r'{hold_dir}'])",
+            args.device, log,
+        )
+        out["vel_mae"] = vel["vel_mae"]
+        out["vel_baseline_mae"] = vel["baseline_mae"]
+        out["vel_matched"] = vel["matched"]
+        out["vel_pass"] = vel["vel_mae"] < 0.5 * vel["baseline_mae"]
+    return out
 
 
 def main() -> None:
@@ -211,6 +238,10 @@ def main() -> None:
     p.add_argument("--n-hold", type=int, default=100)
     p.add_argument("--device", default="tpu", choices=("tpu", "cpu"))
     p.add_argument("--vfe", default="", help="3d: vfe mode override")
+    p.add_argument("--family", default="pointpillars",
+                   choices=("pointpillars", "second_iou", "centerpoint"),
+                   help="3d loop family; centerpoint adds 5-sweep "
+                   "moving-object scenes + the velocity probe")
     args = p.parse_args()
     run = run_2d if args.loop == "2d" else run_3d
     result = run(args)

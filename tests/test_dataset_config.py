@@ -114,3 +114,22 @@ def test_anchor_class_unknown_key_fails(tmp_path):
     p.write_text(yaml.safe_dump(doc))
     with pytest.raises(KeyError, match="bottomz"):
         detect3d_from_yaml(str(p))
+
+
+def test_kitti_pointpillars_capacity_yaml():
+    """examples/pointpillar_wide serves the measured pp_capacity
+    configuration (perf/profile_capacity3d.py: 6.8x FLOPs, -18%
+    throughput) — the yaml must reproduce those hyperparameters on the
+    unchanged reference grid."""
+    name, model_cfg, pipe_cfg = detect3d_from_yaml(
+        "data/kitti_pointpillars_capacity.yaml"
+    )
+    assert name == "pointpillars"
+    assert model_cfg.vfe_filters == 128
+    assert model_cfg.backbone_filters == (128, 256, 512)
+    assert model_cfg.upsample_filters == (256, 256, 256)
+    assert model_cfg.backbone_layers == (6, 10, 10)
+    # grid unchanged vs the base entry (same anchors/range)
+    base_name, base_cfg, _ = detect3d_from_yaml("data/kitti_pointpillars.yaml")
+    assert model_cfg.voxel == base_cfg.voxel
+    assert model_cfg.anchor_classes == base_cfg.anchor_classes

@@ -107,3 +107,37 @@ def test_3d_writer_roundtrip(tmp_path):
         assert gts is not None and gts.shape[1] == 8
     with open(gt_path) as f:
         assert len(f.readlines()) == 3
+
+
+def test_synth_scene_sweeps_velocity_observable():
+    """n_sweeps mode: (N, 5) clouds with a Δt channel, (n, 10) boxes
+    with velocity, and the motion is IN the data — an object's sweep-k
+    returns center at c - v*k*dt (what the velocity head learns from)."""
+    import numpy as np
+
+    from triton_client_tpu.io.synthdata import synth_scene_frame
+
+    rng = np.random.default_rng(3)
+    pts, boxes = synth_scene_frame(
+        rng, n_objects=1, n_clutter=0, n_sweeps=5, sweep_dt=0.1,
+        velocity_max=4.0, min_points=40,
+    )
+    assert pts.shape[1] == 5 and boxes.shape == (1, 10)
+    cx, cy = boxes[0, :2]
+    vx, vy = boxes[0, 8:10]
+    for k in range(5):
+        sweep = pts[np.isclose(pts[:, 4], k * 0.1)]
+        assert len(sweep) >= 4
+        # mean of surface samples ~ displaced center (loose: surface
+        # sampling is not centered exactly, but displacement dominates)
+        np.testing.assert_allclose(
+            sweep[:, 0].mean(), cx - vx * k * 0.1, atol=1.5
+        )
+        np.testing.assert_allclose(
+            sweep[:, 1].mean(), cy - vy * k * 0.1, atol=1.5
+        )
+    # static mode is unchanged: 4 columns, 8-column boxes
+    p2, b2 = synth_scene_frame(
+        np.random.default_rng(1), n_objects=1, n_clutter=10, min_points=10
+    )
+    assert p2.shape[1] == 4 and b2.shape[1] == 8

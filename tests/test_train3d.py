@@ -309,3 +309,134 @@ def test_second_train_step_loss_decreases():
     assert losses[-1] < losses[0]
     assert np.isfinite(losses).all()
     assert "iou" in metrics
+
+
+# --- CenterPoint (anchor-free) training ------------------------------------
+
+from triton_client_tpu.models.centerpoint import (  # noqa: E402
+    CenterPointConfig,
+    init_centerpoint,
+)
+
+TINY_CENTER = CenterPointConfig(
+    voxel=VoxelConfig(
+        point_cloud_range=(0.0, -8.0, -5.0, 16.0, 8.0, 3.0),
+        voxel_size=(0.5, 0.5, 8.0),
+        max_voxels=512,
+        max_points_per_voxel=8,
+    ),
+    vfe_filters=16,
+    backbone_layers=(1, 1),
+    backbone_strides=(1, 2),
+    backbone_filters=(16, 16),
+    upsample_strides=(1, 2),
+    upsample_filters=(16, 16),
+    class_names=("Car", "Pedestrian", "Cyclist"),
+    head_width=16,
+    max_objects=8,
+)
+
+
+def test_centerpoint_targets_peak_and_reg():
+    cfg = train3d.CenterLossConfig()
+    gt = np.full((4, 10), -1, np.float32)
+    # Car at (5.3, 2.2): cell (cx, cy) = (10.6, 20.4) at stride 1
+    gt[0] = [5.3, 2.2, -0.5, 3.9, 1.6, 1.56, 0.3, 0.0, 1.5, -0.5]
+    heat, flat, reg, valid = train3d.centerpoint_targets(
+        jnp.asarray(gt), TINY_CENTER, cfg
+    )
+    h, w = TINY_CENTER.head_hw
+    assert heat.shape == (h, w, 3)
+    assert bool(valid[0]) and not bool(valid[1])
+    # unit peak exactly at the GT's center cell, class channel 0
+    assert np.isclose(float(heat[20, 10, 0]), 1.0)
+    assert float(heat[:, :, 1].max()) == 0.0  # no Pedestrian GT
+    assert int(flat[0]) == 20 * w + 10
+    np.testing.assert_allclose(
+        np.asarray(reg[0, :2]), [0.6, 0.4], atol=1e-5
+    )  # sub-cell offset
+    np.testing.assert_allclose(float(reg[0, 2]), -0.5)  # height
+    np.testing.assert_allclose(
+        np.asarray(reg[0, 3:6]), np.log([3.9, 1.6, 1.56]), atol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(reg[0, 6:8]), [np.sin(0.3), np.cos(0.3)], atol=1e-6
+    )
+    np.testing.assert_allclose(np.asarray(reg[0, 8:10]), [1.5, -0.5])
+    # neighbors decay but stay positive under the gaussian
+    assert 0.0 < float(heat[20, 11, 0]) < 1.0
+
+
+def test_center3d_step_loss_and_velocity_decrease():
+    import optax
+
+    from triton_client_tpu.io.synthdata import synth_scene_frame
+    from triton_client_tpu.parallel.mesh import MeshConfig, make_mesh
+
+    model, variables = init_centerpoint(jax.random.PRNGKey(0), TINY_CENTER)
+    mesh = make_mesh(MeshConfig(data=1))
+    optimizer = optax.adam(3e-3)
+    state = train3d.init_train3d_state(model, variables, optimizer, mesh)
+    step = train3d.make_center3d_step(
+        model, optimizer, train3d.CenterLossConfig(), mesh
+    )
+
+    rng = np.random.default_rng(9)
+    points, boxes = synth_scene_frame(
+        rng,
+        pc_range=(0.0, -8.0, -3.0, 16.0, 8.0, 1.0),
+        n_objects=2,
+        n_clutter=300,
+        min_points=10,
+    )
+    budget = 2048
+    pts = np.zeros((1, budget, 4), np.float32)
+    m = min(len(points), budget)
+    pts[0, :m] = points[:m]
+    counts = np.asarray([m], np.int32)
+    tgt = np.full((1, 8, 10), -1, np.float32)
+    vels = rng.uniform(-2, 2, (len(boxes), 2)).astype(np.float32)
+    tgt[0, : len(boxes), :8] = boxes
+    tgt[0, : len(boxes), 8:10] = vels
+
+    losses, vel_l1s = [], []
+    for _ in range(45):
+        state, metrics = step(
+            state, jnp.asarray(pts), jnp.asarray(counts), jnp.asarray(tgt)
+        )
+        losses.append(float(metrics["loss"]))
+        vel_l1s.append(float(metrics["vel_l1"]))
+    assert losses[-1] < losses[0]
+    assert np.isfinite(losses).all()
+    # the velocity head must actually learn (gradient flows end to
+    # end), not just stay differentiable. The curve is noisy while the
+    # heatmap loss dominates early (probed: 0.36 -> ~0.1-0.25 by step
+    # 35-45), so gate on the best recent value, not the last sample.
+    assert min(vel_l1s[-10:]) < 0.5 * vel_l1s[0]
+
+
+def test_center3d_step_accepts_targets_without_velocity():
+    import optax
+
+    from triton_client_tpu.parallel.mesh import MeshConfig, make_mesh
+
+    model, variables = init_centerpoint(jax.random.PRNGKey(1), TINY_CENTER)
+    mesh = make_mesh(MeshConfig(data=1))
+    optimizer = optax.adam(1e-3)
+    state = train3d.init_train3d_state(model, variables, optimizer, mesh)
+    step = train3d.make_center3d_step(
+        model, optimizer, train3d.CenterLossConfig(), mesh
+    )
+    pts = np.zeros((1, 256, 4), np.float32)
+    pts[0, :, 0] = np.random.default_rng(0).uniform(0, 16, 256)
+    pts[0, :, 1] = np.random.default_rng(1).uniform(-8, 8, 256)
+    tgt = np.full((1, 4, 8), -1, np.float32)
+    tgt[0, 0] = [5.0, 0.0, -0.5, 3.9, 1.6, 1.56, 0.0, 0.0]
+    state, metrics = step(
+        state,
+        jnp.asarray(pts),
+        jnp.asarray(np.asarray([256], np.int32)),
+        jnp.asarray(tgt),
+    )
+    assert np.isfinite(float(metrics["loss"]))
+    assert "vel_l1" not in metrics

@@ -125,7 +125,10 @@ class TPUChannel(BaseChannel):
             # 4x the bytes; on the r4 rig that one cast tripled serving
             # batch latency). Narrow inputs upload as-is — every
             # in-tree pipeline widens on device, where the cast fuses
-            # into the program for free.
+            # into the program for free. This is a REGISTRATION
+            # CONTRACT (see runtime/repository.py RegisteredModel):
+            # pipelines must widen internally and each distinct narrow
+            # dtype traces its own executable.
             try:
                 want = model.spec.input_by_name(name).np_dtype()
                 if arr.dtype != want and (

@@ -23,10 +23,13 @@ import numpy as np
 def parse_args(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--family", default="yolov5",
-                   choices=("yolov5", "pointpillars", "second_iou"),
-                   help="model family: yolov5 (2D, image sources) or "
+                   choices=("yolov5", "pointpillars", "second_iou",
+                            "centerpoint"),
+                   help="model family: yolov5 (2D, image sources), "
                    "pointpillars / second_iou (3D anchor-head "
-                   "detectors, .npy cloud sources + gt3d JSONL)")
+                   "detectors, .npy cloud sources + gt3d JSONL), or "
+                   "centerpoint (anchor-free center-heatmap 3D; gt3d "
+                   "rows may carry optional [vx, vy] velocity columns)")
     p.add_argument("-i", "--input", default="synthetic:64",
                    help="image dir | synthetic[:N[:HxW]] (2D); .npy cloud "
                    "dir (3D)")
@@ -166,6 +169,8 @@ def _load_batches3d(
     rows: int | None = None,
     stride: int | None = None,
     pc_range: tuple | None = None,
+    point_cols: int = 4,
+    target_cols: int = 8,
 ):
     """3D sibling of _load_batches: yield (points (rows, P, 4) padded,
     counts (rows,), targets (rows, T, 8) [box7, cls] padded with -1)
@@ -220,15 +225,25 @@ def _load_batches3d(
     stride = args.batch_size if stride is None else stride
     while True:
         pairs = list(itertools.islice(stream, stride))[row0 : row0 + rows]
-        points = np.zeros((rows, budget, 4), np.float32)
+        # both widths are the MODEL's contract, not the data's: clouds
+        # narrower than point_cols zero-pad the missing Δt channel
+        # (mirroring the serving path, pipelines/detect3d.py infer);
+        # sniffing widths from data would mis-lock on an unlucky first
+        # window and silently drop velocity labels / crash the VFE
+        points = np.zeros((rows, budget, point_cols), np.float32)
         counts = np.zeros((rows,), np.int32)
-        targets = np.full((rows, t_max, 8), -1.0, np.float32)
+        targets = np.full((rows, t_max, target_cols), -1.0, np.float32)
         for i, (pts, boxes) in enumerate(pairs):
             m = min(len(pts), budget)
-            points[i, :m] = pts[:m, :4]
+            w = min(pts.shape[1], point_cols)
+            points[i, :m, :w] = pts[:m, :w]
             counts[i] = m
             k = min(len(boxes), t_max)
-            targets[i, :k] = boxes[:k]
+            if k:
+                bw = min(boxes.shape[1], target_cols)
+                targets[i, :k, :bw] = boxes[:k, :bw]
+                if bw < target_cols:
+                    targets[i, :k, bw:] = 0.0  # missing vel -> 0
         yield points, counts, targets
 
 
@@ -289,13 +304,15 @@ def main(argv=None) -> None:
         optimizer = optax.adam(schedule)
     else:
         optimizer = optax.adam(args.lr)
-    family3d = args.family in ("pointpillars", "second_iou")
+    family3d = args.family in ("pointpillars", "second_iou", "centerpoint")
     if family3d and args.mxu_opt:
         raise SystemExit("--mxu-opt is yolov5-only")
     if family3d:
         from triton_client_tpu.parallel.train3d import (
+            CenterLossConfig,
             Loss3DConfig,
             init_train3d_state,
+            make_center3d_step,
             make_train3d_step,
         )
 
@@ -318,6 +335,12 @@ def main(argv=None) -> None:
                     "sparse config after import"
                 )
             model, variables = init_second(jax.random.PRNGKey(0), model_cfg)
+        elif args.family == "centerpoint":
+            from triton_client_tpu.models.centerpoint import init_centerpoint
+
+            model, variables = init_centerpoint(
+                jax.random.PRNGKey(0), model_cfg
+            )
         else:
             from triton_client_tpu.models.pointpillars import init_pointpillars
 
@@ -328,9 +351,20 @@ def main(argv=None) -> None:
         def init_state(vars_):
             return init_train3d_state(model, vars_, optimizer, mesh)
 
-        step_fn = make_train3d_step(model, optimizer, Loss3DConfig(), mesh)
+        if args.family == "centerpoint":
+            step_fn = make_center3d_step(
+                model, optimizer, CenterLossConfig(), mesh
+            )
+        else:
+            step_fn = make_train3d_step(
+                model, optimizer, Loss3DConfig(), mesh
+            )
         loader = functools.partial(
-            _load_batches3d, pc_range=model.cfg.voxel.point_cloud_range
+            _load_batches3d,
+            pc_range=model.cfg.voxel.point_cloud_range,
+            point_cols=model.cfg.voxel.point_features,
+            # centerpoint targets carry [vx, vy]; 8-col gt rows pad 0
+            target_cols=10 if args.family == "centerpoint" else 8,
         )
         export_doc = {"family": args.family}
         if args.config:

@@ -345,7 +345,16 @@ class Detection3DEvaluator(DetectionEvaluator):
         ground_truths: np.ndarray,  # (m, 8), cls 0-indexed
     ) -> FrameStats:
         pred_boxes = np.asarray(pred_boxes, np.float64).reshape(-1, 7)
-        gts = np.asarray(ground_truths, np.float64).reshape(-1, 8)
+        gts = np.asarray(ground_truths, np.float64)
+        # 10-column rows carry [vx, vy] velocity labels (multi-sweep
+        # gt3d, io/synthdata.py) — the box metric ignores them
+        if gts.ndim != 2 or gts.size == 0:
+            gts = gts.reshape(-1, 8)
+        if gts.shape[1] not in (8, 10):
+            raise ValueError(
+                f"ground_truths must have 8 or 10 columns, got {gts.shape[1]}"
+            )
+        gts = gts[:, :8]
         pred_cls = np.asarray(pred_labels, np.int64) - 1
         if len(pred_boxes) and len(gts):
             iou = rotated_bev_iou_np(

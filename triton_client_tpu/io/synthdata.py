@@ -193,6 +193,9 @@ def synth_scene_frame(
     yaw: bool = True,
     yaw_mode: str = "uniform",
     min_points: int = 20,
+    n_sweeps: int = 0,
+    sweep_dt: float = 0.05,
+    velocity_max: float = 0.0,
 ) -> tuple[np.ndarray, np.ndarray]:
     """One labeled scan: (points (N, 4) [x, y, z, intensity] float32,
     boxes (n, 8) [cx, cy, cz, dx, dy, dz, yaw, cls] float32).
@@ -208,8 +211,20 @@ def synth_scene_frame(
     0.15), axis in {0, pi/2, pi, -pi/2}) + 20% uniform — KITTI-like
     traffic, the distribution the reference's axis-aligned anchor
     config (data/pointpillar.yaml:118-142 rotations [0, 1.57]) is
-    designed for."""
+    designed for.
+
+    ``n_sweeps > 0`` switches to the nuScenes multi-sweep contract the
+    served CenterPoint consumes (reference clients/preprocess/
+    voxelize.py:13-24 feeds 10-sweep clouds): points gain a Δt channel
+    (-> (N, 5)), each object gets a ground-plane velocity drawn from
+    [-velocity_max, velocity_max]² whose MOTION IS IN THE DATA — sweep
+    k's returns sample the object displaced to c - v·k·dt — and boxes
+    gain [vx, vy] (-> (n, 10)). Velocity is thereby observable from a
+    single stacked cloud (the motion streak), which is exactly what the
+    CenterPoint velocity head trains on."""
     x0, y0, _z0, x1, y1, _z1 = pc_range
+    sweeps = max(1, n_sweeps)
+    cols = 5 if n_sweeps > 0 else 4
     ground = np.stack(
         [
             rng.uniform(x0, x1, n_clutter),
@@ -219,6 +234,13 @@ def synth_scene_frame(
         ],
         axis=1,
     ).astype(np.float32)
+    if cols == 5:
+        # static clutter appears in every sweep at the same place;
+        # spread its Δt uniformly over the sweep window
+        ts = rng.integers(0, sweeps, n_clutter) * sweep_dt
+        ground = np.concatenate(
+            [ground, ts[:, None].astype(np.float32)], axis=1
+        )
     parts = [ground]
     boxes: list[list[float]] = []
     for _ in range(n_objects):
@@ -235,6 +257,10 @@ def synth_scene_frame(
                 ry = float(axis + rng.normal(0.0, 0.15))
             else:
                 ry = float(rng.uniform(-np.pi, np.pi))
+            vx = vy = 0.0
+            if velocity_max > 0:
+                vx = float(rng.uniform(-velocity_max, velocity_max))
+                vy = float(rng.uniform(-velocity_max, velocity_max))
             r = float(np.hypot(cx, cy))
             n_pts = int(60_000 / max(r, 5) ** 2)
             if n_pts < min_points:
@@ -248,27 +274,39 @@ def synth_scene_frame(
             )
             if too_close:
                 continue
-            face = rng.integers(0, 3, n_pts)
-            u = rng.uniform(-0.5, 0.5, (n_pts, 3))
-            u[face == 0, 0] = np.sign(u[face == 0, 0]) * 0.5
-            u[face == 1, 1] = np.sign(u[face == 1, 1]) * 0.5
-            u[face == 2, 2] = 0.5  # top surface
-            lx, ly, lz = u[:, 0] * dx, u[:, 1] * dy, u[:, 2] * dz
-            c, s = np.cos(ry), np.sin(ry)
-            pts = np.stack(
-                [
-                    cx + lx * c - ly * s,
-                    cy + lx * s + ly * c,
+            obj_parts = []
+            for k in range(sweeps):
+                nk = max(n_pts // sweeps, 4)
+                face = rng.integers(0, 3, nk)
+                u = rng.uniform(-0.5, 0.5, (nk, 3))
+                u[face == 0, 0] = np.sign(u[face == 0, 0]) * 0.5
+                u[face == 1, 1] = np.sign(u[face == 1, 1]) * 0.5
+                u[face == 2, 2] = 0.5  # top surface
+                lx, ly, lz = u[:, 0] * dx, u[:, 1] * dy, u[:, 2] * dz
+                c, s = np.cos(ry), np.sin(ry)
+                # sweep k observed the object k·dt in the past: its
+                # center was displaced by -v·k·dt (the motion streak
+                # the velocity head reads)
+                t = k * sweep_dt
+                sweep_cols = [
+                    cx - vx * t + lx * c - ly * s,
+                    cy - vy * t + lx * s + ly * c,
                     cz + lz,
-                    rng.uniform(0, 1, n_pts),
-                ],
-                axis=1,
-            ).astype(np.float32)
-            parts.append(pts)
-            boxes.append([cx, cy, cz, dx, dy, dz, ry, float(cls)])
+                    rng.uniform(0, 1, nk),
+                ]
+                if cols == 5:
+                    sweep_cols.append(np.full(nk, t))
+                obj_parts.append(
+                    np.stack(sweep_cols, axis=1).astype(np.float32)
+                )
+            parts.extend(obj_parts)
+            row = [cx, cy, cz, dx, dy, dz, ry, float(cls)]
+            if cols == 5:
+                row += [vx, vy]
+            boxes.append(row)
             break
     points = np.concatenate(parts)
-    return points, np.asarray(boxes, np.float32).reshape(-1, 8)
+    return points, np.asarray(boxes, np.float32).reshape(-1, 10 if cols == 5 else 8)
 
 
 def write_scene_dataset(
@@ -298,17 +336,23 @@ def write_scene_dataset(
 
 
 def load_gt3d_lookup(path: str):
-    """gt3d JSONL -> frame lookup of (n, 8) [cx, cy, cz, dx, dy, dz,
-    yaw, cls] arrays (the 3D sibling of cli/common.load_gt_lookup)."""
+    """gt3d JSONL -> frame lookup of (n, 8|10) [cx, cy, cz, dx, dy, dz,
+    yaw, cls(, vx, vy)] arrays (the 3D sibling of
+    cli/common.load_gt_lookup); 10-column rows carry the multi-sweep
+    velocity labels."""
     table: dict[int, np.ndarray] = {}
     with open(path) as f:
         for line in f:
             if not line.strip():
                 continue
             row = json.loads(line)
-            table[int(row["frame_id"])] = np.asarray(
-                row["boxes"], np.float64
-            ).reshape(-1, 8)
+            arr = np.asarray(row["boxes"], np.float64)
+            arr = arr.reshape(len(row["boxes"]), -1) if len(row["boxes"]) else arr.reshape(0, 8)
+            if arr.shape[1] not in (8, 10):
+                raise ValueError(
+                    f"gt3d rows must have 8 or 10 columns, got {arr.shape[1]}"
+                )
+            table[int(row["frame_id"])] = arr
 
     def lookup(frame):
         return table.get(frame.frame_id)

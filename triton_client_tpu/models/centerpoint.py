@@ -173,6 +173,32 @@ class CenterPoint(nn.Module):
         canvas = scatter_max_canvas(x, vid, valid, (ny, nx))
         return self.head(self.backbone(canvas[None], train), train)
 
+    def from_points_batch(
+        self,
+        points: jnp.ndarray,  # (B, P, F>=4) padded clouds
+        counts: jnp.ndarray,  # (B,) real rows per cloud
+        train: bool = False,
+    ) -> dict[str, jnp.ndarray]:
+        """Batched sort-free path for TRAINING (round 5 — makes the
+        velocity head trainable end-to-end): per-sample pillar
+        assignment (pure vmap), ONE flat VFE encode over all B*P rows
+        so BatchNorm sees the whole batch's point population (a
+        per-sample vmap would trip flax's broadcast-state mutation —
+        the same constraint as PointPillars.from_points_batch), then
+        per-sample canvas scatter. Multi-sweep training clouds carry
+        the Δt channel as feature 5 exactly like serving."""
+        require_pillar_grid(self.cfg.voxel.grid_size)
+        nx, ny, _ = self.cfg.voxel.grid_size
+        feats, vid, valid, _cnt = jax.vmap(
+            lambda p, c: augment_points(p, c, self.cfg.voxel)
+        )(points, counts)
+        b, n, f = feats.shape
+        x = self.vfe.encode(feats.reshape(b * n, f), train).reshape(b, n, -1)
+        canvas = jax.vmap(
+            lambda xx, vv, va: scatter_max_canvas(xx, vv, va, (ny, nx))
+        )(x, vid, valid)
+        return self.head(self.backbone(canvas, train), train)
+
     def decode(self, heads: dict[str, jnp.ndarray]) -> dict[str, jnp.ndarray]:
         """Center decode -> flat predictions shaped like the anchor
         models' contract so extract_boxes_3d / nms_bev apply unchanged:

@@ -36,7 +36,13 @@ _ACTS = {
 
 
 class ConvBnAct(nn.Module):
-    """Conv2D + BatchNorm + activation — the universal YOLO block."""
+    """Conv2D + BatchNorm + activation — the universal YOLO block.
+
+    ``eps`` follows the source framework so imported running stats
+    reproduce the upstream forward exactly: ultralytics YOLOv5 uses
+    BatchNorm2d(eps=1e-3) (the default here); pytorch-YOLOv4 keeps
+    torch's 1e-5 default (yolov4.py overrides per-model).
+    """
 
     features: int
     kernel: int = 1
@@ -44,6 +50,7 @@ class ConvBnAct(nn.Module):
     padding: int | None = None
     groups: int = 1
     act: bool | str = True
+    eps: float = 1e-3
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -62,7 +69,7 @@ class ConvBnAct(nn.Module):
         x = nn.BatchNorm(
             use_running_average=not train,
             momentum=0.97,
-            epsilon=1e-3,
+            epsilon=self.eps,
             dtype=self.dtype,
             name="bn",
         )(x)

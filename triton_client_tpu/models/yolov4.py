@@ -16,13 +16,21 @@ tensor so YOLOv4 drops into the same Detect2DPipeline as YOLOv5.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Sequence
 
 import jax.numpy as jnp
 import numpy as np
 from flax import linen as nn
 
-from triton_client_tpu.models.layers import ConvBnAct, make_divisible
+from triton_client_tpu.models.layers import make_divisible
+from triton_client_tpu.models.layers import ConvBnAct as _ConvBnAct
+
+# pytorch-YOLOv4 (the checkpoint lineage the reference's ONNX artifact
+# exports from, examples/YOLOv4/config.pbtxt:2) keeps torch's BN
+# default eps=1e-5 — every block in this file must match it or imported
+# running stats reproduce a slightly different function per layer.
+ConvBnAct = functools.partial(_ConvBnAct, eps=1e-5)
 from triton_client_tpu.ops.yolo_decode import decode_yolo_grid
 
 # Upstream YOLOv4 anchors (pixels at 512 input), masks [0:3, 3:6, 6:9]

@@ -290,6 +290,224 @@ def detection3d_loss(
     return loss, metrics
 
 
+# ---------------------------------------------------------------------------
+# CenterPoint (anchor-free) training — center heatmap + offset/size/
+# rot/velocity regression, the det3d CenterHead loss semantics as
+# fixed-shape JAX (round 5: proves the velocity head end-to-end).
+# Reference anchor: the served det3d CenterPoint lineage
+# (clients/preprocess/voxelize.py:13-24,
+# data/nusc_centerpoint_pp_02voxel_two_pfn_10sweep.py).
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class CenterLossConfig:
+    hm_w: float = 1.0
+    reg_w: float = 0.25       # det3d loc weight
+    vel_code_w: float = 0.2   # nuScenes code_weights for vx, vy
+    focal_alpha: float = 2.0  # CenterNet penalty-reduced focal
+    focal_beta: float = 4.0
+    min_radius: float = 2.0
+    gaussian_overlap: float = 0.1
+
+
+def gaussian_radius(dims_cells: jnp.ndarray, min_overlap: float) -> jnp.ndarray:
+    """CenterNet's gaussian radius, EXACTLY as det3d/CenterPoint ship it
+    (det3d core/utils/center_utils.py): all three quadratic roots use
+    the upstream (b + sqrt(disc)) / 2 form — including the well-known
+    quirk that r2/r3 skip the 1/(2a) divisor. Matching the shipped
+    formula, not the textbook roots, is deliberate: the loss semantics
+    being reproduced are det3d's (dims in feature cells, (..., 2))."""
+    h, w = dims_cells[..., 0], dims_cells[..., 1]
+    a1 = 1.0
+    b1 = h + w
+    c1 = w * h * (1 - min_overlap) / (1 + min_overlap)
+    r1 = (b1 + jnp.sqrt(jnp.maximum(b1**2 - 4 * a1 * c1, 0.0))) / 2
+    a2 = 4.0
+    b2 = 2 * (h + w)
+    c2 = (1 - min_overlap) * w * h
+    r2 = (b2 + jnp.sqrt(jnp.maximum(b2**2 - 4 * a2 * c2, 0.0))) / 2
+    a3 = 4 * min_overlap
+    b3 = -2 * min_overlap * (h + w)
+    c3 = (min_overlap - 1) * w * h
+    r3 = (b3 + jnp.sqrt(jnp.maximum(b3**2 - 4 * a3 * c3, 0.0))) / 2
+    return jnp.minimum(jnp.minimum(r1, r2), r3)
+
+
+def centerpoint_targets(
+    gt: jnp.ndarray,  # (T, 8|10) [box7, cls(, vx, vy)], cls == -1 pad
+    model_cfg,
+    cfg: CenterLossConfig,
+):
+    """One sample's center targets: heatmap (H, W, nc) with unit peaks
+    at GT center cells under clamped-radius gaussians (rendered by a
+    lax.scan elementwise-max, so the (T, H, W, nc) tensor never
+    materializes), plus per-GT regression rows gathered at those
+    cells."""
+    h, w = model_cfg.head_hw
+    nc = model_cfg.num_classes
+    stride = model_cfg.head_stride
+    vs = model_cfg.voxel.voxel_size
+    r0 = model_cfg.voxel.point_cloud_range
+
+    cls = gt[:, 7].astype(jnp.int32)
+    cx = (gt[:, 0] - r0[0]) / (vs[0] * stride)
+    cy = (gt[:, 1] - r0[1]) / (vs[1] * stride)
+    ix = jnp.clip(jnp.floor(cx).astype(jnp.int32), 0, w - 1)
+    iy = jnp.clip(jnp.floor(cy).astype(jnp.int32), 0, h - 1)
+    inside = (cx >= 0) & (cx < w) & (cy >= 0) & (cy < h)
+    valid = (cls >= 0) & inside
+
+    dims_cells = jnp.stack(
+        [gt[:, 4] / (vs[1] * stride), gt[:, 3] / (vs[0] * stride)], axis=-1
+    )
+    radius = jnp.maximum(
+        gaussian_radius(dims_cells, cfg.gaussian_overlap), cfg.min_radius
+    )
+    sigma = (2 * radius + 1) / 6.0
+
+    ys = jnp.arange(h, dtype=jnp.float32)[:, None]
+    xs = jnp.arange(w, dtype=jnp.float32)[None, :]
+
+    def render(heat, row):
+        rix, riy, rsig, rcls, rvalid = row
+        g = jnp.exp(
+            -((xs - rix) ** 2 + (ys - riy) ** 2) / (2.0 * rsig**2)
+        ) * rvalid
+        return jnp.maximum(
+            heat, g[:, :, None] * jax.nn.one_hot(rcls.astype(jnp.int32), nc)
+        ), None
+
+    heat, _ = jax.lax.scan(
+        render,
+        jnp.zeros((h, w, nc), jnp.float32),
+        (
+            ix.astype(jnp.float32),
+            iy.astype(jnp.float32),
+            sigma,
+            cls,
+            valid.astype(jnp.float32),
+        ),
+    )
+
+    vel = gt[:, 8:10] if gt.shape[1] >= 10 else jnp.zeros((gt.shape[0], 2))
+    reg = jnp.concatenate(
+        [
+            (cx - ix)[:, None], (cy - iy)[:, None],        # offset
+            gt[:, 2:3],                                    # height
+            jnp.log(jnp.maximum(gt[:, 3:6], 1e-3)),        # size
+            jnp.sin(gt[:, 6:7]), jnp.cos(gt[:, 6:7]),      # rot
+            vel,                                           # velocity
+        ],
+        axis=-1,
+    )  # (T, 10)
+    flat = iy * w + ix
+    return heat, flat, reg, valid
+
+
+def centerpoint_loss(
+    heads: dict[str, jnp.ndarray],
+    targets: jnp.ndarray,  # (B, T, 8|10)
+    model_cfg,
+    cfg: CenterLossConfig,
+) -> tuple[jnp.ndarray, dict[str, jnp.ndarray]]:
+    """Penalty-reduced focal on the class heatmap + masked L1 on the
+    center-gathered regression bundle (velocity channels down-weighted
+    by the nuScenes code weights). When targets carry no velocity
+    columns the vel loss is exactly zero (head still differentiable)."""
+    has_vel = targets.shape[-1] >= 10 and "vel" in heads
+    heat_t, flat, reg_t, valid = jax.vmap(
+        lambda g: centerpoint_targets(g, model_cfg, cfg)
+    )(targets)
+
+    logits = heads["heatmap"]
+    p = jnp.clip(jax.nn.sigmoid(logits), 1e-6, 1 - 1e-6)
+    pos = heat_t >= 0.9999
+    pos_loss = -((1 - p) ** cfg.focal_alpha) * jnp.log(p) * pos
+    neg_loss = (
+        -((1 - heat_t) ** cfg.focal_beta)
+        * (p**cfg.focal_alpha)
+        * jnp.log(1 - p)
+        * (~pos)
+    )
+    n_pos = jnp.maximum(valid.sum(), 1).astype(jnp.float32)
+    hm_loss = (pos_loss.sum() + neg_loss.sum()) / n_pos
+
+    b, hh, ww, _ = logits.shape
+    parts = [heads["offset"], heads["height"], heads["size"], heads["rot"]]
+    if has_vel:
+        parts.append(heads["vel"])
+    pred = jnp.concatenate(parts, axis=-1).reshape(b, hh * ww, -1)
+    pred_at = jnp.take_along_axis(
+        pred, flat[..., None], axis=1
+    )  # (B, T, 8|10)
+    ch = pred_at.shape[-1]
+    code_w = jnp.concatenate(
+        [jnp.ones(8), jnp.full(2, cfg.vel_code_w)]
+    )[:ch]
+    l1 = jnp.abs(pred_at - reg_t[..., :ch]) * code_w
+    reg_loss = (l1.sum(-1) * valid).sum() / n_pos
+
+    loss = cfg.hm_w * hm_loss + cfg.reg_w * reg_loss
+    metrics = {"hm": hm_loss, "reg": reg_loss, "n_pos": n_pos, "loss": loss}
+    if has_vel:
+        vel_l1 = jnp.abs(pred_at[..., 8:10] - reg_t[..., 8:10])
+        metrics["vel_l1"] = (
+            vel_l1.mean(-1) * valid
+        ).sum() / n_pos  # un-weighted, for monitoring
+    return loss, metrics
+
+
+def make_center3d_step(
+    model,
+    optimizer: optax.GradientTransformation,
+    loss_cfg: CenterLossConfig,
+    mesh: Mesh,
+):
+    """CenterPoint training step: (state, points (B, P, F), counts (B,),
+    targets (B, T, 8|10)) -> (state, metrics), batch sharded over the
+    data axis — the anchor-free sibling of make_train3d_step."""
+
+    def step_fn(state: TrainState, points, counts, targets):
+        def loss_fn(params):
+            variables = {**state.variables, "params": params}
+            heads, mutated = model.apply(
+                variables,
+                points,
+                counts,
+                train=True,
+                mutable=["batch_stats"],
+                method=type(model).from_points_batch,
+            )
+            loss, metrics = centerpoint_loss(
+                heads, targets, model.cfg, loss_cfg
+            )
+            return loss, (metrics, mutated["batch_stats"])
+
+        grads, (metrics, new_stats) = jax.grad(loss_fn, has_aux=True)(
+            state.variables["params"]
+        )
+        updates, new_opt = optimizer.update(
+            grads, state.opt_state, state.variables["params"]
+        )
+        new_params = optax.apply_updates(state.variables["params"], updates)
+        return (
+            TrainState(
+                variables={"params": new_params, "batch_stats": new_stats},
+                opt_state=new_opt,
+                step=state.step + 1,
+            ),
+            metrics,
+        )
+
+    data = NamedSharding(mesh, P(DATA_AXIS))
+    return jax.jit(
+        step_fn,
+        in_shardings=(None, data, data, data),
+        donate_argnums=(0,),
+    )
+
+
 def make_train3d_step(
     model: PointPillars,
     optimizer: optax.GradientTransformation,

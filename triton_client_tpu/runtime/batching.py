@@ -212,8 +212,26 @@ class BatchingChannel(BaseChannel):
 
     def _dispatch_loop(self) -> None:
         while True:
-            self._inflight.acquire()
-            group = None
+            try:
+                if self._dispatch_once():
+                    return
+            except Exception:
+                # The dispatcher is the only thread that forms batches:
+                # an escaped error here would stall every later
+                # do_inference forever on future.result(). Log and keep
+                # serving; the failed slot's futures were already
+                # failed by _dispatch_once.
+                log.exception("dispatcher slot failed; dispatcher continues")
+
+    def _dispatch_once(self) -> bool:
+        """One dispatcher slot: acquire a permit, form a group, submit.
+        Returns True when the loop should exit (close() requested and
+        the staging deque is drained). Any unexpected error fails the
+        formed group's futures, releases the permit, and re-raises for
+        the loop to log — the thread itself survives."""
+        self._inflight.acquire()
+        group = None
+        try:
             with self._ready_cv:
                 while not self._ready and not self._dispatch_stop:
                     self._ready_cv.wait(timeout=0.1)
@@ -259,10 +277,10 @@ class BatchingChannel(BaseChannel):
                     self._merge_occupancy[frames] += 1
                 elif self._dispatch_stop:
                     self._inflight.release()
-                    return
+                    return True
             if group is None:
                 self._inflight.release()
-                continue
+                return False
 
             def run(g=group):
                 try:
@@ -283,6 +301,14 @@ class BatchingChannel(BaseChannel):
                 for _, _, _, future in group:
                     if not future.done():
                         future.set_exception(e)
+            return False
+        except Exception as e:
+            self._inflight.release()
+            if group:
+                for _, _, _, future in group:
+                    if not future.done():
+                        future.set_exception(e)
+            raise
 
     def _form_group_locked(self):
         """Pop the head item plus every queued same-key item that fits
@@ -409,7 +435,20 @@ class BatchingChannel(BaseChannel):
         with self._ready_cv:
             self._dispatch_stop = True
             self._ready_cv.notify_all()
-        self._dispatcher.join(timeout=30.0)
+        # The executor must not shut down while the dispatcher can
+        # still submit (futures would get 'cannot schedule new
+        # futures' instead of executing), and this rig's tunnel stalls
+        # run minutes — so loop-join with a progress warning instead of
+        # abandoning the thread after a fixed timeout.
+        waited = 0.0
+        while self._dispatcher.is_alive():
+            self._dispatcher.join(timeout=30.0)
+            if self._dispatcher.is_alive():
+                waited += 30.0
+                log.warning(
+                    "batcher close(): dispatcher still draining after "
+                    "%.0fs (device call in flight?)", waited,
+                )
         # after the dispatcher stops, drain in-flight groups so every
         # admitted future resolves before close() returns
         self._exec.shutdown(wait=True)

@@ -57,7 +57,12 @@ def _torch_importers() -> dict[str, Callable]:
 
     return {
         "yolov5": importers.load_yolov5,
+        "yolov4": importers.load_yolov4,
+        "retinanet": importers.load_retinanet,
+        "fcos": importers.load_fcos,
         "pointpillars": importers.load_pointpillars,
+        "second_iou": importers.load_second,
+        "centerpoint": importers.load_centerpoint,
     }
 
 

@@ -185,15 +185,35 @@ _PP_HEADS = {
 }
 
 
-def pointpillars_torch_key(path: tuple[str, ...]) -> str:
-    """flax PointPillars path -> OpenPCDet state_dict key.
+def _bev_backbone_key(name: str, leaf: str, prefix: str) -> str | None:
+    """BEVBackbone flax child name -> '<prefix>.blocks/deblocks.…' key.
 
-    OpenPCDet's BaseBEVBackbone builds each block as
-    Sequential(ZeroPad2d, Conv2d, BN, ReLU, [Conv2d, BN, ReLU] * L)
-    (pcdet/models/backbones_2d/base_bev_backbone.py), so the down conv
-    sits at index 1, its BN at 2, and layer li's conv/BN at 4+3*li /
-    5+3*li. Deblocks are Sequential(ConvTranspose2d, BN, ReLU).
+    The second.pytorch-lineage BEV backbone (OpenPCDet BaseBEVBackbone
+    under ``backbone_2d``, det3d RPN under ``neck`` — both pcdet/models/
+    backbones_2d/base_bev_backbone.py shape) builds each block as
+    Sequential(ZeroPad2d, Conv2d, BN, ReLU, [Conv2d, BN, ReLU] * L), so
+    the down conv sits at index 1, its BN at 2, and layer li's conv/BN
+    at 4+3*li / 5+3*li. Deblocks are Sequential(ConvTranspose2d, BN,
+    ReLU). Returns None for a non-backbone name.
     """
+    m = _PP_BLOCK_DOWN.match(name)
+    if m:
+        b, is_bn = m.group(1), bool(m.group(2))
+        return f"{prefix}.blocks.{b}.{2 if is_bn else 1}.{leaf}"
+    m = _PP_BLOCK_CONV.match(name)
+    if m:
+        b, kind, li = m.group(1), m.group(2), int(m.group(3))
+        idx = 4 + 3 * li if kind == "conv" else 5 + 3 * li
+        return f"{prefix}.blocks.{b}.{idx}.{leaf}"
+    m = _PP_UP.match(name)
+    if m:
+        b, is_bn = m.group(1), bool(m.group(2))
+        return f"{prefix}.deblocks.{b}.{1 if is_bn else 0}.{leaf}"
+    return None
+
+
+def pointpillars_torch_key(path: tuple[str, ...]) -> str:
+    """flax PointPillars path -> OpenPCDet state_dict key."""
     parts = [p for p in path if p not in ("params", "batch_stats")]
     head, *rest = parts
     leaf = default_name_map((parts[-1],))
@@ -204,20 +224,9 @@ def pointpillars_torch_key(path: tuple[str, ...]) -> str:
     if head in _PP_HEADS:
         return f"{_PP_HEADS[head]}.{leaf}"
     if head == "backbone":
-        name = rest[0]
-        m = _PP_BLOCK_DOWN.match(name)
-        if m:
-            b, is_bn = m.group(1), bool(m.group(2))
-            return f"backbone_2d.blocks.{b}.{2 if is_bn else 1}.{leaf}"
-        m = _PP_BLOCK_CONV.match(name)
-        if m:
-            b, kind, li = m.group(1), m.group(2), int(m.group(3))
-            idx = 4 + 3 * li if kind == "conv" else 5 + 3 * li
-            return f"backbone_2d.blocks.{b}.{idx}.{leaf}"
-        m = _PP_UP.match(name)
-        if m:
-            b, is_bn = m.group(1), bool(m.group(2))
-            return f"backbone_2d.deblocks.{b}.{1 if is_bn else 0}.{leaf}"
+        key = _bev_backbone_key(rest[0], leaf, "backbone_2d")
+        if key:
+            return key
     raise KeyError(f"unmapped PointPillars path: {path}")
 
 
@@ -234,6 +243,397 @@ def load_pointpillars(path_or_state: Any, variables: Mapping, strict: bool = Tru
         name_map=pointpillars_torch_key,
         strict=strict,
         transposed_conv=_pp_is_transposed_conv,
+    )
+
+
+# --- SECOND-IoU (OpenPCDet naming, examples/second_iou/1/model.py:96-117) ---
+
+_SECOND_HEADS = {
+    "cls_head": "dense_head.conv_cls",
+    "box_head": "dense_head.conv_box",
+    "dir_head": "dense_head.conv_dir_cls",
+    # The per-anchor IoU-quality conv — this framework's dense re-design
+    # of the reference's SECONDHead ROI IoU branch (examples/second_iou/
+    # 1/second_iou.yaml:92 IOU_FC) — imports under OpenPCDet's
+    # conv-head naming convention.
+    "iou_head": "dense_head.conv_iou",
+}
+_MIDDLE_CONV = re.compile(r"^conv(\d+)$")
+_MIDDLE_BN = re.compile(r"^bn(\d+)$")
+
+
+def second_torch_key(path: tuple[str, ...]) -> str:
+    """flax SECONDIoU path -> OpenPCDet state_dict key.
+
+    The middle encoder maps onto spconv's SparseSequential index
+    convention (pcdet backbone_3d: each stage is Sequential(conv, BN,
+    ReLU) -> conv at .0, BN at .1): stage si lives at
+    ``backbone_3d.conv{si}``. MeanVFE is parameter-free on both sides.
+    """
+    parts = [p for p in path if p not in ("params", "batch_stats")]
+    head, *rest = parts
+    leaf = default_name_map((parts[-1],))
+    if head == "middle":
+        name = rest[0]
+        m = _MIDDLE_CONV.match(name)
+        if m and len(rest) == 1:
+            # sparse middle: the (k^3, cin, cout) gather-conv param IS
+            # the leaf (no nn.Conv wrapper)
+            return f"backbone_3d.conv{m.group(1)}.0.weight"
+        if m:
+            return f"backbone_3d.conv{m.group(1)}.0.{leaf}"
+        m = _MIDDLE_BN.match(name)
+        if m:
+            return f"backbone_3d.conv{m.group(1)}.1.{leaf}"
+    if head in _SECOND_HEADS:
+        return f"{_SECOND_HEADS[head]}.{leaf}"
+    if head == "backbone":
+        key = _bev_backbone_key(rest[0], leaf, "backbone_2d")
+        if key:
+            return key
+    raise KeyError(f"unmapped SECOND path: {path}")
+
+
+def load_second(path_or_state: Any, variables: Mapping, strict: bool = True) -> dict:
+    """OpenPCDet-named SECOND(-IoU) checkpoint -> flax variables.
+
+    Works for both middle encoders: the dense stages import Conv3d
+    kernels directly (OIDHW -> DHWIO); the SPARSE middle's (27, cin,
+    cout) gather weights are the row-major reshape of the same 3^3
+    kernel (ops/sparse_conv.py kernel_offsets ordering, parity pinned
+    by tests/test_sparse_conv.py) — so ONE trained checkpoint serves
+    either encoder. A 2^3 stride kernel has no 3^3 source and raises.
+    """
+    state = _as_state_dict(path_or_state)
+
+    def transform(key_path, nat, leaf):
+        key_path = tuple(
+            p for p in key_path if p not in ("params", "batch_stats")
+        )
+        target = tuple(leaf.shape)
+        if nat.shape == target:
+            return nat
+        if (
+            len(key_path) >= 2
+            and key_path[-2] == "middle"
+            and _MIDDLE_CONV.match(key_path[-1])
+            and nat.ndim == 5
+            and len(target) == 3
+        ):
+            # torch Conv3d (out, in, kd, kh, kw) -> (kd, kh, kw, in,
+            # out) -> row-major (k^3, in, out): exactly the
+            # kernel_offsets(3) enumeration the sparse conv gathers by.
+            w = nat.transpose(2, 3, 4, 1, 0)
+            k3 = w.shape[0] * w.shape[1] * w.shape[2]
+            if (k3,) + w.shape[3:] != target:
+                raise ValueError(
+                    f"sparse middle stage {key_path[-1]} expects "
+                    f"{target} (a {target[0]}^(1/3)-kernel); the "
+                    f"checkpoint's {nat.shape} kernel does not reshape "
+                    "to it — stride_kernel=2 stages have no upstream "
+                    "3^3 source, import a dense-template checkpoint "
+                    "or serve with sparse_stride_kernel=3"
+                )
+            return np.ascontiguousarray(w.reshape(target))
+        raise ValueError(
+            f"second import: {'.'.join(key_path)} {nat.shape} does not "
+            f"fit the template {target} (wrong grid/filters/classes?)"
+        )
+
+    return convert_state_dict(
+        state, variables, name_map=second_torch_key, strict=strict,
+        transposed_conv=_pp_is_transposed_conv, leaf_transform=transform,
+    )
+
+
+# --- CenterPoint (det3d naming, data/nusc_centerpoint_pp_02voxel_...py) ---
+
+_CP_BRANCH = {
+    "heatmap": "hm",
+    "offset": "reg",
+    "height": "height",
+    "size": "dim",
+    "rot": "rot",
+    "vel": "vel",
+}
+
+
+def centerpoint_torch_key(path: tuple[str, ...]) -> str:
+    """flax CenterPoint path -> det3d state_dict key.
+
+    det3d's pillar CenterPoint names its trunk ``reader`` (the
+    PillarFeatureNet), ``neck`` (the second.pytorch RPN — same
+    Sequential layout as OpenPCDet's BEV backbone) and ``bbox_head``
+    (CenterHead: shared_conv Sequential + per-task SepHead branches
+    hm/reg/height/dim/rot/vel, each a Sequential of convs). This
+    framework's head is a single-task re-design (one shared 3x3 + 1x1
+    branches), so branches sit at ``bbox_head.tasks.0.<name>.0``.
+    """
+    parts = [p for p in path if p not in ("params", "batch_stats")]
+    head, *rest = parts
+    leaf = default_name_map((parts[-1],))
+    if head == "vfe":
+        sub = "linear" if rest[0] == "linear" else "norm"
+        return f"reader.pfn_layers.0.{sub}.{leaf}"
+    if head == "backbone":
+        key = _bev_backbone_key(rest[0], leaf, "neck")
+        if key:
+            return key
+    if head == "head":
+        name = rest[0]
+        if name == "shared":
+            return f"bbox_head.shared_conv.0.{leaf}"
+        if name == "shared_bn":
+            return f"bbox_head.shared_conv.1.{leaf}"
+        if name in _CP_BRANCH:
+            return f"bbox_head.tasks.0.{_CP_BRANCH[name]}.0.{leaf}"
+    raise KeyError(f"unmapped CenterPoint path: {path}")
+
+
+def load_centerpoint(
+    path_or_state: Any, variables: Mapping, strict: bool = True
+) -> dict:
+    """det3d-named CenterPoint checkpoint -> flax variables.
+
+    det3d's shared_conv uses Conv2d(bias=True) + BN; this framework's
+    shared conv is bias-free (the BN immediately consumes any bias).
+    An upstream bias is folded EXACTLY into the imported BN running
+    mean — BN((conv+b) - m) == BN(conv - (m-b)) — so the forward is
+    unchanged rather than silently dropping the term.
+    """
+    state = dict(_as_state_dict(path_or_state))
+    bias_key = "bbox_head.shared_conv.0.bias"
+    mean_key = "bbox_head.shared_conv.1.running_mean"
+    if bias_key in state:
+        if mean_key not in state:
+            raise KeyError(
+                f"{bias_key} present but {mean_key} missing — cannot "
+                "fold the shared-conv bias into BN"
+            )
+        state[mean_key] = np.asarray(state[mean_key]) - np.asarray(state[bias_key])
+        del state[bias_key]
+        log.info("folded %s into %s (bias-free shared conv)", bias_key, mean_key)
+    return convert_state_dict(
+        state, variables, name_map=centerpoint_torch_key, strict=strict,
+        transposed_conv=_pp_is_transposed_conv,
+    )
+
+
+# --- RetinaNet / FCOS (detectron2 naming, the reference's libtorch
+#     export lineage: examples/RetinaNet_detectron/config.pbtxt:2) -----------
+
+_D2_BLOCK = re.compile(r"^s(\d+)b(\d+)$")
+_D2_CONV = {"c1": "conv1", "c2": "conv2", "c3": "conv3", "down": "shortcut"}
+_D2_LAT = re.compile(r"^lat(\d)$")
+_D2_OUT = re.compile(r"^out(\d)$")
+_D2_SUBNET = re.compile(r"^(cls|box|reg)(\d+)$")
+_D2_SCALE = re.compile(r"^scale(\d+)$")
+
+
+def detectron_torch_key(path: tuple[str, ...]) -> str:
+    """flax RetinaNet/FCOS path -> detectron2 state_dict key.
+
+    detectron2 layout (modeling/meta_arch/retinanet.py + fcos.py):
+    ``backbone.bottom_up.stem.conv1`` / ``res{2-5}.{i}.conv{1-3}`` (+
+    ``.shortcut``) with norms as ``.norm`` children,
+    ``backbone.fpn_lateral{l}`` / ``fpn_output{l}`` /
+    ``top_block.p6/p7``, and heads ``head.cls_subnet.{2i}`` /
+    ``bbox_subnet.{2i}`` (ReLU at odd indices), ``head.cls_score`` /
+    ``bbox_pred`` / ``ctrness``. Residual stride sits on conv2 — the
+    torchvision-style STRIDE_IN_1X1=False layout; caffe-style R50
+    checkpoints share key names but put stride on conv1, which a
+    state_dict cannot reveal, so that variant is out of contract.
+    FCOS per-level scales use the AdelaiDet ``head.scales.{l}.scale``
+    naming (stock detectron2 FCOS has none — see load_fcos).
+    """
+    parts = [p for p in path if p not in ("params", "batch_stats")]
+    head, *rest = parts
+    leaf = default_name_map((parts[-1],))
+    if head == "backbone":
+        name = rest[0]
+        if name == "stem":
+            base = "backbone.bottom_up.stem.conv1"
+            return f"{base}.{leaf}" if rest[1] == "conv" else f"{base}.norm.{leaf}"
+        m = _D2_BLOCK.match(name)
+        if m:
+            conv = _D2_CONV[rest[1]]
+            base = (
+                f"backbone.bottom_up.res{int(m.group(1)) + 2}."
+                f"{int(m.group(2))}.{conv}"
+            )
+            return f"{base}.{leaf}" if rest[2] == "conv" else f"{base}.norm.{leaf}"
+        m = _D2_LAT.match(name)
+        if m:
+            return f"backbone.fpn_lateral{m.group(1)}.{leaf}"
+        m = _D2_OUT.match(name)
+        if m:
+            return f"backbone.fpn_output{m.group(1)}.{leaf}"
+        if name in ("p6", "p7"):
+            return f"backbone.top_block.{name}.{leaf}"
+    if head == "head":
+        name = rest[0]
+        m = _D2_SCALE.match(name)
+        if m:
+            return f"head.scales.{m.group(1)}.scale"
+        m = _D2_SUBNET.match(name)
+        if m:
+            sub = "cls_subnet" if m.group(1) == "cls" else "bbox_subnet"
+            return f"head.{sub}.{2 * int(m.group(2))}.{leaf}"
+        if name == "cls_out":
+            return f"head.cls_score.{leaf}"
+        if name in ("box_out", "reg_out"):
+            return f"head.bbox_pred.{leaf}"
+        if name == "ctr_out":
+            return f"head.ctrness.{leaf}"
+    raise KeyError(f"unmapped detectron path: {path}")
+
+
+def load_retinanet(
+    path_or_state: Any, variables: Mapping, strict: bool = True
+) -> dict:
+    """detectron2-named RetinaNet checkpoint -> flax variables."""
+    state = {
+        k.removeprefix("model."): v
+        for k, v in _as_state_dict(path_or_state).items()
+    }
+    return convert_state_dict(
+        state, variables, name_map=detectron_torch_key, strict=strict
+    )
+
+
+def load_fcos(path_or_state: Any, variables: Mapping, strict: bool = True) -> dict:
+    """detectron2/AdelaiDet-named FCOS checkpoint -> flax variables.
+
+    Stock detectron2 FCOS predicts unscaled distances (no Scale
+    modules); AdelaiDet checkpoints carry ``head.scales.{l}.scale``.
+    Missing scales default to the neutral 1.0 — exactly stock d2's
+    function — rather than failing strict import.
+    """
+    state = dict(_as_state_dict(path_or_state))
+    state = {k.removeprefix("model."): v for k, v in state.items()}
+    params = variables.get("params", variables)
+    n_scales = sum(1 for k in params.get("head", {}) if _D2_SCALE.match(str(k)))
+    for li in range(n_scales):
+        state.setdefault(f"head.scales.{li}.scale", np.ones((1,), np.float32))
+    return convert_state_dict(
+        state, variables, name_map=detectron_torch_key, strict=strict
+    )
+
+
+# --- YOLOv4 (pytorch-YOLOv4 naming — the torch source of the ONNX the
+#     reference serves: examples/YOLOv4/config.pbtxt:2, deploy.sh) ----------
+
+# Tianxiaomo/pytorch-YOLOv4 module layout: backbone DownSample1..5
+# ('down{k}'), neck ('neek' [sic]), head. Every Conv_Bn_Activation
+# stores its layers in a ModuleList 'conv' -> conv at .conv.0, BN at
+# .conv.1. DownSample1 inlines the first CSP stage as conv1..conv8;
+# DownSample2-5 use conv1..conv5 + ResBlock ('resblock.module_list.
+# {i}.{0,1}'). The flax model's stage-local names map as:
+_V4_DOWN1 = {  # stem + stage1 (first=True) -> down1.conv{n}
+    "stem": 1, "down": 2, "split_short": 3, "split_main": 4,
+    "res0_cv1": 5, "res0_cv2": 6, "post": 7, "merge": 8,
+}
+_V4_STAGE = {  # stage2-5 locals -> down{k}.conv{n}
+    "down": 1, "split_short": 2, "split_main": 3, "post": 4, "merge": 5,
+}
+_V4_RES = re.compile(r"^res(\d+)_cv([12])$")
+_V4_TOP = {  # neck/head ConvBnActs and detect convs, in upstream order
+    "pre_spp0": "neek.conv1", "pre_spp1": "neek.conv2",
+    "pre_spp2": "neek.conv3", "post_spp0": "neek.conv5",
+    "post_spp1": "neek.conv6", "td4_up": "neek.conv7",
+    "td4_lat": "neek.conv8", "td3_up": "neek.conv14",
+    "td3_lat": "neek.conv15",
+    "head0_cv": "head.conv1", "detect0": "head.conv2",
+    "bu4_down": "head.conv3", "head1_cv": "head.conv9",
+    "detect1": "head.conv10", "bu5_down": "head.conv11",
+    "head2_cv": "head.conv17", "detect2": "head.conv18",
+}
+_V4_CONV5_BASE = {  # 1-3-1-3-1 neck blocks: _cv{i} -> base+i
+    "td4": ("neek", 9), "td3": ("neek", 16),
+    "bu4": ("head", 4), "bu5": ("head", 12),
+}
+_V4_CV = re.compile(r"^(td4|td3|bu4|bu5)_cv(\d)$")
+
+
+def yolov4_torch_key(path: tuple[str, ...]) -> str:
+    """flax YoloV4 path -> pytorch-YOLOv4 state_dict key."""
+    parts = [p for p in path if p not in ("params", "batch_stats")]
+    head, *rest = parts
+    leaf = default_name_map((parts[-1],))
+
+    def cba(mod: str, sub: str) -> str:
+        # Conv_Bn_Activation: ModuleList 'conv' -> [Conv2d, BN, act]
+        idx = 0 if sub == "conv" else 1
+        return f"{mod}.conv.{idx}.{leaf}"
+
+    if head == "stem":
+        return cba(f"down1.conv{_V4_DOWN1['stem']}", rest[0])
+    if head == "stage1":
+        name = rest[0]
+        if name in _V4_DOWN1:
+            return cba(f"down1.conv{_V4_DOWN1[name]}", rest[1])
+    elif head.startswith("stage"):
+        k, name = head[len("stage"):], rest[0]
+        if name in _V4_STAGE:
+            return cba(f"down{k}.conv{_V4_STAGE[name]}", rest[1])
+        m = _V4_RES.match(name)
+        if m:
+            i, cv = m.group(1), int(m.group(2)) - 1
+            return cba(f"down{k}.resblock.module_list.{i}.{cv}", rest[1])
+    if head == "spp":  # SPP merge conv == neek.conv4
+        return cba("neek.conv4", rest[1])
+    if head in _V4_TOP:
+        mod = _V4_TOP[head]
+        if head.startswith("detect"):  # bare Conv2d (bn=False, bias)
+            return f"{mod}.conv.0.{leaf}"
+        return cba(mod, rest[0])
+    m = _V4_CV.match(head)
+    if m:
+        mod, base = _V4_CONV5_BASE[m.group(1)]
+        return cba(f"{mod}.conv{base + int(m.group(2))}", rest[0])
+    raise KeyError(f"unmapped YOLOv4 path: {path}")
+
+
+def load_yolov4(path_or_state: Any, variables: Mapping, strict: bool = True) -> dict:
+    """pytorch-YOLOv4 checkpoint (.pth, or its ONNX export read back
+    through onnx_reader) -> flax variables.
+
+    One upstream/flax divergence needs a kernel fix-up: upstream's SPP
+    concatenates [pool13, pool9, pool5, x] (models.py Neck.forward)
+    while this model concatenates [x, pool5, pool9, pool13] — so the
+    SPP merge conv's INPUT-channel blocks import block-reversed. The
+    function is identical; only the concat bookkeeping differs.
+    """
+    state = _as_state_dict(path_or_state)
+    # torch.onnx initializer names / some forks use 'neck.'; canonical
+    # upstream spells it 'neek.'.
+    state = {
+        ("neek." + k[len("neck."):] if k.startswith("neck.") else k): v
+        for k, v in state.items()
+    }
+
+    def transform(key_path, nat, leaf):
+        key_path = tuple(
+            p for p in key_path if p not in ("params", "batch_stats")
+        )
+        target = tuple(leaf.shape)
+        if key_path[:2] == ("spp", "merge") and key_path[-1] == "kernel":
+            kh, kw, cin, cout = nat.shape
+            blocks = nat.reshape(kh, kw, 4, cin // 4, cout)
+            nat = np.ascontiguousarray(
+                blocks[:, :, ::-1].reshape(kh, kw, cin, cout)
+            )
+        if nat.shape != target:
+            raise ValueError(
+                f"yolov4 import: {'.'.join(key_path)} {nat.shape} does "
+                f"not fit the template {target} (wrong width multiple "
+                "or num_classes?)"
+            )
+        return nat
+
+    return convert_state_dict(
+        state, variables, name_map=yolov4_torch_key, strict=strict,
+        leaf_transform=transform,
     )
 
 

@@ -28,6 +28,15 @@ def _version_key(v: str):
 
 @dataclasses.dataclass
 class RegisteredModel:
+    # CONTRACT (round 4 dtype policy): infer_fn may receive inputs
+    # NARROWER than the declared wire dtype (e.g. uint8 frames against
+    # an FP32 spec) — TPUChannel deliberately skips host-side widening
+    # so the 4x-inflated host->device copy never happens
+    # (channel/tpu_channel.py). Every pipeline registered here must
+    # therefore widen/normalize INSIDE its jitted program, where the
+    # cast fuses for free, and must not trust the declared dtype of a
+    # leading input. Out-of-tree pipelines that cannot widen internally
+    # should declare the narrow dtype in their spec instead.
     spec: ModelSpec
     infer_fn: InferFn
     # Optional warmup callable (compile-ahead on register)

@@ -21,6 +21,23 @@ import pathlib
 _DEFAULT_DIR = pathlib.Path(__file__).resolve().parents[2] / ".jax_cache"
 
 
+def _accelerator_plugin_present() -> bool:
+    """True when a non-CPU jax backend could load: libtpu on the path
+    or any PJRT plugin advertised via the 'jax_plugins' entry-point
+    group / namespace package. Never imports or initializes a backend."""
+    import importlib.metadata
+    import importlib.util
+
+    try:
+        if importlib.util.find_spec("libtpu") is not None:
+            return True
+        if importlib.util.find_spec("jax_plugins") is not None:
+            return True
+        return bool(list(importlib.metadata.entry_points(group="jax_plugins")))
+    except Exception:
+        return False
+
+
 def enable_persistent_cache(cache_dir: str | os.PathLike | None = None) -> str:
     """Point jax at the repo-local persistent compilation cache.
 
@@ -45,8 +62,15 @@ def enable_persistent_cache(cache_dir: str | os.PathLike | None = None) -> str:
         or os.environ.get("JAX_PLATFORMS")
         or ""
     )
-    if not explicit and selected.split(",")[0] == "cpu":
-        return ""
+    if not explicit:
+        if selected.split(",")[0] == "cpu":
+            return ""
+        # No platform selected at all: a host with no accelerator
+        # plugin will default to CPU too — same SIGILL hazard, so the
+        # same gate applies (plugin presence checked without importing
+        # or initializing anything backend-side).
+        if not selected and not _accelerator_plugin_present():
+            return ""
 
     path = str(explicit or _DEFAULT_DIR)
     pathlib.Path(path).mkdir(parents=True, exist_ok=True)

@@ -171,6 +171,8 @@ class PrometheusStageExporter:
         import prometheus_client
 
         self._lock = threading.Lock()
+        self._label_sources: dict[str, str] = {}
+        self._warned: set[tuple[str, str]] = set()
         try:
             self._family = prometheus_client.Histogram(
                 f"{namespace}_stage_latency_seconds",
@@ -195,8 +197,24 @@ class PrometheusStageExporter:
         if self._family is None:
             return
         safe = "".join(c if c.isalnum() else "_" for c in stage)
+        collision = None
         with self._lock:
+            # two distinct stage names sanitizing to one label value
+            # ('a.b' and 'a_b') would silently merge their series —
+            # warn once per colliding PAIR (the first-seen source is
+            # kept so alternating names cannot re-trigger every call)
+            first = self._label_sources.setdefault(safe, stage)
+            if first != stage and (safe, stage) not in self._warned:
+                self._warned.add((safe, stage))
+                collision = first
             child = self._family.labels(stage=safe)
+        if collision is not None:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "stage label %r now receives both %r and %r — series "
+                "merged", safe, collision, stage,
+            )
         child.observe(seconds)
 
     def attach(self, profiler: StageProfiler) -> "PrometheusStageExporter":
