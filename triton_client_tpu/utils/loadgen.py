@@ -50,13 +50,29 @@ def run_pool(
     use_shared_memory: bool = False,
     stagger_s: float = 0.25,
     on_window_start=None,
+    mode: str = "unary",
+    inflight: int = 1,
 ) -> PoolResult:
     """Drive ``clients`` closed-loop threads for ``duration_s`` and
     return counts/latencies. ``on_window_start`` fires after the warm
     barrier, immediately before the timed window — the hook for
-    clearing server-side accounting (batcher stats, occupancy taps)."""
+    clearing server-side accounting (batcher stats, occupancy taps).
+
+    ``mode`` selects the client protocol (round 5 — puts numbers on
+    the reference's dead --streaming/--async flags, main.py:59-70):
+      * 'unary'  — one synchronous ModelInfer per iteration (default);
+      * 'stream' — ONE long-lived ModelStreamInfer session per client,
+        ``inflight`` requests pipelined inside it (latency = send ->
+        matching response; responses preserve order on a stream);
+      * 'async'  — ModelInfer call-futures with ``inflight`` in the
+        air per client (the --async --inflight N path).
+    """
     from triton_client_tpu.channel.base import InferRequest
     from triton_client_tpu.channel.grpc_channel import GRPCChannel
+
+    if mode not in ("unary", "stream", "async"):
+        raise ValueError(f"unknown pool mode {mode!r}")
+    inflight = max(1, int(inflight))
 
     served: list = []
     latencies: list = []
@@ -95,7 +111,7 @@ def run_pool(
         except threading.BrokenBarrierError:
             pass
         try:
-            if chan is not None:
+            if chan is not None and mode == "unary":
                 while not stop.is_set():
                     t0 = time.perf_counter()
                     chan.do_inference(req)
@@ -106,6 +122,48 @@ def run_pool(
                     # diluted by the post-stop drain time
                     if not stop.is_set():
                         n += 1
+            elif chan is not None and mode == "stream":
+                import queue as _q
+
+                sent: _q.Queue = _q.Queue(maxsize=inflight)
+
+                def gen():
+                    # closed-loop through the stream: the bounded queue
+                    # caps in-flight requests; put blocks until a
+                    # response frees a slot. The timestamp is taken
+                    # AFTER the slot is granted, immediately before the
+                    # request goes to gRPC — timing the backpressure
+                    # wait would double-count the previous in-flight
+                    # request's latency
+                    while not stop.is_set():
+                        cell = [0.0]
+                        sent.put(cell)
+                        cell[0] = time.perf_counter()
+                        yield req
+
+                for _resp in chan.infer_stream(gen(), stream_timeout_s=deadline_s):
+                    t0 = sent.get()[0]
+                    mine.append((time.perf_counter() - t0) * 1e3)
+                    if not stop.is_set():
+                        n += 1
+            elif chan is not None:  # async futures, inflight in the air
+                from collections import deque
+
+                air: deque = deque()
+                while not stop.is_set():
+                    while len(air) < inflight and not stop.is_set():
+                        air.append(
+                            (time.perf_counter(), chan.do_inference_async(req))
+                        )
+                    if not air:  # stop raced the fill loop
+                        break
+                    t0, fut = air.popleft()
+                    fut.result()
+                    mine.append((time.perf_counter() - t0) * 1e3)
+                    if not stop.is_set():
+                        n += 1
+                while air:  # drain, uncounted
+                    air.popleft()[1].result()
         except Exception as e:  # a dying client must still report
             with lock:
                 errors.append(repr(e))
