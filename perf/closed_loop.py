@@ -136,12 +136,12 @@ def run_2d(args) -> dict:
 
 
 def run_3d(args) -> dict:
-    # workdir encodes the dataset recipe (incl. yaw distribution and
-    # sweep mode) so a recipe change can never silently reuse a stale
-    # cached dataset
+    # workdir encodes the dataset recipe (incl. yaw distribution,
+    # sweep count, and front bias) so a recipe change can never
+    # silently reuse a stale cached dataset
     family = args.family
     sweeps = family == "centerpoint"
-    tag = "_sweeps" if sweeps else ""
+    tag = "_sweeps10fb65" if sweeps else ""
     work = RUNS / f"3d_{family}_n{args.n_train}x{args.n_hold}_road{tag}"
     work.mkdir(parents=True, exist_ok=True)
     log = work / "log.txt"
@@ -151,10 +151,14 @@ def run_3d(args) -> dict:
         print(f"generating {args.n_train}+{args.n_hold} scenes ...", flush=True)
         # road-like yaw: the distribution the reference's axis-aligned
         # anchor config is designed for (KITTI traffic). The
-        # centerpoint loop adds 5-sweep clouds with moving objects so
-        # the velocity head has observable motion to learn from.
+        # centerpoint loop matches the nuScenes 10-sweep contract
+        # (nusc_centerpoint_pp_02voxel_two_pfn_10sweep.py) with moving
+        # objects, plus front-biased returns so full-circle yaw is
+        # observable (see synth_scene_frame).
         extra = (
-            ", n_sweeps=5, velocity_max=3.0" if sweeps else ""
+            ", n_sweeps=10, velocity_max=3.0, front_bias=0.65"
+            if sweeps
+            else ""
         )
         _python(
             "from triton_client_tpu.io.synthdata import write_scene_dataset;"

@@ -141,3 +141,32 @@ def test_synth_scene_sweeps_velocity_observable():
         np.random.default_rng(1), n_objects=1, n_clutter=10, min_points=10
     )
     assert p2.shape[1] == 4 and b2.shape[1] == 8
+
+
+def test_synth_scene_front_bias_breaks_pi_symmetry():
+    """front_bias > 0: an object's returns skew toward its +x (heading)
+    half IN THE OBJECT FRAME, so yaw is observable modulo 2π — a
+    perfect symmetric cuboid is π-rotation-invariant, which makes the
+    CenterPoint (sin, cos) regression target unlearnable on principle
+    (the L1 median of the {±(sinθ, cosθ)} mixture is (0, 0))."""
+    import numpy as np
+
+    from triton_client_tpu.io.synthdata import synth_scene_frame
+
+    rng = np.random.default_rng(7)
+    pts, boxes = synth_scene_frame(
+        rng, n_objects=1, n_clutter=0, min_points=60, front_bias=0.65,
+    )
+    cx, cy, _, dx, _, _, yaw = boxes[0, :7]
+    c, s = np.cos(yaw), np.sin(yaw)
+    # rotate returns into the object frame; longitudinal mean must sit
+    # clearly forward of center (0.65/0.35 split over uniform |x|)
+    lx = (pts[:, 0] - cx) * c + (pts[:, 1] - cy) * s
+    assert lx.mean() > 0.04 * dx
+    # unbiased stays symmetric
+    p0, b0 = synth_scene_frame(
+        np.random.default_rng(7), n_objects=1, n_clutter=0, min_points=60,
+    )
+    cx0, cy0, _, dx0, _, _, yaw0 = b0[0, :7]
+    lx0 = (p0[:, 0] - cx0) * np.cos(yaw0) + (p0[:, 1] - cy0) * np.sin(yaw0)
+    assert abs(lx0.mean()) < 0.04 * dx0

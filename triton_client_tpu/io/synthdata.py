@@ -196,6 +196,7 @@ def synth_scene_frame(
     n_sweeps: int = 0,
     sweep_dt: float = 0.05,
     velocity_max: float = 0.0,
+    front_bias: float = 0.0,
 ) -> tuple[np.ndarray, np.ndarray]:
     """One labeled scan: (points (N, 4) [x, y, z, intensity] float32,
     boxes (n, 8) [cx, cy, cz, dx, dy, dz, yaw, cls] float32).
@@ -221,7 +222,18 @@ def synth_scene_frame(
     k's returns sample the object displaced to c - v·k·dt — and boxes
     gain [vx, vy] (-> (n, 10)). Velocity is thereby observable from a
     single stacked cloud (the motion streak), which is exactly what the
-    CenterPoint velocity head trains on."""
+    CenterPoint velocity head trains on.
+
+    ``front_bias > 0`` skews each object's surface returns toward its
+    +x (heading) half: a fraction ``front_bias`` of returns land on the
+    front half, the rest on the rear. A perfect cuboid with symmetric
+    sampling is EXACTLY π-rotation-invariant, which makes full-circle
+    yaw unlearnable on principle — component-wise L1 over the
+    {(sinθ, cosθ), (−sinθ, −cosθ)} mixture medians to (0, 0), the
+    failure CenterPoint's det3d (sin, cos) regression hits on such
+    data (anchor heads dodge it via the mod-π sin-difference loss).
+    Real lidar returns are front/back asymmetric (bumpers, windshield
+    rake, mirrors), which is the asymmetry this models."""
     x0, y0, _z0, x1, y1, _z1 = pc_range
     sweeps = max(1, n_sweeps)
     cols = 5 if n_sweeps > 0 else 4
@@ -279,6 +291,11 @@ def synth_scene_frame(
                 nk = max(n_pts // sweeps, 4)
                 face = rng.integers(0, 3, nk)
                 u = rng.uniform(-0.5, 0.5, (nk, 3))
+                if front_bias > 0:
+                    to_front = rng.uniform(size=nk) < front_bias
+                    u[:, 0] = np.where(
+                        to_front, np.abs(u[:, 0]), -np.abs(u[:, 0])
+                    )
                 u[face == 0, 0] = np.sign(u[face == 0, 0]) * 0.5
                 u[face == 1, 1] = np.sign(u[face == 1, 1]) * 0.5
                 u[face == 2, 2] = 0.5  # top surface
