@@ -136,12 +136,22 @@ def run_2d(args) -> dict:
 
 
 def run_3d(args) -> dict:
-    # workdir encodes the dataset recipe (incl. yaw distribution,
-    # sweep count, and front bias) so a recipe change can never
-    # silently reuse a stale cached dataset
+    # workdir encodes the dataset recipe — tag and generator kwargs are
+    # built from the SAME dict, so a recipe change can never silently
+    # reuse a stale cached dataset. The centerpoint recipe matches the
+    # nuScenes 10-sweep contract (nusc_centerpoint_pp_02voxel_two_pfn_
+    # 10sweep.py) with moving objects, plus front-biased returns so
+    # full-circle yaw is observable (see synth_scene_frame).
     family = args.family
     sweeps = family == "centerpoint"
-    tag = "_sweeps10fb65" if sweeps else ""
+    recipe = (
+        {"n_sweeps": 10, "velocity_max": 3.0, "front_bias": 0.65}
+        if sweeps
+        else {}
+    )
+    tag = "".join(
+        f"_{k}{v}" for k, v in sorted(recipe.items())
+    ).replace(".", "p")
     work = RUNS / f"3d_{family}_n{args.n_train}x{args.n_hold}_road{tag}"
     work.mkdir(parents=True, exist_ok=True)
     log = work / "log.txt"
@@ -150,16 +160,10 @@ def run_3d(args) -> dict:
     if not (train_dir / "gt3d.jsonl").exists():
         print(f"generating {args.n_train}+{args.n_hold} scenes ...", flush=True)
         # road-like yaw: the distribution the reference's axis-aligned
-        # anchor config is designed for (KITTI traffic). The
-        # centerpoint loop matches the nuScenes 10-sweep contract
-        # (nusc_centerpoint_pp_02voxel_two_pfn_10sweep.py) with moving
-        # objects, plus front-biased returns so full-circle yaw is
-        # observable (see synth_scene_frame).
-        extra = (
-            ", n_sweeps=10, velocity_max=3.0, front_bias=0.65"
-            if sweeps
-            else ""
-        )
+        # anchor config is designed for (KITTI traffic). The extra
+        # kwargs come from the same `recipe` dict the cache tag is
+        # derived from.
+        extra = "".join(f", {k}={v}" for k, v in sorted(recipe.items()))
         _python(
             "from triton_client_tpu.io.synthdata import write_scene_dataset;"
             f"write_scene_dataset(r'{train_dir}', {args.n_train}, seed=0,"
