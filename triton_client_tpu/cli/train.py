@@ -65,6 +65,14 @@ def parse_args(argv=None):
         "command on every host; the mesh then spans all hosts' chips "
         "(data axis over DCN, model/seq/pipe on intra-host ICI)",
     )
+    p.add_argument(
+        "--aug3d", default="auto", choices=("auto", "on", "off"),
+        help="global rot/flip/scale train augmentation (3D families; "
+        "the det3d/OpenPCDet recipe). auto = on for centerpoint — "
+        "whose single-cell yaw/velocity regression does not "
+        "generalize without it — off for the anchor heads, whose "
+        "mod-pi sin-difference loss already does",
+    )
     p.add_argument("--mesh", default="",
                    help="e.g. 'data=8' or 'data=4,model=2'")
     p.add_argument("--per-host-source", action="store_true",
@@ -307,8 +315,11 @@ def main(argv=None) -> None:
     family3d = args.family in ("pointpillars", "second_iou", "centerpoint")
     if family3d and args.mxu_opt:
         raise SystemExit("--mxu-opt is yolov5-only")
+    if not family3d and args.aug3d != "auto":
+        raise SystemExit("--aug3d applies to the 3D families only")
     if family3d:
         from triton_client_tpu.parallel.train3d import (
+            Augment3DConfig,
             CenterLossConfig,
             Loss3DConfig,
             init_train3d_state,
@@ -351,13 +362,17 @@ def main(argv=None) -> None:
         def init_state(vars_):
             return init_train3d_state(model, vars_, optimizer, mesh)
 
+        aug_on = args.aug3d == "on" or (
+            args.aug3d == "auto" and args.family == "centerpoint"
+        )
+        augment = Augment3DConfig() if aug_on else None
         if args.family == "centerpoint":
             step_fn = make_center3d_step(
-                model, optimizer, CenterLossConfig(), mesh
+                model, optimizer, CenterLossConfig(), mesh, augment=augment
             )
         else:
             step_fn = make_train3d_step(
-                model, optimizer, Loss3DConfig(), mesh
+                model, optimizer, Loss3DConfig(), mesh, augment=augment
             )
         loader = functools.partial(
             _load_batches3d,

@@ -57,6 +57,76 @@ class Loss3DConfig:
     num_dir_bins: int = 2
 
 
+@dataclasses.dataclass(frozen=True)
+class Augment3DConfig:
+    """Global scene augmentation, the det3d/OpenPCDet train-time
+    recipe (GlobalRotScaleTrans + RandomFlip in every shipped config,
+    e.g. nusc_centerpoint_pp_02voxel_two_pfn_10sweep.py): per sample,
+    one rotation about the z axis, an optional y-mirror, and an
+    isotropic scale, applied identically to points, boxes, and
+    ground-plane velocities. This is what makes single-cell yaw/
+    velocity regression GENERALIZE — without it a center head binds
+    heading to absolute scene context and memorizes the train split
+    (round-5 closed-loop finding: train rot err 0.22 rad vs holdout
+    1.0 rad)."""
+
+    rot_max: float = 0.7854       # U(-pi/4, pi/4), OpenPCDet KITTI
+    scale_min: float = 0.95
+    scale_max: float = 1.05
+    flip_y: bool = True           # mirror across y=0 with p=0.5
+    seed: int = 17
+
+
+def augment_scene_batch(
+    key: jax.Array,
+    points: jnp.ndarray,   # (B, P, F>=3) padded clouds
+    targets: jnp.ndarray,  # (B, T, 8|10) [box7, cls(, vx, vy)]
+    cfg: Augment3DConfig,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Jittable global rot/flip/scale over a padded batch. Padded
+    point rows (zeros) stay zeros under rotation+scale; padded target
+    rows keep cls == -1 untouched. Boxes pushed out of the grid by the
+    transform are dropped by the target assigners' in-range masks,
+    matching the reference pipelines' post-augment filtering."""
+    b = points.shape[0]
+    k_rot, k_scale, k_flip = jax.random.split(key, 3)
+    theta = jax.random.uniform(
+        k_rot, (b,), minval=-cfg.rot_max, maxval=cfg.rot_max
+    )
+    scale = jax.random.uniform(
+        k_scale, (b,), minval=cfg.scale_min, maxval=cfg.scale_max
+    )
+    flip = jax.random.bernoulli(k_flip, 0.5 if cfg.flip_y else 0.0, (b,))
+    sign = jnp.where(flip, -1.0, 1.0)[:, None]  # y-mirror per sample
+    c = jnp.cos(theta)[:, None]
+    s = jnp.sin(theta)[:, None]
+
+    def rot_xy(x, y):
+        y = y * sign
+        return c * x - s * y, s * x + c * y
+
+    px, py = rot_xy(points[..., 0], points[..., 1])
+    sc = scale[:, None]
+    points = points.at[..., 0].set(px * sc)
+    points = points.at[..., 1].set(py * sc)
+    points = points.at[..., 2].set(points[..., 2] * sc)
+
+    cx, cy = rot_xy(targets[..., 0], targets[..., 1])
+    # mirror then rotate: yaw -> -yaw under the y-flip, then + theta
+    yaw = targets[..., 6] * sign + theta[:, None]
+    out = targets
+    out = out.at[..., 0].set(cx * sc)
+    out = out.at[..., 1].set(cy * sc)
+    out = out.at[..., 2].set(targets[..., 2] * sc)
+    out = out.at[..., 3:6].set(targets[..., 3:6] * sc[..., None])
+    out = out.at[..., 6].set(yaw)
+    if targets.shape[-1] >= 10:
+        vx, vy = rot_xy(targets[..., 8], targets[..., 9])
+        out = out.at[..., 8].set(vx * sc)
+        out = out.at[..., 9].set(vy * sc)
+    return points, out
+
+
 def nearest_bev_halfdims(dims_xy: jnp.ndarray, yaw: jnp.ndarray) -> jnp.ndarray:
     """(..., 2) BEV half-extents with yaw rounded to the nearest axis
     (OpenPCDet boxes3d_nearest_bev_iou): within pi/4 of the x axis the
@@ -458,17 +528,33 @@ def centerpoint_loss(
     return loss, metrics
 
 
+def _maybe_augment(augment, state, points, targets):
+    """Shared per-step augmentation: key folded from the step counter
+    so a resumed run replays the same stream (and both step factories
+    derive it identically)."""
+    if augment is None:
+        return points, targets
+    key = jax.random.fold_in(jax.random.PRNGKey(augment.seed), state.step)
+    return augment_scene_batch(key, points, targets, augment)
+
+
 def make_center3d_step(
     model,
     optimizer: optax.GradientTransformation,
     loss_cfg: CenterLossConfig,
     mesh: Mesh,
+    augment: Augment3DConfig | None = None,
 ):
     """CenterPoint training step: (state, points (B, P, F), counts (B,),
     targets (B, T, 8|10)) -> (state, metrics), batch sharded over the
-    data axis — the anchor-free sibling of make_train3d_step."""
+    data axis — the anchor-free sibling of make_train3d_step. With
+    ``augment``, the global rot/flip/scale transform is applied inside
+    the jit (key folded from the step counter, so resume replays the
+    same stream)."""
 
     def step_fn(state: TrainState, points, counts, targets):
+        points, targets = _maybe_augment(augment, state, points, targets)
+
         def loss_fn(params):
             variables = {**state.variables, "params": params}
             heads, mutated = model.apply(
@@ -513,11 +599,15 @@ def make_train3d_step(
     optimizer: optax.GradientTransformation,
     loss_cfg: Loss3DConfig,
     mesh: Mesh,
+    augment: Augment3DConfig | None = None,
 ):
     """(state, points (B, P, F), counts (B,), targets (B, T, 8)) ->
-    (state, metrics), batch sharded over the data axis."""
+    (state, metrics), batch sharded over the data axis. ``augment``
+    enables the global rot/flip/scale transform inside the jit."""
 
     def step_fn(state: TrainState, points, counts, targets):
+        points, targets = _maybe_augment(augment, state, points, targets)
+
         def loss_fn(params):
             variables = {**state.variables, "params": params}
             heads, mutated = model.apply(
