@@ -97,8 +97,13 @@ def main() -> None:
             dt = time.perf_counter() - t0
             with lock:
                 device_busy[0] += dt
+                # input-agnostic batch bucket (same r5 fix as
+                # bench.measure_serving's tap: a non-image request
+                # through the tapped channel must not KeyError)
+                arr = req.inputs.get("images")
                 dev_calls.append(
-                    (int(np.shape(req.inputs["images"])[0]), round(dt, 3))
+                    (int(np.shape(arr)[0]) if arr is not None else 1,
+                     round(dt, 3))
                 )
 
     inner.do_inference = tapped
