@@ -91,6 +91,19 @@ LAT_CALLS = 20       # single-call latency samples (readback per call)
 # warmup, not just the serving windows themselves.
 SERVING_RESERVE_S = 280.0
 
+# The serving stage's own envelope — the thing SERVING_RESERVE_S exists
+# to protect. The start gate and the window sizing both derive from
+# these (the gate used to hardcode 170, the OLD reserve value, and
+# silently drifted when the reserve was retuned to 280):
+SERVING_TAIL_S = 120.0      # merge-size precompiles + row-flush slack
+SERVING_MIN_WINDOW_S = 15.0  # floor per transport window (~20 batches)
+SERVING_MAX_WINDOW_S = 60.0
+# cheapest viable stage: the tail plus one minimum window per transport
+# row (3 rows) — below this the window formula would bottom out under
+# its own floor, so don't start at all
+SERVING_FLOOR_S = SERVING_TAIL_S + 3 * SERVING_MIN_WINDOW_S
+assert SERVING_FLOOR_S < SERVING_RESERVE_S
+
 # Wall-clock budget (VERDICT r3 #1): BENCH_r03.json shows the driver's
 # clock ran out with 902 s of warmups + 8 trial rounds + a setup phase
 # (10 config builds + NMS gate) on the books — i.e. the external cap
@@ -513,12 +526,17 @@ def measure_serving(
                         # join deadline escapes the row entirely)
 
     def tapped(req):
-        # batch forensics are 2D-batch semantics; the 3D served row's
-        # single-scan requests ({"points", ...}) ride through the same
-        # tapped channel and count as solo dispatches (r5: the tap's
-        # hard "images" lookup KeyError'd the whole 3D row)
+        # batch forensics are leading-dim semantics for every request
+        # shape: the first input tensor's leading dim is the batch (a
+        # 3D single-scan request's (N, pf) points then count the
+        # cloud-size bucket, not a silent 1 — r5's hard "images"
+        # lookup KeyError'd the whole 3D row; a flat b=1 fallback
+        # would misattribute a future batched-points request)
         arr = req.inputs.get("images")
-        b = int(np.shape(arr)[0]) if arr is not None else 1
+        if arr is None and req.inputs:
+            arr = next(iter(req.inputs.values()))
+        shape = np.shape(arr) if arr is not None else ()
+        b = int(shape[0]) if shape else 1
         with occ_lock:
             occupancy[b] += 1
         t0 = time.perf_counter()
@@ -1170,16 +1188,22 @@ def main() -> None:
     # serving stage is strictly best-effort after the contract rows:
     # fresh it precompiles every merge size (minutes over the tunnel),
     # so it only starts with real budget left
-    if _remaining() > 170.0:
+    if _remaining() > SERVING_FLOOR_S:
         try:
             # window sized to the leftover budget (post-fix serving
-            # runs ~15 fps, so even a 15 s window resolves ~20 device
-            # batches); each transport's row is emitted the moment its
-            # window closes, so a cap landing mid-stage keeps the
-            # wire row
+            # runs ~15 fps, so even a minimum window resolves ~20
+            # device batches); each transport's row is emitted the
+            # moment its window closes, so a cap landing mid-stage
+            # keeps the wire row
             measure_serving(
                 rtt,
-                duration_s=min(60.0, max(15.0, (_remaining() - 120.0) / 3)),
+                duration_s=min(
+                    SERVING_MAX_WINDOW_S,
+                    max(
+                        SERVING_MIN_WINDOW_S,
+                        (_remaining() - SERVING_TAIL_S) / 3,
+                    ),
+                ),
                 on_row=lambda row: (_emit_row(row, primary=False),
                                     _write_local()),
             )

@@ -1,0 +1,187 @@
+"""Eager vs overlapped dispatch A/B (round 6 tentpole).
+
+The overlapped TPUChannel splits the serving hot path into
+stage -> launch -> readback so batch N+1's host->device copy and host
+prep run while batch N executes (channel/tpu_channel.py). This harness
+puts numbers on the split: the same pipeline, driven two ways —
+
+  * eager    — pipeline_depth=1, donation off, blocking do_inference:
+               the strictly serial pre-round-6 path;
+  * overlap  — pipeline_depth=2 (double-buffered), donation on,
+               do_inference_async with the readback resolved one
+               request behind issue.
+
+Per (model, batch) case it reports frames/s, per-request p50/p99, and
+the DEVICE-IDLE FRACTION: pure device execution time per batch is
+measured separately (block_until_ready over the jitted device program
+on device-resident inputs, harness methodology from perf/_harness.py),
+so idle = 1 - requests * t_exec / wall — the share of the window the
+chip spent waiting on host staging/readback. Overlap should push idle
+toward zero; the eager row is the baseline it is stealing from.
+
+Models: yolov5n (batched images, b in {1,8,64}) and pointpillars
+(single-scan padded contract; b = scans per round, fps counts scans).
+
+Usage: python perf/profile_serving_overlap.py [--rounds 12]
+       [--batches 1,8,64] [--models yolov5,pointpillars]
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import statistics
+import sys
+import time
+
+import numpy as np
+
+import _harness  # noqa: F401  (repo-path + compilation-cache bootstrap)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+
+def _device_exec_ms(device_fn, device_inputs, trials: int = 5) -> float:
+    """Median ms of the jitted device program alone, inputs already
+    resident: execution-complete (block_until_ready), no readback."""
+    jfn = jax.jit(device_fn)
+    out = jfn(device_inputs)
+    jax.block_until_ready(out)
+    acc = []
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        jax.block_until_ready(jfn(device_inputs))
+        acc.append((time.perf_counter() - t0) * 1e3)
+    return statistics.median(acc)
+
+
+def _drive(chan, requests, overlap: bool, depth: int = 2):
+    """Run the request stream; returns (wall_s, per-request ms)."""
+    from triton_client_tpu.channel.base import InferRequest  # noqa: F401
+
+    lats = []
+    t_start = time.perf_counter()
+    if not overlap:
+        for req in requests:
+            t0 = time.perf_counter()
+            chan.do_inference(req)
+            lats.append((time.perf_counter() - t0) * 1e3)
+    else:
+        pending = collections.deque()
+        for req in requests:
+            pending.append((time.perf_counter(), chan.do_inference_async(req)))
+            # keep `depth` requests in flight; resolve the oldest once
+            # the window is full (issue-order retirement, lazy readback)
+            while len(pending) >= depth:
+                t0, fut = pending.popleft()
+                fut.result()
+                lats.append((time.perf_counter() - t0) * 1e3)
+        while pending:
+            t0, fut = pending.popleft()
+            fut.result()
+            lats.append((time.perf_counter() - t0) * 1e3)
+    return time.perf_counter() - t_start, lats
+
+
+def _cases(models, batches, rounds):
+    from triton_client_tpu.channel.base import InferRequest
+    from triton_client_tpu.pipelines import build_yolov5_pipeline
+    from triton_client_tpu.pipelines.detect3d import build_pointpillars_pipeline
+
+    rng = np.random.default_rng(0)
+    if "yolov5" in models:
+        hw = (512, 512)
+        pipe, spec, _ = build_yolov5_pipeline(
+            jax.random.PRNGKey(0), variant="n", num_classes=2, input_hw=hw
+        )
+        for b in batches:
+            frames = rng.integers(0, 255, (b, *hw, 3)).astype(np.uint8)
+            reqs = [
+                InferRequest(spec.name, {"images": frames})
+                for _ in range(rounds)
+            ]
+            yield ("yolov5n_512", b, b, pipe, spec, {"images": frames}, reqs)
+    if "pointpillars" in models:
+        pipe, spec, _ = build_pointpillars_pipeline(jax.random.PRNGKey(0))
+        budget = spec.extra["point_buckets"][0]
+        pf = spec.inputs[0].shape[1]
+        for b in batches:
+            # single-scan padded contract: b scans per round, each its
+            # own request — overlap pipelines them back-to-back
+            scans = []
+            for _ in range(b):
+                pts = rng.uniform(-40, 40, (budget, pf)).astype(np.float32)
+                pts[:, 2] = rng.uniform(-2, 2, budget)
+                scans.append(
+                    {
+                        "points": pts,
+                        "num_points": np.int32(budget),
+                    }
+                )
+            reqs = [
+                InferRequest(spec.name, scans[i % b]) for i in range(rounds * b)
+            ]
+            yield ("pointpillars", b, 1, pipe, spec, scans[0], reqs)
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--rounds", type=int, default=12,
+                   help="timed requests per case (per scan for 3D)")
+    p.add_argument("--batches", default="1,8,64")
+    p.add_argument("--models", default="yolov5,pointpillars")
+    p.add_argument("--depth", type=int, default=2)
+    args = p.parse_args(argv)
+    batches = [int(b) for b in args.batches.split(",") if b]
+    models = [m.strip() for m in args.models.split(",") if m.strip()]
+
+    from triton_client_tpu.channel.tpu_channel import TPUChannel
+    from triton_client_tpu.runtime.repository import ModelRepository
+
+    for name, b, frames_per_req, pipe, spec, sample, reqs in _cases(
+        models, batches, args.rounds
+    ):
+        repo = ModelRepository()
+        repo.register(spec, pipe.infer_fn(), device_fn=pipe.device_fn())
+        dev_in = {k: jnp.asarray(v) for k, v in sample.items()}
+        t_exec_ms = _device_exec_ms(pipe.device_fn(), dev_in)
+        for mode, overlap in (("eager", False), ("overlap", True)):
+            chan = TPUChannel(
+                repo,
+                pipeline_depth=args.depth if overlap else 1,
+                donate=overlap,
+            )
+            chan.do_inference(reqs[0])  # warm the launch path
+            s0 = chan.stats()
+            wall, lats = _drive(chan, reqs, overlap, depth=args.depth)
+            busy = len(reqs) * t_exec_ms / 1e3
+            stats = chan.stats()
+            occupancy = {
+                k: v - s0["slot_occupancy"].get(k, 0)
+                for k, v in stats["slot_occupancy"].items()
+                if v - s0["slot_occupancy"].get(k, 0)
+            }
+            row = {
+                "case": f"{name}_b{b}_{mode}",
+                "model": name,
+                "batch": b,
+                "mode": mode,
+                "pipeline_depth": chan.pipeline_depth,
+                "requests": len(reqs),
+                "fps": round(len(reqs) * frames_per_req / wall, 2),
+                "p50_ms": round(float(np.percentile(lats, 50)), 2),
+                "p99_ms": round(float(np.percentile(lats, 99)), 2),
+                "device_exec_ms": round(t_exec_ms, 3),
+                "device_idle_frac": round(max(0.0, 1.0 - busy / wall), 3),
+                "donated_launches": (
+                    stats["donated_launches"] - s0["donated_launches"]
+                ),
+                "slot_occupancy": occupancy,
+            }
+            print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -97,13 +97,16 @@ def main() -> None:
             dt = time.perf_counter() - t0
             with lock:
                 device_busy[0] += dt
-                # input-agnostic batch bucket (same r5 fix as
+                # input-agnostic batch bucket (same r5/r6 fix as
                 # bench.measure_serving's tap: a non-image request
-                # through the tapped channel must not KeyError)
+                # must not KeyError, and its batch is the first
+                # tensor's leading dim, not a silent 1)
                 arr = req.inputs.get("images")
+                if arr is None and req.inputs:
+                    arr = next(iter(req.inputs.values()))
+                shape = np.shape(arr) if arr is not None else ()
                 dev_calls.append(
-                    (int(np.shape(arr)[0]) if arr is not None else 1,
-                     round(dt, 3))
+                    (int(shape[0]) if shape else 1, round(dt, 3))
                 )
 
     inner.do_inference = tapped

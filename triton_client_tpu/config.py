@@ -76,6 +76,13 @@ class TensorSpec:
     shape: tuple[int, ...]
     dtype: str = "FP32"
     layout: str = ""  # e.g. "NHWC" / "NCHW" for image inputs
+    # Input-only: the serving channel may donate this tensor's staged
+    # device buffer to the launch (jax donate_argnums), letting XLA
+    # reuse the HBM across consecutive batches. Only safe to declare
+    # when no consumer re-reads the staged buffer after launch — the
+    # channel stages a fresh copy per request, so in-tree pipelines
+    # qualify; the request's host arrays are never donated.
+    donatable: bool = False
 
     def np_dtype(self) -> np.dtype:
         if self.dtype not in _DTYPES or _DTYPES[self.dtype] is None:
@@ -116,6 +123,11 @@ class ModelSpec:
             if t.name == name:
                 return t
         raise KeyError(f"model '{self.name}' has no input '{name}'")
+
+    def donatable_inputs(self) -> tuple[str, ...]:
+        """Input names whose staged device buffers the serving channel
+        may donate to the launch (channel/tpu_channel.py)."""
+        return tuple(t.name for t in self.inputs if t.donatable)
 
     def wire_bytes(self) -> int:
         """Max raw-tensor payload of one full-batch request/response, or

@@ -152,9 +152,16 @@ class CenterPoint(nn.Module):
         train: bool = False,
     ) -> dict[str, jnp.ndarray]:
         nx, ny, _ = self.cfg.voxel.grid_size
-        feats = jax.vmap(lambda v, n, c: self.vfe(v, n, c, train))(
-            voxels, num_points, coords
-        )
+        b, v, k, f = voxels.shape
+        # ONE flat VFE call over all B*V pillars (see
+        # PointPillars.__call__): a parameterized module call under
+        # jax.vmap trips flax's transform check.
+        feats = self.vfe(
+            voxels.reshape(b * v, k, f),
+            num_points.reshape(b * v),
+            coords.reshape(b * v, 3),
+            train,
+        ).reshape(b, v, -1)
         canvas = jax.vmap(lambda f, c: scatter_to_bev(f, c, (ny, nx)))(feats, coords)
         return self.head(self.backbone(canvas, train), train)
 

@@ -402,9 +402,17 @@ class PointPillars(nn.Module):
         train: bool = False,
     ) -> dict[str, jnp.ndarray]:
         nx, ny, _ = self.cfg.voxel.grid_size
-        feats = jax.vmap(lambda v, n, c: self.vfe(v, n, c, train))(
-            voxels, num_points, coords
-        )  # (B, V, C)
+        b, v, k, f = voxels.shape
+        # ONE flat VFE call over all B*V pillars: the per-pillar math is
+        # batch-independent, and a parameterized module call under
+        # jax.vmap trips flax's transform check (the from_points_batch
+        # constraint); flat BN also sees the whole batch's pillars.
+        feats = self.vfe(
+            voxels.reshape(b * v, k, f),
+            num_points.reshape(b * v),
+            coords.reshape(b * v, 3),
+            train,
+        ).reshape(b, v, -1)  # (B, V, C)
         canvas = jax.vmap(lambda f, c: scatter_to_bev(f, c, (ny, nx)))(
             feats, coords
         )  # (B, ny, nx, C)

@@ -330,12 +330,22 @@ class SECONDIoU(nn.Module):
         train: bool = False,
     ) -> dict[str, jnp.ndarray]:
         nx, ny, nz = self.cfg.voxel.grid_size
-        feats = jax.vmap(self.vfe)(voxels, num_points)  # (B, V, F)
+        b, v, k, f = voxels.shape
+        # flat (B*V) mean-VFE (module calls under jax.vmap trip flax's
+        # transform check; the per-voxel mean is batch-independent)
+        feats = self.vfe(
+            voxels.reshape(b * v, k, f), num_points.reshape(b * v)
+        ).reshape(b, v, -1)  # (B, V, F)
         if self.cfg.middle == "sparse":
             valid = coords[:, :, 0] >= 0
-            bev = jax.vmap(
-                lambda c, f, v: self.middle(c, f, v, train)
-            )(coords, feats, valid)
+            # unrolled per-sample loop instead of vmap for the same
+            # flax constraint; serving batches are B=1 scans
+            bev = jnp.stack(
+                [
+                    self.middle(coords[i], feats[i], valid[i], train)
+                    for i in range(b)
+                ]
+            )
             return self._heads_from_bev(bev, train)
         volume = jax.vmap(lambda f, c: scatter_to_volume(f, c, (nz, ny, nx)))(
             feats, coords
@@ -388,7 +398,9 @@ class SECONDIoU(nn.Module):
         return self._heads_from_bev(bev, train)
 
     def _heads(self, volume: jnp.ndarray, train: bool) -> dict[str, jnp.ndarray]:
-        bev = jax.vmap(lambda v: self.middle(v, train))(volume)  # (B, h, w, C)
+        # the middle encoder is rank-5 aware (see from_points_batch), so
+        # the batch runs directly — no module call under jax.vmap
+        bev = self.middle(volume, train)  # (B, h, w, C)
         return self._heads_from_bev(bev, train)
 
     def _heads_from_bev(

@@ -1,0 +1,23 @@
+"""jax API compatibility shims for the parallel kernels.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` to the
+top-level ``jax`` namespace (and renamed ``check_rep`` to
+``check_vma``) across jax releases; the pinned toolchain may sit on
+either side. The kernels import the modern spelling from here so both
+jax generations collect and run.
+"""
+
+from __future__ import annotations
+
+try:  # modern jax: top-level export with check_vma
+    from jax import shard_map  # type: ignore[attr-defined]
+except ImportError:  # older jax: experimental API with check_rep
+
+    from jax.experimental.shard_map import shard_map as _experimental_shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, **kw):
+        if check_vma is not None and "check_rep" not in kw:
+            kw["check_rep"] = check_vma
+        return _experimental_shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+        )

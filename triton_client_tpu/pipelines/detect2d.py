@@ -275,7 +275,12 @@ def _detect2d_spec(cfg: Detect2DConfig, n_predictions: int) -> ModelSpec:
         platform="jax",
         # Any camera resolution is accepted; the jitted graph re-traces
         # once per distinct resolution and resizes to input_hw on-device.
-        inputs=(TensorSpec("images", (-1, -1, -1, 3), "FP32", "NHWC"),),
+        # donatable: the pipeline consumes the staged frames exactly
+        # once, so the serving channel may recycle the HBM input buffer
+        # across consecutive batches (channel/tpu_channel.py).
+        inputs=(
+            TensorSpec("images", (-1, -1, -1, 3), "FP32", "NHWC", donatable=True),
+        ),
         outputs=(
             TensorSpec("detections", (-1, cfg.max_det, 6), "FP32"),
             TensorSpec("valid", (-1, cfg.max_det), "BOOL"),

@@ -253,7 +253,10 @@ def _detect3d_spec(
         version="1",
         platform="jax",
         inputs=(
-            TensorSpec("points", (-1, pf), "FP32"),
+            # donatable: the voxelizer consumes the staged scan exactly
+            # once, so the serving channel may recycle the HBM buffer
+            # across consecutive scans (channel/tpu_channel.py).
+            TensorSpec("points", (-1, pf), "FP32", donatable=True),
             TensorSpec("num_points", (), "INT32"),
         ),
         outputs=(

@@ -115,7 +115,18 @@ class BatchingChannel(BaseChannel):
         allocation per batch. Slots are sized from the first merged
         batch per input name; oversized batches and exhausted pools
         fall back to the allocating path. Requires the native library;
-        silently off when it cannot build."""
+        silently off when it cannot build.
+
+        Slot lifetime (round 6 — overlapped dispatch): an execution
+        slot frees at *launch*, not at readback. Each group dispatches
+        through ``inner.do_inference_async`` and releases its permit as
+        soon as the call returns (inputs staged on device, compute
+        enqueued); the split/respond work then runs outside the permit,
+        so batch formation self-clocks off device occupancy instead of
+        host copy time. When the inner channel exposes a
+        ``pipeline_depth`` staging knob (TPUChannel), it is aligned to
+        this batcher's depth so the channel's staging slots provide the
+        device-side backpressure."""
         self._inner = inner
         self._pending: dict[int, tuple[InferRequest, concurrent.futures.Future]] = {}
         self._lock = threading.Lock()
@@ -137,8 +148,13 @@ class BatchingChannel(BaseChannel):
         self._dispatch_stop = False
         self._merge_stats = {
             "merges": 0, "merged_frames": 0, "padded_frames": 0,
+            "launch_frees": 0,
         }
         self._merge_occupancy: collections.Counter = collections.Counter()
+        # per-slot occupancy: concurrently-active execution slots
+        # observed at each group launch (1..pipeline_depth)
+        self._active_slots = 0
+        self._slot_occupancy: collections.Counter = collections.Counter()
         # per-batch wall decomposition sums (stats() exposes means):
         # queue_wait (first item staged -> executor slot), exec_wait
         # (submit -> run), stage (host merge build), device (inner
@@ -148,6 +164,15 @@ class BatchingChannel(BaseChannel):
         # reveals its slot size (max_merge rows of the widest input)
         self._arena_slots = max(0, int(arena_slots))
         self._arena = None
+        # plumb the depth through to the inner channel's staging slots
+        # (TPUChannel double-buffers H2D against execution at depth 2):
+        # the channel then backpressures on device occupancy while this
+        # batcher's permits backpressure on formed groups
+        if hasattr(inner, "pipeline_depth"):
+            try:
+                inner.pipeline_depth = max(1, int(pipeline_depth))
+            except (AttributeError, TypeError):
+                pass  # read-only attribute on a custom channel
         if use_native:
             try:
                 from triton_client_tpu.native import NativeBatchServer
@@ -301,6 +326,9 @@ class BatchingChannel(BaseChannel):
                 self._inflight.release()
                 return False
 
+            with self._ready_cv:
+                self._active_slots += 1
+
             def run(g=group, t_submit=time.perf_counter()):
                 t_run = time.perf_counter()
                 with self._ready_cv:
@@ -309,8 +337,28 @@ class BatchingChannel(BaseChannel):
                     self._decomp["queue_wait_s"] += t_run - min(
                         it[4] for it in g
                     )
+                # the slot frees the moment the group LAUNCHES (inputs
+                # staged, compute enqueued on the inner channel) — the
+                # dispatcher can then form the next batch against
+                # device occupancy while this group's readback/split
+                # still runs. Exactly-once: the finally covers groups
+                # whose launch never happened (errors before dispatch).
+                released = [False]
+
+                def free_slot():
+                    if released[0]:
+                        return
+                    released[0] = True
+                    with self._ready_cv:
+                        self._slot_occupancy[self._active_slots] += 1
+                        self._active_slots -= 1
+                        self._merge_stats["launch_frees"] += 1
+                    self._inflight.release()
+
                 try:
-                    self._run_group([(None, it[2], it[3]) for it in g])
+                    self._run_group(
+                        [(None, it[2], it[3]) for it in g], free_slot
+                    )
                 except Exception as e:
                     # No exception may escape: an unresolved future
                     # hangs its caller forever.
@@ -318,11 +366,13 @@ class BatchingChannel(BaseChannel):
                         if not it[3].done():
                             it[3].set_exception(e)
                 finally:
-                    self._inflight.release()
+                    free_slot()
 
             try:
                 self._exec.submit(run)
             except RuntimeError as e:  # executor shut down mid-close
+                with self._ready_cv:
+                    self._active_slots -= 1
                 self._inflight.release()
                 for it in group:
                     if not it[3].done():
@@ -358,10 +408,14 @@ class BatchingChannel(BaseChannel):
 
     # -- batch execution (runs on the executor threads) -----------------------
 
-    def _run_group(self, group) -> None:
+    def _run_group(self, group, free_slot=None) -> None:
+        """Execute one formed group. ``free_slot`` (when given) is
+        called exactly once, as soon as the group's device work is
+        launched — inputs staged, compute enqueued — so the dispatcher
+        slot frees before the readback/split work."""
         if len(group) == 1 and not self._pad_to_buckets:
             _, request, future = group[0]
-            self._run_solo(request, future)
+            self._run_solo(request, future, free_slot)
             return
         requests = [g[1] for g in group]
         futures = [g[2] for g in group]
@@ -394,13 +448,21 @@ class BatchingChannel(BaseChannel):
                 merged[name] = self._merge_parts(name, parts, arena_held)
             t_disp = time.perf_counter()
             try:
-                resp = self._inner.do_inference(
+                # async launch + deferred readback: by the time the
+                # call returns, the inner channel has device_put the
+                # merged batch and enqueued the compute — the slot can
+                # free NOW; result() below pays the device wait +
+                # host copy outside the permit
+                fut = self._inner.do_inference_async(
                     InferRequest(
                         model_name=requests[0].model_name,
                         model_version=requests[0].model_version,
                         inputs=merged,
                     )
                 )
+                if free_slot is not None:
+                    free_slot()
+                resp = fut.result()
             finally:
                 t_dev_end = time.perf_counter()
                 if arena_held and self._arena is not None:
@@ -490,9 +552,12 @@ class BatchingChannel(BaseChannel):
                     return out
         return np.concatenate(parts)
 
-    def _run_solo(self, request: InferRequest, future) -> None:
+    def _run_solo(self, request: InferRequest, future, free_slot=None) -> None:
         try:
-            future.set_result(self._inner.do_inference(request))
+            fut = self._inner.do_inference_async(request)
+            if free_slot is not None:
+                free_slot()  # launched: slot frees before the readback
+            future.set_result(fut.result())
         except Exception as e:
             future.set_exception(e)
 
@@ -505,6 +570,11 @@ class BatchingChannel(BaseChannel):
             out["merge_occupancy"] = dict(
                 sorted(self._merge_occupancy.items())
             )
+            # concurrently-active execution slots observed at each
+            # group launch: {slots_active: launches} — 2s and above mean
+            # batch N+1 formed/staged while batch N still executed
+            out["slot_occupancy"] = dict(sorted(self._slot_occupancy.items()))
+            out["active_slots"] = self._active_slots
             out["ready_depth"] = len(self._ready)
             n = self._decomp.get("n", 0.0)
             if n:

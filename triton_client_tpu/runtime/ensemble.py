@@ -194,6 +194,18 @@ def build_ensemble(
             f"expose no device_fn (host-only)"
         )
     fused = fuse != "never" and not host_only
+    # partial device residency (round 6): a MIXED ensemble (some
+    # members device-capable, some host-only) keeps intermediates in
+    # HBM across consecutive device-capable steps and pays the host
+    # round-trip only at host-only member boundaries — the in-process
+    # preprocess -> detector hop stays on device. fuse="never" keeps
+    # the all-host path (the compatibility fallback for cross-runtime
+    # consumers that must see numpy between every step).
+    mixed = (
+        fuse != "never"
+        and not fused
+        and any(m.device_fn is not None for _, m in step_list)
+    )
 
     spec = ModelSpec(
         name=name,
@@ -203,8 +215,15 @@ def build_ensemble(
         outputs=tuple(produced[o] for o in outputs),
         max_batch_size=max_batch_size,
         # "fused" surfaces which data path this ensemble serves
-        # (tests/operators read it via model metadata)
-        extra={"steps": [s.model for s in steps], "fused": fused},
+        # (tests/operators read it via model metadata); "data_path"
+        # refines it: fused | device-resident (mixed) | host
+        extra={
+            "steps": [s.model for s in steps],
+            "fused": fused,
+            "data_path": (
+                "fused" if fused else "device-resident" if mixed else "host"
+            ),
+        },
     )
 
     def host_infer_fn(inputs: Mapping) -> dict:
@@ -264,18 +283,62 @@ def build_ensemble(
                 k: np.asarray(v, dtype=out_np_dtype[k] or None)
                 for k, v in out.items()
             }
+    elif mixed:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from triton_client_tpu.config import config_dtypes
+
+        # one jit per device-capable member: consecutive device steps
+        # hand intermediates over as device arrays (jnp.asarray on an
+        # already-device value is a no-op), host-only steps force the
+        # boundary readback via np.asarray, and the ensemble boundary
+        # casts to the wire contract exactly like the fused path
+        member_jit = {
+            i: jax.jit(member.device_fn)
+            for i, (_, member) in enumerate(step_list)
+            if member.device_fn is not None
+        }
+        out_np_dtype = {
+            o: config_dtypes().get(produced[o].dtype) for o in output_names
+        }
+
+        def infer_fn(inputs: Mapping) -> dict:
+            pool = dict(inputs)
+            for i, (step, member) in enumerate(step_list):
+                step_inputs = {
+                    step_in: pool[pool_name]
+                    for step_in, pool_name in step.input_map.items()
+                }
+                jitted = member_jit.get(i)
+                if jitted is not None:
+                    result = jitted(
+                        {k: jnp.asarray(v) for k, v in step_inputs.items()}
+                    )
+                else:
+                    result = member.infer_fn(
+                        {k: np.asarray(v) for k, v in step_inputs.items()}
+                    )
+                for step_out, pool_name in step.output_map.items():
+                    pool[pool_name] = result[step_out]
+            return {
+                o: np.asarray(pool[o], dtype=out_np_dtype[o] or None)
+                for o in output_names
+            }
     else:
         infer_fn = host_infer_fn
 
-    if fused:
+    if fused or mixed:
         import numpy as np
 
         from triton_client_tpu.config import config_dtypes
 
         def warmup() -> None:
             # member warmups compile the members' STANDALONE wire
-            # programs, which the fused path never executes — warm the
-            # fused DAG itself instead, on a nominal spec-shaped batch
+            # programs, which neither the fused nor the mixed
+            # device-resident path executes — warm the served DAG
+            # itself instead, on a nominal spec-shaped batch
             # (wildcard dims -> 64, batch -> 1; like the member
             # pipelines, a new input resolution retraces at request
             # time — warmup covers the whole-DAG compile cost once)

@@ -63,11 +63,13 @@ class _Servicer(service.GRPCInferenceServiceServicer):
         channel: BaseChannel,
         profiler=None,
         shm_registry=None,
+        stream_pipeline_depth: int = 2,
     ) -> None:
         self._repo = repository
         self._channel = channel
         self._profiler = profiler
         self._shm = shm_registry
+        self._stream_depth = max(1, int(stream_pipeline_depth))
 
     # -- health ---------------------------------------------------------------
 
@@ -156,23 +158,27 @@ class _Servicer(service.GRPCInferenceServiceServicer):
     # -- shared memory (Triton system-shared-memory extension) ----------------
 
     @staticmethod
-    def _require_local(context) -> None:
+    def _is_local_peer(context) -> bool:
+        peer = context.peer()
+        # ipv6:[::ffff:127.*] is the v4-mapped loopback a dual-stack
+        # bind reports for a 127.0.0.1 dial
+        return peer.startswith(
+            ("ipv4:127.", "ipv6:[::1]", "ipv6:[::ffff:127.", "unix:")
+        )
+
+    @classmethod
+    def _require_local(cls, context) -> None:
         """Shared memory is a SAME-HOST transport: registration maps a
         /dev/shm file into the server and infer requests can read/write
         it, so a remote peer must never reach it (a remote client could
         otherwise attach any flat-named segment on the server host and
         exfiltrate or corrupt it through model IO). Loopback and unix
         sockets only."""
-        peer = context.peer()
-        # ipv6:[::ffff:127.*] is the v4-mapped loopback a dual-stack
-        # bind reports for a 127.0.0.1 dial
-        if not peer.startswith(
-            ("ipv4:127.", "ipv6:[::1]", "ipv6:[::ffff:127.", "unix:")
-        ):
+        if not cls._is_local_peer(context):
             context.abort(
                 grpc.StatusCode.PERMISSION_DENIED,
                 f"shared-memory extension is restricted to same-host "
-                f"clients (peer {peer})",
+                f"clients (peer {context.peer()})",
             )
 
     def SystemSharedMemoryRegister(self, request, context):
@@ -209,10 +215,17 @@ class _Servicer(service.GRPCInferenceServiceServicer):
 
     # -- inference ------------------------------------------------------------
 
-    def _infer(self, request):
+    def _issue(self, request):
+        """Parse + dispatch one request; returns a finisher callable.
+
+        The dispatch goes through ``do_inference_async`` so the device
+        (or inner batcher) starts while THIS thread still prepares the
+        response scaffolding; the finisher resolves the future (the
+        only blocking step — deferred readback) and encodes the
+        response. Stream pipelining keeps several finishers pending."""
         t0 = time.perf_counter()
         inputs = codec.parse_infer_request(request, shm=self._shm)
-        result = self._channel.do_inference(
+        future = self._channel.do_inference_async(
             InferRequest(
                 model_name=request.model_name,
                 model_version=request.model_version,
@@ -220,25 +233,35 @@ class _Servicer(service.GRPCInferenceServiceServicer):
                 request_id=request.id,
             )
         )
-        if self._profiler is not None:
-            # per-model request latency — the Triton :8002 serving
-            # metrics role (README.md:88-95)
-            self._profiler.record(
-                f"infer_{request.model_name}", time.perf_counter() - t0
-            )
+        # overlapped with device execution: shm placement parsing needs
+        # only the request, not the result
         shm_outputs = {
             t.name: params
             for t in request.outputs
             if (params := codec.shm_params(t)) is not None
         }
-        return codec.build_infer_response(
-            model_name=result.model_name,
-            model_version=result.model_version,
-            outputs=result.outputs,
-            request_id=result.request_id,
-            shm_outputs=shm_outputs,
-            shm=self._shm,
-        )
+
+        def finish():
+            result = future.result()
+            if self._profiler is not None:
+                # per-model request latency — the Triton :8002 serving
+                # metrics role (README.md:88-95)
+                self._profiler.record(
+                    f"infer_{request.model_name}", time.perf_counter() - t0
+                )
+            return codec.build_infer_response(
+                model_name=result.model_name,
+                model_version=result.model_version,
+                outputs=result.outputs,
+                request_id=result.request_id,
+                shm_outputs=shm_outputs,
+                shm=self._shm,
+            )
+
+        return finish
+
+    def _infer(self, request):
+        return self._issue(request)()
 
     def _uses_shm(self, request) -> bool:
         return any(
@@ -257,15 +280,81 @@ class _Servicer(service.GRPCInferenceServiceServicer):
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
 
     def ModelStreamInfer(self, request_iterator, context):
-        for request in request_iterator:
-            if self._uses_shm(request):
-                self._require_local(context)
+        """Pipelined stream serving: up to ``stream_pipeline_depth``
+        requests stay in flight per stream — request N+1 parses and
+        launches (on a reader thread) while request N's compute runs;
+        responses come back in request order, each sent the moment it
+        resolves. Responses are NEVER withheld pending further
+        requests, so a lock-step client (send, wait, send) sees
+        strictly serial semantics regardless of depth — the pipelining
+        only engages when the client itself keeps requests in flight.
+        Depth 1 skips the reader thread entirely."""
+        if self._stream_depth <= 1:
+            for request in request_iterator:
+                if self._uses_shm(request):
+                    self._require_local(context)
+                try:
+                    yield pb.ModelStreamInferResponse(
+                        infer_response=self._infer(request)
+                    )
+                except (KeyError, ValueError) as e:
+                    yield pb.ModelStreamInferResponse(error_message=str(e))
+            return
+
+        import queue
+        import threading
+
+        # bounded handoff: the reader blocks once `depth` issued
+        # requests are awaiting resolution — the device-side
+        # backpressure for a client that floods the stream
+        q: queue.Queue = queue.Queue(maxsize=self._stream_depth)
+
+        def issue_loop() -> None:
             try:
-                yield pb.ModelStreamInferResponse(
-                    infer_response=self._infer(request)
-                )
-            except (KeyError, ValueError) as e:
-                yield pb.ModelStreamInferResponse(error_message=str(e))
+                for request in request_iterator:
+                    if self._uses_shm(request) and not self._is_local_peer(
+                        context
+                    ):
+                        # the abort must run on the handler thread
+                        q.put(("non_local", None))
+                        return
+                    try:
+                        finish = self._issue(request)
+                    except (KeyError, ValueError) as e:
+                        q.put(("error", str(e)))
+                        continue
+                    q.put(("finish", finish))
+            except Exception as e:  # surface reader crashes to the RPC
+                q.put(("crash", e))
+            finally:
+                q.put(("done", None))
+
+        reader = threading.Thread(
+            target=issue_loop, name="stream-issue", daemon=True
+        )
+        reader.start()
+        try:
+            while True:
+                kind, payload = q.get()
+                if kind == "done":
+                    return
+                if kind == "finish":
+                    try:
+                        yield pb.ModelStreamInferResponse(
+                            infer_response=payload()
+                        )
+                    except (KeyError, ValueError) as e:
+                        yield pb.ModelStreamInferResponse(
+                            error_message=str(e)
+                        )
+                elif kind == "error":
+                    yield pb.ModelStreamInferResponse(error_message=payload)
+                elif kind == "non_local":
+                    self._require_local(context)
+                else:  # crash
+                    raise payload
+        finally:
+            reader.join(timeout=5.0)
 
 
 class InferenceServer:
@@ -280,11 +369,15 @@ class InferenceServer:
         max_message_bytes: int | None = None,
         profiler=None,
         metrics_port: int = 0,
+        stream_pipeline_depth: int = 2,
     ) -> None:
         """``metrics_port``: serve per-model latency Histograms over
         Prometheus (Triton's :8002 role); 0 disables. ``profiler``: a
         StageProfiler to record into (created automatically when
-        metrics_port is set)."""
+        metrics_port is set). ``stream_pipeline_depth``: in-flight
+        requests per ModelStreamInfer stream (request N+1 launches
+        while N computes; 1 = strictly serial, the pre-round-6
+        behavior)."""
         if metrics_port and profiler is None:
             from triton_client_tpu.utils.profiling import StageProfiler
 
@@ -332,6 +425,7 @@ class InferenceServer:
                 channel,
                 profiler=profiler,
                 shm_registry=self.shm_registry,
+                stream_pipeline_depth=stream_pipeline_depth,
             ),
             self._server,
         )
