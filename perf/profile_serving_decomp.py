@@ -49,6 +49,7 @@ def main(argv=None) -> None:
 
     from triton_client_tpu.channel.base import InferRequest
     from triton_client_tpu.channel.tpu_channel import TPUChannel
+    from triton_client_tpu.obs import RuntimeCollector
     from triton_client_tpu.pipelines.detect2d import build_yolov5_pipeline
     from triton_client_tpu.runtime.batching import BatchingChannel
     from triton_client_tpu.runtime.repository import ModelRepository
@@ -93,18 +94,28 @@ def main(argv=None) -> None:
             inner, max_batch=8, timeout_us=3000, max_merge=16,
             pad_to_buckets=True, merge_hold_us=25_000, **kw,
         )
+        # the same snapshot/delta API the Prometheus custom collector
+        # scrapes in production — perf rows and dashboards read
+        # identical numbers instead of hand-diffing stats()
+        collector = RuntimeCollector(channel=batching)
         server = InferenceServer(
             repo, batching, address="127.0.0.1:0",
             max_workers=args.clients + 8,
         )
         server.start()
+        s0 = collector.snapshot()
         try:
             res = run_pool(
                 f"127.0.0.1:{server.port}", spec.name, {"images": frame},
                 clients=args.clients, duration_s=args.duration,
                 deadline_s=300.0,
             )
-            stats = batching.stats()
+            s1 = collector.snapshot()
+            stats = RuntimeCollector.delta(s1, s0).get("batching", {})
+            # level quantities (means / free-slot count), not counters:
+            # read from the raw snapshot, not the delta
+            for key in ("decomp_ms", "arena_free_slots"):
+                stats[key] = s1["batching"].get(key)
             lat = res.latencies_ms
             row = {
                 "case": name,

@@ -57,31 +57,47 @@ def _device_exec_ms(device_fn, device_inputs, trials: int = 5) -> float:
     return statistics.median(acc)
 
 
-def _drive(chan, requests, overlap: bool, depth: int = 2):
-    """Run the request stream; returns (wall_s, per-request ms)."""
-    from triton_client_tpu.channel.base import InferRequest  # noqa: F401
+def _drive(chan, requests, overlap: bool, depth: int = 2, tracer=None):
+    """Run the request stream; returns (wall_s, per-request ms).
 
+    ``tracer`` (obs.Tracer) attaches request-scoped spans — the
+    telemetry-overhead A/B: the span path must stay within 2% of the
+    untraced number with bitwise-identical results."""
     lats = []
     t_start = time.perf_counter()
     if not overlap:
         for req in requests:
+            req.trace = (
+                tracer.start(model=req.model_name) if tracer is not None else None
+            )
             t0 = time.perf_counter()
             chan.do_inference(req)
             lats.append((time.perf_counter() - t0) * 1e3)
+            if tracer is not None:
+                tracer.finish(req.trace)
     else:
         pending = collections.deque()
+
+        def resolve_oldest():
+            t0, fut, trace = pending.popleft()
+            fut.result()
+            lats.append((time.perf_counter() - t0) * 1e3)
+            if tracer is not None:
+                tracer.finish(trace)
+
         for req in requests:
-            pending.append((time.perf_counter(), chan.do_inference_async(req)))
+            req.trace = (
+                tracer.start(model=req.model_name) if tracer is not None else None
+            )
+            pending.append(
+                (time.perf_counter(), chan.do_inference_async(req), req.trace)
+            )
             # keep `depth` requests in flight; resolve the oldest once
             # the window is full (issue-order retirement, lazy readback)
             while len(pending) >= depth:
-                t0, fut = pending.popleft()
-                fut.result()
-                lats.append((time.perf_counter() - t0) * 1e3)
+                resolve_oldest()
         while pending:
-            t0, fut = pending.popleft()
-            fut.result()
-            lats.append((time.perf_counter() - t0) * 1e3)
+            resolve_oldest()
     return time.perf_counter() - t_start, lats
 
 
@@ -133,11 +149,17 @@ def main(argv=None) -> None:
     p.add_argument("--batches", default="1,8,64")
     p.add_argument("--models", default="yolov5,pointpillars")
     p.add_argument("--depth", type=int, default=2)
+    p.add_argument(
+        "--trace", action="store_true",
+        help="attach request-scoped spans (obs.Tracer) — the telemetry "
+        "overhead A/B; rows gain min span coverage",
+    )
     args = p.parse_args(argv)
     batches = [int(b) for b in args.batches.split(",") if b]
     models = [m.strip() for m in args.models.split(",") if m.strip()]
 
     from triton_client_tpu.channel.tpu_channel import TPUChannel
+    from triton_client_tpu.obs import RuntimeCollector, Tracer
     from triton_client_tpu.runtime.repository import ModelRepository
 
     for name, b, frames_per_req, pipe, spec, sample, reqs in _cases(
@@ -153,16 +175,22 @@ def main(argv=None) -> None:
                 pipeline_depth=args.depth if overlap else 1,
                 donate=overlap,
             )
+            # the same snapshot/delta API production scrapes through
+            # the Prometheus custom collector — no hand-rolled stats()
+            # diffing, offline and prod read identical numbers
+            collector = RuntimeCollector(channel=chan)
+            tracer = (
+                Tracer(capacity=len(reqs)) if args.trace else None
+            )
+            reqs[0].trace = None
             chan.do_inference(reqs[0])  # warm the launch path
-            s0 = chan.stats()
-            wall, lats = _drive(chan, reqs, overlap, depth=args.depth)
+            s0 = collector.snapshot()
+            wall, lats = _drive(
+                chan, reqs, overlap, depth=args.depth, tracer=tracer
+            )
             busy = len(reqs) * t_exec_ms / 1e3
-            stats = chan.stats()
-            occupancy = {
-                k: v - s0["slot_occupancy"].get(k, 0)
-                for k, v in stats["slot_occupancy"].items()
-                if v - s0["slot_occupancy"].get(k, 0)
-            }
+            d = RuntimeCollector.delta(collector.snapshot(), s0)
+            dchan = d.get("channel", {})
             row = {
                 "case": f"{name}_b{b}_{mode}",
                 "model": name,
@@ -175,11 +203,14 @@ def main(argv=None) -> None:
                 "p99_ms": round(float(np.percentile(lats, 99)), 2),
                 "device_exec_ms": round(t_exec_ms, 3),
                 "device_idle_frac": round(max(0.0, 1.0 - busy / wall), 3),
-                "donated_launches": (
-                    stats["donated_launches"] - s0["donated_launches"]
-                ),
-                "slot_occupancy": occupancy,
+                "donated_launches": dchan.get("donated_launches", 0),
+                "slot_occupancy": dchan.get("slot_occupancy", {}),
+                "jit_compiles": d.get("compile", {}).get("compiles", 0),
             }
+            if tracer is not None:
+                row["span_coverage_min"] = round(
+                    min(t.span_coverage() for t in tracer.recent()), 3
+                )
             print(json.dumps(row), flush=True)
 
 

@@ -161,6 +161,40 @@ def test_exporter_collision_degrades_not_raises():
     ex.observe("yolo.v5", 0.01)  # and keeps working afterwards
 
 
+def test_exporter_shares_family_on_same_registry():
+    """Registry-collision fix: a second exporter on the same registry
+    reuses the registered Histogram family — both record — instead of
+    hitting the duplicate-registration ValueError and silently
+    recording nothing."""
+    prometheus_client = pytest.importorskip("prometheus_client")
+    from triton_client_tpu.utils.profiling import PrometheusStageExporter
+
+    registry = prometheus_client.CollectorRegistry()
+    a = PrometheusStageExporter(0, registry=registry)
+    b = PrometheusStageExporter(0, registry=registry)
+    a.observe("infer_m", 0.01)
+    b.observe("infer_m", 0.02)
+    body = prometheus_client.generate_latest(registry).decode()
+    assert (
+        'tpu_serving_stage_latency_seconds_count{stage="infer_m"} 2.0'
+        in body
+    )
+
+
+def test_exporter_registries_are_independent():
+    prometheus_client = pytest.importorskip("prometheus_client")
+    from triton_client_tpu.utils.profiling import PrometheusStageExporter
+
+    r1 = prometheus_client.CollectorRegistry()
+    r2 = prometheus_client.CollectorRegistry()
+    PrometheusStageExporter(0, registry=r1).observe("only_r1", 0.01)
+    PrometheusStageExporter(0, registry=r2).observe("only_r2", 0.01)
+    b1 = prometheus_client.generate_latest(r1).decode()
+    b2 = prometheus_client.generate_latest(r2).decode()
+    assert 'stage="only_r1"' in b1 and 'stage="only_r1"' not in b2
+    assert 'stage="only_r2"' in b2 and 'stage="only_r2"' not in b1
+
+
 def test_listener_exception_does_not_break_record():
     p = StageProfiler()
 

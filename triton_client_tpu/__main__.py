@@ -12,6 +12,8 @@ Commands map 1:1 onto the reference's entry scripts:
   bag-stitch — tools/bag_stitch.py (truncate a bag)
   repo-index — list a model repository (local dir or grpc:<addr>)
   bag-info   — rosbag info equivalent
+  trace-dump — Chrome-trace JSON of recent requests from a serving
+               process's telemetry port (serve --metrics-port)
 """
 
 from __future__ import annotations
@@ -30,6 +32,7 @@ COMMANDS = (
     "bag-stitch",
     "bag-info",
     "repo-index",
+    "trace-dump",
 )
 
 
@@ -61,6 +64,8 @@ def main() -> None:
         from triton_client_tpu.cli.tools import bag_info as run
     elif cmd == "repo-index":
         from triton_client_tpu.cli.tools import repo_index as run
+    elif cmd == "trace-dump":
+        from triton_client_tpu.cli.tools import trace_dump as run
     else:
         print(f"unknown command '{cmd}'; commands: {', '.join(COMMANDS)}")
         raise SystemExit(2)

@@ -27,6 +27,12 @@ class InferRequest:
     inputs: Mapping[str, np.ndarray]
     model_version: str = ""
     request_id: str = ""
+    # request-scoped telemetry (obs.trace.RequestTrace / MultiTrace).
+    # None on the un-traced hot path: channels guard on the attribute,
+    # so disabled tracing costs one attribute read per phase.
+    trace: object | None = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
 
 
 @dataclasses.dataclass

@@ -178,6 +178,7 @@ class TPUChannel(BaseChannel):
             out = dict(self._stats)
             out["slot_occupancy"] = dict(sorted(self._slot_occupancy.items()))
             out["inflight"] = len(self._inflight)
+            out["slots_active"] = self._slots_active
             out["pipeline_depth"] = self._pipeline_depth
         return out
 
@@ -190,6 +191,8 @@ class TPUChannel(BaseChannel):
         executing, so the H2D copy of the next batch overlaps (at most)
         depth in-flight computations — double-buffered at the default
         depth of 2. Must be paired with ``launch``."""
+        tr = request.trace
+        t_s0 = time.perf_counter() if tr is not None else 0.0
         model = self._repository.get(request.model_name, request.model_version)
         if self._validate:
             for tensor_spec in model.spec.inputs:
@@ -200,7 +203,12 @@ class TPUChannel(BaseChannel):
                         f"{sorted(request.inputs)}"
                     )
                 tensor_spec.validate(np.asarray(request.inputs[tensor_spec.name]))
-        self._acquire_slot()
+        if tr is not None:
+            t_w0 = time.perf_counter()
+            self._acquire_slot()
+            tr.add("slot_wait", t_w0, time.perf_counter())
+        else:
+            self._acquire_slot()
         try:
             sharding = batch_sharding(self._mesh)
             device_inputs = {}
@@ -240,7 +248,11 @@ class TPUChannel(BaseChannel):
             raise
         with self._slot_cv:
             self._stats["staged"] += 1
-        return StagedRequest(model, device_inputs, request, time.perf_counter())
+        t_staged = time.perf_counter()
+        if tr is not None:
+            # the whole stage phase: validate + slot admission + H2D
+            tr.add("stage", t_s0, t_staged)
+        return StagedRequest(model, device_inputs, request, t_staged)
 
     def _acquire_slot(self) -> None:
         waited = False
@@ -292,6 +304,7 @@ class TPUChannel(BaseChannel):
         finishes executing (whichever of a later ``stage`` or this
         future's resolution observes it first)."""
         model, request = staged.model, staged.request
+        tr = request.trace
         t0 = time.perf_counter()
         try:
             launcher, donate_names, out_dtype = self._launcher(model)
@@ -313,6 +326,9 @@ class TPUChannel(BaseChannel):
             self._release_slot()
             return InferFuture.failed(e)
         rec = _Inflight(outputs)
+        t_launched = time.perf_counter()
+        if tr is not None:
+            tr.add("launch", t0, t_launched)
         with self._slot_cv:
             self._inflight.append(rec)
             self._stats["launched"] += 1
@@ -322,6 +338,14 @@ class TPUChannel(BaseChannel):
 
         def resolve() -> InferResponse:
             try:
+                if tr is not None:
+                    # device window: enqueue -> execution complete.
+                    # block_until_ready is what np.asarray would wait on
+                    # anyway; forcing it here splits execute from the
+                    # device->host copy in the request timeline.
+                    jax.block_until_ready(outputs)
+                    t_ready = time.perf_counter()
+                    tr.add("device_execute", t_launched, t_ready)
                 host = {}
                 for k, v in outputs.items():
                     # wire-contract dtypes at the host boundary: device
@@ -330,6 +354,8 @@ class TPUChannel(BaseChannel):
                     # device_fn — the cast keeps launch paths identical
                     dt = out_dtype.get(k) if out_dtype else None
                     host[k] = np.asarray(v, dtype=dt) if dt else np.asarray(v)
+                if tr is not None:
+                    tr.add("readback", t_ready, time.perf_counter())
             finally:
                 self._retire(rec)
             return InferResponse(

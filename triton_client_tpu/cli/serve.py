@@ -63,8 +63,14 @@ def main(argv=None) -> None:
     )
     p.add_argument(
         "--metrics-port", type=int, default=8002,
-        help="Prometheus per-model latency metrics (Triton :8002 parity; "
-        "0 disables)",
+        help="telemetry endpoint: Prometheus metrics on /metrics (Triton "
+        ":8002 parity), Chrome-trace JSON on /traces, raw collector "
+        "state on /snapshot (0 disables)",
+    )
+    p.add_argument(
+        "--trace-capacity", type=int, default=256,
+        help="recent request traces kept for /traces export "
+        "(`trace-dump`); 0 disables request-scoped spans",
     )
     p.add_argument(
         "--warmup", action="store_true",
@@ -79,7 +85,10 @@ def main(argv=None) -> None:
     # where block buffering would hold it until exit.
     print(f"KServe v2 gRPC server listening on port {server.port}", flush=True)
     if server.metrics_enabled:
-        print(f"Prometheus metrics on :{args.metrics_port}", flush=True)
+        print(
+            f"telemetry on :{server.metrics_port} "
+            "(/metrics /traces /snapshot)", flush=True,
+        )
     try:
         server.wait()
     except KeyboardInterrupt:
@@ -130,6 +139,7 @@ def build_server(args):
         address=args.address,
         max_workers=args.max_workers,
         metrics_port=args.metrics_port,
+        trace_capacity=getattr(args, "trace_capacity", 256),
     )
 
 

@@ -8,6 +8,9 @@
                 (tools/bag_stitch.py:1-8).
   bag-info    — topics/types/counts of a bag (rosbag info equivalent,
                 handy since TPU hosts have no ROS tooling).
+  trace-dump  — pull the request-trace ring buffer off a serving
+                process's telemetry port as Chrome-trace JSON
+                (open in Perfetto / chrome://tracing).
 """
 
 from __future__ import annotations
@@ -85,6 +88,57 @@ def bag_info(argv=None) -> None:
         print(f"duration: {t1 - t0:.3f}s  messages: {sum(counts.values())}")
     for topic in sorted(counts):
         print(f"  {topic}  {types.get(topic, '?')}  {counts[topic]} msgs")
+
+
+def trace_dump(argv=None) -> None:
+    """Fetch recent request traces from a live server's telemetry port
+    and write Chrome-trace JSON — the CLI face of the /traces handler
+    (runtime server -> obs.TelemetryServer)."""
+    p = argparse.ArgumentParser(
+        description="dump recent request traces as Chrome-trace JSON"
+    )
+    p.add_argument(
+        "--url", default="http://127.0.0.1:8002",
+        help="telemetry endpoint of the serving process "
+        "(serve --metrics-port)",
+    )
+    p.add_argument(
+        "-n", "--count", type=int, default=0,
+        help="most recent N traces (0 = everything buffered)",
+    )
+    p.add_argument(
+        "-o", "--output", default="-",
+        help="output file ('-' = stdout); load in Perfetto or "
+        "chrome://tracing",
+    )
+    p.add_argument("--timeout", type=float, default=10.0)
+    args = p.parse_args(argv)
+
+    import json
+    import sys
+    import urllib.request
+
+    url = args.url.rstrip("/") + "/traces"
+    if args.count:
+        url += f"?n={args.count}"
+    with urllib.request.urlopen(url, timeout=args.timeout) as resp:
+        doc = json.load(resp)
+    events = doc.get("traceEvents")
+    if events is None:
+        raise SystemExit(f"{url} returned no traceEvents (not a trace dump?)")
+    body = json.dumps(doc)
+    if args.output == "-":
+        print(body)
+    else:
+        with open(args.output, "w") as f:
+            f.write(body)
+        n_req = sum(
+            1 for e in events if e.get("ph") == "X" and e.get("name") == "request"
+        )
+        print(
+            f"wrote {n_req} request traces ({len(events)} events) -> "
+            f"{args.output}", file=sys.stderr,
+        )
 
 
 def repo_index(argv=None) -> None:

@@ -1,0 +1,437 @@
+"""Runtime collector: in-process serving counters -> Prometheus.
+
+TPUChannel and BatchingChannel keep their hot-path counters in plain
+dicts (``stats()``) so recording costs an increment under a lock the
+path already holds. Until this module, those numbers were visible only
+to offline perf scripts that diffed ``stats()`` dicts by hand
+(perf/profile_serving_overlap.py, perf/profile_serving_decomp.py).
+``RuntimeCollector`` is the bridge:
+
+- ``snapshot()`` / ``delta()`` — one structured read of everything
+  (channel, batcher, HBM, jit compile events, error counts), used by
+  the perf scripts AND by the Prometheus export, so offline and
+  production read identical numbers;
+- Prometheus custom collector — registered into a (per-server)
+  registry, it converts each snapshot into typed gauge/counter
+  families at scrape time: no background thread, no double
+  bookkeeping, scrape-time consistency with ``stats()``.
+
+Compile events ride ``jax.monitoring``: every
+``.../backend_compile_duration`` event increments a process-global
+counter (count + cumulative seconds), so a recompile storm — e.g. an
+unbucketed shape leaking one executable per batch size — shows up as a
+climbing ``tpu_serving_jit_compiles_total`` instead of mystery tail
+latency.
+"""
+
+from __future__ import annotations
+
+import threading
+
+# Every family the collector always exports, name -> prometheus type.
+# Families exist (HELP/TYPE lines) even when their component is absent
+# or idle, so a refactor that drops a series fails the smoke test
+# (tests/test_telemetry.py) instead of silently blanking a dashboard.
+# Device HBM gauges are deliberately NOT here: they exist only on
+# backends whose devices report memory_stats() (TPU/GPU, not CPU).
+METRIC_TYPES: dict[str, str] = {
+    # server / request plane
+    "tpu_serving_inflight_requests": "gauge",
+    "tpu_serving_request_errors_total": "counter",
+    # TPUChannel staging slots
+    "tpu_serving_inflight_batches": "gauge",
+    "tpu_serving_staging_slots_active": "gauge",
+    "tpu_serving_pipeline_depth": "gauge",
+    "tpu_serving_staged_requests_total": "counter",
+    "tpu_serving_launched_batches_total": "counter",
+    "tpu_serving_donated_launches_total": "counter",
+    "tpu_serving_stage_slot_waits_total": "counter",
+    "tpu_serving_slot_occupancy_launches_total": "counter",
+    # BatchingChannel formation
+    "tpu_serving_queue_depth": "gauge",
+    "tpu_serving_batch_active_slots": "gauge",
+    "tpu_serving_batch_fill_ratio": "gauge",
+    "tpu_serving_batch_merges_total": "counter",
+    "tpu_serving_batched_frames_total": "counter",
+    "tpu_serving_padded_frames_total": "counter",
+    "tpu_serving_batch_launch_frees_total": "counter",
+    "tpu_serving_merge_occupancy_total": "counter",
+    # jit compile events (process-global)
+    "tpu_serving_jit_compiles_total": "counter",
+    "tpu_serving_jit_compile_seconds_total": "counter",
+    # tracer ring buffer
+    "tpu_serving_traces_finished_total": "counter",
+    "tpu_serving_trace_buffered": "gauge",
+}
+
+_HBM_KINDS = ("bytes_in_use", "bytes_limit", "peak_bytes_in_use")
+
+
+class CompileEvents:
+    """Process-global jit compile-event counter (jax.monitoring).
+
+    One listener per process, installed lazily on first use; jax has no
+    listener removal API short of clear_event_listeners, so the
+    singleton stays for the process lifetime — which is exactly the
+    scope a compile counter wants."""
+
+    _instance: "CompileEvents | None" = None
+    _install_lock = threading.Lock()
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.compiles = 0
+        self.compile_seconds = 0.0
+
+    @classmethod
+    def install(cls) -> "CompileEvents":
+        with cls._install_lock:
+            if cls._instance is None:
+                inst = cls()
+                try:
+                    import jax.monitoring
+
+                    jax.monitoring.register_event_duration_secs_listener(
+                        inst._on_event
+                    )
+                except Exception:  # jax absent/too old: counter stays 0
+                    pass
+                cls._instance = inst
+            return cls._instance
+
+    def _on_event(self, name: str, duration: float, **kwargs) -> None:
+        # "/jax/core/compile/backend_compile_duration" fires once per
+        # XLA compilation; the other /jax/core/compile/* events are
+        # tracing/lowering stages we fold out to keep 1 event == 1
+        # executable.
+        if name.endswith("backend_compile_duration"):
+            with self._lock:
+                self.compiles += 1
+                self.compile_seconds += float(duration)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "compiles": self.compiles,
+                "compile_seconds": self.compile_seconds,
+            }
+
+
+def _split_channel(channel):
+    """(BatchingChannel | None, TPUChannel | None) from a channel stack.
+
+    Duck-typed: the batcher is anything with ``inner`` + ``stats``; the
+    staging channel is anything with ``stats`` + ``pipeline_depth``."""
+    batching, tpu, c = None, None, channel
+    if c is not None and hasattr(c, "inner") and hasattr(c, "stats"):
+        batching = c
+        c = c.inner
+    if c is not None and hasattr(c, "stats") and hasattr(c, "pipeline_depth"):
+        tpu = c
+    return batching, tpu
+
+
+class RuntimeCollector:
+    """One structured read of the serving plane's runtime state.
+
+    Works with or without prometheus_client: ``snapshot()``/``delta()``
+    are plain dicts (the perf-script API); passing ``registry=``
+    additionally registers this object as a Prometheus custom collector
+    whose families are generated from a snapshot at scrape time."""
+
+    def __init__(
+        self,
+        channel=None,
+        tracer=None,
+        namespace: str = "tpu_serving",
+        registry=None,
+    ) -> None:
+        self._batching, self._tpu = _split_channel(channel)
+        self._tracer = tracer
+        self._ns = namespace
+        self._compile = CompileEvents.install()
+        self._lock = threading.Lock()
+        self._inflight_requests = 0
+        self._errors: dict[tuple[str, str], int] = {}
+        self._registry = None
+        if registry is not None:
+            registry.register(self)
+            self._registry = registry
+
+    # -- request-plane hooks (called by the server) ---------------------------
+
+    def request_started(self) -> None:
+        with self._lock:
+            self._inflight_requests += 1
+
+    def request_finished(self) -> None:
+        with self._lock:
+            self._inflight_requests -= 1
+
+    def record_error(self, model: str, code: str) -> None:
+        with self._lock:
+            key = (model, code)
+            self._errors[key] = self._errors.get(key, 0) + 1
+
+    # -- snapshot API (perf scripts + scrape share this) ----------------------
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            inflight = self._inflight_requests
+            errors = {f"{m}|{c}": n for (m, c), n in self._errors.items()}
+        snap = {
+            "channel": self._tpu.stats() if self._tpu is not None else None,
+            "batching": (
+                self._batching.stats() if self._batching is not None else None
+            ),
+            "inflight_requests": inflight,
+            "errors": errors,
+            "compile": self._compile.snapshot(),
+            "memory": self._memory(),
+        }
+        if self._tracer is not None:
+            snap["tracer"] = self._tracer.stats()
+        return snap
+
+    @staticmethod
+    def delta(new: dict, old: dict) -> dict:
+        """Recursive numeric diff of two snapshots, zero/empty leaves
+        dropped — the structured replacement for the hand-rolled
+        ``stats()`` delta-diffing the perf scripts used to do."""
+
+        def diff(n, o):
+            if isinstance(n, dict):
+                o = o if isinstance(o, dict) else {}
+                out = {}
+                for k, v in n.items():
+                    r = diff(v, o.get(k))
+                    if r not in (None, 0, 0.0, {}):
+                        out[k] = r
+                return out
+            if isinstance(n, bool) or not isinstance(n, (int, float)):
+                return None
+            base = o if isinstance(o, (int, float)) and not isinstance(o, bool) else 0
+            return n - base
+
+        return diff(new, old if isinstance(old, dict) else {})
+
+    def _memory(self) -> dict:
+        """Per-device memory_stats() (HBM on TPU; None/absent on CPU)."""
+        out = {}
+        try:
+            if self._tpu is not None:
+                devices = list(self._tpu.fetch_channel().devices.flat)
+            else:
+                import jax
+
+                devices = jax.local_devices()
+        except Exception:
+            return out
+        for d in devices:
+            try:
+                ms = d.memory_stats()
+            except Exception:
+                ms = None
+            if ms:
+                out[str(getattr(d, "id", d))] = {
+                    k: v for k, v in ms.items() if isinstance(v, (int, float))
+                }
+        return out
+
+    # -- Prometheus custom-collector protocol ---------------------------------
+
+    def describe(self):
+        # Registered as an "unchecked" collector: families are dynamic
+        # (labels appear as models/depths are observed), so describe()
+        # returns nothing rather than a stale inventory.
+        return []
+
+    def collect(self):
+        from prometheus_client.core import (
+            CounterMetricFamily,
+            GaugeMetricFamily,
+        )
+
+        snap = self.snapshot()
+        chan = snap["channel"] or {}
+        bat = snap["batching"] or {}
+        ns = self._ns
+
+        def gauge(name, doc, value, labels=None, samples=()):
+            fam = GaugeMetricFamily(name, doc, labels=labels or [])
+            if labels:
+                for lv, v in samples:
+                    fam.add_metric(lv, v)
+            else:
+                fam.add_metric([], value)
+            return fam
+
+        def counter(name, doc, value, labels=None, samples=()):
+            fam = CounterMetricFamily(name, doc, labels=labels or [])
+            if labels:
+                for lv, v in samples:
+                    fam.add_metric(lv, v)
+            else:
+                fam.add_metric([], value)
+            return fam
+
+        yield gauge(
+            f"{ns}_inflight_requests",
+            "gRPC requests currently being served",
+            snap["inflight_requests"],
+        )
+        yield counter(
+            f"{ns}_request_errors_total",
+            "failed requests by model and gRPC status code",
+            0,
+            labels=["model", "code"],
+            samples=[
+                (key.split("|", 1), n) for key, n in snap["errors"].items()
+            ],
+        )
+
+        # TPUChannel staging slots
+        yield gauge(
+            f"{ns}_inflight_batches",
+            "launched, not-yet-retired device batches",
+            chan.get("inflight", 0),
+        )
+        yield gauge(
+            f"{ns}_staging_slots_active",
+            "staging slots currently held (stage..retire)",
+            chan.get("slots_active", 0),
+        )
+        yield gauge(
+            f"{ns}_pipeline_depth",
+            "configured staging pipeline depth",
+            chan.get("pipeline_depth", 0),
+        )
+        yield counter(
+            f"{ns}_staged_requests_total",
+            "requests staged onto the device mesh",
+            chan.get("staged", 0),
+        )
+        yield counter(
+            f"{ns}_launched_batches_total",
+            "device batches launched",
+            chan.get("launched", 0),
+        )
+        yield counter(
+            f"{ns}_donated_launches_total",
+            "launches through the donated-buffer jit path",
+            chan.get("donated_launches", 0),
+        )
+        yield counter(
+            f"{ns}_stage_slot_waits_total",
+            "stage() calls that blocked on a staging slot",
+            chan.get("stage_slot_waits", 0),
+        )
+        yield counter(
+            f"{ns}_slot_occupancy_launches_total",
+            "launches observed at each in-flight depth",
+            0,
+            labels=["inflight"],
+            samples=[
+                ([str(k)], v)
+                for k, v in (chan.get("slot_occupancy") or {}).items()
+            ],
+        )
+
+        # BatchingChannel formation
+        queue_depth = bat.get("ready_depth", 0) + bat.get("queue_depth", 0)
+        yield gauge(
+            f"{ns}_queue_depth",
+            "requests admitted or staged, awaiting dispatch",
+            queue_depth,
+        )
+        yield gauge(
+            f"{ns}_batch_active_slots",
+            "batcher execution slots currently active",
+            bat.get("active_slots", 0),
+        )
+        merges = bat.get("merges", 0)
+        fill = 0.0
+        if merges and bat.get("max_merge"):
+            fill = bat.get("merged_frames", 0) / merges / bat["max_merge"]
+        yield gauge(
+            f"{ns}_batch_fill_ratio",
+            "mean merged frames per dispatch / max_merge",
+            fill,
+        )
+        yield counter(
+            f"{ns}_batch_merges_total",
+            "device batches formed at dispatch time",
+            merges,
+        )
+        yield counter(
+            f"{ns}_batched_frames_total",
+            "frames merged into device batches",
+            bat.get("merged_frames", 0),
+        )
+        yield counter(
+            f"{ns}_padded_frames_total",
+            "pad rows added by bucket padding",
+            bat.get("padded_frames", 0),
+        )
+        yield counter(
+            f"{ns}_batch_launch_frees_total",
+            "execution slots freed at launch (pre-readback)",
+            bat.get("launch_frees", 0),
+        )
+        yield counter(
+            f"{ns}_merge_occupancy_total",
+            "dispatches observed at each merged frame count",
+            0,
+            labels=["frames"],
+            samples=[
+                ([str(k)], v)
+                for k, v in (bat.get("merge_occupancy") or {}).items()
+            ],
+        )
+
+        # jit compile events
+        comp = snap["compile"]
+        yield counter(
+            f"{ns}_jit_compiles_total",
+            "XLA backend compilations observed (jax.monitoring)",
+            comp["compiles"],
+        )
+        yield counter(
+            f"{ns}_jit_compile_seconds_total",
+            "cumulative seconds spent in XLA backend compilation",
+            comp["compile_seconds"],
+        )
+
+        # tracer ring buffer
+        tr = snap.get("tracer") or {}
+        yield counter(
+            f"{ns}_traces_finished_total",
+            "request traces finished",
+            tr.get("finished", 0),
+        )
+        yield gauge(
+            f"{ns}_trace_buffered",
+            "request traces held in the export ring buffer",
+            tr.get("buffered", 0),
+        )
+
+        # device HBM (absent on backends without memory_stats)
+        if snap["memory"]:
+            fam = GaugeMetricFamily(
+                f"{ns}_device_hbm_bytes",
+                "per-device memory_stats() bytes",
+                labels=["device", "kind"],
+            )
+            for dev, stats in snap["memory"].items():
+                for kind in _HBM_KINDS:
+                    if kind in stats:
+                        fam.add_metric([dev, kind], stats[kind])
+            yield fam
+
+    def close(self) -> None:
+        if self._registry is not None:
+            try:
+                self._registry.unregister(self)
+            except KeyError:
+                pass
+            self._registry = None

@@ -1,0 +1,120 @@
+"""Telemetry HTTP endpoint: /metrics + /traces + /snapshot on one port.
+
+Replaces ``prometheus_client.start_http_server`` on the serving metrics
+port so the same port the Prometheus scraper already targets (the
+reference's :8002 story, data/prometheus.yml) also serves the request
+traces and the raw collector snapshot:
+
+  GET /metrics   Prometheus exposition of the server's registry
+  GET /traces    Chrome-trace JSON of the tracer ring buffer
+                 (?n=K limits to the K most recent; load in Perfetto)
+  GET /snapshot  RuntimeCollector.snapshot() as JSON (debug/automation)
+
+Paths degrade independently: without prometheus_client /metrics is 503
+but traces still export; without a tracer /traces is 404.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+log = logging.getLogger(__name__)
+
+
+class TelemetryServer:
+    """Bound on construction (port 0 picks an ephemeral port — tests and
+    multi-server processes); serves on a daemon thread until close()."""
+
+    def __init__(
+        self,
+        port: int = 8002,
+        registry=None,
+        tracer=None,
+        collector=None,
+        host: str = "0.0.0.0",
+    ) -> None:
+        self._registry = registry
+        self._tracer = tracer
+        self._collector = collector
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # no per-scrape stderr spam
+                log.debug("telemetry http: " + fmt, *args)
+
+            def do_GET(self):
+                try:
+                    outer._handle(self)
+                except BrokenPipeError:
+                    pass  # scraper went away mid-response
+                except Exception:
+                    log.exception("telemetry handler failed for %s", self.path)
+                    try:
+                        self.send_error(500)
+                    except Exception:
+                        pass
+
+        self._httpd = ThreadingHTTPServer((host, int(port)), Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="telemetry-http",
+            daemon=True,
+        )
+        self._thread.start()
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def _handle(self, req) -> None:
+        parsed = urlparse(req.path)
+        path = parsed.path.rstrip("/") or "/"
+        if path == "/metrics":
+            if self._registry is None:
+                self._send(req, 503, b"prometheus_client unavailable\n")
+                return
+            import prometheus_client
+
+            body = prometheus_client.generate_latest(self._registry)
+            self._send(req, 200, body, prometheus_client.CONTENT_TYPE_LATEST)
+        elif path in ("/traces", "/trace"):
+            if self._tracer is None:
+                self._send(req, 404, b"tracing disabled\n")
+                return
+            q = parse_qs(parsed.query)
+            try:
+                n = int(q.get("n", ["0"])[0])
+            except ValueError:
+                n = 0
+            body = json.dumps(self._tracer.chrome_trace(n)).encode()
+            self._send(req, 200, body, "application/json")
+        elif path == "/snapshot":
+            if self._collector is None:
+                self._send(req, 404, b"collector disabled\n")
+                return
+            body = json.dumps(self._collector.snapshot(), default=str).encode()
+            self._send(req, 200, body, "application/json")
+        elif path == "/":
+            self._send(
+                req, 200, b"tpu_serving telemetry: /metrics /traces /snapshot\n"
+            )
+        else:
+            self._send(req, 404, b"not found\n")
+
+    @staticmethod
+    def _send(req, code: int, body: bytes, ctype: str = "text/plain") -> None:
+        req.send_response(code)
+        req.send_header("Content-Type", ctype)
+        req.send_header("Content-Length", str(len(body)))
+        req.end_headers()
+        req.wfile.write(body)
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5.0)

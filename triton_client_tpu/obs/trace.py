@@ -1,0 +1,328 @@
+"""Request-scoped spans through the overlapped serving pipeline.
+
+A ``RequestTrace`` is a flat, thread-safe list of named
+``(t0, t1)`` intervals on the ``time.perf_counter`` clock — one trace
+per served request, carried on ``InferRequest.trace`` through the
+server, the batcher and the channel. Call sites guard on the attribute
+(``tr = request.trace; if tr is not None: ...``), so the un-traced hot
+path costs one attribute read per phase and allocates nothing.
+
+Spans deliberately do NOT form a tree: the overlapped pipeline runs a
+request's phases on several threads (gRPC handler, batch dispatcher,
+executor), and what tail-latency attribution needs is the wall-clock
+interval of each phase, not a call stack. Nesting falls out of
+interval containment in the Chrome trace view (``stage`` contains
+``slot_wait``; the request row contains everything).
+
+``Tracer`` owns the bounded ring buffer of recently finished traces
+and the Chrome-trace JSON export (``chrome_trace``) that Perfetto /
+``chrome://tracing`` load directly; finished spans also feed the
+per-stage Prometheus histogram family through the attached
+StageProfiler (stage label ``span_<name>``).
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import itertools
+import json
+import threading
+import time
+from typing import Iterator
+
+
+class Span:
+    """One named wall-clock interval on the perf_counter clock."""
+
+    __slots__ = ("name", "t0", "t1")
+
+    def __init__(self, name: str, t0: float, t1: float) -> None:
+        self.name = name
+        self.t0 = t0
+        self.t1 = t1
+
+    @property
+    def duration_s(self) -> float:
+        return self.t1 - self.t0
+
+    def __repr__(self) -> str:  # test/debug ergonomics
+        return f"Span({self.name!r}, {self.duration_s * 1e3:.3f} ms)"
+
+
+class RequestTrace:
+    """Spans for one request. Append-only, safe from any thread.
+
+    ``begin(name)`` / ``end(name)`` open and close a span across
+    threads (the batcher opens ``batch_queue`` on the gRPC handler
+    thread and closes it on the executor); ``end`` without a matching
+    ``begin`` is a no-op, and a span left open when the trace finishes
+    is dropped — observability must never fail the observed path.
+    """
+
+    __slots__ = (
+        "trace_id",
+        "model",
+        "request_id",
+        "t_start",
+        "t_end",
+        "status",
+        "spans",
+        "_open",
+        "_lock",
+    )
+
+    def __init__(self, trace_id: int, model: str = "", request_id: str = "") -> None:
+        self.trace_id = trace_id
+        self.model = model
+        self.request_id = request_id
+        self.t_start = time.perf_counter()
+        self.t_end: float | None = None
+        self.status = "ok"
+        self.spans: list[Span] = []
+        self._open: dict[str, float] = {}
+        self._lock = threading.Lock()
+
+    # -- recording ------------------------------------------------------------
+
+    def add(self, name: str, t0: float, t1: float) -> None:
+        with self._lock:
+            self.spans.append(Span(name, t0, t1))
+
+    @contextlib.contextmanager
+    def span(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add(name, t0, time.perf_counter())
+
+    def begin(self, name: str) -> None:
+        with self._lock:
+            self._open[name] = time.perf_counter()
+
+    def end(self, name: str) -> None:
+        t1 = time.perf_counter()
+        with self._lock:
+            t0 = self._open.pop(name, None)
+            if t0 is not None:
+                self.spans.append(Span(name, t0, t1))
+
+    # -- reading --------------------------------------------------------------
+
+    def wall_s(self) -> float:
+        end = self.t_end if self.t_end is not None else time.perf_counter()
+        return end - self.t_start
+
+    def span_coverage(self) -> float:
+        """Fraction of [t_start, t_end] covered by the union of spans —
+        the acceptance gauge for 'no invisible time in the pipeline'."""
+        wall = self.wall_s()
+        if wall <= 0:
+            return 1.0
+        with self._lock:
+            ivals = sorted((s.t0, s.t1) for s in self.spans)
+        covered, cur0, cur1 = 0.0, None, None
+        for t0, t1 in ivals:
+            if cur1 is None or t0 > cur1:
+                if cur1 is not None:
+                    covered += cur1 - cur0
+                cur0, cur1 = t0, t1
+            else:
+                cur1 = max(cur1, t1)
+        if cur1 is not None:
+            covered += cur1 - cur0
+        return min(1.0, covered / wall)
+
+    def summary(self) -> dict:
+        with self._lock:
+            spans = [
+                {
+                    "name": s.name,
+                    "t0_s": s.t0 - self.t_start,
+                    "dur_ms": s.duration_s * 1e3,
+                }
+                for s in sorted(self.spans, key=lambda s: s.t0)
+            ]
+        return {
+            "trace_id": self.trace_id,
+            "model": self.model,
+            "request_id": self.request_id,
+            "status": self.status,
+            "wall_ms": self.wall_s() * 1e3,
+            "spans": spans,
+        }
+
+
+class MultiTrace:
+    """Fan-out proxy for merged device batches.
+
+    The batcher concatenates N requests into one inner-channel call;
+    the merged InferRequest carries a MultiTrace over the members'
+    traces, so channel-side spans (stage/launch/device_execute/
+    readback) land on EVERY member — each request's trace shows the
+    shared device work it rode on."""
+
+    __slots__ = ("members",)
+
+    def __init__(self, members) -> None:
+        self.members = [m for m in members if m is not None]
+
+    def add(self, name: str, t0: float, t1: float) -> None:
+        for m in self.members:
+            m.add(name, t0, t1)
+
+    @contextlib.contextmanager
+    def span(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            t1 = time.perf_counter()
+            for m in self.members:
+                m.add(name, t0, t1)
+
+    def begin(self, name: str) -> None:
+        for m in self.members:
+            m.begin(name)
+
+    def end(self, name: str) -> None:
+        for m in self.members:
+            m.end(name)
+
+
+class Tracer:
+    """Trace factory + bounded ring buffer of finished request traces.
+
+    ``enabled=False`` makes ``start`` return None, which propagates the
+    zero-cost path through every call site. ``profiler`` (a
+    StageProfiler) receives each finished span as a ``span_<name>``
+    stage sample, which the Prometheus stage-histogram family exports —
+    per-stage span histograms under the existing ``stage`` label.
+    """
+
+    def __init__(
+        self,
+        enabled: bool = True,
+        capacity: int = 256,
+        profiler=None,
+    ) -> None:
+        self.enabled = bool(enabled) and capacity > 0
+        self.capacity = int(capacity)
+        self._profiler = profiler
+        self._ring: collections.deque[RequestTrace] = collections.deque(
+            maxlen=max(1, self.capacity)
+        )
+        self._lock = threading.Lock()
+        self._ids = itertools.count(1)
+        self._finished = 0
+
+    def start(self, model: str = "", request_id: str = "") -> RequestTrace | None:
+        if not self.enabled:
+            return None
+        return RequestTrace(next(self._ids), model=model, request_id=request_id)
+
+    def finish(self, trace: RequestTrace | None, status: str = "ok") -> None:
+        if trace is None:
+            return
+        trace.t_end = time.perf_counter()
+        trace.status = status
+        with self._lock:
+            self._ring.append(trace)
+            self._finished += 1
+        if self._profiler is not None:
+            for s in list(trace.spans):
+                self._profiler.record(f"span_{s.name}", s.duration_s)
+
+    def recent(self, n: int = 0) -> list[RequestTrace]:
+        """Most recent ``n`` finished traces (0 = everything buffered),
+        oldest first."""
+        with self._lock:
+            traces = list(self._ring)
+        return traces[-n:] if n else traces
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "finished": self._finished,
+                "buffered": len(self._ring),
+                "capacity": self.capacity,
+            }
+
+    def chrome_trace(self, n: int = 0) -> dict:
+        return chrome_trace(self.recent(n))
+
+
+def chrome_trace(traces) -> dict:
+    """Chrome-trace ('Trace Event Format') JSON for a list of traces.
+
+    Loadable in Perfetto / chrome://tracing: complete ('X') events with
+    microsecond timestamps, one tid (row) per request, the whole
+    request as a parent event so the per-phase spans nest visually
+    inside it. Timestamps rebase onto the earliest trace start so the
+    viewer opens at t=0."""
+    traces = [t for t in traces if t is not None]
+    if not traces:
+        return {"traceEvents": [], "displayTimeUnit": "ms"}
+    base = min(t.t_start for t in traces)
+
+    def us(t: float) -> float:
+        return round((t - base) * 1e6, 3)
+
+    events: list[dict] = [
+        {
+            "ph": "M",
+            "name": "process_name",
+            "pid": 1,
+            "tid": 0,
+            "args": {"name": "tpu_serving"},
+        }
+    ]
+    for tr in traces:
+        tid = tr.trace_id
+        label = f"req {tr.trace_id} {tr.model}".strip()
+        events.append(
+            {
+                "ph": "M",
+                "name": "thread_name",
+                "pid": 1,
+                "tid": tid,
+                "args": {"name": label},
+            }
+        )
+        t_end = tr.t_end if tr.t_end is not None else time.perf_counter()
+        events.append(
+            {
+                "ph": "X",
+                "name": "request",
+                "cat": "request",
+                "pid": 1,
+                "tid": tid,
+                "ts": us(tr.t_start),
+                "dur": max(0.0, (t_end - tr.t_start) * 1e6),
+                "args": {
+                    "model": tr.model,
+                    "request_id": tr.request_id,
+                    "status": tr.status,
+                },
+            }
+        )
+        for s in sorted(tr.spans, key=lambda s: s.t0):
+            events.append(
+                {
+                    "ph": "X",
+                    "name": s.name,
+                    "cat": "span",
+                    "pid": 1,
+                    "tid": tid,
+                    "ts": us(s.t0),
+                    "dur": max(0.0, s.duration_s * 1e6),
+                }
+            )
+    events.sort(key=lambda e: (e.get("ts", -1.0), e["tid"]))
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def dump_chrome_trace(traces, path: str) -> None:
+    with open(path, "w") as f:
+        json.dump(chrome_trace(traces), f)

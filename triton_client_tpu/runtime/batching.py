@@ -43,6 +43,7 @@ import time
 import numpy as np
 
 from triton_client_tpu.channel.base import BaseChannel, InferRequest, InferResponse
+from triton_client_tpu.obs.trace import MultiTrace
 
 log = logging.getLogger(__name__)
 
@@ -136,6 +137,7 @@ class BatchingChannel(BaseChannel):
         self._max_merge = int(max_merge if max_merge is not None else max_batch)
         self._pad_to_buckets = bool(pad_to_buckets)
         self._merge_hold_s = max(0, int(merge_hold_us)) / 1e6
+        self._pipeline_depth = max(1, int(pipeline_depth))
         self._inflight = threading.Semaphore(max(1, pipeline_depth))
         self._exec = concurrent.futures.ThreadPoolExecutor(
             max_workers=max(1, pipeline_depth),
@@ -197,6 +199,11 @@ class BatchingChannel(BaseChannel):
 
     # -- BaseChannel ----------------------------------------------------------
 
+    @property
+    def inner(self) -> BaseChannel:
+        """The wrapped channel (obs.RuntimeCollector walks the stack)."""
+        return self._inner
+
     def register_channel(self) -> None:
         self._inner.register_channel()
 
@@ -209,6 +216,10 @@ class BatchingChannel(BaseChannel):
     def do_inference(self, request: InferRequest) -> InferResponse:
         future: concurrent.futures.Future = concurrent.futures.Future()
         rid = next(self._ids)
+        if request.trace is not None:
+            # closed at dispatch time (_run_group/_run_solo): admission
+            # window + ready-queue wait + slot backpressure, end to end
+            request.trace.begin("batch_queue")
         with self._lock:
             self._pending[rid] = (request, future)
         try:
@@ -419,6 +430,10 @@ class BatchingChannel(BaseChannel):
             return
         requests = [g[1] for g in group]
         futures = [g[2] for g in group]
+        traces = [r.trace for r in requests]
+        for tr in traces:
+            if tr is not None:
+                tr.end("batch_queue")
         try:
             sizes = [
                 next(iter(np.asarray(a).shape[0] for a in r.inputs.values()))
@@ -447,6 +462,9 @@ class BatchingChannel(BaseChannel):
                     parts.append(np.repeat(parts[0][:1], pad, axis=0))
                 merged[name] = self._merge_parts(name, parts, arena_held)
             t_disp = time.perf_counter()
+            for tr in traces:
+                if tr is not None:
+                    tr.add("batch_merge", t_stage0, t_disp)
             try:
                 # async launch + deferred readback: by the time the
                 # call returns, the inner channel has device_put the
@@ -458,6 +476,13 @@ class BatchingChannel(BaseChannel):
                         model_name=requests[0].model_name,
                         model_version=requests[0].model_version,
                         inputs=merged,
+                        # channel-side spans (stage/launch/device/
+                        # readback) fan out to every member's trace
+                        trace=(
+                            MultiTrace(traces)
+                            if any(t is not None for t in traces)
+                            else None
+                        ),
                     )
                 )
                 if free_slot is not None:
@@ -485,6 +510,7 @@ class BatchingChannel(BaseChannel):
             for request, future in zip(requests, futures):
                 self._run_solo(request, future)
             return
+        t_resp0 = time.perf_counter()
         total_padded = total + pad
         splits = np.cumsum(sizes)[:-1]
         per_output = {}
@@ -497,6 +523,10 @@ class BatchingChannel(BaseChannel):
             else:  # non-batched output — replicate
                 per_output[name] = [arr] * len(requests)
         for i, (request, future) in enumerate(zip(requests, futures)):
+            if request.trace is not None:
+                # before set_result: the waiting thread may finish the
+                # trace the moment the future resolves
+                request.trace.add("batch_respond", t_resp0, time.perf_counter())
             future.set_result(
                 InferResponse(
                     model_name=resp.model_name,
@@ -553,6 +583,8 @@ class BatchingChannel(BaseChannel):
         return np.concatenate(parts)
 
     def _run_solo(self, request: InferRequest, future, free_slot=None) -> None:
+        if request.trace is not None:
+            request.trace.end("batch_queue")  # no-op on the retry path
         try:
             fut = self._inner.do_inference_async(request)
             if free_slot is not None:
@@ -576,6 +608,8 @@ class BatchingChannel(BaseChannel):
             out["slot_occupancy"] = dict(sorted(self._slot_occupancy.items()))
             out["active_slots"] = self._active_slots
             out["ready_depth"] = len(self._ready)
+            out["max_merge"] = self._max_merge
+            out["pipeline_depth"] = self._pipeline_depth
             n = self._decomp.get("n", 0.0)
             if n:
                 out["decomp_ms"] = {

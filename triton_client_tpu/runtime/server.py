@@ -56,6 +56,16 @@ def message_limit(repository: ModelRepository) -> int:
     return best
 
 
+def _grpc_code(exc: BaseException) -> str:
+    """gRPC status-code label for the per-model error counter, matching
+    the codes ModelInfer aborts with."""
+    if isinstance(exc, KeyError):
+        return "NOT_FOUND"
+    if isinstance(exc, ValueError):
+        return "INVALID_ARGUMENT"
+    return "INTERNAL"
+
+
 class _Servicer(service.GRPCInferenceServiceServicer):
     def __init__(
         self,
@@ -64,12 +74,16 @@ class _Servicer(service.GRPCInferenceServiceServicer):
         profiler=None,
         shm_registry=None,
         stream_pipeline_depth: int = 2,
+        tracer=None,
+        collector=None,
     ) -> None:
         self._repo = repository
         self._channel = channel
         self._profiler = profiler
         self._shm = shm_registry
         self._stream_depth = max(1, int(stream_pipeline_depth))
+        self._tracer = tracer
+        self._collector = collector
 
     # -- health ---------------------------------------------------------------
 
@@ -222,43 +236,112 @@ class _Servicer(service.GRPCInferenceServiceServicer):
         (or inner batcher) starts while THIS thread still prepares the
         response scaffolding; the finisher resolves the future (the
         only blocking step — deferred readback) and encodes the
-        response. Stream pipelining keeps several finishers pending."""
+        response. Stream pipelining keeps several finishers pending.
+
+        Telemetry: a request-scoped trace (when tracing is on) rides
+        the InferRequest through the batcher and channel, collecting
+        parse/queue/stage/launch/device/readback/encode spans; the
+        per-model latency histogram sample is recorded in a finally so
+        FAILING requests are measured and counted too (they previously
+        vanished from the metrics entirely)."""
         t0 = time.perf_counter()
-        inputs = codec.parse_infer_request(request, shm=self._shm)
-        future = self._channel.do_inference_async(
-            InferRequest(
-                model_name=request.model_name,
-                model_version=request.model_version,
-                inputs=inputs,
-                request_id=request.id,
+        trace = (
+            self._tracer.start(
+                model=request.model_name, request_id=request.id
             )
+            if self._tracer is not None
+            else None
         )
-        # overlapped with device execution: shm placement parsing needs
-        # only the request, not the result
-        shm_outputs = {
-            t.name: params
-            for t in request.outputs
-            if (params := codec.shm_params(t)) is not None
-        }
+        if self._collector is not None:
+            self._collector.request_started()
+        try:
+            if trace is not None:
+                with trace.span("parse"):
+                    inputs = codec.parse_infer_request(request, shm=self._shm)
+            else:
+                inputs = codec.parse_infer_request(request, shm=self._shm)
+            if trace is not None:
+                # closed in finish() once the future resolves: the whole
+                # channel-stack residence (queue/stage/device/readback
+                # land inside it, plus the cross-thread hand-off gaps
+                # none of those sub-spans can see)
+                trace.begin("channel")
+            future = self._channel.do_inference_async(
+                InferRequest(
+                    model_name=request.model_name,
+                    model_version=request.model_version,
+                    inputs=inputs,
+                    request_id=request.id,
+                    trace=trace,
+                )
+            )
+            # overlapped with device execution: shm placement parsing
+            # needs only the request, not the result
+            shm_outputs = {
+                t.name: params
+                for t in request.outputs
+                if (params := codec.shm_params(t)) is not None
+            }
+        except BaseException as e:
+            # parse/dispatch failed before a finisher existed: close out
+            # the request's accounting here (finish() will never run)
+            self._account(request.model_name, t0, trace, error=e)
+            raise
 
         def finish():
-            result = future.result()
-            if self._profiler is not None:
-                # per-model request latency — the Triton :8002 serving
-                # metrics role (README.md:88-95)
-                self._profiler.record(
-                    f"infer_{request.model_name}", time.perf_counter() - t0
+            error = None
+            try:
+                try:
+                    result = future.result()
+                finally:
+                    if trace is not None:
+                        trace.end("channel")
+                if trace is not None:
+                    with trace.span("encode"):
+                        return codec.build_infer_response(
+                            model_name=result.model_name,
+                            model_version=result.model_version,
+                            outputs=result.outputs,
+                            request_id=result.request_id,
+                            shm_outputs=shm_outputs,
+                            shm=self._shm,
+                        )
+                return codec.build_infer_response(
+                    model_name=result.model_name,
+                    model_version=result.model_version,
+                    outputs=result.outputs,
+                    request_id=result.request_id,
+                    shm_outputs=shm_outputs,
+                    shm=self._shm,
                 )
-            return codec.build_infer_response(
-                model_name=result.model_name,
-                model_version=result.model_version,
-                outputs=result.outputs,
-                request_id=result.request_id,
-                shm_outputs=shm_outputs,
-                shm=self._shm,
-            )
+            except BaseException as e:
+                error = e
+                raise
+            finally:
+                self._account(request.model_name, t0, trace, error=error)
 
         return finish
+
+    def _account(self, model_name, t0, trace, error=None) -> None:
+        """Per-request bookkeeping, success or failure: latency sample
+        (the Triton :8002 serving-metrics role, README.md:88-95), error
+        counter with a gRPC status-code label, in-flight gauge, trace
+        finish."""
+        if self._tracer is not None:
+            # close the trace FIRST: everything below is bookkeeping
+            # that would otherwise show up as an uncovered tail on the
+            # request wall
+            self._tracer.finish(
+                trace, status="ok" if error is None else _grpc_code(error)
+            )
+        if self._profiler is not None:
+            self._profiler.record(
+                f"infer_{model_name}", time.perf_counter() - t0
+            )
+        if self._collector is not None:
+            if error is not None:
+                self._collector.record_error(model_name, _grpc_code(error))
+            self._collector.request_finished()
 
     def _infer(self, request):
         return self._issue(request)()
@@ -368,43 +451,80 @@ class InferenceServer:
         max_workers: int = 8,
         max_message_bytes: int | None = None,
         profiler=None,
-        metrics_port: int = 0,
+        metrics_port: int | str = 0,
         stream_pipeline_depth: int = 2,
+        trace_capacity: int = 256,
     ) -> None:
-        """``metrics_port``: serve per-model latency Histograms over
-        Prometheus (Triton's :8002 role); 0 disables. ``profiler``: a
-        StageProfiler to record into (created automatically when
-        metrics_port is set). ``stream_pipeline_depth``: in-flight
-        requests per ModelStreamInfer stream (request N+1 launches
-        while N computes; 1 = strictly serial, the pre-round-6
-        behavior)."""
+        """``metrics_port``: serve the telemetry endpoint — Prometheus
+        exposition on ``/metrics`` (Triton's :8002 role), Chrome-trace
+        JSON on ``/traces``, raw collector state on ``/snapshot``.
+        0 disables; ``"auto"`` binds an ephemeral port (read it back
+        from ``.metrics_port`` — tests and multi-server processes).
+        ``profiler``: a StageProfiler to record into (created
+        automatically when metrics_port is set).
+        ``stream_pipeline_depth``: in-flight requests per
+        ModelStreamInfer stream (request N+1 launches while N computes;
+        1 = strictly serial, the pre-round-6 behavior).
+        ``trace_capacity``: bounded ring of recent request traces kept
+        for export (0 disables request tracing; spans then cost one
+        attribute read per pipeline phase)."""
         if metrics_port and profiler is None:
             from triton_client_tpu.utils.profiling import StageProfiler
 
             profiler = StageProfiler()
         self.profiler = profiler
+        self.tracer = None
+        self.collector = None
         self.metrics_enabled = False
+        self._telemetry = None
         if metrics_port:
-            # Degrade, don't die: metrics are optional observability —
+            # Degrade, don't die: telemetry is optional observability —
             # a missing prometheus_client or an occupied port must not
             # take down the inference service (the reference's optional
             # import pattern, communicator/__init__.py:5-8).
+            registry = None
             try:
+                import prometheus_client
+
                 from triton_client_tpu.utils.profiling import (
                     PrometheusStageExporter,
                 )
 
-                PrometheusStageExporter(metrics_port).attach(profiler)
-                self.metrics_enabled = True
+                # per-server registry: several InferenceServers in one
+                # process each export their own complete metric set
+                registry = prometheus_client.CollectorRegistry()
+                PrometheusStageExporter(
+                    0, registry=registry
+                ).attach(profiler)
             except ImportError:
                 log.warning(
-                    "prometheus_client not installed; metrics port %d disabled",
-                    metrics_port,
+                    "prometheus_client not installed; /metrics on port %s "
+                    "disabled (traces still export)", metrics_port,
                 )
+            from triton_client_tpu.obs.collector import RuntimeCollector
+            from triton_client_tpu.obs.trace import Tracer
+
+            if trace_capacity > 0:
+                self.tracer = Tracer(
+                    capacity=trace_capacity, profiler=profiler
+                )
+            self.collector = RuntimeCollector(
+                channel=channel, tracer=self.tracer, registry=registry
+            )
+            try:
+                from triton_client_tpu.obs.http import TelemetryServer
+
+                self._telemetry = TelemetryServer(
+                    port=0 if metrics_port == "auto" else int(metrics_port),
+                    registry=registry,
+                    tracer=self.tracer,
+                    collector=self.collector,
+                )
+                self.metrics_enabled = registry is not None
             except OSError as e:
                 log.warning(
-                    "could not bind metrics port %d (%s); metrics disabled",
-                    metrics_port, e,
+                    "could not bind metrics port %s (%s); telemetry "
+                    "endpoint disabled", metrics_port, e,
                 )
         limit = max_message_bytes or message_limit(repository)
         self._server = grpc.server(
@@ -426,6 +546,8 @@ class InferenceServer:
                 profiler=profiler,
                 shm_registry=self.shm_registry,
                 stream_pipeline_depth=stream_pipeline_depth,
+                tracer=self.tracer,
+                collector=self.collector,
             ),
             self._server,
         )
@@ -438,6 +560,11 @@ class InferenceServer:
     def port(self) -> int:
         return self._port
 
+    @property
+    def metrics_port(self) -> int:
+        """Bound telemetry port (0 when telemetry is disabled)."""
+        return self._telemetry.port if self._telemetry is not None else 0
+
     def start(self) -> None:
         self._server.start()
         log.info("KServe v2 server listening on %s", self._address)
@@ -447,5 +574,10 @@ class InferenceServer:
 
     def stop(self, grace: float = 1.0) -> None:
         self._server.stop(grace).wait()
+        if self._telemetry is not None:
+            self._telemetry.close()
+            self._telemetry = None
+        if self.collector is not None:
+            self.collector.close()
         # detach (never unlink — the segments are client-owned)
         self.shm_registry.unregister_all()

@@ -164,34 +164,71 @@ class PrometheusStageExporter:
     PromQL without recording rules — the label design is also how
     Triton's own nv_inference_* metrics carry the model. The serving
     stage label is ``infer_<model>``, matching the profiler's stage
-    naming (runtime/server.py _infer).
+    naming (runtime/server.py _infer); request traces land as
+    ``span_<name>`` stages through obs.Tracer.
+
+    ``registry``: the prometheus CollectorRegistry to export into
+    (default the process-global ``prometheus_client.REGISTRY``). A
+    second exporter on the same (registry, namespace) reuses the
+    already-registered family instead of degrading to a no-op, so
+    tests and multi-server processes can each export; pass each server
+    its own registry for fully independent series.
     """
 
-    def __init__(self, port: int = 8002, namespace: str = "tpu_serving") -> None:
+    # (registry -> {family name -> Histogram}): a second exporter on
+    # the same registry records into the SAME family rather than
+    # hitting prometheus's duplicate-registration ValueError and
+    # silently recording nothing (the pre-telemetry failure mode).
+    _family_cache = None
+    _family_cache_lock = threading.Lock()
+
+    def __init__(
+        self,
+        port: int = 8002,
+        namespace: str = "tpu_serving",
+        registry=None,
+    ) -> None:
+        import weakref
+
         import prometheus_client
 
+        if registry is None:
+            registry = prometheus_client.REGISTRY
         self._lock = threading.Lock()
         self._label_sources: dict[str, str] = {}
         self._warned: set[tuple[str, str]] = set()
-        try:
-            self._family = prometheus_client.Histogram(
-                f"{namespace}_stage_latency_seconds",
-                "wall-clock latency per pipeline/serving stage",
-                labelnames=("stage",),
-                buckets=_BUCKETS,
-            )
-        except ValueError:
-            # registry collision (a second exporter in-process): export
-            # nothing rather than poison the record path
-            import logging
+        name = f"{namespace}_stage_latency_seconds"
+        cls = type(self)
+        with cls._family_cache_lock:
+            if cls._family_cache is None:
+                cls._family_cache = weakref.WeakKeyDictionary()
+            per_registry = cls._family_cache.setdefault(registry, {})
+            family = per_registry.get(name)
+            if family is None:
+                try:
+                    family = prometheus_client.Histogram(
+                        name,
+                        "wall-clock latency per pipeline/serving stage",
+                        labelnames=("stage",),
+                        buckets=_BUCKETS,
+                        registry=registry,
+                    )
+                    per_registry[name] = family
+                except ValueError:
+                    # the name is taken by a collector we did not
+                    # create and cannot reuse: export nothing rather
+                    # than poison the record path
+                    import logging
 
-            logging.getLogger(__name__).warning(
-                "metric family %s_stage_latency_seconds already "
-                "registered; this exporter records nothing", namespace,
-            )
-            self._family = None
+                    logging.getLogger(__name__).warning(
+                        "metric family %s already registered by a "
+                        "foreign collector; this exporter records "
+                        "nothing", name,
+                    )
+                    family = None
+        self._family = family
         if port:
-            prometheus_client.start_http_server(port)
+            prometheus_client.start_http_server(port, registry=registry)
 
     def observe(self, stage: str, seconds: float) -> None:
         if self._family is None:
