@@ -1,0 +1,550 @@
+"""tpulint (triton_client_tpu.analysis): fixture-proven rule behavior.
+
+Per rule family: at least one true-positive fixture, one true-negative
+fixture, and a pragma-suppressed case; plus engine-level tests (JSON
+schema, baseline round-trip/matching, call-graph reachability) and the
+whole-package gate — the same invocation ci.sh runs — asserting the
+tree lints clean against the committed baseline. Everything here is
+pure-stdlib AST work: CPU-only, tier-1 safe, no jax import required.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from triton_client_tpu import analysis
+from triton_client_tpu.analysis import Baseline, lint_source
+from triton_client_tpu.analysis.engine import load_source
+from triton_client_tpu.analysis.rules.hostsync import check_reachable
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "triton_client_tpu")
+BASELINE = os.path.join(REPO, "tpulint.baseline.json")
+
+
+def codes(findings):
+    return sorted({f.code for f in findings})
+
+
+# -- TPL1xx recompilation ---------------------------------------------------
+
+
+class TestRecompileRules:
+    def test_traced_branch_positive(self):
+        src = (
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    if x > 0:\n"
+            "        return x\n"
+            "    return -x\n"
+        )
+        found = lint_source(src, codes=["TPL101"])
+        assert len(found) == 1 and found[0].code == "TPL101"
+        assert "`x`" in found[0].message
+
+    def test_device_fn_counts_as_jitted(self):
+        src = (
+            "def device_fn(inputs):\n"
+            "    for row in inputs:\n"
+            "        pass\n"
+        )
+        assert codes(lint_source(src, codes=["TPL1"])) == ["TPL101"]
+
+    def test_shape_branch_negative(self):
+        # .shape/.ndim/len() are static at trace time — must NOT flag
+        src = (
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    if x.shape[0] > 2 and x.ndim == 2 and len(x) > 1:\n"
+            "        return x\n"
+            "    return x + 1\n"
+        )
+        assert lint_source(src, codes=["TPL1"]) == []
+
+    def test_static_arg_is_not_traced(self):
+        src = (
+            "import jax\n"
+            "@jax.jit(static_argnums=(1,))\n"
+            "def f(x, n):\n"
+            "    if n > 0:\n"
+            "        return x\n"
+            "    return -x\n"
+        )
+        assert lint_source(src, codes=["TPL101"]) == []
+
+    def test_static_argnums_list_positive(self):
+        src = "import jax\ng = jax.jit(lambda x, n: x, static_argnums=[1])\n"
+        found = lint_source(src, codes=["TPL102"])
+        assert len(found) == 1 and "tuple" in found[0].message
+
+    def test_static_argnums_tuple_negative(self):
+        src = "import jax\ng = jax.jit(lambda x, n: x, static_argnums=(1,))\n"
+        assert lint_source(src, codes=["TPL102"]) == []
+
+    def test_fstring_leak_positive(self):
+        src = (
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    name = f'value={x}'\n"
+            "    return x\n"
+        )
+        assert codes(lint_source(src, codes=["TPL103"])) == ["TPL103"]
+
+    def test_fstring_of_shape_negative(self):
+        src = (
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    name = f'shape={x.shape}'\n"
+            "    return x\n"
+        )
+        assert lint_source(src, codes=["TPL103"]) == []
+
+    def test_pragma_suppresses(self):
+        src = (
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    if x > 0:  # tpulint: disable=TPL101\n"
+            "        return x\n"
+            "    return -x\n"
+        )
+        assert lint_source(src, codes=["TPL101"]) == []
+
+
+# -- TPL2xx donation --------------------------------------------------------
+
+
+DONATION_POSITIVE = (
+    "import jax\n"
+    "launcher = jax.jit(lambda a, b: a, donate_argnums=(0,))\n"
+    "def go(x, y):\n"
+    "    out = launcher(x, y)\n"
+    "    return out, x.shape\n"  # x read after donation
+)
+
+DONATION_NEGATIVE = (
+    "import jax\n"
+    "launcher = jax.jit(lambda a, b: a, donate_argnums=(0,))\n"
+    "def go(x, y):\n"
+    "    out = launcher(x, y)\n"
+    "    return out, y.shape\n"  # only the kept arg is re-read
+)
+
+
+class TestDonationRules:
+    def test_read_after_donation_positive(self):
+        found = lint_source(DONATION_POSITIVE, codes=["TPL201"])
+        assert len(found) == 1
+        assert "`x`" in found[0].message and found[0].context == "go"
+
+    def test_kept_arg_read_negative(self):
+        assert lint_source(DONATION_NEGATIVE, codes=["TPL201"]) == []
+
+    def test_reassignment_clears_taint(self):
+        src = (
+            "import jax\n"
+            "launcher = jax.jit(lambda a: a, donate_argnums=(0,))\n"
+            "def go(x):\n"
+            "    x = launcher(x)\n"
+            "    return x + 1\n"
+        )
+        assert lint_source(src, codes=["TPL201"]) == []
+
+    def test_donor_through_factory_unpack(self):
+        # the TPUChannel shape: a same-module factory returns the
+        # donating callable as the head of a tuple
+        src = (
+            "import jax\n"
+            "def make():\n"
+            "    launcher = jax.jit(lambda a: a, donate_argnums=(0,))\n"
+            "    return launcher, 'meta'\n"
+            "def go(x):\n"
+            "    launcher, meta = make()\n"
+            "    out = launcher(x)\n"
+            "    return out + x\n"
+        )
+        found = lint_source(src, codes=["TPL201"])
+        assert len(found) == 1 and "`x`" in found[0].message
+
+    def test_donate_persistent_attribute_positive(self):
+        src = (
+            "import jax\n"
+            "launcher = jax.jit(lambda a: a, donate_argnums=(0,))\n"
+            "class C:\n"
+            "    def go(self):\n"
+            "        return launcher(self._buf)\n"
+        )
+        found = lint_source(src, codes=["TPL202"])
+        assert len(found) == 1 and "self._buf" in found[0].message
+
+    def test_donate_local_negative(self):
+        src = (
+            "import jax\n"
+            "launcher = jax.jit(lambda a: a, donate_argnums=(0,))\n"
+            "def go(x):\n"
+            "    return launcher(x)\n"
+        )
+        assert lint_source(src, codes=["TPL202"]) == []
+
+    def test_pragma_suppresses(self):
+        src = DONATION_POSITIVE.replace(
+            "    return out, x.shape\n",
+            "    return out, x.shape  # tpulint: disable=TPL2\n",
+        )
+        assert lint_source(src, codes=["TPL2"]) == []
+
+
+# -- TPL3xx host sync -------------------------------------------------------
+
+
+HOT_SYNC = (
+    "import numpy as np\n"
+    "import jax\n"
+    "class TPUChannel:\n"
+    "    def stage(self, request):\n"
+    "        return self._prep(request)\n"
+    "    def _prep(self, request):\n"
+    "        return np.asarray(request)\n"  # sync reachable from stage
+    "def cold(x):\n"
+    "    return np.asarray(x)\n"  # NOT reachable -> not flagged
+)
+
+
+class TestHostSyncRules:
+    def test_reachable_sync_flagged_cold_not(self):
+        found = lint_source(HOT_SYNC, codes=["TPL3"])
+        assert len(found) == 1
+        assert found[0].context == "TPUChannel._prep"
+
+    def test_nested_closure_is_hot(self):
+        src = (
+            "class TPUChannel:\n"
+            "    def launch(self, staged):\n"
+            "        def resolve():\n"
+            "            return staged.item()\n"
+            "        return resolve\n"
+        )
+        found = lint_source(src, codes=["TPL3"])
+        assert len(found) == 1 and ".item()" in found[0].message
+
+    def test_block_until_ready_is_tpl302(self):
+        src = (
+            "import jax\n"
+            "class TPUChannel:\n"
+            "    def stage(self, x):\n"
+            "        jax.block_until_ready(x)\n"
+            "        return x\n"
+        )
+        assert codes(lint_source(src, codes=["TPL3"])) == ["TPL302"]
+
+    def test_float_literal_negative(self):
+        src = (
+            "class TPUChannel:\n"
+            "    def stage(self, x):\n"
+            "        return float('1.5') + float(1)\n"
+        )
+        assert lint_source(src, codes=["TPL3"]) == []
+
+    def test_pragma_suppresses(self):
+        src = HOT_SYNC.replace(
+            "        return np.asarray(request)\n",
+            "        return np.asarray(request)  # tpulint: disable=TPL301\n",
+        )
+        assert lint_source(src, codes=["TPL3"]) == []
+
+    def test_check_reachable_custom_roots(self):
+        # the perf/_harness entry point: arbitrary roots, same rule
+        src = "import numpy as np\ndef timed_region(x):\n    return np.asarray(x)\n"
+        pkg = load_source(src, path="snippet.py")
+        found = list(check_reachable(pkg, ["timed_region"]))
+        assert len(found) == 1 and found[0].code == "TPL301"
+        assert list(check_reachable(pkg, ["other_root"])) == []
+
+
+# -- TPL4xx lock discipline -------------------------------------------------
+
+
+LOCK_POSITIVE = (
+    "import threading\n"
+    "class Slots:\n"
+    "    def __init__(self):\n"
+    "        self._lock = threading.Lock()\n"
+    "        self._active = 0\n"
+    "    def acquire(self):\n"
+    "        with self._lock:\n"
+    "            self._active += 1\n"
+    "    def release(self):\n"
+    "        self._active -= 1\n"  # bare: races acquire()
+)
+
+
+class TestLockRules:
+    def test_mixed_guard_positive(self):
+        found = lint_source(LOCK_POSITIVE, codes=["TPL4"])
+        assert len(found) == 1
+        assert found[0].context == "Slots.release"
+        assert "_active" in found[0].message
+
+    def test_consistent_guard_negative(self):
+        src = LOCK_POSITIVE.replace(
+            "    def release(self):\n        self._active -= 1\n",
+            "    def release(self):\n"
+            "        with self._lock:\n"
+            "            self._active -= 1\n",
+        )
+        assert lint_source(src, codes=["TPL4"]) == []
+
+    def test_init_exempt(self):
+        # the bare `self._active = 0` in __init__ must not count as an
+        # unguarded site (object not shared during construction)
+        src = LOCK_POSITIVE.replace(
+            "    def release(self):\n        self._active -= 1\n", ""
+        )
+        assert lint_source(src, codes=["TPL4"]) == []
+
+    def test_locked_suffix_convention_exempt(self):
+        src = LOCK_POSITIVE.replace("def release(self):", "def release_locked(self):")
+        assert lint_source(src, codes=["TPL4"]) == []
+
+    def test_container_mutation_counts(self):
+        src = (
+            "import threading, collections\n"
+            "class Q:\n"
+            "    def __init__(self):\n"
+            "        self._cv = threading.Condition()\n"
+            "        self._ready = collections.deque()\n"
+            "    def put(self, x):\n"
+            "        with self._cv:\n"
+            "            self._ready.append(x)\n"
+            "    def steal(self, x):\n"
+            "        self._ready.append(x)\n"
+        )
+        found = lint_source(src, codes=["TPL4"])
+        assert len(found) == 1 and found[0].context == "Q.steal"
+
+    def test_pragma_suppresses(self):
+        src = LOCK_POSITIVE.replace(
+            "        self._active -= 1\n",
+            "        self._active -= 1  # tpulint: disable=TPL401\n",
+        )
+        assert lint_source(src, codes=["TPL4"]) == []
+
+
+# -- TPL5xx telemetry -------------------------------------------------------
+
+
+class TestTelemetryRules:
+    def test_begin_without_end_positive(self):
+        src = (
+            "def issue(trace):\n"
+            "    trace.begin('channel')\n"
+            "    return 1\n"
+        )
+        found = lint_source(src, codes=["TPL501"])
+        assert len(found) == 1 and "`channel`" in found[0].message
+
+    def test_begin_with_end_negative(self):
+        src = (
+            "def issue(trace):\n"
+            "    trace.begin('channel')\n"
+            "def finish(trace):\n"
+            "    trace.end('channel')\n"
+        )
+        assert lint_source(src, codes=["TPL501"]) == []
+
+    def test_gauge_inc_no_finally_positive(self):
+        src = (
+            "def serve(g):\n"
+            "    g.inc()\n"
+            "    work()\n"
+            "    g.dec()\n"  # not in a finally: leaks on exception
+        )
+        found = lint_source(src, codes=["TPL502"])
+        assert len(found) == 1 and "finally" in found[0].message
+
+    def test_gauge_dec_in_finally_negative(self):
+        src = (
+            "def serve(g):\n"
+            "    g.inc()\n"
+            "    try:\n"
+            "        work()\n"
+            "    finally:\n"
+            "        g.dec()\n"
+        )
+        assert lint_source(src, codes=["TPL502"]) == []
+
+    def test_gauge_dec_via_helper_called_in_finally(self):
+        # the server.py shape: _account() holds the dec and is invoked
+        # from a finally
+        src = (
+            "def serve(self):\n"
+            "    self.request_started()\n"
+            "    try:\n"
+            "        work()\n"
+            "    finally:\n"
+            "        self._account()\n"
+            "def _account(self):\n"
+            "    self.request_finished()\n"
+        )
+        assert lint_source(src, codes=["TPL502"]) == []
+
+    def test_pragma_suppresses(self):
+        src = (
+            "def issue(trace):\n"
+            "    trace.begin('x')  # tpulint: disable=TPL501\n"
+        )
+        assert lint_source(src, codes=["TPL501"]) == []
+
+
+# -- engine / CLI / baseline ------------------------------------------------
+
+
+class TestEngine:
+    def test_file_pragma_disables_family(self):
+        src = (
+            "# tpulint: disable-file=TPL1\n"
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    if x > 0:\n"
+            "        return x\n"
+            "    return -x\n"
+        )
+        assert lint_source(src, codes=["TPL1"]) == []
+
+    def test_registry_has_all_families(self):
+        reg = analysis.registry()
+        fams = {c[:4] for c in reg}
+        assert {"TPL1", "TPL2", "TPL3", "TPL4", "TPL5"} <= fams
+        for cls in reg.values():
+            assert cls.doc, f"{cls.code} has no doc"
+
+    def test_findings_sorted_and_fingerprint_stable(self):
+        found = lint_source(DONATION_POSITIVE + LOCK_POSITIVE)
+        assert found == sorted(
+            found, key=lambda f: (f.path, f.line, f.col, f.code)
+        )
+        f = found[0]
+        again = lint_source(DONATION_POSITIVE + LOCK_POSITIVE)[0]
+        assert f.fingerprint() == again.fingerprint()
+
+    def test_render_json_schema(self):
+        found = lint_source(DONATION_POSITIVE)
+        doc = json.loads(analysis.render_json(found, suppressed=3))
+        assert doc["version"] == 1 and doc["tool"] == "tpulint"
+        assert doc["summary"]["total"] == len(found)
+        assert doc["summary"]["suppressed_by_baseline"] == 3
+        for item in doc["findings"]:
+            assert {
+                "code", "name", "path", "line", "col", "message",
+                "context", "fingerprint",
+            } <= set(item)
+        assert doc["summary"]["by_code"]
+        assert isinstance(doc["errors"], list)
+
+
+class TestBaseline:
+    def test_round_trip_and_split(self, tmp_path):
+        found = lint_source(DONATION_POSITIVE, path="fix.py")
+        bl = Baseline.from_findings(found, justification="accepted: test")
+        path = str(tmp_path / "bl.json")
+        bl.save(path)
+        loaded = Baseline.load(path)
+        new, suppressed = loaded.split(found)
+        assert new == [] and len(suppressed) == len(found)
+        assert loaded.unjustified() == []
+
+    def test_unjustified_detected(self):
+        found = lint_source(DONATION_POSITIVE, path="fix.py")
+        bl = Baseline.from_findings(found)  # default TODO justification
+        assert bl.unjustified() == sorted(f.fingerprint() for f in found)
+
+    def test_line_churn_keeps_match(self):
+        # identical hazard shifted down two lines: same fingerprint
+        a = lint_source(DONATION_POSITIVE, path="fix.py")
+        b = lint_source("# pad\n# pad\n" + DONATION_POSITIVE, path="fix.py")
+        assert [f.fingerprint() for f in a] == [f.fingerprint() for f in b]
+        assert a[0].line != b[0].line
+
+    def test_new_finding_not_suppressed(self, tmp_path):
+        bl = Baseline.from_findings(
+            lint_source(DONATION_POSITIVE, path="fix.py"), "ok"
+        )
+        other = lint_source(LOCK_POSITIVE, path="other.py")
+        new, suppressed = bl.split(other)
+        assert suppressed == [] and len(new) == len(other)
+
+
+class TestCallGraph:
+    def test_reachability_walks_methods_and_imports(self):
+        pkg = load_source(
+            "class TPUChannel:\n"
+            "    def stage(self, r):\n"
+            "        return helper(r)\n"
+            "def helper(r):\n"
+            "    return deeper(r)\n"
+            "def deeper(r):\n"
+            "    return r\n"
+            "def unrelated(r):\n"
+            "    return r\n",
+            path="mod.py",
+        )
+        hot = pkg.callgraph.reachable(["TPUChannel.stage"])
+        names = {q.rsplit(".", 1)[-1] for q in hot}
+        assert {"stage", "helper", "deeper"} <= names
+        assert "unrelated" not in names
+
+
+# -- whole-package gate (the same check ci.sh runs) -------------------------
+
+
+class TestPackageGate:
+    def test_package_lints_clean_against_baseline(self):
+        package = analysis.load_package([PKG], root=REPO)
+        assert not package.errors, package.errors
+        findings = analysis.run_rules(package)
+        bl = Baseline.load(BASELINE)
+        new, suppressed = bl.split(findings)
+        assert new == [], "un-baselined findings:\n" + "\n".join(
+            f.render() for f in new
+        )
+        assert bl.unjustified() == []
+        assert suppressed, "baseline should be exercised (stale otherwise)"
+
+    def test_cli_json_and_exit_codes(self, tmp_path):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        ok = subprocess.run(
+            [
+                sys.executable, "-m", "triton_client_tpu", "lint",
+                "triton_client_tpu", "--baseline", "tpulint.baseline.json",
+                "--json",
+            ],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=300,
+        )
+        assert ok.returncode == 0, ok.stdout + ok.stderr
+        doc = json.loads(ok.stdout)
+        assert doc["summary"]["total"] == 0
+        assert doc["summary"]["suppressed_by_baseline"] > 0
+        # a known-bad snippet must fail with findings in the JSON
+        bad = tmp_path / "bad.py"
+        bad.write_text(LOCK_POSITIVE)
+        fail = subprocess.run(
+            [
+                sys.executable, "-m", "triton_client_tpu", "lint",
+                str(bad), "--json",
+            ],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=300,
+        )
+        assert fail.returncode == 1
+        doc = json.loads(fail.stdout)
+        assert doc["summary"]["total"] == 1
+        assert doc["findings"][0]["code"] == "TPL401"

@@ -14,6 +14,8 @@ Commands map 1:1 onto the reference's entry scripts:
   bag-info   — rosbag info equivalent
   trace-dump — Chrome-trace JSON of recent requests from a serving
                process's telemetry port (serve --metrics-port)
+  lint       — tpulint AST hazard analysis (recompilation / donation /
+               host-sync / lock / telemetry rules; docs/LINTING.md)
 """
 
 from __future__ import annotations
@@ -33,6 +35,7 @@ COMMANDS = (
     "bag-info",
     "repo-index",
     "trace-dump",
+    "lint",
 )
 
 
@@ -66,6 +69,8 @@ def main() -> None:
         from triton_client_tpu.cli.tools import repo_index as run
     elif cmd == "trace-dump":
         from triton_client_tpu.cli.tools import trace_dump as run
+    elif cmd == "lint":
+        from triton_client_tpu.cli.tools import lint as run
     else:
         print(f"unknown command '{cmd}'; commands: {', '.join(COMMANDS)}")
         raise SystemExit(2)

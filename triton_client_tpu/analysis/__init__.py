@@ -1,0 +1,64 @@
+"""tpulint: AST-based hazard analysis for the JAX serving stack.
+
+The fast serving constructs PRs 1–2 introduced (buffer donation,
+overlapped dispatch, cross-thread batching, request spans) each come
+with a failure mode that is invisible to CPU-only tests and shows up
+only as a production perf/correctness regression: use-after-donation,
+silent retraces, host syncs inside the overlap window, unguarded
+shared counters, unbalanced spans/gauges. All five are *structural* —
+visible in the syntax tree — so this package lints for them at review
+time. Five rule families:
+
+  TPL1xx  recompilation hazards      TPL4xx  lock discipline
+  TPL2xx  donation misuse            TPL5xx  telemetry correctness
+  TPL3xx  host sync on the hot path
+
+Entry points: ``python -m triton_client_tpu lint`` (CLI, see
+cli/tools.py), :func:`lint_paths` / :func:`lint_source` (library / test
+fixtures), docs/LINTING.md (rule catalogue + baseline workflow).
+
+stdlib-only by design: it must run on a bare TPU pod image.
+"""
+
+from __future__ import annotations
+
+from triton_client_tpu.analysis.baseline import Baseline
+from triton_client_tpu.analysis.engine import (
+    Finding,
+    Module,
+    Package,
+    Rule,
+    load_package,
+    load_source,
+    registry,
+    render_json,
+    render_text,
+    run_rules,
+)
+
+
+def lint_paths(paths, root=None, codes=None) -> list[Finding]:
+    """Parse + analyze ``paths``; returns pragma-filtered findings."""
+    return run_rules(load_package(paths, root=root), codes=codes)
+
+
+def lint_source(source: str, path: str = "<string>", codes=None) -> list[Finding]:
+    """Analyze one source snippet (the test-fixture entry point)."""
+    return run_rules(load_source(source, path=path), codes=codes)
+
+
+__all__ = [
+    "Baseline",
+    "Finding",
+    "Module",
+    "Package",
+    "Rule",
+    "lint_paths",
+    "lint_source",
+    "load_package",
+    "load_source",
+    "registry",
+    "render_json",
+    "render_text",
+    "run_rules",
+]

@@ -1,0 +1,97 @@
+"""Baseline suppression: the reviewed set of accepted findings.
+
+Some findings are correct *and* intentional — the lazy readback in
+``resolve()`` is a host sync on the hot path because readback IS the
+hot path's designed sync point. Those live in a committed
+``tpulint.baseline.json`` with a one-line justification each; the CLI
+exits 0 when every finding is baselined and non-zero the moment a NEW
+finding appears. Matching is by :meth:`Finding.fingerprint` (code +
+path + lexical context + message), so unrelated line churn does not
+invalidate the baseline, while moving/duplicating the hazard does.
+
+Workflow (docs/LINTING.md):
+  1. ``python -m triton_client_tpu lint`` — see new findings
+  2. fix them, or
+  3. ``lint --write-baseline tpulint.baseline.json`` then EDIT the file
+     to replace every ``"TODO: justify"`` with a real reason; an
+     unjustified entry is itself reported.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Iterable
+
+from triton_client_tpu.analysis.engine import Finding
+
+UNJUSTIFIED = "TODO: justify"
+
+
+class Baseline:
+    def __init__(self, entries: dict[str, dict] | None = None) -> None:
+        # fingerprint -> {"code", "path", "context", "message",
+        #                  "justification"}
+        self.entries: dict[str, dict] = dict(entries or {})
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+        if not isinstance(doc, dict) or "entries" not in doc:
+            raise ValueError(f"{path}: not a tpulint baseline (no 'entries')")
+        return cls(doc["entries"])
+
+    def save(self, path: str) -> None:
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(
+                {"version": 1, "tool": "tpulint", "entries": self.entries},
+                f,
+                indent=2,
+                sort_keys=True,
+            )
+            f.write("\n")
+
+    def match(self, finding: Finding) -> bool:
+        return finding.fingerprint() in self.entries
+
+    def split(
+        self, findings: Iterable[Finding]
+    ) -> tuple[list[Finding], list[Finding]]:
+        """(new, suppressed) — new findings fail the build."""
+        new: list[Finding] = []
+        suppressed: list[Finding] = []
+        for f in findings:
+            (suppressed if self.match(f) else new).append(f)
+        return new, suppressed
+
+    def unjustified(self) -> list[str]:
+        return sorted(
+            fp
+            for fp, e in self.entries.items()
+            if not str(e.get("justification", "")).strip()
+            or e.get("justification") == UNJUSTIFIED
+        )
+
+    def stale(self, findings: Iterable[Finding]) -> list[str]:
+        """Baseline entries no finding matched — candidates to delete
+        (reported as a warning, not an error: rules may be narrowed by
+        a --rules selection)."""
+        seen = {f.fingerprint() for f in findings}
+        return sorted(fp for fp in self.entries if fp not in seen)
+
+    @classmethod
+    def from_findings(
+        cls, findings: Iterable[Finding], justification: str = UNJUSTIFIED
+    ) -> "Baseline":
+        entries: dict[str, dict] = {}
+        for f in findings:
+            entries[f.fingerprint()] = {
+                "code": f.code,
+                "path": f.path,
+                "context": f.context,
+                "message": f.message,
+                "justification": entries.get(f.fingerprint(), {}).get(
+                    "justification", justification
+                ),
+            }
+        return cls(entries)

@@ -1,0 +1,338 @@
+"""tpulint engine: modules, findings, rule registry, pragma handling.
+
+The analyzer is deliberately stdlib-only (``ast`` + ``tokenize``-free
+line scanning): it must run in every environment the serving stack
+runs in, including the TPU pods where nothing beyond the runtime deps
+is installed. A *rule* is a class with a ``TPLnnn`` code that walks the
+parsed package and yields :class:`Finding` records; the *engine* owns
+module loading, the rule registry, inline-pragma suppression and the
+text/JSON renderers. Baseline suppression (accepted findings carried
+in ``tpulint.baseline.json``) lives in :mod:`.baseline`.
+
+Why AST and not runtime checks: the hazards tpulint targets —
+use-after-donation, trace-time branching on traced values, host syncs
+on the hot path, unguarded shared state — are *structural* properties
+of the code (see the compiled-TPU literature cited in docs/LINTING.md);
+they are visible in the syntax tree at review time, long before a perf
+run would surface them as a regression.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import json
+import os
+import re
+import sys
+from typing import Iterable, Iterator
+
+# ``# tpulint: disable=TPL101,TPL2`` — codes may be full (TPL101) or a
+# family prefix (TPL1, TPL2xx-style "TPL2"); ``all`` disables every rule.
+_PRAGMA_RE = re.compile(r"#\s*tpulint:\s*disable=([A-Za-z0-9_,\s]+)")
+_FILE_PRAGMA_RE = re.compile(r"#\s*tpulint:\s*disable-file=([A-Za-z0-9_,\s]+)")
+
+
+@dataclasses.dataclass
+class Finding:
+    """One rule violation at a source location.
+
+    ``context`` is the dotted lexical context (``Class.method`` or
+    ``function``) the finding sits in; it feeds the fingerprint so
+    baselines survive unrelated line-number churn.
+    """
+
+    code: str
+    name: str
+    path: str
+    line: int
+    col: int
+    message: str
+    context: str = ""
+
+    def fingerprint(self) -> str:
+        """Stable identity for baseline matching: everything except the
+        line/column, so a finding keeps its suppression when code above
+        it moves."""
+        raw = "|".join((self.code, self.path, self.context, self.message))
+        return hashlib.sha1(raw.encode()).hexdigest()[:16]
+
+    def to_dict(self) -> dict:
+        return {
+            "code": self.code,
+            "name": self.name,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "context": self.context,
+            "fingerprint": self.fingerprint(),
+        }
+
+    def render(self) -> str:
+        ctx = f" [{self.context}]" if self.context else ""
+        return f"{self.path}:{self.line}:{self.col}: {self.code} {self.message}{ctx}"
+
+
+class Module:
+    """One parsed source file plus the line-level pragma index."""
+
+    def __init__(self, path: str, relpath: str, source: str) -> None:
+        self.path = path
+        self.relpath = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        # line -> set of disabled codes/prefixes ("ALL" disables all)
+        self._pragmas: dict[int, set[str]] = {}
+        self._file_pragmas: set[str] = set()
+        for i, text in enumerate(self.lines, start=1):
+            m = _PRAGMA_RE.search(text)
+            if m:
+                self._pragmas[i] = {
+                    c.strip().upper() for c in m.group(1).split(",") if c.strip()
+                }
+            m = _FILE_PRAGMA_RE.search(text)
+            if m:
+                self._file_pragmas |= {
+                    c.strip().upper() for c in m.group(1).split(",") if c.strip()
+                }
+
+    def suppressed(self, code: str, line: int) -> bool:
+        code = code.upper()
+
+        def match(disabled: set[str]) -> bool:
+            return any(
+                d == "ALL" or code == d or code.startswith(d) for d in disabled
+            )
+
+        if self._file_pragmas and match(self._file_pragmas):
+            return True
+        disabled = self._pragmas.get(line)
+        return bool(disabled) and match(disabled)
+
+
+class Package:
+    """The analyzed module set + shared lazy facilities (call graph)."""
+
+    def __init__(self, modules: list[Module]) -> None:
+        self.modules = modules
+        self._callgraph = None
+        self.errors: list[str] = []
+
+    @property
+    def callgraph(self):
+        if self._callgraph is None:
+            from triton_client_tpu.analysis.callgraph import CallGraph
+
+            self._callgraph = CallGraph(self.modules)
+        return self._callgraph
+
+
+class Rule:
+    """Base rule: subclasses set ``code``/``name``/``doc`` and implement
+    ``check(package)``. ``doc`` is the one-paragraph rationale the CLI
+    prints for ``lint --list-rules`` (docs/LINTING.md holds the long
+    form with bad/good examples)."""
+
+    code: str = "TPL000"
+    name: str = "base"
+    doc: str = ""
+
+    def check(self, package: Package) -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def finding(
+        self, module: Module, node: ast.AST, message: str, context: str = "",
+        code: str | None = None,
+    ) -> Finding:
+        return Finding(
+            code=code or self.code,
+            name=self.name,
+            path=module.relpath,
+            line=getattr(node, "lineno", 0),
+            col=getattr(node, "col_offset", 0),
+            message=message,
+            context=context,
+        )
+
+
+_REGISTRY: dict[str, type[Rule]] = {}
+
+
+def register(cls: type[Rule]) -> type[Rule]:
+    _REGISTRY[cls.code] = cls
+    return cls
+
+
+def registry() -> dict[str, type[Rule]]:
+    """code -> rule class; importing .rules populates it exactly once."""
+    import triton_client_tpu.analysis.rules  # noqa: F401  (registration side effect)
+
+    return dict(sorted(_REGISTRY.items()))
+
+
+# -- module loading ---------------------------------------------------------
+
+
+def _iter_py_files(path: str) -> Iterator[str]:
+    if os.path.isfile(path):
+        yield path
+        return
+    for root, dirs, files in os.walk(path):
+        dirs[:] = sorted(
+            d for d in dirs if d not in ("__pycache__", ".git", ".venv")
+        )
+        for f in sorted(files):
+            if f.endswith(".py"):
+                yield os.path.join(root, f)
+
+
+def load_package(paths: Iterable[str], root: str | None = None) -> Package:
+    """Parse every .py under ``paths`` into a Package. Unparseable files
+    are recorded on ``package.errors`` instead of aborting the run —
+    the CLI reports them and exits non-zero (a file the analyzer cannot
+    read is a file the rules cannot vouch for)."""
+    modules: list[Module] = []
+    errors: list[str] = []
+    root = os.path.abspath(root) if root else os.getcwd()
+    for path in paths:
+        for fpath in _iter_py_files(path):
+            abspath = os.path.abspath(fpath)
+            rel = os.path.relpath(abspath, root)
+            if rel.startswith(".."):
+                rel = abspath
+            try:
+                with open(abspath, encoding="utf-8") as f:
+                    source = f.read()
+                modules.append(Module(abspath, rel, source))
+            except (OSError, SyntaxError, ValueError) as e:
+                errors.append(f"{rel}: {e}")
+    pkg = Package(modules)
+    pkg.errors = errors
+    return pkg
+
+
+def load_source(
+    source: str, path: str = "<string>", relpath: str | None = None
+) -> Package:
+    """Single-snippet package: the test-fixture entry point."""
+    return Package([Module(path, relpath or path, source)])
+
+
+# -- running ----------------------------------------------------------------
+
+
+def run_rules(
+    package: Package, codes: Iterable[str] | None = None
+) -> list[Finding]:
+    """Run the (selected) registry over the package; pragma-suppressed
+    findings are dropped here, baseline suppression happens in the CLI
+    so ``--write-baseline`` can see the full set."""
+    selected = registry()
+    if codes:
+        wanted = {c.strip().upper() for c in codes}
+        selected = {
+            code: cls
+            for code, cls in selected.items()
+            if any(code == w or code.startswith(w) for w in wanted)
+        }
+    by_path = {m.relpath: m for m in package.modules}
+    findings: list[Finding] = []
+    for cls in selected.values():
+        for f in cls().check(package):
+            mod = by_path.get(f.path)
+            if mod is not None and mod.suppressed(f.code, f.line):
+                continue
+            findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.code))
+    return findings
+
+
+def render_text(findings: list[Finding], stream=None) -> None:
+    stream = stream or sys.stdout
+    for f in findings:
+        print(f.render(), file=stream)
+
+
+def render_json(
+    findings: list[Finding], suppressed: int = 0, errors: list[str] | None = None
+) -> str:
+    return json.dumps(
+        {
+            "version": 1,
+            "tool": "tpulint",
+            "findings": [f.to_dict() for f in findings],
+            "summary": {
+                "total": len(findings),
+                "suppressed_by_baseline": suppressed,
+                "by_code": _count_by(findings, "code"),
+                "by_path": _count_by(findings, "path"),
+            },
+            "errors": list(errors or ()),
+        },
+        indent=2,
+        sort_keys=True,
+    )
+
+
+def _count_by(findings: list[Finding], attr: str) -> dict:
+    out: dict[str, int] = {}
+    for f in findings:
+        k = getattr(f, attr)
+        out[k] = out.get(k, 0) + 1
+    return dict(sorted(out.items()))
+
+
+# -- shared AST helpers (used by several rule modules) ----------------------
+
+
+def qualname_contexts(tree: ast.AST) -> dict[ast.AST, str]:
+    """node -> dotted lexical context for every function/class def."""
+    out: dict[ast.AST, str] = {}
+
+    def walk(node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                name = prefix + ("." if prefix else "") + child.name
+                out[child] = name
+                walk(child, name)
+            else:
+                walk(child, prefix)
+
+    walk(tree, "")
+    return out
+
+
+def context_of(module: Module, node: ast.AST) -> str:
+    """Nearest enclosing function/class context of ``node`` (by position;
+    cheap — rules call it per finding, not per node)."""
+    best = ""
+    target_line = getattr(node, "lineno", 0)
+    for def_node, name in qualname_contexts(module.tree).items():
+        if (
+            def_node.lineno <= target_line
+            and getattr(def_node, "end_lineno", def_node.lineno) >= target_line
+        ):
+            best = name if len(name) > len(best) else best
+    return best
+
+
+def call_name(node: ast.Call) -> str:
+    """Dotted best-effort name of a call target ('np.asarray',
+    'self._retire', 'float', '' when dynamic)."""
+    return dotted_name(node.func)
+
+
+def dotted_name(node: ast.AST) -> str:
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""

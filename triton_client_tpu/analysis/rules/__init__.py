@@ -1,0 +1,22 @@
+"""Rule families. Importing this package registers every rule with the
+engine registry (each module calls ``@register`` at import time).
+
+  TPL1xx  recompilation hazards     (rules.recompile)
+  TPL2xx  buffer-donation misuse    (rules.donation)
+  TPL3xx  host sync on the hot path (rules.hostsync)
+  TPL4xx  lock discipline           (rules.locks)
+  TPL5xx  telemetry correctness     (rules.telemetry)
+
+Adding a family: create ``rules/<name>.py``, subclass ``engine.Rule``
+with a fresh TPLnxx code, decorate with ``@register``, import it here,
+document it in docs/LINTING.md, and add positive/negative fixtures to
+``tests/test_tpulint.py``.
+"""
+
+from triton_client_tpu.analysis.rules import (  # noqa: F401
+    donation,
+    hostsync,
+    locks,
+    recompile,
+    telemetry,
+)

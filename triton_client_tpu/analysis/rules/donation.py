@@ -1,0 +1,238 @@
+"""TPL2xx — buffer-donation misuse.
+
+``jax.jit(..., donate_argnums=...)`` hands the argument's HBM buffer to
+XLA for reuse: after the call the donated array is *deleted* — touching
+it raises ``RuntimeError: Array has been deleted`` (and only at
+runtime, only on backends that honor donation, which is why CI on CPU
+never sees it). The serving launch path donates every spec-marked
+input (channel/tpu_channel.py ``_launcher``), so the two bug shapes
+worth catching at review time are:
+
+  TPL201  read-after-donation: a variable passed in a donated position
+          is loaded again later in the same function (flow-sensitive in
+          statement order; reassignment clears the taint). This covers
+          the "stats()/telemetry span touches a donated buffer later"
+          case too — the later touch IS the read.
+  TPL202  donating persistent state: the donated argument is an
+          attribute (``self._buf``) or subscript into shared state —
+          the owner object still holds a reference to a now-deleted
+          array, so the next reader anywhere in the process blows up.
+
+Donating callables are found two ways: names bound from a
+``jax.jit(..., donate_argnums=...)`` expression anywhere in the module,
+and (one level deep) names unpacked from a call to a same-module
+function that *returns* such a callable — the shape ``launcher, ... =
+self._launcher(model)`` the channel actually uses.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from triton_client_tpu.analysis.engine import (
+    Finding,
+    Module,
+    Package,
+    Rule,
+    call_name,
+    qualname_contexts,
+    register,
+)
+
+
+def _donate_positions(call: ast.Call) -> tuple[int, ...]:
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            try:
+                v = ast.literal_eval(kw.value)
+            except (ValueError, SyntaxError):
+                return ()
+            return tuple(v) if isinstance(v, (list, tuple)) else (int(v),)
+    return ()
+
+
+def _is_jit(call: ast.Call) -> bool:
+    return call_name(call) in ("jax.jit", "jit", "pjit", "jax.pjit")
+
+
+class _DonorIndex:
+    """Module-wide map of names that are donating callables.
+
+    ``direct``: {function-scope or module-level name -> donate positions}
+    ``via_call``: {callable name (function or method) -> positions} for
+    same-module functions whose return value is (or starts with) a
+    donating jit callable — callers that unpack the result get the
+    first target marked.
+    """
+
+    def __init__(self, module: Module) -> None:
+        self.direct: dict[str, tuple[int, ...]] = {}
+        self.via_call: dict[str, tuple[int, ...]] = {}
+        jit_names: dict[str, tuple[int, ...]] = {}
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                call = node.value
+                if _is_jit(call):
+                    pos = _donate_positions(call)
+                    if pos:
+                        for tgt in node.targets:
+                            if isinstance(tgt, ast.Name):
+                                jit_names[tgt.id] = pos
+                                self.direct[tgt.id] = pos
+        # functions returning a donating callable (directly or as the
+        # head of a returned tuple)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for ret in ast.walk(node):
+                if not isinstance(ret, ast.Return) or ret.value is None:
+                    continue
+                head = ret.value
+                if isinstance(head, ast.Tuple) and head.elts:
+                    head = head.elts[0]
+                if isinstance(head, ast.Name) and head.id in jit_names:
+                    self.via_call[node.name] = jit_names[head.id]
+                elif isinstance(head, ast.Call) and _is_jit(head):
+                    pos = _donate_positions(head)
+                    if pos:
+                        self.via_call[node.name] = pos
+
+
+def _donating_calls(
+    fn: ast.AST, index: _DonorIndex
+) -> Iterator[tuple[ast.Call, tuple[int, ...]]]:
+    """(call node, donated positions) for donating call sites in fn,
+    including local rebinds from `x, ... = self._maker(...)`."""
+    local: dict[str, tuple[int, ...]] = dict(index.direct)
+    # first pass: local names bound from donor-returning calls
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            callee = call_name(node.value).split(".")[-1]
+            pos = index.via_call.get(callee)
+            if pos:
+                tgt = node.targets[0]
+                if isinstance(tgt, ast.Tuple) and tgt.elts:
+                    tgt = tgt.elts[0]
+                if isinstance(tgt, ast.Name):
+                    local[tgt.id] = pos
+            elif _is_jit(node.value):
+                p = _donate_positions(node.value)
+                if p and isinstance(node.targets[0], ast.Name):
+                    local[node.targets[0].id] = p
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            simple = name.split(".")[-1] if name else ""
+            pos = local.get(name) or local.get(simple)
+            if pos:
+                yield node, pos
+            elif _is_jit(node):
+                # immediate call: jax.jit(f, donate_argnums=(0,))(x)
+                pass
+            elif isinstance(node.func, ast.Call) and _is_jit(node.func):
+                p = _donate_positions(node.func)
+                if p:
+                    yield node, p
+
+
+@register
+class ReadAfterDonationRule(Rule):
+    code = "TPL201"
+    name = "read-after-donation"
+    doc = (
+        "A variable passed in a `donate_argnums` position is read again "
+        "after the donating call — the buffer was handed to XLA and "
+        "deleted; reads fail at runtime on donation-capable backends."
+    )
+
+    def check(self, package: Package) -> Iterator[Finding]:
+        for module in package.modules:
+            index = _DonorIndex(module)
+            contexts = qualname_contexts(module.tree)
+            for fn in ast.walk(module.tree):
+                if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                ctx = contexts.get(fn, fn.name)
+                donated: dict[str, int] = {}  # name -> donation lineno
+                for call, positions in _donating_calls(fn, index):
+                    for p in positions:
+                        if p < len(call.args) and isinstance(
+                            call.args[p], ast.Name
+                        ):
+                            name = call.args[p].id
+                            line = call.lineno
+                            if name not in donated or line < donated[name]:
+                                donated[name] = line
+                if not donated:
+                    continue
+                # reassignments clear the taint from their line onward
+                cleared: dict[str, int] = {}
+                for node in ast.walk(fn):
+                    tgts = []
+                    if isinstance(node, ast.Assign):
+                        tgts = node.targets
+                    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                        tgts = [node.target]
+                    for tgt in tgts:
+                        for leaf in ast.walk(tgt):
+                            if (
+                                isinstance(leaf, ast.Name)
+                                and leaf.id in donated
+                                and leaf.lineno >= donated[leaf.id]
+                            ):
+                                prev = cleared.get(leaf.id)
+                                if prev is None or leaf.lineno < prev:
+                                    cleared[leaf.id] = leaf.lineno
+                for node in ast.walk(fn):
+                    if (
+                        isinstance(node, ast.Name)
+                        and isinstance(node.ctx, ast.Load)
+                        and node.id in donated
+                        and node.lineno > donated[node.id]
+                        and node.lineno < cleared.get(node.id, 10**9)
+                    ):
+                        # no line numbers in the message: fingerprints
+                        # must survive unrelated line churn
+                        yield self.finding(
+                            module,
+                            node,
+                            f"`{node.id}` read after being passed in a "
+                            "donated position (buffer deleted by XLA)",
+                            context=ctx,
+                        )
+
+
+@register
+class DonatePersistentRule(Rule):
+    code = "TPL202"
+    name = "donate-persistent-buffer"
+    doc = (
+        "A donated argument is an attribute or subscript of longer-lived "
+        "state (`self._buf`, `cache[k]`): the owner keeps a reference to "
+        "a deleted array and any later reader crashes."
+    )
+
+    def check(self, package: Package) -> Iterator[Finding]:
+        for module in package.modules:
+            index = _DonorIndex(module)
+            contexts = qualname_contexts(module.tree)
+            for fn in ast.walk(module.tree):
+                if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                ctx = contexts.get(fn, fn.name)
+                for call, positions in _donating_calls(fn, index):
+                    for p in positions:
+                        if p >= len(call.args):
+                            continue
+                        arg = call.args[p]
+                        if isinstance(arg, (ast.Attribute, ast.Subscript)):
+                            src = ast.unparse(arg)
+                            yield self.finding(
+                                module,
+                                arg,
+                                f"donated argument `{src}` is held by "
+                                "longer-lived state; donation deletes the "
+                                "buffer under that reference",
+                                context=ctx,
+                            )

@@ -1,0 +1,181 @@
+"""TPL3xx — host synchronization on the serving hot path.
+
+JAX dispatch is asynchronous: the whole overlapped-serving design
+(stage → launch → lazy readback, PR 1) only works because nothing on
+the request path forces the host to wait for the device. One stray
+``np.asarray``/``.item()``/``float()`` on a device value serializes the
+pipeline back to pre-overlap behavior — and profiling shows it as
+"device time" because the wait happens inside the span. The rule walks
+the package call graph from the serving roots and flags every
+host-sync call in a reachable function:
+
+  TPL301  blocking readback (``np.asarray``/``np.array``/
+          ``jax.device_get``/``.item()``/``.tolist()``/``float()``/
+          ``int()`` over a non-literal) in a hot-path function
+  TPL302  explicit device fence (``block_until_ready``) in a hot-path
+          function
+
+Some syncs are the *point* (the readback in ``resolve()``, the trace's
+execute/readback split): those stay, with a one-line justification in
+``tpulint.baseline.json`` — the rule's job is making every sync an
+explicit, reviewed decision rather than an accident.
+
+Roots (suffix-matched against dotted qualnames) default to
+:data:`HOT_PATH_ROOTS`; ``perf/_harness.py`` reuses this rule with a
+single callable as the root set to vet timed regions.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Iterator
+
+from triton_client_tpu.analysis.engine import (
+    Finding,
+    Package,
+    Rule,
+    call_name,
+    register,
+)
+
+#: The serving hot path: channel staging/launch (and the nested
+#: ``resolve`` readback closure), the batcher's dispatch/merge/execute
+#: machinery, and the gRPC servicer's issue path.
+HOT_PATH_ROOTS = (
+    "TPUChannel.stage",
+    "TPUChannel.launch",
+    "TPUChannel.do_inference",
+    "TPUChannel.do_inference_async",
+    "BatchingChannel.do_inference",
+    "BatchingChannel._on_batch",
+    "BatchingChannel._dispatch_once",
+    "BatchingChannel._run_group",
+    "BatchingChannel._run_solo",
+    "BatchingChannel._merge_parts",
+    "_Servicer._issue",
+)
+
+# module-level call targets that force a host sync
+_SYNC_CALLS = {
+    "np.asarray": "blocking device->host readback",
+    "np.array": "blocking device->host readback",
+    "numpy.asarray": "blocking device->host readback",
+    "numpy.array": "blocking device->host readback",
+    "jax.device_get": "blocking device->host readback",
+    "jax.block_until_ready": "device fence",
+}
+# zero-ambiguity method syncs on array-likes
+_SYNC_METHODS = {
+    "item": "scalar readback",
+    "tolist": "full-array readback",
+    "block_until_ready": "device fence",
+}
+# float() is the classic accidental fence (`float(loss)` in a hot
+# loop); int()/bool() are overwhelmingly host-side shape/flag math in
+# this codebase, so only float() is flagged.
+_SCALAR_CASTS = {"float"}
+
+
+def _sync_calls_in(fn: ast.AST) -> Iterator[tuple[ast.Call, str, str]]:
+    """(call, code, description) for host-sync calls lexically inside
+    ``fn`` but NOT inside a nested def (nested defs are their own call
+    graph nodes and get scanned under their own qualname)."""
+
+    def walk(node: ast.AST, top: bool) -> Iterator[tuple[ast.Call, str, str]]:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            if isinstance(child, ast.Call):
+                name = call_name(child)
+                if name in _SYNC_CALLS:
+                    code = (
+                        "TPL302"
+                        if "block_until_ready" in name
+                        else "TPL301"
+                    )
+                    yield child, code, f"`{name}` ({_SYNC_CALLS[name]})"
+                elif (
+                    isinstance(child.func, ast.Attribute)
+                    and child.func.attr in _SYNC_METHODS
+                ):
+                    code = (
+                        "TPL302"
+                        if child.func.attr == "block_until_ready"
+                        else "TPL301"
+                    )
+                    yield (
+                        child,
+                        code,
+                        f"`.{child.func.attr}()` "
+                        f"({_SYNC_METHODS[child.func.attr]})",
+                    )
+                elif (
+                    name in _SCALAR_CASTS
+                    and child.args
+                    and not isinstance(child.args[0], ast.Constant)
+                    and not (
+                        isinstance(child.args[0], ast.Call)
+                        and call_name(child.args[0])
+                        in ("len", "round", "perf_counter", "time.perf_counter")
+                    )
+                ):
+                    yield (
+                        child,
+                        "TPL301",
+                        f"`{name}()` over a non-literal (scalar readback "
+                        "if the value is on device)",
+                    )
+            yield from walk(child, top)
+
+    yield from walk(fn, True)
+
+
+@register
+class HostSyncRule(Rule):
+    code = "TPL301"
+    name = "hot-path-host-sync"
+    doc = (
+        "A blocking device->host readback (`np.asarray`, `.item()`, "
+        "`float()`, ...) sits in a function reachable from the serving "
+        "hot path; it serializes the overlapped pipeline. Move it to "
+        "the deferred-readback side or baseline it with a justification."
+    )
+
+    roots: tuple[str, ...] = HOT_PATH_ROOTS
+
+    def check(self, package: Package) -> Iterator[Finding]:
+        yield from check_reachable(package, self.roots)
+
+
+def check_reachable(
+    package: Package, roots: Iterable[str]
+) -> Iterator[Finding]:
+    """Shared worker: flag sync calls in every function reachable from
+    ``roots``. Used by the registry rule and by perf/_harness.py's
+    timed-region assertion."""
+    graph = package.callgraph
+    hot = graph.reachable(roots)
+    rule = HostSyncRule()
+    for qn in sorted(hot):
+        info = graph.functions.get(qn)
+        if info is None:
+            continue
+        for call, code, desc in _sync_calls_in(info.node):
+            yield rule.finding(
+                info.module,
+                call,
+                f"{desc} on the hot path (reachable from serving roots)",
+                context=_short_context(qn),
+                code=code,
+            )
+
+
+def _short_context(qualname: str) -> str:
+    """Drop the module-path prefix: keep Class.method / func.nested."""
+    parts = qualname.split(".")
+    # heuristics: module path components are lowercase_with_underscores
+    # file names; keep from the first CamelCase part or the last two
+    for i, p in enumerate(parts):
+        if p[:1].isupper():
+            return ".".join(parts[i:])
+    return ".".join(parts[-2:]) if len(parts) > 1 else qualname

@@ -1,0 +1,208 @@
+"""TPL4xx — lock discipline over shared instance state.
+
+The serving stack is aggressively multi-threaded (gRPC handler threads,
+the batch dispatcher, executor workers, staging threads), and its
+convention is lock-per-structure: ``self._lock`` guards ``_pending``,
+``self._ready_cv`` guards the dispatch state, ``self._slot_cv`` guards
+the channel slots. The bug class this rule catches is an attribute that
+is *sometimes* mutated under the class's lock and *sometimes* bare —
+the bare site is either a forgotten guard (a data race that loses
+counter increments under load) or evidence the attribute doesn't need
+the lock at all (in which case the guarded sites are lying to readers).
+
+  TPL401  attribute mutated both under a ``with self.<lock>:`` block
+          and outside one, in the same class; every unguarded mutation
+          site is flagged. ``__init__``/``__new__`` are exempt (the
+          object is not yet shared during construction), and so are
+          methods named ``*_locked`` — the codebase convention (e.g.
+          ``_form_group_locked``) for "caller already holds the lock".
+
+"Lock" means any attribute the class binds to ``threading.Lock /
+RLock / Condition / Semaphore`` in ``__init__``, plus anything named
+``*lock*`` / ``*_cv`` used as a context manager.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from triton_client_tpu.analysis.engine import (
+    Finding,
+    Module,
+    Package,
+    Rule,
+    call_name,
+    register,
+)
+
+_LOCK_FACTORIES = {
+    "threading.Lock",
+    "threading.RLock",
+    "threading.Condition",
+    "threading.Semaphore",
+    "threading.BoundedSemaphore",
+    "Lock",
+    "RLock",
+    "Condition",
+    "Semaphore",
+}
+_EXEMPT_METHODS = {"__init__", "__new__", "__post_init__"}
+
+
+def _lock_attrs(cls: ast.ClassDef) -> set[str]:
+    """Attribute names holding a lock/condition in this class."""
+    out: set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if call_name(node.value) in _LOCK_FACTORIES:
+                for tgt in node.targets:
+                    if (
+                        isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"
+                    ):
+                        out.add(tgt.attr)
+    for node in ast.walk(cls):
+        if isinstance(node, ast.With):
+            for item in node.items:
+                ctx = item.context_expr
+                if (
+                    isinstance(ctx, ast.Attribute)
+                    and isinstance(ctx.value, ast.Name)
+                    and ctx.value.id == "self"
+                    and ("lock" in ctx.attr.lower() or ctx.attr.endswith("_cv"))
+                ):
+                    out.add(ctx.attr)
+    return out
+
+
+def _self_attr_of_target(tgt: ast.AST) -> str | None:
+    """The self-attribute a store mutates: `self.x = ...` -> x,
+    `self.x[k] = / += ...` -> x (subscript stores mutate the container
+    the attribute holds)."""
+    node = tgt
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+@register
+class LockDisciplineRule(Rule):
+    code = "TPL401"
+    name = "mixed-lock-discipline"
+    doc = (
+        "An instance attribute is mutated both inside a `with "
+        "self.<lock>:` block and outside one in the same class — the "
+        "unguarded site races with every guarded reader/writer."
+    )
+
+    def check(self, package: Package) -> Iterator[Finding]:
+        for module in package.modules:
+            for cls in ast.walk(module.tree):
+                if not isinstance(cls, ast.ClassDef):
+                    continue
+                locks = _lock_attrs(cls)
+                if not locks:
+                    continue
+                yield from self._check_class(module, cls, locks)
+
+    def _check_class(
+        self, module: Module, cls: ast.ClassDef, locks: set[str]
+    ) -> Iterator[Finding]:
+        guarded: set[str] = set()
+        # (attr, node, method) mutation sites outside any lock
+        bare: list[tuple[str, ast.AST, str]] = []
+
+        def mutations(node: ast.AST) -> Iterator[tuple[str, ast.AST]]:
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    attr = _self_attr_of_target(tgt)
+                    if attr:
+                        yield attr, node
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                attr = _self_attr_of_target(node.target)
+                if attr:
+                    yield attr, node
+            elif isinstance(node, ast.Call):
+                # mutating method calls on a self attribute:
+                # self._q.append(x), self._cache.pop(k), ...
+                f = node.func
+                if (
+                    isinstance(f, ast.Attribute)
+                    and f.attr
+                    in (
+                        "append",
+                        "appendleft",
+                        "extend",
+                        "extendleft",
+                        "pop",
+                        "popleft",
+                        "add",
+                        "remove",
+                        "discard",
+                        "clear",
+                        "update",
+                        "setdefault",
+                        "put",
+                        "put_nowait",
+                    )
+                ):
+                    attr = _self_attr_of_target(f.value)
+                    if attr:
+                        yield attr, node
+
+        def is_lock_with(node: ast.With) -> bool:
+            for item in node.items:
+                ctx = item.context_expr
+                if (
+                    isinstance(ctx, ast.Attribute)
+                    and isinstance(ctx.value, ast.Name)
+                    and ctx.value.id == "self"
+                    and ctx.attr in locks
+                ):
+                    return True
+            return False
+
+        def walk(node: ast.AST, under_lock: bool, method: str) -> None:
+            for child in ast.iter_child_nodes(node):
+                child_method = method
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    # direct methods reset lock state; nested defs
+                    # (closures) inherit the enclosing method name but
+                    # NOT the lock — they usually run later, unlocked
+                    child_method = method or child.name
+                    if child.name in _EXEMPT_METHODS or child.name.endswith(
+                        "_locked"
+                    ):
+                        continue
+                    walk(child, False, child_method)
+                    continue
+                child_lock = under_lock
+                if isinstance(child, ast.With) and is_lock_with(child):
+                    child_lock = True
+                for attr, site in mutations(child):
+                    if attr in locks:
+                        continue
+                    if child_lock:
+                        guarded.add(attr)
+                    else:
+                        bare.append((attr, site, method))
+                walk(child, child_lock, child_method)
+
+        walk(cls, False, "")
+        for attr, site, method in bare:
+            if attr in guarded:
+                yield self.finding(
+                    module,
+                    site,
+                    f"`self.{attr}` is mutated without the lock here but "
+                    "under a lock elsewhere in this class (data race)",
+                    context=f"{cls.name}.{method}" if method else cls.name,
+                )

@@ -1,0 +1,181 @@
+"""TPL5xx — telemetry correctness.
+
+Observability must never lie: an unbalanced span leaves "invisible
+time" in the request wall (breaking the >=95% coverage gate from PR 2)
+and an unbalanced gauge drifts monotonically until the Grafana panel is
+fiction. Both bugs are structural:
+
+  TPL501  ``.begin("name")`` with no ``.end("name")`` anywhere in the
+          same module — the span can never close, so every traced
+          request shows an open interval that gets silently dropped.
+  TPL502  an in-flight gauge increment (``request_started``, ``.inc(``,
+          ``_started``-style) whose paired decrement is neither inside
+          a ``finally`` block nor inside a function that is itself
+          called from a ``finally`` — an exception between the two
+          leaks the gauge upward forever.
+
+Pairs are matched by convention: (``begin``/``end``), (``inc``/``dec``),
+(``request_started``/``request_finished``), (``acquire``/``release`` is
+deliberately NOT included — lock pairing is TPL4xx's domain and
+``with`` statements hide the release).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from triton_client_tpu.analysis.engine import (
+    Finding,
+    Module,
+    Package,
+    Rule,
+    qualname_contexts,
+    register,
+)
+
+_GAUGE_PAIRS = {
+    "inc": "dec",
+    "request_started": "request_finished",
+}
+
+
+def _literal_str_arg(call: ast.Call) -> str | None:
+    if call.args and isinstance(call.args[0], ast.Constant):
+        v = call.args[0].value
+        if isinstance(v, str):
+            return v
+    return None
+
+
+@register
+class UnbalancedSpanRule(Rule):
+    code = "TPL501"
+    name = "span-begin-without-end"
+    doc = (
+        "A trace span is opened with `.begin(\"name\")` but no "
+        "`.end(\"name\")` for the same literal name exists in the "
+        "module — the span never closes and is dropped at finish."
+    )
+
+    def check(self, package: Package) -> Iterator[Finding]:
+        for module in package.modules:
+            begins: list[tuple[ast.Call, str]] = []
+            ends: set[str] = set()
+            skip = False
+            for node in ast.walk(module.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    # the trace classes themselves define begin/end
+                    if node.name in ("begin", "end"):
+                        skip = True
+            if skip:
+                continue
+            contexts = qualname_contexts(module.tree)
+            for node in ast.walk(module.tree):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                ):
+                    continue
+                name = _literal_str_arg(node)
+                if name is None:
+                    continue
+                if node.func.attr == "begin":
+                    begins.append((node, name))
+                elif node.func.attr == "end":
+                    ends.add(name)
+            for call, name in begins:
+                if name not in ends:
+                    yield self.finding(
+                        module,
+                        call,
+                        f"span `{name}` is begun but never ended in this "
+                        "module (open span is dropped at trace finish)",
+                        context=_ctx_of(module, call, contexts),
+                    )
+
+
+@register
+class GaugeLeakRule(Rule):
+    code = "TPL502"
+    name = "gauge-inc-without-finally-dec"
+    doc = (
+        "An in-flight gauge increment has no matching decrement in a "
+        "`finally` block (directly, or via a helper that a `finally` "
+        "calls) — any exception in between leaks the gauge upward."
+    )
+
+    def check(self, package: Package) -> Iterator[Finding]:
+        for module in package.modules:
+            defines = {
+                node.name
+                for node in ast.walk(module.tree)
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            }
+            contexts = qualname_contexts(module.tree)
+            # every call that appears lexically inside a `finally:`
+            finally_calls: set[str] = set()
+            dec_sites: dict[str, set[str]] = {}  # dec attr -> funcs containing it
+            for node in ast.walk(module.tree):
+                if isinstance(node, ast.Try) and node.finalbody:
+                    for stmt in node.finalbody:
+                        for sub in ast.walk(stmt):
+                            if isinstance(sub, ast.Call) and isinstance(
+                                sub.func, ast.Attribute
+                            ):
+                                finally_calls.add(sub.func.attr)
+                            elif isinstance(sub, ast.Call) and isinstance(
+                                sub.func, ast.Name
+                            ):
+                                finally_calls.add(sub.func.id)
+            for fn in ast.walk(module.tree):
+                if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                for sub in ast.walk(fn):
+                    if isinstance(sub, ast.Call) and isinstance(
+                        sub.func, ast.Attribute
+                    ):
+                        dec_sites.setdefault(sub.func.attr, set()).add(fn.name)
+            for node in ast.walk(module.tree):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                ):
+                    continue
+                inc_name = node.func.attr
+                dec_name = _GAUGE_PAIRS.get(inc_name)
+                if dec_name is None:
+                    continue
+                if inc_name == "inc" and module.relpath.endswith(
+                    ("collector.py",)
+                ):
+                    # the collector defines the gauges; inc/dec pairing
+                    # there is the metric's own contract
+                    continue
+                ok = dec_name in finally_calls or any(
+                    holder in finally_calls
+                    for holder in dec_sites.get(dec_name, ())
+                    if holder in defines
+                )
+                if not ok:
+                    yield self.finding(
+                        module,
+                        node,
+                        f"`{inc_name}()` has no `{dec_name}()` reachable "
+                        "from a `finally` in this module (gauge leaks on "
+                        "exceptions)",
+                        context=_ctx_of(module, node, contexts),
+                    )
+
+
+def _ctx_of(module: Module, node: ast.AST, contexts: dict) -> str:
+    best = ""
+    line = getattr(node, "lineno", 0)
+    for def_node, name in contexts.items():
+        if (
+            def_node.lineno <= line
+            and getattr(def_node, "end_lineno", def_node.lineno) >= line
+            and len(name) > len(best)
+        ):
+            best = name
+    return best

@@ -11,6 +11,9 @@
   trace-dump  — pull the request-trace ring buffer off a serving
                 process's telemetry port as Chrome-trace JSON
                 (open in Perfetto / chrome://tracing).
+  lint        — tpulint: AST hazard analysis of the serving stack
+                (recompilation/donation/host-sync/lock/telemetry rules;
+                docs/LINTING.md). The CI gate runs this before pytest.
 """
 
 from __future__ import annotations
@@ -139,6 +142,118 @@ def trace_dump(argv=None) -> None:
             f"wrote {n_req} request traces ({len(events)} events) -> "
             f"{args.output}", file=sys.stderr,
         )
+
+
+def lint(argv=None) -> None:
+    """tpulint CLI: run the TPL rule families over the package (or the
+    given paths), apply the baseline, print text or JSON, and exit
+    non-zero on NEW findings. The serving analogue of `ruff check` for
+    hazards ruff cannot know about (donation, retraces, hot-path
+    syncs)."""
+    p = argparse.ArgumentParser(
+        prog="tpulint",
+        description="AST hazard analysis for the JAX serving stack "
+        "(TPL1xx recompilation, TPL2xx donation, TPL3xx host-sync, "
+        "TPL4xx locks, TPL5xx telemetry; see docs/LINTING.md)",
+    )
+    p.add_argument(
+        "paths", nargs="*",
+        help="files/dirs to analyze (default: the triton_client_tpu "
+        "package this CLI runs from)",
+    )
+    p.add_argument(
+        "--json", action="store_true", help="machine-readable JSON output"
+    )
+    p.add_argument(
+        "--baseline", default=None,
+        help="baseline file of accepted findings (tpulint.baseline.json); "
+        "only findings NOT in it fail the run",
+    )
+    p.add_argument(
+        "--write-baseline", default=None, metavar="FILE",
+        help="write every current finding to FILE as a baseline "
+        "(justifications start as TODO and must be edited) and exit 0",
+    )
+    p.add_argument(
+        "--rules", default=None,
+        help="comma-separated code selection (full codes or family "
+        "prefixes: 'TPL3,TPL401')",
+    )
+    p.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule catalogue and exit",
+    )
+    p.add_argument(
+        "--no-stale-check", action="store_true",
+        help="do not warn about baseline entries nothing matched",
+    )
+    args = p.parse_args(argv)
+
+    import json as _json
+    import sys
+
+    from triton_client_tpu import analysis
+
+    if args.list_rules:
+        for code, cls in analysis.registry().items():
+            print(f"{code}  {cls.name}")
+            doc = " ".join((cls.doc or "").split())
+            if doc:
+                print(f"       {doc}")
+        return
+
+    paths = args.paths or [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+    codes = args.rules.split(",") if args.rules else None
+    package = analysis.load_package(paths)
+    findings = analysis.run_rules(package, codes=codes)
+
+    if args.write_baseline:
+        analysis.Baseline.from_findings(findings).save(args.write_baseline)
+        print(
+            f"wrote {len(findings)} finding(s) -> {args.write_baseline}; "
+            "edit the TODO justifications before committing",
+            file=sys.stderr,
+        )
+        return
+
+    suppressed: list = []
+    problems: list[str] = list(package.errors)
+    if args.baseline:
+        bl = analysis.Baseline.load(args.baseline)
+        findings, suppressed = bl.split(findings)
+        for fp in bl.unjustified():
+            e = bl.entries[fp]
+            problems.append(
+                f"baseline entry {fp} ({e.get('code')} {e.get('path')}) "
+                "has no justification"
+            )
+        if not args.no_stale_check:
+            for fp in bl.stale(findings + suppressed):
+                e = bl.entries[fp]
+                print(
+                    f"tpulint: warning: stale baseline entry {fp} "
+                    f"({e.get('code')} {e.get('path')}: nothing matches it)",
+                    file=sys.stderr,
+                )
+
+    if args.json:
+        doc = _json.loads(
+            analysis.render_json(
+                findings, suppressed=len(suppressed), errors=problems
+            )
+        )
+        print(_json.dumps(doc, indent=2, sort_keys=True))
+    else:
+        analysis.render_text(findings)
+        for msg in problems:
+            print(f"tpulint: error: {msg}", file=sys.stderr)
+        tail = f", {len(suppressed)} baselined" if args.baseline else ""
+        print(
+            f"tpulint: {len(findings)} new finding(s){tail}",
+            file=sys.stderr,
+        )
+    if findings or problems:
+        raise SystemExit(1)
 
 
 def repo_index(argv=None) -> None:

@@ -652,9 +652,13 @@ class BatchingChannel(BaseChannel):
         # after the dispatcher stops, drain in-flight groups so every
         # admitted future resolves before close() returns
         self._exec.shutdown(wait=True)
-        if self._arena is not None:
-            self._arena.close()
-            self._arena = None
+        # _arena is published under _lock (_merge_parts' double-checked
+        # init); tear it down under the same lock — tpulint TPL401
+        # caught the bare mutation racing a straggler executor thread
+        with self._lock:
+            arena, self._arena = self._arena, None
+        if arena is not None:
+            arena.close()
 
 
 class _PyBatcher:
