@@ -39,6 +39,14 @@ if [[ "${1:-}" == "--fast" ]]; then
     exit 0
 fi
 
+echo "== multi-device serving shard (8 virtual host devices) =="
+# the mesh-sharded channel's parity/stacking contract on the virtual
+# CPU mesh conftest.py provisions — runs first and alone so a sharding
+# regression is named by its shard, not buried in the tier-1 wall
+python -m pytest tests/test_sharded_channel.py -q \
+    --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+
 echo "== tier-1 pytest =="
 exec python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors \

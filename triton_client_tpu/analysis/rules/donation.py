@@ -18,11 +18,18 @@ worth catching at review time are:
           the owner object still holds a reference to a now-deleted
           array, so the next reader anywhere in the process blows up.
 
-Donating callables are found two ways: names bound from a
-``jax.jit(..., donate_argnums=...)`` expression anywhere in the module,
-and (one level deep) names unpacked from a call to a same-module
-function that *returns* such a callable — the shape ``launcher, ... =
-self._launcher(model)`` the channel actually uses.
+Donating callables are found three ways: names bound from a
+``jax.jit(..., donate_argnums=...)`` expression anywhere in the module;
+names unpacked from a call to a function that *returns* such a callable
+— the shape ``launcher, ... = self._launcher(model)`` the channel
+actually uses; and (a bounded package-wide fixpoint) functions whose
+returned head is itself bound from a known donor factory — required
+since the stage/launch engine moved to ``channel/staged.py`` while the
+``jax.jit`` factories live in the subclass modules
+(``TPUChannel._make_launcher`` / ``ShardedTPUChannel._make_launcher``):
+``StagedChannel._launcher`` returns what ``_make_launcher`` built, so
+it must inherit the factory's donate positions for the launch call site
+to stay tracked.
 """
 
 from __future__ import annotations
@@ -56,6 +63,15 @@ def _is_jit(call: ast.Call) -> bool:
     return call_name(call) in ("jax.jit", "jit", "pjit", "jax.pjit")
 
 
+def _return_head(ret: ast.Return) -> ast.AST | None:
+    """The returned value, or the first element of a returned tuple —
+    the factory convention is ``return launcher, donate_names, ...``."""
+    head = ret.value
+    if isinstance(head, ast.Tuple) and head.elts:
+        head = head.elts[0]
+    return head
+
+
 class _DonorIndex:
     """Module-wide map of names that are donating callables.
 
@@ -63,10 +79,16 @@ class _DonorIndex:
     ``via_call``: {callable name (function or method) -> positions} for
     same-module functions whose return value is (or starts with) a
     donating jit callable — callers that unpack the result get the
-    first target marked.
+    first target marked. ``shared_via_call`` merges in the package-wide
+    factory map (:func:`build_donor_map`) so a module can consume a
+    factory defined elsewhere (the staged/subclass split).
     """
 
-    def __init__(self, module: Module) -> None:
+    def __init__(
+        self,
+        module: Module,
+        shared_via_call: dict[str, tuple[int, ...]] | None = None,
+    ) -> None:
         self.direct: dict[str, tuple[int, ...]] = {}
         self.via_call: dict[str, tuple[int, ...]] = {}
         jit_names: dict[str, tuple[int, ...]] = {}
@@ -88,15 +110,65 @@ class _DonorIndex:
             for ret in ast.walk(node):
                 if not isinstance(ret, ast.Return) or ret.value is None:
                     continue
-                head = ret.value
-                if isinstance(head, ast.Tuple) and head.elts:
-                    head = head.elts[0]
+                head = _return_head(ret)
                 if isinstance(head, ast.Name) and head.id in jit_names:
                     self.via_call[node.name] = jit_names[head.id]
                 elif isinstance(head, ast.Call) and _is_jit(head):
                     pos = _donate_positions(head)
                     if pos:
                         self.via_call[node.name] = pos
+        if shared_via_call:
+            for name, pos in shared_via_call.items():
+                self.via_call.setdefault(name, pos)
+
+
+def build_donor_map(package: Package) -> dict[str, tuple[int, ...]]:
+    """Package-wide donor-factory map: simple callable name -> donate
+    positions, closed over factory-returns-factory chains.
+
+    Seeded with every module's local ``via_call``, then a bounded
+    fixpoint: a function whose returned head is a name bound (in that
+    function) from a call to a known factory becomes a factory with the
+    same positions. One round covers ``StagedChannel._launcher``
+    (returns ``_make_launcher``'s launcher); the bound keeps pathological
+    chains from looping."""
+    via: dict[str, tuple[int, ...]] = {}
+    for module in package.modules:
+        via.update(_DonorIndex(module).via_call)
+    for _ in range(len(package.modules) + 1):
+        grew = False
+        for module in package.modules:
+            for fn in ast.walk(module.tree):
+                if (
+                    not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    or fn.name in via
+                ):
+                    continue
+                bound: dict[str, tuple[int, ...]] = {}
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Assign) and isinstance(
+                        node.value, ast.Call
+                    ):
+                        callee = call_name(node.value).split(".")[-1]
+                        pos = via.get(callee)
+                        if pos:
+                            tgt = node.targets[0]
+                            if isinstance(tgt, ast.Tuple) and tgt.elts:
+                                tgt = tgt.elts[0]
+                            if isinstance(tgt, ast.Name):
+                                bound[tgt.id] = pos
+                if not bound:
+                    continue
+                for ret in ast.walk(fn):
+                    if isinstance(ret, ast.Return) and ret.value is not None:
+                        head = _return_head(ret)
+                        if isinstance(head, ast.Name) and head.id in bound:
+                            via[fn.name] = bound[head.id]
+                            grew = True
+                            break
+        if not grew:
+            return via
+    return via
 
 
 def _donating_calls(
@@ -147,8 +219,9 @@ class ReadAfterDonationRule(Rule):
     )
 
     def check(self, package: Package) -> Iterator[Finding]:
+        shared = build_donor_map(package)
         for module in package.modules:
-            index = _DonorIndex(module)
+            index = _DonorIndex(module, shared)
             contexts = qualname_contexts(module.tree)
             for fn in ast.walk(module.tree):
                 if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
@@ -214,8 +287,9 @@ class DonatePersistentRule(Rule):
     )
 
     def check(self, package: Package) -> Iterator[Finding]:
+        shared = build_donor_map(package)
         for module in package.modules:
-            index = _DonorIndex(module)
+            index = _DonorIndex(module, shared)
             contexts = qualname_contexts(module.tree)
             for fn in ast.walk(module.tree):
                 if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):

@@ -38,14 +38,30 @@ from triton_client_tpu.analysis.engine import (
     register,
 )
 
-#: The serving hot path: channel staging/launch (and the nested
-#: ``resolve`` readback closure), the batcher's dispatch/merge/execute
+#: The serving hot path: the shared StagedChannel engine (stage/launch
+#: and the nested ``resolve`` readback closure) plus each subclass's
+#: placement/launcher/readback hooks — the call graph resolves
+#: ``self._place_inputs()`` to the base-class stub only, so overrides
+#: must be roots themselves — the batcher's dispatch/merge/execute
 #: machinery, and the gRPC servicer's issue path.
 HOT_PATH_ROOTS = (
+    "StagedChannel.stage",
+    "StagedChannel.launch",
+    "StagedChannel.do_inference",
+    "StagedChannel.do_inference_async",
+    # stage/launch live on StagedChannel since the round-7 factoring,
+    # but a subclass-qualified definition (out-of-tree channels, doc
+    # examples, test fixtures) is just as hot — keep the historical
+    # names rooted too (suffix patterns that match nothing are inert)
     "TPUChannel.stage",
     "TPUChannel.launch",
     "TPUChannel.do_inference",
     "TPUChannel.do_inference_async",
+    "TPUChannel._place_inputs",
+    "TPUChannel._make_launcher",
+    "ShardedTPUChannel._place_inputs",
+    "ShardedTPUChannel._make_launcher",
+    "ShardedTPUChannel._host_outputs",
     "BatchingChannel.do_inference",
     "BatchingChannel._on_batch",
     "BatchingChannel._dispatch_once",

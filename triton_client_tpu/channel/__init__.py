@@ -12,6 +12,8 @@ from triton_client_tpu.channel.base import (
     InferRequest,
     InferResponse,
 )
+from triton_client_tpu.channel.sharded_channel import ShardedTPUChannel
+from triton_client_tpu.channel.staged import StagedChannel
 from triton_client_tpu.channel.tpu_channel import TPUChannel
 
 __all__ = [
@@ -19,6 +21,8 @@ __all__ = [
     "GRPCChannel",
     "InferRequest",
     "InferResponse",
+    "ShardedTPUChannel",
+    "StagedChannel",
     "TPUChannel",
 ]
 

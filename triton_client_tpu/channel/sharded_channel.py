@@ -1,0 +1,173 @@
+"""ShardedTPUChannel: one server saturating a whole mesh.
+
+The replicate-params / shard-batch serving shape used by TPU LLM
+serving stacks (PAPERS.md — Ragged Paged Attention, Gemma-on-TPU),
+applied to the perception stack: one model, all devices of a
+``parallel/mesh.py`` mesh, one executable per padded batch bucket.
+
+  * **params** are placed ONCE with ``replicated(mesh)`` sharding — at
+    launcher build for an explicit ``RegisteredModel.params`` tree, or
+    implicitly by XLA for the closure-captured weights every in-tree
+    pipeline carries (replication happens at first trace per bucket,
+    then every launch reads the local HBM copy).
+  * **batches** are padded to the shared bucket table
+    (:mod:`triton_client_tpu.runtime.padding` — ``bucket_for`` keeps
+    each padded size divisible by the data-axis width) and split over
+    the ``data`` axis via ``jax.device_put(arr, batch_sharding(mesh))``,
+    so each device runs batch/N rows of the SAME program — per-request
+    numerics are bitwise identical to the single-device channel because
+    data parallelism never changes a row's compute and pad rows
+    replicate a real row before being sliced back off.
+  * **dispatch** keeps PR 1's staged/launch/lazy-readback overlap via
+    the shared :class:`~triton_client_tpu.channel.staged.StagedChannel`
+    engine: staging slots are per MESH (one admission window over all
+    devices), so batch N+1's host->device scatter overlaps batch N's
+    mesh-wide execution. The launcher is a cached
+    ``jax.jit(..., in_shardings=(batch_sharding, None),
+    donate_argnums=...)`` so consecutive padded batches reuse the same
+    per-device HBM input shards.
+
+``BatchingChannel`` stacks in front unchanged through the ``inner``
+channel interface and reads :attr:`batch_multiple` (the data-axis
+width) to size merge groups up to ``max_batch x data_axis`` and align
+its pad buckets, so batcher padding and shard padding never disagree.
+
+Models whose spec declares ``max_batch_size <= 1`` (pointpillars: the
+leading ``points`` dim is a point-count bucket, not a batch) cannot be
+row-split; they run fully replicated on the mesh — same answers,
+no speedup — so one server can still serve a mixed model set.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from triton_client_tpu.channel.staged import StagedChannel, cast_wire_input
+from triton_client_tpu.parallel.mesh import (
+    data_axis_size,
+    replicate_params,
+    serving_shardings,
+)
+from triton_client_tpu.runtime.padding import bucket_for, pad_batch, unpad_rows
+
+
+class ShardedTPUChannel(StagedChannel):
+    """Data-parallel serving channel over every device of the mesh."""
+
+    # -- placement ------------------------------------------------------------
+
+    @property
+    def batch_multiple(self) -> int:
+        """The data-axis width: the batcher sizes merge groups and pad
+        buckets off this so a merged batch always splits evenly."""
+        return data_axis_size(self._mesh)
+
+    def _batched_names(self, model) -> frozenset[str]:
+        """Inputs carrying the request batch on their leading dim.
+
+        Triton's own convention: a model is batchable iff its spec
+        declares ``max_batch_size > 1``, and then every input whose
+        leading dim is dynamic (-1) is batch-leading. Models at the
+        default ``max_batch_size=1`` have NO batch inputs here — their
+        dynamic leading dims mean something else (pointpillars' point
+        count) and splitting them over devices would change answers."""
+        if model.spec.max_batch_size <= 1:
+            return frozenset()
+        return frozenset(
+            t.name for t in model.spec.inputs if t.shape and t.shape[0] == -1
+        )
+
+    def _place_inputs(self, model, request):
+        batch_s, repl_s = serving_shardings(self._mesh)
+        multiple = self.batch_multiple
+        batched = self._batched_names(model)
+        # the request batch: leading dim of the first declared batched
+        # input (spec order, so every request of a model agrees)
+        n = None
+        for t in model.spec.inputs:
+            if t.name in batched and t.name in request.inputs:
+                n = int(np.asarray(request.inputs[t.name]).shape[0])
+                break
+        target = bucket_for(n, multiple) if n is not None else None
+        device_inputs = {}
+        for name, arr in request.inputs.items():
+            arr = cast_wire_input(model, name, np.asarray(arr))
+            if (
+                n is not None
+                and name in batched
+                and arr.ndim > 0
+                and arr.shape[0] == n
+            ):
+                # pad rows replicate a real row (bitwise-safe; see
+                # runtime/padding.py), then split rows over the data
+                # axis — the only H2D path that scatters
+                device_inputs[name] = jax.device_put(
+                    pad_batch(arr, target), batch_s
+                )
+            else:
+                device_inputs[name] = jax.device_put(arr, repl_s)
+        # meta: (real rows, padded rows) so resolve can slice the pad
+        # back off before the host copy pays for it
+        meta = (n, target) if n is not None and target != n else None
+        return device_inputs, meta
+
+    # -- launch ---------------------------------------------------------------
+
+    def _make_launcher(self, model):
+        """Cached sharded launcher: donated arg carries the batched
+        donatable inputs with an explicit ``in_shardings`` batch
+        sharding (so XLA reuses the per-device input shards across
+        consecutive padded batches), everything else propagates its
+        device_put placement. An explicit ``model.params`` tree is
+        replicated onto the mesh ONCE here and closed over as a
+        committed jit argument."""
+        from triton_client_tpu.config import config_dtypes
+
+        batch_s, repl_s = serving_shardings(self._mesh)
+        batched = self._batched_names(model)
+        donate_names = (
+            frozenset(model.spec.donatable_inputs()) & batched
+            if self._donate
+            else frozenset()
+        )
+        device_fn = model.device_fn
+        out_dtype = {
+            t.name: config_dtypes().get(t.dtype) for t in model.spec.outputs
+        }
+        if model.params is not None:
+            placed = replicate_params(model.params, self._mesh)
+            jitted = jax.jit(
+                lambda params, batched, rest: device_fn(
+                    {**batched, **rest}, params
+                ),
+                in_shardings=(repl_s, batch_s, None),
+                donate_argnums=(1,),
+            )
+            return (
+                lambda d, k: jitted(placed, d, k),
+                donate_names,
+                out_dtype,
+            )
+        launcher = jax.jit(
+            lambda donated, kept: device_fn({**donated, **kept}),
+            in_shardings=(batch_s, None),
+            donate_argnums=(0,),
+        )
+        return launcher, donate_names, out_dtype
+
+    # -- readback -------------------------------------------------------------
+
+    def _host_outputs(self, outputs, out_dtype, meta) -> dict:
+        """Slice pad rows off batch-leading outputs (lazy device slice —
+        the host copy only ever pays for real rows), then the base
+        wire-dtype readback."""
+        if meta is not None:
+            n, target = meta
+            outputs = {
+                k: unpad_rows(v, n)
+                if getattr(v, "ndim", 0) >= 1 and v.shape[0] == target
+                else v
+                for k, v in outputs.items()
+            }
+        return super()._host_outputs(outputs, out_dtype, meta)

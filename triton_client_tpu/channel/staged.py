@@ -1,0 +1,415 @@
+"""Staged dispatch protocol: the stage/launch/resolve engine.
+
+PR 1 split the in-process serving hot path into three overlapping
+phases (stage the H2D copy, launch the jitted compute, resolve the
+readback lazily) inside ``TPUChannel``. The mesh-sharded channel
+(``channel/sharded_channel.py``) needs the SAME engine — staging slots,
+trace spans, donation-aware launch cache, deferred error surfacing —
+over a different placement policy (pad + shard the batch over the
+``data`` axis instead of device_put per array). This module is that
+engine factored out once, so the protocol cannot drift between the
+single-device and mesh paths:
+
+  * **stage**   — validate, acquire a staging slot, then hand the
+    request to :meth:`StagedChannel._place_inputs` (the subclass
+    placement policy). Slot admission is per CHANNEL — i.e. per mesh,
+    not per device: at ``pipeline_depth`` (default 2) batch N+1's
+    host->device copy runs while batch N executes across the whole
+    mesh; ``pipeline_depth=1`` is the strictly serial legacy path.
+  * **launch**  — enqueue the jitted compute through the launcher the
+    subclass builds in :meth:`StagedChannel._make_launcher` (cached per
+    model identity; donation split handled here). Outputs stay
+    device-resident.
+  * **resolve** — lazy. ``launch`` returns an ``InferFuture``; the
+    device->host copy happens in :meth:`StagedChannel._host_outputs`
+    only when the driver resolves it, and resolution retires the
+    staging slot.
+
+``do_inference`` is stage→launch→result; ``do_inference_async`` defers
+the readback (and any dispatch-time error) to ``result()``.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+
+import jax
+import numpy as np
+
+from triton_client_tpu.channel.base import (
+    BaseChannel,
+    InferFuture,
+    InferRequest,
+    InferResponse,
+)
+from triton_client_tpu.config import ModelSpec
+from triton_client_tpu.parallel.mesh import MeshConfig, make_mesh
+from triton_client_tpu.runtime.repository import ModelRepository
+
+
+def cast_wire_input(model, name: str, arr: np.ndarray) -> np.ndarray:
+    """The round-4 host-side dtype policy, shared by every placement
+    policy so single-device and sharded channels cannot drift: a stray
+    WIDER dtype (float64/int64) casts down to the wire contract so it
+    can't trigger one retrace per dtype, but a NARROWER input uploads
+    as-is — casting uint8 camera frames up to FP32 on the host is 4x
+    the host->device bytes, and every in-tree pipeline widens on device
+    where the cast fuses for free (the registration contract in
+    runtime/repository.py)."""
+    try:
+        want = model.spec.input_by_name(name).np_dtype()
+        if arr.dtype != want and np.dtype(want).itemsize <= arr.dtype.itemsize:
+            arr = arr.astype(want)
+    except (KeyError, ValueError, TypeError):
+        pass  # undeclared/BF16 inputs pass through as-is
+    return arr
+
+
+class StagedRequest:
+    """A request whose inputs live on the mesh, awaiting launch.
+
+    Produced by ``StagedChannel.stage``; consumed exactly once by
+    ``StagedChannel.launch`` (the staging slot it occupies frees when
+    the launched batch finishes executing, or immediately on launch
+    failure). ``meta`` carries subclass placement state (the sharded
+    channel records the real row count so resolve can slice the pad
+    rows back off)."""
+
+    __slots__ = ("model", "device_inputs", "request", "t_stage", "meta")
+
+    def __init__(self, model, device_inputs, request, t_stage, meta=None) -> None:
+        self.model = model
+        self.device_inputs = device_inputs
+        self.request = request
+        self.t_stage = t_stage
+        self.meta = meta
+
+
+class _Inflight:
+    """One launched, not-yet-retired batch (a staging slot occupant)."""
+
+    __slots__ = ("outputs", "retired")
+
+    def __init__(self, outputs) -> None:
+        self.outputs = outputs
+        self.retired = False
+
+    def wait_device(self) -> None:
+        # Execution-complete, NOT readback: arrays stay on device.
+        jax.block_until_ready(self.outputs)
+
+
+class StagedChannel(BaseChannel):
+    """Shared stage/launch/resolve machinery over a device mesh.
+
+    Subclasses implement the placement policy:
+
+      * :meth:`_place_inputs` — request host arrays -> device arrays on
+        the mesh (plus opaque ``meta`` threaded to the readback);
+      * :meth:`_make_launcher` — the cached jit wrapper over a model's
+        ``device_fn`` (donation split, shardings);
+      * :meth:`_host_outputs`  — device outputs -> host numpy at the
+        wire dtypes (the designed readback sync point).
+    """
+
+    def __init__(
+        self,
+        repository: ModelRepository,
+        mesh_config: MeshConfig | None = None,
+        devices=None,
+        validate: bool = True,
+        pipeline_depth: int = 2,
+        donate: bool = True,
+    ) -> None:
+        """``pipeline_depth``: launched-but-unretired batches allowed
+        before ``stage`` blocks on the oldest batch's execution; 1 is
+        the strictly serial legacy path. ``donate``: honor spec
+        ``donatable`` marks (buffer reuse needs a ``device_fn``; on
+        backends without donation support jax falls back to a copy)."""
+        self._repository = repository
+        self._mesh_config = mesh_config
+        self._devices = devices
+        self._mesh = None
+        self._validate = validate
+        self._pipeline_depth = max(1, int(pipeline_depth))
+        self._donate = bool(donate)
+        # staging slots: launched batches not yet retired (execution
+        # still pending or readback not requested yet). Slots are per
+        # channel — one admission window over the whole mesh.
+        self._slot_cv = threading.Condition()
+        self._inflight: collections.deque[_Inflight] = collections.deque()
+        self._slots_active = 0
+        self._slot_occupancy: collections.Counter = collections.Counter()
+        self._stats = {
+            "staged": 0,
+            "launched": 0,
+            "donated_launches": 0,
+            "stage_slot_waits": 0,
+        }
+        # (name, version) -> (model identity, launcher, donate_names,
+        # output wire dtypes); rebuilt when the repository reloads the
+        # model (identity mismatch)
+        self._launch_cache: dict = {}
+        self.register_channel()
+
+    # -- BaseChannel protocol -------------------------------------------------
+
+    def register_channel(self) -> None:
+        self._mesh = make_mesh(self._mesh_config, self._devices)
+
+    def fetch_channel(self):
+        return self._mesh
+
+    def get_metadata(self, model_name: str, model_version: str = "") -> ModelSpec:
+        return self._repository.metadata(model_name, model_version)
+
+    def do_inference(self, request: InferRequest) -> InferResponse:
+        return self.launch(self.stage(request)).result()
+
+    def do_inference_async(self, request: InferRequest) -> InferFuture:
+        """The in-process --async path: JAX dispatch is asynchronous, so
+        launch returns as soon as the computation is enqueued on the
+        device; materializing numpy (the only blocking step) is deferred
+        to result(). The driver can therefore preprocess frame N+1 while
+        the chip runs frame N — no threads needed.
+
+        Per the BaseChannel contract, dispatch-time errors (validation,
+        unknown model, staging) are deferred to result() rather than
+        raised here, so async callers have one error-surfacing point."""
+        try:
+            staged = self.stage(request)
+        except Exception as e:
+            return InferFuture.failed(e)
+        return self.launch(staged)
+
+    # -- subclass placement hooks ---------------------------------------------
+
+    def _place_inputs(self, model, request: InferRequest):
+        """Place the request's host arrays onto the mesh.
+
+        Returns ``(device_inputs, meta)``. Runs INSIDE the staging slot
+        (a raised error releases the slot); must not block on device
+        execution."""
+        raise NotImplementedError
+
+    def _make_launcher(self, model):
+        """Build ``(launcher | None, donate_names, out_dtypes)`` for a
+        model. ``launcher(donated, kept)`` runs the jitted device_fn
+        with ``donated`` in a ``donate_argnums`` position; None falls
+        back to the host-boundary ``infer_fn``. Called once per model
+        identity (cached by :meth:`_launcher`)."""
+        raise NotImplementedError
+
+    def _host_outputs(self, outputs, out_dtype, meta) -> dict:
+        """Device outputs -> host numpy dict at the wire dtypes. The
+        designed deferred-readback sync point (tpulint TPL301 baseline);
+        subclasses slice off pad rows here before the copy."""
+        host = {}
+        for k, v in outputs.items():
+            # wire-contract dtypes at the host boundary: device traces
+            # run with x64 disabled, so e.g. a scored head's INT64
+            # classes come back int32 from device_fn — the cast keeps
+            # launch paths identical
+            dt = out_dtype.get(k) if out_dtype else None
+            host[k] = np.asarray(v, dtype=dt) if dt else np.asarray(v)
+        return host
+
+    # -- pipeline knobs -------------------------------------------------------
+
+    @property
+    def pipeline_depth(self) -> int:
+        return self._pipeline_depth
+
+    @pipeline_depth.setter
+    def pipeline_depth(self, depth: int) -> None:
+        with self._slot_cv:
+            self._pipeline_depth = max(1, int(depth))
+            self._slot_cv.notify_all()
+
+    @property
+    def batch_multiple(self) -> int:
+        """Preferred divisor for device batch sizes. 1 for per-device
+        channels; the data-axis width for mesh-sharded channels (the
+        batcher sizes merge groups and pad buckets off this)."""
+        return 1
+
+    def stats(self) -> dict:
+        """Staging-slot counters (the channel-level analogue of
+        BatchingChannel.stats): ``slot_occupancy`` maps concurrent
+        in-flight batches at launch -> launches observed at that depth."""
+        with self._slot_cv:
+            out = dict(self._stats)
+            out["slot_occupancy"] = dict(sorted(self._slot_occupancy.items()))
+            out["inflight"] = len(self._inflight)
+            out["slots_active"] = self._slots_active
+            out["pipeline_depth"] = self._pipeline_depth
+        if self._mesh is not None:
+            out["mesh_devices"] = int(self._mesh.devices.size)
+            out["data_axis_size"] = int(self._mesh.shape["data"])
+        return out
+
+    # -- stage ----------------------------------------------------------------
+
+    def stage(self, request: InferRequest) -> StagedRequest:
+        """Validate the request and place its arrays onto the mesh.
+
+        Blocks while ``pipeline_depth`` launched batches are still
+        executing, so the H2D copy of the next batch overlaps (at most)
+        depth in-flight computations — double-buffered at the default
+        depth of 2. Must be paired with ``launch``."""
+        tr = request.trace
+        t_s0 = time.perf_counter() if tr is not None else 0.0
+        model = self._repository.get(request.model_name, request.model_version)
+        if self._validate:
+            for tensor_spec in model.spec.inputs:
+                if tensor_spec.name not in request.inputs:
+                    raise ValueError(
+                        f"model '{model.spec.name}' requires input "
+                        f"'{tensor_spec.name}'; request has "
+                        f"{sorted(request.inputs)}"
+                    )
+                tensor_spec.validate(np.asarray(request.inputs[tensor_spec.name]))
+        if tr is not None:
+            t_w0 = time.perf_counter()
+            self._acquire_slot()
+            tr.add("slot_wait", t_w0, time.perf_counter())
+        else:
+            self._acquire_slot()
+        try:
+            device_inputs, meta = self._place_inputs(model, request)
+        except Exception:
+            self._release_slot()
+            raise
+        with self._slot_cv:
+            self._stats["staged"] += 1
+        t_staged = time.perf_counter()
+        if tr is not None:
+            # the whole stage phase: validate + slot admission + H2D
+            tr.add("stage", t_s0, t_staged)
+        return StagedRequest(model, device_inputs, request, t_staged, meta)
+
+    def _acquire_slot(self) -> None:
+        waited = False
+        while True:
+            rec = None
+            with self._slot_cv:
+                if self._slots_active < self._pipeline_depth:
+                    self._slots_active += 1
+                    if waited:
+                        self._stats["stage_slot_waits"] += 1
+                    return
+                waited = True
+                if self._inflight:
+                    rec = self._inflight.popleft()
+                else:
+                    # every slot is held by a peer between stage and
+                    # launch; timed wait covers a missed notify
+                    self._slot_cv.wait(timeout=0.05)
+                    continue
+            # block on EXECUTION completion outside the lock (readback
+            # stays lazy; a concurrent resolve() of the same record is
+            # fine — _retire is idempotent)
+            rec.wait_device()
+            self._retire(rec)
+
+    def _release_slot(self) -> None:
+        with self._slot_cv:
+            self._slots_active -= 1
+            self._slot_cv.notify_all()
+
+    def _retire(self, rec: _Inflight) -> None:
+        with self._slot_cv:
+            if rec.retired:
+                return
+            rec.retired = True
+            try:
+                self._inflight.remove(rec)
+            except ValueError:
+                pass  # already popped by a staging thread
+            self._slots_active -= 1
+            self._slot_cv.notify_all()
+
+    # -- launch ---------------------------------------------------------------
+
+    def launch(self, staged: StagedRequest) -> InferFuture:
+        """Enqueue the jitted compute for a staged request; returns a
+        lazy InferFuture holding device arrays. The device->host copy
+        happens at result(); the staging slot frees when the batch
+        finishes executing (whichever of a later ``stage`` or this
+        future's resolution observes it first)."""
+        model, request = staged.model, staged.request
+        tr = request.trace
+        t0 = time.perf_counter()
+        try:
+            launcher, donate_names, out_dtype = self._launcher(model)
+            if launcher is not None:
+                donated = {
+                    k: v
+                    for k, v in staged.device_inputs.items()
+                    if k in donate_names
+                }
+                kept = {
+                    k: v
+                    for k, v in staged.device_inputs.items()
+                    if k not in donate_names
+                }
+                outputs = launcher(donated, kept)
+            else:
+                outputs = model.infer_fn(staged.device_inputs)
+        except Exception as e:
+            self._release_slot()
+            return InferFuture.failed(e)
+        rec = _Inflight(outputs)
+        t_launched = time.perf_counter()
+        if tr is not None:
+            tr.add("launch", t0, t_launched)
+        with self._slot_cv:
+            self._inflight.append(rec)
+            self._stats["launched"] += 1
+            if donate_names:
+                self._stats["donated_launches"] += 1
+            self._slot_occupancy[len(self._inflight)] += 1
+
+        def resolve() -> InferResponse:
+            try:
+                if tr is not None:
+                    # device window: enqueue -> execution complete.
+                    # block_until_ready is what np.asarray would wait on
+                    # anyway; forcing it here splits execute from the
+                    # device->host copy in the request timeline.
+                    jax.block_until_ready(outputs)
+                    t_ready = time.perf_counter()
+                    tr.add("device_execute", t_launched, t_ready)
+                host = self._host_outputs(outputs, out_dtype, staged.meta)
+                if tr is not None:
+                    tr.add("readback", t_ready, time.perf_counter())
+            finally:
+                self._retire(rec)
+            return InferResponse(
+                model_name=request.model_name,
+                model_version=model.spec.version,
+                outputs=host,
+                request_id=request.request_id,
+                latency_s=time.perf_counter() - t0,
+            )
+
+        return InferFuture(resolve)
+
+    def _launcher(self, model):
+        """(jitted device_fn launcher | None, donate names, out dtypes),
+        cached per model identity. Host-only models (no device_fn) keep
+        the legacy infer_fn call, which may block on its own internal
+        readback."""
+        if model.device_fn is None:
+            return None, (), None
+        key = (model.spec.name, model.spec.version)
+        with self._slot_cv:
+            cached = self._launch_cache.get(key)
+            if cached is not None and cached[0] is model:
+                return cached[1], cached[2], cached[3]
+        launcher, donate_names, out_dtype = self._make_launcher(model)
+        with self._slot_cv:
+            self._launch_cache[key] = (model, launcher, donate_names, out_dtype)
+        return launcher, donate_names, out_dtype

@@ -12,378 +12,62 @@ driver boundary.
 endpoint); "get_metadata" reads the local repository (the analogue of
 the two startup RPCs, grpc_channel.py:39-54).
 
-Overlapped dispatch (round 6): the serving hot path is split into
-three phases so consecutive batches overlap instead of serializing —
+The overlapped stage/launch/lazy-readback protocol introduced in
+round 6 now lives in :mod:`triton_client_tpu.channel.staged`
+(``StagedChannel``), shared with the mesh-sharded serving channel
+(round 9). This subclass keeps the single-executable placement policy:
 
-  * **stage**   — validate + cast + ``device_put`` the request onto the
-    mesh. Staging slot admission lives here: at ``pipeline_depth`` (the
-    double-buffer default is 2) batch N+1's host->device copy runs
-    while batch N executes; batch N+2 waits for N's *execution* (not
-    its readback) to finish. ``pipeline_depth=1`` restores the strictly
-    serial pre-round-6 behavior.
-  * **launch**  — enqueue the jitted compute. Models that expose a
-    jit-traceable ``device_fn`` launch through a cached
-    ``jax.jit(..., donate_argnums=(0,))`` wrapper whose first argument
-    carries the inputs the spec marks ``donatable``: XLA reuses those
-    HBM input buffers across consecutive batches instead of
-    reallocating. Outputs stay device-resident.
-  * **readback** — lazy. ``launch`` returns an ``InferFuture`` holding
-    device arrays; the device->host copy happens only when the driver
-    resolves the future, and resolution retires the staging slot.
-
-``do_inference`` is stage→launch→result (unchanged semantics);
-``do_inference_async`` is stage→launch with the readback deferred.
+  * dtype policy (round 4): narrow inputs upload as-is (pipelines widen
+    on device), wider stray dtypes cast down to the wire contract;
+  * per-array sharding heuristic: shard batch-leading arrays over the
+    ``data`` axis when the batch divides, otherwise replicate;
+  * launcher: cached ``jax.jit(fn, donate_argnums=(0,))`` whose first
+    arg carries the spec-marked ``donatable`` inputs, so consecutive
+    batches reuse the same HBM input buffers.
 """
 
 from __future__ import annotations
-
-import collections
-import threading
-import time
 
 import jax
 import numpy as np
 
 from jax.sharding import NamedSharding, PartitionSpec
 
-from triton_client_tpu.channel.base import (
-    BaseChannel,
-    InferFuture,
-    InferRequest,
-    InferResponse,
+from triton_client_tpu.channel.staged import (  # noqa: F401 — re-exported
+    StagedChannel,
+    StagedRequest,
+    _Inflight,
+    cast_wire_input,
 )
-from triton_client_tpu.config import ModelSpec, config_dtypes
-from triton_client_tpu.parallel.mesh import MeshConfig, batch_sharding, make_mesh
-from triton_client_tpu.runtime.repository import ModelRepository
+from triton_client_tpu.config import config_dtypes
+from triton_client_tpu.parallel.mesh import batch_sharding
 
 
-class StagedRequest:
-    """A request whose inputs live on the mesh, awaiting launch.
+class TPUChannel(StagedChannel):
+    """Single-executable serving channel (see module docstring)."""
 
-    Produced by ``TPUChannel.stage``; consumed exactly once by
-    ``TPUChannel.launch`` (the staging slot it occupies frees when the
-    launched batch finishes executing, or immediately on launch
-    failure)."""
-
-    __slots__ = ("model", "device_inputs", "request", "t_stage")
-
-    def __init__(self, model, device_inputs, request, t_stage) -> None:
-        self.model = model
-        self.device_inputs = device_inputs
-        self.request = request
-        self.t_stage = t_stage
-
-
-class _Inflight:
-    """One launched, not-yet-retired batch (a staging slot occupant)."""
-
-    __slots__ = ("outputs", "retired")
-
-    def __init__(self, outputs) -> None:
-        self.outputs = outputs
-        self.retired = False
-
-    def wait_device(self) -> None:
-        # Execution-complete, NOT readback: arrays stay on device.
-        jax.block_until_ready(self.outputs)
-
-
-class TPUChannel(BaseChannel):
-    def __init__(
-        self,
-        repository: ModelRepository,
-        mesh_config: MeshConfig | None = None,
-        devices=None,
-        validate: bool = True,
-        pipeline_depth: int = 2,
-        donate: bool = True,
-    ) -> None:
-        """``pipeline_depth``: launched-but-unretired batches allowed
-        before ``stage`` blocks on the oldest batch's execution; 1 is
-        the strictly serial legacy path. ``donate``: honor spec
-        ``donatable`` marks (buffer reuse needs a ``device_fn``; on
-        backends without donation support jax falls back to a copy)."""
-        self._repository = repository
-        self._mesh_config = mesh_config
-        self._devices = devices
-        self._mesh = None
-        self._validate = validate
-        self._pipeline_depth = max(1, int(pipeline_depth))
-        self._donate = bool(donate)
-        # staging slots: launched batches not yet retired (execution
-        # still pending or readback not requested yet)
-        self._slot_cv = threading.Condition()
-        self._inflight: collections.deque[_Inflight] = collections.deque()
-        self._slots_active = 0
-        self._slot_occupancy: collections.Counter = collections.Counter()
-        self._stats = {
-            "staged": 0,
-            "launched": 0,
-            "donated_launches": 0,
-            "stage_slot_waits": 0,
-        }
-        # (name, version) -> (model identity, launcher, donate_names,
-        # output wire dtypes); rebuilt when the repository reloads the
-        # model (identity mismatch)
-        self._launch_cache: dict = {}
-        self.register_channel()
-
-    # -- BaseChannel protocol -------------------------------------------------
-
-    def register_channel(self) -> None:
-        self._mesh = make_mesh(self._mesh_config, self._devices)
-
-    def fetch_channel(self):
-        return self._mesh
-
-    def get_metadata(self, model_name: str, model_version: str = "") -> ModelSpec:
-        return self._repository.metadata(model_name, model_version)
-
-    def do_inference(self, request: InferRequest) -> InferResponse:
-        return self.launch(self.stage(request)).result()
-
-    def do_inference_async(self, request: InferRequest) -> InferFuture:
-        """The in-process --async path: JAX dispatch is asynchronous, so
-        launch returns as soon as the computation is enqueued on the
-        device; materializing numpy (the only blocking step) is deferred
-        to result(). The driver can therefore preprocess frame N+1 while
-        the chip runs frame N — no threads needed.
-
-        Per the BaseChannel contract, dispatch-time errors (validation,
-        unknown model, staging) are deferred to result() rather than
-        raised here, so async callers have one error-surfacing point."""
-        try:
-            staged = self.stage(request)
-        except Exception as e:
-            return InferFuture.failed(e)
-        return self.launch(staged)
-
-    # -- pipeline knobs -------------------------------------------------------
-
-    @property
-    def pipeline_depth(self) -> int:
-        return self._pipeline_depth
-
-    @pipeline_depth.setter
-    def pipeline_depth(self, depth: int) -> None:
-        with self._slot_cv:
-            self._pipeline_depth = max(1, int(depth))
-            self._slot_cv.notify_all()
-
-    def stats(self) -> dict:
-        """Staging-slot counters (the channel-level analogue of
-        BatchingChannel.stats): ``slot_occupancy`` maps concurrent
-        in-flight batches at launch -> launches observed at that depth."""
-        with self._slot_cv:
-            out = dict(self._stats)
-            out["slot_occupancy"] = dict(sorted(self._slot_occupancy.items()))
-            out["inflight"] = len(self._inflight)
-            out["slots_active"] = self._slots_active
-            out["pipeline_depth"] = self._pipeline_depth
-        return out
-
-    # -- stage ----------------------------------------------------------------
-
-    def stage(self, request: InferRequest) -> StagedRequest:
-        """Validate the request and device_put its arrays onto the mesh.
-
-        Blocks while ``pipeline_depth`` launched batches are still
-        executing, so the H2D copy of the next batch overlaps (at most)
-        depth in-flight computations — double-buffered at the default
-        depth of 2. Must be paired with ``launch``."""
-        tr = request.trace
-        t_s0 = time.perf_counter() if tr is not None else 0.0
-        model = self._repository.get(request.model_name, request.model_version)
-        if self._validate:
-            for tensor_spec in model.spec.inputs:
-                if tensor_spec.name not in request.inputs:
-                    raise ValueError(
-                        f"model '{model.spec.name}' requires input "
-                        f"'{tensor_spec.name}'; request has "
-                        f"{sorted(request.inputs)}"
-                    )
-                tensor_spec.validate(np.asarray(request.inputs[tensor_spec.name]))
-        if tr is not None:
-            t_w0 = time.perf_counter()
-            self._acquire_slot()
-            tr.add("slot_wait", t_w0, time.perf_counter())
-        else:
-            self._acquire_slot()
-        try:
-            sharding = batch_sharding(self._mesh)
-            device_inputs = {}
-            for name, arr in request.inputs.items():
-                # Shard batch-leading arrays over the data axis when the
-                # batch divides; otherwise replicate (single-frame path).
-                arr = np.asarray(arr)
-                # Dtype policy (round 4 — this line was the serving-path
-                # bottleneck): a stray float64/int64 must still be cast so
-                # it can't trigger one retrace per dtype, but casting a
-                # NARROWER wire dtype up to the spec on the HOST inflates
-                # the host->device transfer (uint8 camera frames -> FP32 is
-                # 4x the bytes; on the r4 rig that one cast tripled serving
-                # batch latency). Narrow inputs upload as-is — every
-                # in-tree pipeline widens on device, where the cast fuses
-                # into the program for free. This is a REGISTRATION
-                # CONTRACT (see runtime/repository.py RegisteredModel):
-                # pipelines must widen internally and each distinct narrow
-                # dtype traces its own executable.
-                try:
-                    want = model.spec.input_by_name(name).np_dtype()
-                    if arr.dtype != want and (
-                        np.dtype(want).itemsize <= arr.dtype.itemsize
-                    ):
-                        arr = arr.astype(want)
-                except (KeyError, ValueError, TypeError):
-                    pass  # undeclared/BF16 inputs pass through as-is
-                use = (
-                    sharding
-                    if arr.ndim > 0
-                    and arr.shape[0] % self._mesh.shape["data"] == 0
-                    else NamedSharding(self._mesh, PartitionSpec())
-                )
-                device_inputs[name] = jax.device_put(arr, use)
-        except Exception:
-            self._release_slot()
-            raise
-        with self._slot_cv:
-            self._stats["staged"] += 1
-        t_staged = time.perf_counter()
-        if tr is not None:
-            # the whole stage phase: validate + slot admission + H2D
-            tr.add("stage", t_s0, t_staged)
-        return StagedRequest(model, device_inputs, request, t_staged)
-
-    def _acquire_slot(self) -> None:
-        waited = False
-        while True:
-            rec = None
-            with self._slot_cv:
-                if self._slots_active < self._pipeline_depth:
-                    self._slots_active += 1
-                    if waited:
-                        self._stats["stage_slot_waits"] += 1
-                    return
-                waited = True
-                if self._inflight:
-                    rec = self._inflight.popleft()
-                else:
-                    # every slot is held by a peer between stage and
-                    # launch; timed wait covers a missed notify
-                    self._slot_cv.wait(timeout=0.05)
-                    continue
-            # block on EXECUTION completion outside the lock (readback
-            # stays lazy; a concurrent resolve() of the same record is
-            # fine — _retire is idempotent)
-            rec.wait_device()
-            self._retire(rec)
-
-    def _release_slot(self) -> None:
-        with self._slot_cv:
-            self._slots_active -= 1
-            self._slot_cv.notify_all()
-
-    def _retire(self, rec: _Inflight) -> None:
-        with self._slot_cv:
-            if rec.retired:
-                return
-            rec.retired = True
-            try:
-                self._inflight.remove(rec)
-            except ValueError:
-                pass  # already popped by a staging thread
-            self._slots_active -= 1
-            self._slot_cv.notify_all()
-
-    # -- launch ---------------------------------------------------------------
-
-    def launch(self, staged: StagedRequest) -> InferFuture:
-        """Enqueue the jitted compute for a staged request; returns a
-        lazy InferFuture holding device arrays. The device->host copy
-        happens at result(); the staging slot frees when the batch
-        finishes executing (whichever of a later ``stage`` or this
-        future's resolution observes it first)."""
-        model, request = staged.model, staged.request
-        tr = request.trace
-        t0 = time.perf_counter()
-        try:
-            launcher, donate_names, out_dtype = self._launcher(model)
-            if launcher is not None:
-                donated = {
-                    k: v
-                    for k, v in staged.device_inputs.items()
-                    if k in donate_names
-                }
-                kept = {
-                    k: v
-                    for k, v in staged.device_inputs.items()
-                    if k not in donate_names
-                }
-                outputs = launcher(donated, kept)
-            else:
-                outputs = model.infer_fn(staged.device_inputs)
-        except Exception as e:
-            self._release_slot()
-            return InferFuture.failed(e)
-        rec = _Inflight(outputs)
-        t_launched = time.perf_counter()
-        if tr is not None:
-            tr.add("launch", t0, t_launched)
-        with self._slot_cv:
-            self._inflight.append(rec)
-            self._stats["launched"] += 1
-            if donate_names:
-                self._stats["donated_launches"] += 1
-            self._slot_occupancy[len(self._inflight)] += 1
-
-        def resolve() -> InferResponse:
-            try:
-                if tr is not None:
-                    # device window: enqueue -> execution complete.
-                    # block_until_ready is what np.asarray would wait on
-                    # anyway; forcing it here splits execute from the
-                    # device->host copy in the request timeline.
-                    jax.block_until_ready(outputs)
-                    t_ready = time.perf_counter()
-                    tr.add("device_execute", t_launched, t_ready)
-                host = {}
-                for k, v in outputs.items():
-                    # wire-contract dtypes at the host boundary: device
-                    # traces run with x64 disabled, so e.g. a scored
-                    # head's INT64 classes come back int32 from
-                    # device_fn — the cast keeps launch paths identical
-                    dt = out_dtype.get(k) if out_dtype else None
-                    host[k] = np.asarray(v, dtype=dt) if dt else np.asarray(v)
-                if tr is not None:
-                    tr.add("readback", t_ready, time.perf_counter())
-            finally:
-                self._retire(rec)
-            return InferResponse(
-                model_name=request.model_name,
-                model_version=model.spec.version,
-                outputs=host,
-                request_id=request.request_id,
-                latency_s=time.perf_counter() - t0,
+    def _place_inputs(self, model, request):
+        sharding = batch_sharding(self._mesh)
+        device_inputs = {}
+        for name, arr in request.inputs.items():
+            # Shard batch-leading arrays over the data axis when the
+            # batch divides; otherwise replicate (single-frame path).
+            # round-4 dtype policy (see staged.cast_wire_input: never
+            # widen on the host, cast stray wider dtypes down)
+            arr = cast_wire_input(model, name, np.asarray(arr))
+            use = (
+                sharding
+                if arr.ndim > 0
+                and arr.shape[0] % self._mesh.shape["data"] == 0
+                else NamedSharding(self._mesh, PartitionSpec())
             )
+            device_inputs[name] = jax.device_put(arr, use)
+        return device_inputs, None
 
-        return InferFuture(resolve)
-
-    def _launcher(self, model):
-        """(jitted device_fn launcher | None, donate names, out dtypes).
-
-        Models exposing a jit-traceable ``device_fn`` launch through a
-        cached ``jax.jit(fn, donate_argnums=(0,))`` whose first arg
+    def _make_launcher(self, model):
+        """Cached ``jax.jit(fn, donate_argnums=(0,))`` whose first arg
         carries the spec-marked donatable inputs — consecutive batches
-        then reuse the same HBM input buffers. Host-only models (no
-        device_fn) keep the legacy infer_fn call, which may block on
-        its own internal readback."""
-        if model.device_fn is None:
-            return None, (), None
-        key = (model.spec.name, model.spec.version)
-        with self._slot_cv:
-            cached = self._launch_cache.get(key)
-            if cached is not None and cached[0] is model:
-                return cached[1], cached[2], cached[3]
+        then reuse the same HBM input buffers."""
         donate_names = (
             frozenset(model.spec.donatable_inputs()) if self._donate else frozenset()
         )
@@ -395,6 +79,4 @@ class TPUChannel(BaseChannel):
         out_dtype = {
             t.name: config_dtypes().get(t.dtype) for t in model.spec.outputs
         }
-        with self._slot_cv:
-            self._launch_cache[key] = (model, launcher, donate_names, out_dtype)
         return launcher, donate_names, out_dtype

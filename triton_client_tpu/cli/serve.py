@@ -24,8 +24,10 @@ def main(argv=None) -> None:
     p.add_argument("--max-workers", type=int, default=8)
     p.add_argument(
         "--mesh", default="",
-        help="device mesh, e.g. 'data=4' — batches shard over the data "
-        "axis (multi-camera DP serving)",
+        help="device mesh, e.g. 'data=4' — serve data-parallel over the "
+        "mesh (ShardedTPUChannel): params replicated once, request "
+        "batches padded and sharded over the data axis; empty = "
+        "single-executable TPUChannel",
     )
     p.add_argument(
         "--batching", action="store_true",
@@ -99,6 +101,7 @@ def build_server(args):
     """Repository scan + channel stack + InferenceServer (not started)
     from parsed ``main`` args — split out so tests and embedders can
     stand the server up on a loopback port without blocking in wait()."""
+    from triton_client_tpu.channel.sharded_channel import ShardedTPUChannel
     from triton_client_tpu.channel.tpu_channel import TPUChannel
     from triton_client_tpu.cli.common import parse_mesh
     from triton_client_tpu.runtime.disk_repository import scan_disk
@@ -111,7 +114,18 @@ def build_server(args):
         if args.warmup and model.warmup is not None:
             model.warmup()
 
-    channel = TPUChannel(repo, mesh_config=parse_mesh(args.mesh))
+    mesh_config = parse_mesh(args.mesh)
+    if args.mesh:
+        # explicit --mesh: serve the whole mesh data-parallel — params
+        # replicated, request batches sharded over the data axis
+        channel = ShardedTPUChannel(repo, mesh_config=mesh_config)
+        print(
+            f"mesh serving: {channel.stats()['mesh_devices']} devices, "
+            f"data axis {channel.batch_multiple} "
+            f"(batches shard over 'data'; params replicated)", flush=True,
+        )
+    else:
+        channel = TPUChannel(repo, mesh_config=mesh_config)
     if args.batching:
         from triton_client_tpu.runtime.batching import BatchingChannel
 
@@ -130,7 +144,9 @@ def build_server(args):
             f"micro-batching: max_batch={args.max_batch} "
             f"timeout={args.batch_timeout_us}us "
             f"pipeline_depth={args.pipeline_depth} "
-            f"max_merge={getattr(args, 'max_merge', None) or args.max_batch} "
+            # default merge cap scales with the inner channel's data
+            # axis: max_batch frames per device
+            f"max_merge={getattr(args, 'max_merge', None) or args.max_batch * getattr(channel.inner, 'batch_multiple', 1)} "
             f"pad_buckets={getattr(args, 'pad_buckets', False)}", flush=True,
         )
     return InferenceServer(

@@ -47,6 +47,10 @@ METRIC_TYPES: dict[str, str] = {
     "tpu_serving_donated_launches_total": "counter",
     "tpu_serving_stage_slot_waits_total": "counter",
     "tpu_serving_slot_occupancy_launches_total": "counter",
+    # serving mesh shape (ShardedTPUChannel: batches split over the
+    # data axis; 1/1 on a single-executable channel, 0 when no channel)
+    "tpu_serving_data_axis_size": "gauge",
+    "tpu_serving_mesh_devices": "gauge",
     # BatchingChannel formation
     "tpu_serving_queue_depth": "gauge",
     "tpu_serving_batch_active_slots": "gauge",
@@ -336,6 +340,17 @@ class RuntimeCollector:
                 for k, v in (chan.get("slot_occupancy") or {}).items()
             ],
         )
+        yield gauge(
+            f"{ns}_data_axis_size",
+            "mesh data-axis width request batches shard over "
+            "(1 = single-executable channel, 0 = no channel)",
+            chan.get("data_axis_size", 0),
+        )
+        yield gauge(
+            f"{ns}_mesh_devices",
+            "devices claimed by the serving mesh",
+            chan.get("mesh_devices", 0),
+        )
 
         # BatchingChannel formation
         queue_depth = bat.get("ready_depth", 0) + bat.get("queue_depth", 0)
@@ -427,6 +442,21 @@ class RuntimeCollector:
                     if kind in stats:
                         fam.add_metric([dev, kind], stats[kind])
             yield fam
+            # per-device occupancy: the mesh-serving balance check — on
+            # a healthy data-parallel channel every device sits at the
+            # same ratio (params replicated + 1/N of the batch)
+            occ = GaugeMetricFamily(
+                f"{ns}_device_hbm_occupancy_ratio",
+                "per-device bytes_in_use / bytes_limit",
+                labels=["device"],
+            )
+            for dev, stats in snap["memory"].items():
+                if stats.get("bytes_limit"):
+                    occ.add_metric(
+                        [dev],
+                        stats.get("bytes_in_use", 0) / stats["bytes_limit"],
+                    )
+            yield occ
 
     def close(self) -> None:
         if self._registry is not None:

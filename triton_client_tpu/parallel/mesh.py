@@ -84,3 +84,25 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+def data_axis_size(mesh: Mesh) -> int:
+    """Width of the data axis — the serving channel's batch multiple."""
+    return int(mesh.shape[DATA_AXIS])
+
+
+def serving_shardings(mesh: Mesh) -> tuple[NamedSharding, NamedSharding]:
+    """The two shardings the serving path ever uses: ``(batch, params)``
+    — batch-leading request arrays split over ``data``, everything else
+    (params, scalars, non-batched inputs) replicated on every device.
+    One helper so the channel and the jit ``in_shardings`` can't
+    disagree about placement."""
+    return batch_sharding(mesh), replicated(mesh)
+
+
+def replicate_params(tree, mesh: Mesh):
+    """Place a param pytree once onto the mesh, replicated on every
+    device. Serving's replicate-params / shard-batch shape: params are
+    uploaded a single time at model registration, then every sharded
+    launch reads the local copy — no per-request weight movement."""
+    return jax.device_put(tree, replicated(mesh))

@@ -285,6 +285,13 @@ def _detect2d_spec(cfg: Detect2DConfig, n_predictions: int) -> ModelSpec:
             TensorSpec("detections", (-1, cfg.max_det, 6), "FP32"),
             TensorSpec("valid", (-1, cfg.max_det), "BOOL"),
         ),
+        # the 2D pipelines are genuinely batched (leading dim of every
+        # tensor is the frame batch) — declaring it is what lets the
+        # mesh-sharded serving channel split requests over the data
+        # axis (channel/sharded_channel.py; Triton's own batchable
+        # convention, examples/YOLOv5/config.pbtxt max_batch_size).
+        # 8 matches the examples/ repository configs.
+        max_batch_size=8,
         extra={
             "conf_thresh": cfg.conf_thresh,
             "iou_thresh": cfg.iou_thresh,

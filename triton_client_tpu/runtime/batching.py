@@ -44,8 +44,13 @@ import numpy as np
 
 from triton_client_tpu.channel.base import BaseChannel, InferRequest, InferResponse
 from triton_client_tpu.obs.trace import MultiTrace
+from triton_client_tpu.runtime.padding import bucket, bucket_for, pad_rows
 
 log = logging.getLogger(__name__)
+
+# compat alias: the bucket table now lives in runtime/padding.py (one
+# copy shared with the mesh-sharded channel so the tables can't drift)
+_bucket = bucket
 
 
 def _merge_key(request: InferRequest):
@@ -57,14 +62,6 @@ def _merge_key(request: InferRequest):
             for name, a in sorted(request.inputs.items())
         ),
     )
-
-
-def _bucket(n: int) -> int:
-    """Smallest power of two >= n (the padded device batch size)."""
-    b = 1
-    while b < n:
-        b *= 2
-    return b
 
 
 class BatchingChannel(BaseChannel):
@@ -134,7 +131,17 @@ class BatchingChannel(BaseChannel):
         self._ids = itertools.count(1)
         self._impl = None
         self._py = None
-        self._max_merge = int(max_merge if max_merge is not None else max_batch)
+        # a mesh-sharded inner channel declares its data-axis width as
+        # the preferred batch divisor: merged groups then grow to
+        # max_batch frames PER DEVICE (max_batch x data_axis total) and
+        # pad buckets stay divisible by the axis, so batcher padding and
+        # shard padding agree on the same table (runtime/padding.py)
+        self._batch_multiple = max(1, int(getattr(inner, "batch_multiple", 1)))
+        self._max_merge = int(
+            max_merge
+            if max_merge is not None
+            else max_batch * self._batch_multiple
+        )
         self._pad_to_buckets = bool(pad_to_buckets)
         self._merge_hold_s = max(0, int(merge_hold_us)) / 1e6
         self._pipeline_depth = max(1, int(pipeline_depth))
@@ -445,10 +452,12 @@ class BatchingChannel(BaseChannel):
             # total of 6 up to 8 — past the cap and past any size the
             # inner channel precompiled. Oversized single requests
             # (> max_merge) pass through unpadded for the same reason.
-            bucket = _bucket(total)
+            # bucket_for keeps the padded size divisible by a sharded
+            # inner channel's data axis (== _bucket at multiple 1).
+            rounded = bucket_for(total, self._batch_multiple)
             pad = (
-                bucket - total
-                if self._pad_to_buckets and bucket <= self._max_merge
+                rounded - total
+                if self._pad_to_buckets and rounded <= self._max_merge
                 else 0
             )
             t_stage0 = time.perf_counter()
@@ -459,7 +468,7 @@ class BatchingChannel(BaseChannel):
                 if pad:
                     # replicate a real row: zeros can steer a model
                     # down numerically different paths, a copy cannot
-                    parts.append(np.repeat(parts[0][:1], pad, axis=0))
+                    parts = pad_rows(parts, pad)
                 merged[name] = self._merge_parts(name, parts, arena_held)
             t_disp = time.perf_counter()
             for tr in traces:
@@ -609,6 +618,7 @@ class BatchingChannel(BaseChannel):
             out["active_slots"] = self._active_slots
             out["ready_depth"] = len(self._ready)
             out["max_merge"] = self._max_merge
+            out["batch_multiple"] = self._batch_multiple
             out["pipeline_depth"] = self._pipeline_depth
             n = self._decomp.get("n", 0.0)
             if n:

@@ -1,0 +1,78 @@
+"""Batch padding/bucketing helpers — the ONE copy of the bucket table.
+
+Both batch producers pad to a bounded set of device batch sizes so the
+inner executable cache stays small (the role Triton's
+preferred_batch_size plays): the micro-batcher
+(``BatchingChannel._merge_parts``) pads merged request groups, and the
+mesh-sharded serving channel (``channel/sharded_channel.py``) pads each
+request batch so it splits evenly over the mesh's ``data`` axis. Before
+this module each carried its own ``_bucket`` — two tables that could
+drift apart and double XLA's compiled-shape set. Now:
+
+  * :func:`bucket`      — the classic next-power-of-two table;
+  * :func:`bucket_for`  — the mesh-aware table: smallest padded size
+    that is both bucketed AND divisible by the data-axis width, so one
+    table serves single-device and sharded channels (for the common
+    power-of-two meshes the two tables coincide at sizes >= the axis);
+  * :func:`pad_rows` / :func:`unpad_rows` — the padding policy itself.
+    Pad rows REPLICATE a real row rather than zero-filling: zeros can
+    steer a model down numerically different paths (different NMS
+    survivors, different argmax ties), a copied row cannot, which is
+    what keeps padded launches bitwise identical after the slice-back.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def bucket(n: int) -> int:
+    """Smallest power of two >= n (the padded device batch size)."""
+    b = 1
+    while b < n:
+        b *= 2
+    return b
+
+
+def bucket_for(n: int, multiple: int = 1) -> int:
+    """Smallest bucketed batch size >= n that divides evenly into
+    ``multiple`` shards (the mesh data-axis width).
+
+    ``multiple=1`` is exactly :func:`bucket`. For ``multiple=m`` the
+    table is ``m * 2**k``: still log2-bounded, and every entry splits
+    evenly over the axis — required before ``jax.device_put`` with a
+    batch sharding can place the array at all. For power-of-two meshes
+    the two tables agree at every size >= m, so stacking the batcher's
+    padding in front of a sharded channel never double-pads.
+    """
+    if multiple <= 1:
+        return bucket(n)
+    shards = bucket(max(1, -(-n // multiple)))  # ceil-div, then pow2
+    return multiple * shards
+
+
+def pad_rows(parts: list[np.ndarray], pad: int) -> list[np.ndarray]:
+    """Append ``pad`` replicated rows (copies of the first part's first
+    row) to a list of batch fragments about to be concatenated."""
+    if pad <= 0:
+        return parts
+    return list(parts) + [np.repeat(parts[0][:1], pad, axis=0)]
+
+
+def pad_batch(arr: np.ndarray, target: int) -> np.ndarray:
+    """Pad one batch-leading array up to ``target`` rows by replicating
+    its first row (no-op when already at target)."""
+    if arr.shape[0] >= target:
+        return arr
+    return np.concatenate(pad_rows([arr], target - arr.shape[0]))
+
+
+def unpad_rows(arr, total: int):
+    """Slice the real ``total`` rows back off a padded batch output.
+
+    Works on numpy and on device arrays (a lazy slice — for a sharded
+    device output the host copy that follows only ever pays for the
+    real rows)."""
+    if arr.ndim >= 1 and arr.shape[0] > total:
+        return arr[:total]
+    return arr

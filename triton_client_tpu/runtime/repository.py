@@ -47,6 +47,14 @@ class RegisteredModel:
     # under ONE jit so intermediates stay in HBM (runtime/ensemble.py);
     # None means the model is host-only (wire path still works).
     device_fn: InferFn | None = None
+    # Optional explicit param pytree for the replicate-params /
+    # shard-batch serving shape (channel/sharded_channel.py): when set,
+    # device_fn must accept ``(inputs, params)`` and the sharded channel
+    # uploads the tree ONCE per mesh (replicated on every device) at
+    # launcher build instead of letting the closure re-trace captured
+    # host constants per executable. None keeps the closure-captured
+    # convention every in-tree pipeline uses today.
+    params: object | None = None
 
 
 class ModelRepository:
@@ -62,10 +70,11 @@ class ModelRepository:
         infer_fn: InferFn,
         warmup: Callable[[], None] | None = None,
         device_fn: InferFn | None = None,
+        params: object | None = None,
     ) -> None:
         with self._lock:
             self._models.setdefault(spec.name, {})[spec.version] = RegisteredModel(
-                spec, infer_fn, warmup, device_fn
+                spec, infer_fn, warmup, device_fn, params
             )
 
     def unregister(self, name: str, version: str = "") -> None:

@@ -555,6 +555,20 @@ class InferenceServer:
         if self._port == 0:
             raise RuntimeError(f"could not bind {address}")
         self._address = address
+        # the channel stack is part of the server's public surface:
+        # embedders read stats()/batch_multiple off it, and start()
+        # logs the mesh-serving shape it implies
+        self.channel = channel
+
+    def _channel_multiple(self) -> int:
+        """Data-axis width of the serving channel stack (walk one
+        ``inner`` level for a batcher-wrapped mesh channel)."""
+        c = self.channel
+        m = getattr(c, "batch_multiple", 1)
+        inner = getattr(c, "inner", None)
+        if inner is not None:
+            m = max(m, getattr(inner, "batch_multiple", 1))
+        return int(m)
 
     @property
     def port(self) -> int:
@@ -567,7 +581,14 @@ class InferenceServer:
 
     def start(self) -> None:
         self._server.start()
-        log.info("KServe v2 server listening on %s", self._address)
+        multiple = self._channel_multiple()
+        if multiple > 1:
+            log.info(
+                "KServe v2 server listening on %s (mesh serving: batches "
+                "shard over a data axis of %d)", self._address, multiple,
+            )
+        else:
+            log.info("KServe v2 server listening on %s", self._address)
 
     def wait(self) -> None:
         self._server.wait_for_termination()
