@@ -145,6 +145,16 @@ CAMERA_FPS_BASELINE = 30.0
 LIDAR_HZ_BASELINE = 10.0  # KITTI/nuScenes lidar scan rate
 V5E_PEAK_FLOPS = 197e12   # bf16 MXU peak; fp32 runs the MXU at the same
                           # rate under jax's default (bf16xN) precision
+# Per-policy MXU peak for the MFU denominator (round 10): MFU was
+# computed as-if-f32 for every row. f32/bf16/int8w all execute the
+# matmuls at the bf16 peak (int8w dequantizes to f32 compute inside
+# the trace); full int8 runs the v5e int8 MAC path at 2x.
+POLICY_PEAK_FLOPS = {
+    "f32": V5E_PEAK_FLOPS,
+    "bf16": V5E_PEAK_FLOPS,
+    "int8w": V5E_PEAK_FLOPS,
+    "int8": 2 * V5E_PEAK_FLOPS,
+}
 
 
 def _tunnel_rtt_ms() -> float:
@@ -175,10 +185,11 @@ class Config:
     order of magnitude."""
 
     def __init__(self, name, metric, one, unit_per_call, baseline_hz,
-                 reps=REPS):
+                 reps=REPS, precision="f32"):
         self.name = name
         self.metric = metric
         self.one = one
+        self.precision = precision  # serving policy the row ran under
         self.reps = reps
         self.step = jax.jit(one)          # single-dispatch form (latency)
         self.looped = jax.jit(
@@ -262,11 +273,17 @@ class Config:
             "tunnel_rtt_ms": round(rtt_ms, 3),
             "trial_spread": round(spread, 3),
             "trials": len(self.trial_ms),
+            "precision": self.precision,
         }
         if self.flops_per_call:
+            # MFU against the peak of the dtype the row actually ran
+            # (POLICY_PEAK_FLOPS), not a blanket as-if-f32 denominator
             out["flops_per_call"] = self.flops_per_call
             out["mfu"] = round(
-                self.flops_per_call / (per_call_ms / 1e3) / V5E_PEAK_FLOPS, 4
+                self.flops_per_call
+                / (per_call_ms / 1e3)
+                / POLICY_PEAK_FLOPS.get(self.precision, V5E_PEAK_FLOPS),
+                4,
             )
         return out
 
@@ -307,6 +324,7 @@ def make_yolov5(dtype=None, batch=BATCH, mxu=False) -> Config:
         # dispatch; b64 runs ~18 ms/call so 50 reps lands in the same
         # regime
         reps=120 if batch == BATCH else 50,
+        precision="bf16" if dtype == jnp.bfloat16 else "f32",
     )
 
 
@@ -468,6 +486,7 @@ def measure_serving(
     max_merge: int = 16,
     input_hw: tuple = (512, 512),
     on_row=None,
+    precision: str = "f32",
 ) -> list:
     """Serving-path benchmark (VERDICT r2 #3): N concurrent gRPC
     clients on localhost against the KServe server + micro-batcher —
@@ -506,7 +525,8 @@ def measure_serving(
     from triton_client_tpu.runtime.server import InferenceServer
 
     pipe, spec, _ = build_yolov5_pipeline(
-        jax.random.PRNGKey(0), variant="n", num_classes=2, input_hw=input_hw
+        jax.random.PRNGKey(0), variant="n", num_classes=2, input_hw=input_hw,
+        precision=precision,
     )
     repo = ModelRepository()
     # multi-device rig: serve the whole mesh through the sharded
@@ -521,10 +541,13 @@ def measure_serving(
         )
         from triton_client_tpu.parallel.mesh import MeshConfig
 
-        repo.register(spec, pipe.infer_fn(), device_fn=pipe.device_fn())
+        repo.register(
+            spec, pipe.infer_fn(), device_fn=pipe.device_fn(),
+            precision=pipe.precision,
+        )
         inner = ShardedTPUChannel(repo, MeshConfig(data=data_axis, model=1))
     else:
-        repo.register(spec, pipe.infer_fn())
+        repo.register(spec, pipe.infer_fn(), precision=pipe.precision)
         inner = TPUChannel(repo)
 
     occupancy: collections.Counter = collections.Counter()
@@ -595,6 +618,28 @@ def measure_serving(
     for _ in range(3):
         pipe.infer(direct)
     direct_batch_ms = (time.perf_counter() - t0) / 3 * 1e3
+
+    # dtype-correct FLOP accounting for the served rows (round 10):
+    # derive per-frame FLOPs once from the compiled executable (sidecar
+    # cached, same methodology as the e2e configs) so served mfu stops
+    # being as-if-f32
+    flops_key = f"served_yolov5n_{input_hw[0]}_{precision}_b{max_merge}"
+    flops_per_frame = _FLOPS_SIDEBAR.get(flops_key)
+    if flops_per_frame:
+        flops_per_frame = float(flops_per_frame)
+    else:
+        try:
+            cost = (
+                pipe._jit.lower(jnp.asarray(direct), tuple(input_hw))
+                .compile()
+                .cost_analysis()
+            )
+            if cost and cost.get("flops"):
+                flops_per_frame = float(cost["flops"]) / max_merge
+                _FLOPS_SIDEBAR[flops_key] = flops_per_frame
+                _save_flops_sidecar()
+        except Exception:
+            flops_per_frame = None  # best-effort over the tunnel
 
     # host->device upload bandwidth probe: the per-request transfer the
     # in-process configs never pay (device-resident inputs); over this
@@ -718,7 +763,15 @@ def measure_serving(
                 round(float(np.percentile(device_call_s, 50)), 2)
                 if device_call_s else None
             ),
+            "precision": precision,
         }
+        if flops_per_frame:
+            row["flops_per_frame"] = flops_per_frame
+            row["mfu"] = round(
+                res.fps * flops_per_frame
+                / POLICY_PEAK_FLOPS.get(precision, V5E_PEAK_FLOPS),
+                4,
+            )
         if total == 0:
             row["degraded"] = (
                 f"no request completed in the {duration_s:.0f}s window; "
@@ -837,6 +890,7 @@ def _serve_3d_row(repo, batching, server, rtt_ms, duration_s: float) -> dict:
         # call on this rig (no batch amortization on the 3D wire)
         "device_ceiling_fps": round(1e3 / direct_ms, 2) if direct_ms else None,
         "client_errors": len(res.errors),
+        "precision": "f32",
     }
     if res.served_frames == 0:
         row["degraded"] = f"no request completed; first error: {res.errors[:1]}"

@@ -47,6 +47,14 @@ python -m pytest tests/test_sharded_channel.py -q \
     --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly
 
+echo "== precision-policy shard (accuracy budgets + wire dtypes) =="
+# the serving-precision contract (runtime/precision.py): bf16/int8
+# parity floors, quantized-tree sharding, wire narrowing, gauges —
+# named by its shard for the same reason as the mesh shard above
+python -m pytest tests/test_precision.py -q \
+    --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+
 echo "== tier-1 pytest =="
 exec python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors \

@@ -60,6 +60,47 @@ class TestCodec:
         back = codec.deserialize_tensor(arr.tobytes(), "FP32", (3, 4))
         assert not back.flags.writeable  # view over the wire buffer
 
+    def test_roundtrip_matrix_every_config_dtype(self, rng):
+        """Every entry in the canonical dtype table round-trips bitwise
+        — including the precision-policy wire dtypes: BF16 (ml_dtypes;
+        the bf16 policy's wire words) and INT8 (the int8 policy's
+        quantized activations) — and the deserialize side stays a
+        zero-copy view over the wire buffer."""
+        import ml_dtypes
+
+        from triton_client_tpu.config import config_dtypes
+
+        for datatype, np_dtype in config_dtypes().items():
+            dtype = (
+                np.dtype(ml_dtypes.bfloat16)
+                if np_dtype is None  # the BF16 entry
+                else np.dtype(np_dtype)
+            )
+            if dtype == np.bool_:
+                arr = rng.random((3, 5)) > 0.5
+            elif np.issubdtype(dtype, np.floating) or np_dtype is None:
+                arr = rng.normal(0, 10, (3, 5)).astype(dtype)
+            else:
+                info = np.iinfo(dtype)
+                arr = rng.integers(
+                    max(info.min, -100), min(info.max, 100) + 1, (3, 5)
+                ).astype(dtype)
+            assert codec.datatype_of(arr) == datatype
+            raw = codec.serialize_tensor(arr)
+            assert len(raw) == arr.nbytes
+            back = codec.deserialize_tensor(raw, datatype, arr.shape)
+            assert back.dtype == dtype
+            np.testing.assert_array_equal(
+                back.view(np.uint8), arr.view(np.uint8)
+            )
+            # np.frombuffer view over the wire bytes, never a copy:
+            # read-only, backed by the buffer object itself
+            assert not back.flags.writeable, datatype
+            assert back.base is not None, datatype
+            assert np.shares_memory(
+                back, np.frombuffer(raw, np.uint8)
+            ), datatype
+
     def test_mismatched_raw_buffers_rejected(self):
         req = pb.ModelInferRequest(model_name="m")
         req.inputs.add(name="x", datatype="FP32", shape=[1])

@@ -1,0 +1,142 @@
+"""Boundary cases for the shared batch padding/bucketing helpers
+(runtime/padding.py) — the one bucket table both the micro-batcher and
+the mesh-sharded channel pad against."""
+
+import numpy as np
+import pytest
+
+from triton_client_tpu.runtime.padding import (
+    bucket,
+    bucket_for,
+    pad_batch,
+    pad_rows,
+    unpad_rows,
+)
+
+
+# -- bucket / bucket_for -------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "n,expected",
+    [(1, 1), (2, 2), (3, 4), (5, 8), (8, 8), (9, 16), (1000, 1024)],
+)
+def test_bucket_next_power_of_two(n, expected):
+    assert bucket(n) == expected
+
+
+def test_bucket_for_single_row():
+    assert bucket_for(1) == 1
+    # a single row on a wide mesh still pads up to one row per shard
+    assert bucket_for(1, multiple=4) == 4
+
+
+def test_bucket_for_batch_equals_multiple():
+    # batch == data-axis width: already splits evenly, no padding
+    for m in (1, 2, 4, 8):
+        assert bucket_for(m, multiple=m) == m
+
+
+def test_bucket_for_batch_larger_than_largest_common_bucket():
+    # sizes past the "usual" max_merge table keep the m * 2**k law
+    # rather than falling off the table: 1000 rows over 8 shards pads
+    # to 8 * 128 = 1024, and the result always divides the mesh
+    for n in (100, 1000, 4097):
+        for m in (1, 2, 4, 8):
+            padded = bucket_for(n, multiple=m)
+            assert padded >= n
+            assert padded % m == 0
+            # bucketed: padded/m is a power of two
+            assert bucket(padded // m) == padded // m
+    assert bucket_for(1000, multiple=8) == 1024
+
+
+def test_bucket_for_agrees_with_bucket_on_pow2_meshes():
+    # the docstring claim: for power-of-two meshes the mesh-aware table
+    # coincides with the classic table at every size >= the axis width
+    for m in (2, 4, 8):
+        for n in range(m, 70):
+            assert bucket_for(n, multiple=m) == bucket(n)
+
+
+# -- pad_rows / pad_batch ------------------------------------------------------
+
+
+def test_pad_rows_replicates_first_row():
+    a = np.arange(8, dtype=np.float32).reshape(2, 4)
+    parts = pad_rows([a], 3)
+    merged = np.concatenate(parts)
+    assert merged.shape == (5, 4)
+    assert np.array_equal(merged[2:], np.repeat(a[:1], 3, axis=0))
+
+
+def test_pad_rows_zero_pad_is_identity():
+    a = np.ones((2, 4), np.float32)
+    assert pad_rows([a], 0) == [a]
+    assert pad_rows([a], -1) == [a]
+
+
+def test_pad_rows_skips_empty_leading_fragment():
+    # regression: replicating from a 0-row first fragment produced 0
+    # pad rows and the batch silently under-padded
+    empty = np.zeros((0, 4), np.float32)
+    real = np.full((2, 4), 7.0, np.float32)
+    merged = np.concatenate(pad_rows([empty, real], 2))
+    assert merged.shape == (4, 4)
+    assert np.array_equal(merged[2:], np.repeat(real[:1], 2, axis=0))
+
+
+def test_pad_rows_all_empty_zero_fills():
+    empty = np.zeros((0, 4), np.float32)
+    merged = np.concatenate(pad_rows([empty], 3))
+    assert merged.shape == (3, 4)
+    assert np.array_equal(merged, np.zeros((3, 4), np.float32))
+
+
+def test_pad_batch_pads_and_passes_through():
+    a = np.arange(12, dtype=np.float32).reshape(3, 4)
+    padded = pad_batch(a, 8)
+    assert padded.shape == (8, 4)
+    assert np.array_equal(padded[:3], a)
+    assert np.array_equal(padded[3:], np.repeat(a[:1], 5, axis=0))
+    # already at / beyond target: the SAME object comes back, no copy
+    assert pad_batch(a, 3) is a
+    assert pad_batch(a, 2) is a
+
+
+# -- unpad_rows ----------------------------------------------------------------
+
+
+def test_unpad_rows_slices_back_real_rows():
+    padded = np.arange(32, dtype=np.float32).reshape(8, 4)
+    out = unpad_rows(padded, 5)
+    assert out.shape == (5, 4)
+    assert np.array_equal(out, padded[:5])
+
+
+def test_unpad_rows_lazy_view_not_copy():
+    # the slice must stay a view of the padded buffer (numpy) so the
+    # host never copies the pad rows; on device arrays the same slice
+    # is lazy and the readback only pays for the real rows
+    padded = np.zeros((8, 4), np.float32)
+    out = unpad_rows(padded, 5)
+    assert np.shares_memory(out, padded)
+
+
+def test_unpad_rows_noop_is_same_object():
+    a = np.zeros((4, 4), np.float32)
+    assert unpad_rows(a, 4) is a
+    # total larger than the batch: nothing to slice
+    assert unpad_rows(a, 9) is a
+
+
+def test_unpad_rows_scalarlike_passthrough():
+    a = np.float32(3.0)  # ndim 0: no batch axis to slice
+    assert unpad_rows(np.asarray(a), 1) is not None
+
+
+def test_unpad_rows_device_array_lazy():
+    jnp = pytest.importorskip("jax.numpy")
+    arr = jnp.zeros((8, 4))
+    out = unpad_rows(arr, 3)
+    assert out.shape == (3, 4)

@@ -79,6 +79,60 @@ class TestRecompileRules:
         )
         assert lint_source(src, codes=["TPL101"]) == []
 
+    def test_static_policy_param_is_exempt(self):
+        # round 10: a precision policy (runtime/precision.py) threaded
+        # through a jitted body is static python config, not a tracer —
+        # dtype-dispatching on `policy.name` compiles one executable
+        # per policy by design and must NOT flag
+        src = (
+            "def device_fn(inputs, policy):\n"
+            "    if policy.name == 'bf16':\n"
+            "        return {k: v * 2 for k, v in inputs.items()}\n"
+            "    for key in policy.act_scales:\n"
+            "        pass\n"
+            "    return inputs\n"
+        )
+        assert lint_source(src, codes=["TPL1"]) == []
+
+    def test_static_policy_suffix_convention(self):
+        # *_policy / *_precision / precision all ride the convention;
+        # an f-string over the policy name is fine too (TPL103)
+        src = (
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(x, wire_policy, precision):\n"
+            "    label = f'{wire_policy}/{precision}'\n"
+            "    if precision == 'int8':\n"
+            "        return x\n"
+            "    return x + 1\n"
+        )
+        assert lint_source(src, codes=["TPL1"]) == []
+
+    def test_ordinary_param_still_flags_beside_policy(self):
+        # the exemption is name-scoped: a traced param in the same
+        # signature still flags
+        src = (
+            "def device_fn(inputs, policy):\n"
+            "    if inputs > 0:\n"
+            "        return inputs\n"
+            "    return -inputs\n"
+        )
+        found = lint_source(src, codes=["TPL101"])
+        assert len(found) == 1 and "`inputs`" in found[0].message
+
+    def test_policy_substring_is_not_exempt(self):
+        # only the exact name or `_`-suffixed convention is static:
+        # `policyx` is an ordinary traced param
+        src = (
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(policyx):\n"
+            "    if policyx > 0:\n"
+            "        return policyx\n"
+            "    return -policyx\n"
+        )
+        assert codes(lint_source(src, codes=["TPL101"])) == ["TPL101"]
+
     def test_static_argnums_list_positive(self):
         src = "import jax\ng = jax.jit(lambda x, n: x, static_argnums=[1])\n"
         found = lint_source(src, codes=["TPL102"])

@@ -44,6 +44,26 @@ _STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "at"}
 _STATIC_CALLS = {"len", "isinstance", "type", "hasattr", "getattr"}
 _JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit"}
 
+# Parameter-name convention for STATIC serving config threaded through
+# jitted bodies (round 10): a precision policy (runtime/precision.py
+# PrecisionPolicy — a frozen dataclass of python strings/floats) rides
+# into launched programs by closure or argument, and branching on it
+# (`if policy.name == "bf16"`, `policy.scale_for(k) is not None`)
+# dispatches on serving CONFIG, not on a tracer — one executable per
+# policy is exactly the intent. Names matching this convention are
+# excluded from the traced-param set for every TPL1xx rule.
+_STATIC_PARAM_SUFFIXES = ("policy", "precision")
+
+
+def is_static_param_name(name: str) -> bool:
+    """True for parameter names that carry static (python) serving
+    config by convention: ``policy``, ``precision``, ``*_policy``,
+    ``*_precision``."""
+    n = name.lower()
+    return any(
+        n == s or n.endswith("_" + s) for s in _STATIC_PARAM_SUFFIXES
+    )
+
 
 def _is_jit_call(node: ast.Call) -> bool:
     name = call_name(node)
@@ -69,7 +89,8 @@ def jit_bodies(module: Module) -> Iterator[tuple[ast.AST, list[str], str]]:
         ``jax.jit(...)`` call
 
     Static args named by ``static_argnums``/``static_argnames`` are
-    excluded from the traced set.
+    excluded from the traced set, as are params matching the
+    static-config naming convention (:func:`is_static_param_name`).
     """
     contexts = qualname_contexts(module.tree)
 
@@ -82,6 +103,8 @@ def jit_bodies(module: Module) -> Iterator[tuple[ast.AST, list[str], str]]:
         for i, n in enumerate(names):
             if i in static_nums or n in static_names:
                 continue
+            if is_static_param_name(n):
+                continue  # precision policy config — never a tracer
             out.append(n)
         return out
 

@@ -121,7 +121,10 @@ class ShardedTPUChannel(StagedChannel):
         consecutive padded batches), everything else propagates its
         device_put placement. An explicit ``model.params`` tree is
         replicated onto the mesh ONCE here and closed over as a
-        committed jit argument."""
+        committed jit argument — including int8 ``QuantizedParam``
+        leaves (runtime/precision.py registered pytree nodes): the
+        policy quantized the tree at registration, so the SMALL tree is
+        what ships to every device."""
         from triton_client_tpu.config import config_dtypes
 
         batch_s, repl_s = serving_shardings(self._mesh)
@@ -131,7 +134,7 @@ class ShardedTPUChannel(StagedChannel):
             if self._donate
             else frozenset()
         )
-        device_fn = model.device_fn
+        device_fn = self._device_body(model)
         out_dtype = {
             t.name: config_dtypes().get(t.dtype) for t in model.spec.outputs
         }

@@ -57,13 +57,22 @@ def cast_wire_input(model, name: str, arr: np.ndarray) -> np.ndarray:
     as-is — casting uint8 camera frames up to FP32 on the host is 4x
     the host->device bytes, and every in-tree pipeline widens on device
     where the cast fuses for free (the registration contract in
-    runtime/repository.py)."""
+    runtime/repository.py).
+
+    Round 10 extends the same never-widen rule to precision policies
+    (runtime/precision.py): a model registered at bf16/int8 narrows its
+    float wire inputs FURTHER here (f32 frames stage as bf16 words or
+    calibrated int8 codes — half/quarter the H2D bytes), with keep-list
+    inputs and integer frames untouched."""
     try:
         want = model.spec.input_by_name(name).np_dtype()
         if arr.dtype != want and np.dtype(want).itemsize <= arr.dtype.itemsize:
             arr = arr.astype(want)
     except (KeyError, ValueError, TypeError):
         pass  # undeclared/BF16 inputs pass through as-is
+    policy = getattr(model, "precision", None)
+    if policy is not None:
+        arr = policy.wire_cast(name, arr)
     return arr
 
 
@@ -201,6 +210,23 @@ class StagedChannel(BaseChannel):
         back to the host-boundary ``infer_fn``. Called once per model
         identity (cached by :meth:`_launcher`)."""
         raise NotImplementedError
+
+    def _device_body(self, model):
+        """The traced body both launcher implementations jit: the
+        model's ``device_fn``, wrapped with the registered precision
+        policy's wire ingest when the policy quantized activations —
+        int8 wire inputs then dequantize INSIDE the launched program
+        (runtime/precision.py), so the cached launcher stages in the
+        wire dtype and runs the body at the policy dtype."""
+        device_fn = model.device_fn
+        policy = getattr(model, "precision", None)
+        if (
+            device_fn is None
+            or policy is None
+            or not getattr(policy, "wire_ingest_needed", False)
+        ):
+            return device_fn
+        return lambda inputs, *rest: device_fn(policy.ingest(inputs), *rest)
 
     def _host_outputs(self, outputs, out_dtype, meta) -> dict:
         """Device outputs -> host numpy dict at the wire dtypes. The

@@ -71,7 +71,7 @@ class TPUChannel(StagedChannel):
         donate_names = (
             frozenset(model.spec.donatable_inputs()) if self._donate else frozenset()
         )
-        device_fn = model.device_fn
+        device_fn = self._device_body(model)
         launcher = jax.jit(
             lambda donated, kept: device_fn({**donated, **kept}),
             donate_argnums=(0,),

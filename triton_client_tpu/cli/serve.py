@@ -30,6 +30,14 @@ def main(argv=None) -> None:
         "single-executable TPUChannel",
     )
     p.add_argument(
+        "--precision", default="", choices=["", "f32", "bf16", "int8w", "int8"],
+        help="serving precision policy applied to EVERY repository entry "
+        "(runtime/precision.py), overriding per-model config.yaml "
+        "model.precision: bf16 = params+compute+wire in bfloat16, "
+        "int8w = int8 weights, int8 = int8 weights+activations with "
+        "calibrated scales; empty = per-model config (default f32)",
+    )
+    p.add_argument(
         "--batching", action="store_true",
         help="micro-batch concurrent requests before dispatch (Triton's "
         "dynamic batcher role; native C++ batcher with python fallback)",
@@ -107,10 +115,17 @@ def build_server(args):
     from triton_client_tpu.runtime.disk_repository import scan_disk
     from triton_client_tpu.runtime.server import InferenceServer
 
-    repo = scan_disk(args.model_repository)
+    repo = scan_disk(
+        args.model_repository,
+        precision=getattr(args, "precision", "") or None,
+    )
     for name, version in repo.list_models():
         model = repo.get(name, version)
-        print(f"loaded {name}:{version} ({model.spec.platform})")
+        policy = model.spec.extra.get("precision", "f32")
+        print(
+            f"loaded {name}:{version} ({model.spec.platform}, "
+            f"precision={policy})"
+        )
         if args.warmup and model.warmup is not None:
             model.warmup()
 

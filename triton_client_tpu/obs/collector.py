@@ -60,6 +60,11 @@ METRIC_TYPES: dict[str, str] = {
     "tpu_serving_padded_frames_total": "counter",
     "tpu_serving_batch_launch_frees_total": "counter",
     "tpu_serving_merge_occupancy_total": "counter",
+    # per-model precision policy + quantized param footprint (round 10:
+    # a bf16/int8 registration should visibly shrink param_bytes — the
+    # HBM-occupancy regression check in tests/test_precision.py)
+    "tpu_serving_model_precision_info": "gauge",
+    "tpu_serving_model_param_bytes": "gauge",
     # jit compile events (process-global)
     "tpu_serving_jit_compiles_total": "counter",
     "tpu_serving_jit_compile_seconds_total": "counter",
@@ -149,9 +154,11 @@ class RuntimeCollector:
         tracer=None,
         namespace: str = "tpu_serving",
         registry=None,
+        repository=None,
     ) -> None:
         self._batching, self._tpu = _split_channel(channel)
         self._tracer = tracer
+        self._repository = repository
         self._ns = namespace
         self._compile = CompileEvents.install()
         self._lock = threading.Lock()
@@ -195,7 +202,36 @@ class RuntimeCollector:
         }
         if self._tracer is not None:
             snap["tracer"] = self._tracer.stats()
+        models = self._models()
+        if models is not None:
+            snap["models"] = models
         return snap
+
+    def _models(self) -> list | None:
+        """Per-registered-model precision + param footprint rows (round
+        10), read from each ModelSpec's extra at snapshot time so a
+        model reload is reflected on the next scrape."""
+        if self._repository is None:
+            return None
+        rows = []
+        try:
+            listing = self._repository.list_models()
+        except Exception:
+            return None
+        for name, version in listing:
+            try:
+                extra = self._repository.get(name, version).spec.extra
+            except Exception:
+                continue
+            rows.append(
+                {
+                    "model": name,
+                    "version": version,
+                    "precision": str(extra.get("precision", "f32")),
+                    "param_bytes": int(extra.get("param_bytes", 0) or 0),
+                }
+            )
+        return rows
 
     @staticmethod
     def delta(new: dict, old: dict) -> dict:
@@ -401,6 +437,31 @@ class RuntimeCollector:
             samples=[
                 ([str(k)], v)
                 for k, v in (bat.get("merge_occupancy") or {}).items()
+            ],
+        )
+
+        # per-model precision + param footprint (empty families when no
+        # repository is wired — the HELP/TYPE lines still export so the
+        # telemetry smoke test pins the series names)
+        models = snap.get("models") or []
+        yield gauge(
+            f"{ns}_model_precision_info",
+            "serving precision policy per registered model (info gauge)",
+            0,
+            labels=["model", "version", "precision"],
+            samples=[
+                ([m["model"], m["version"], m["precision"]], 1)
+                for m in models
+            ],
+        )
+        yield gauge(
+            f"{ns}_model_param_bytes",
+            "registered parameter bytes per model (post-quantization)",
+            0,
+            labels=["model", "version"],
+            samples=[
+                ([m["model"], m["version"]], m["param_bytes"])
+                for m in models
             ],
         )
 

@@ -30,6 +30,12 @@ from triton_client_tpu.ops.detect_postprocess import (
     extract_boxes_scored,
 )
 from triton_client_tpu.ops.preprocess import normalize_image
+from triton_client_tpu.runtime.precision import (
+    KEEP_F32_2D,
+    PrecisionPolicy,
+    realize,
+    resolve_policy,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -60,25 +66,37 @@ class Detect2DPipeline:
         self,
         config: Detect2DConfig,
         forward: Callable[[jnp.ndarray], jnp.ndarray],
+        precision: PrecisionPolicy | str | None = None,
     ) -> None:
         """``forward``: (B, H, W, 3) float input -> (B, N, 5+nc) decoded
-        predictions in input-pixel units."""
+        predictions in input-pixel units. ``precision``: the serving
+        PrecisionPolicy (runtime/precision.py) — ingress frames cast to
+        its compute dtype, model outputs return to f32 at ``boundary()``
+        before the keep-list ops (box decode / NMS / rescale)."""
         self.config = config
         self._forward = forward
+        self.precision = PrecisionPolicy.parse(precision)
         self._jit = jax.jit(self._pipeline, static_argnames=("orig_hw",))
 
     def _pipeline(
         self, frames: jnp.ndarray, orig_hw: tuple[int, int]
     ) -> tuple[jnp.ndarray, jnp.ndarray]:
         cfg = self.config
-        x = frames.astype(jnp.float32)
+        # policy compute dtype (f32 legacy; bf16 halves the resize/
+        # normalize/forward HBM traffic). Wire inputs may arrive already
+        # narrowed (uint8 frames, bf16 words, dequantized int8) — the
+        # cast fuses into the first op either way.
+        x = self.precision.cast_in(frames)
         if orig_hw != cfg.input_hw:
             b = x.shape[0]
             x = jax.image.resize(
                 x, (b, cfg.input_hw[0], cfg.input_hw[1], 3), method="bilinear"
             )
         x = normalize_image(x, cfg.scaling)
-        pred = self._forward(x)
+        # keep-list boundary (KEEP_F32_2D, declared in the spec): box
+        # decode, NMS scoring and pixel rescale below run in f32
+        # regardless of policy
+        pred = self.precision.boundary(self._forward(x))
         if cfg.head_style == "scored":
             boxes_scores = pred
             dets, valid = extract_boxes_scored(
@@ -198,6 +216,7 @@ def build_yolov5_pipeline(
     config: Detect2DConfig | None = None,
     s2d: bool = False,
     ch_floor: int = 0,
+    precision: PrecisionPolicy | str | None = None,
 ) -> tuple[Detect2DPipeline, ModelSpec, dict]:
     """Construct model + pipeline + serving spec in one call.
 
@@ -205,8 +224,11 @@ def build_yolov5_pipeline(
     (examples/YOLOv5/config.pbtxt: images in, [1, N, 5+nc] out) plus the
     packed-detections outputs unique to the fused pipeline.
     ``s2d``/``ch_floor`` are the MXU-shape options (models/yolov5.py) —
-    identical detection function, faster chip layout.
+    identical detection function, faster chip layout. ``precision``
+    selects the serving precision policy (runtime/precision.py): params
+    are cast/quantized HERE, once, before registration.
     """
+    policy, dtype = _resolve_precision(precision, dtype)
     model = YoloV5(
         num_classes=num_classes, variant=variant, dtype=dtype,
         s2d=s2d, ch_floor=ch_floor,
@@ -216,16 +238,29 @@ def build_yolov5_pipeline(
             rng = jax.random.PRNGKey(0)
         dummy = jnp.zeros((1, input_hw[0], input_hw[1], 3), jnp.float32)
         variables = model.init(rng, dummy, train=False)
+    # cast/quantize ONCE here; the UNCAST tree is still returned as the
+    # weight-loading template (disk_repository restores checkpoints onto
+    # the f32 structure, then rebuilds through this path)
+    cast_vars = policy.cast_params(variables)
 
     def forward(x: jnp.ndarray) -> jnp.ndarray:
-        return model.decode(model.apply(variables, x, train=False))
+        # realize: int8 kernels dequantize inside the trace (HBM reads
+        # stay int8); boundary: raw heads re-enter f32 BEFORE decode —
+        # the KEEP_F32_2D contract
+        raw = model.apply(realize(cast_vars), x, train=False)
+        return model.decode(policy.boundary(raw))
 
     cfg = config or Detect2DConfig(
         model_name=f"yolov5{variant}", input_hw=input_hw, num_classes=num_classes
     )
-    pipeline = Detect2DPipeline(cfg, forward)
+    pipeline = Detect2DPipeline(cfg, forward, precision=policy)
     spec = _detect2d_spec(cfg, num_predictions(cfg.input_hw))
+    spec.extra.update(policy.spec_extra(cast_vars, KEEP_F32_2D))
     return pipeline, spec, variables
+
+
+# builder-shared policy/compute-dtype resolution (runtime/precision.py)
+_resolve_precision = resolve_policy
 
 
 def build_yolov4_pipeline(
@@ -236,6 +271,7 @@ def build_yolov4_pipeline(
     variables=None,
     dtype: jnp.dtype = jnp.float32,
     config: Detect2DConfig | None = None,
+    precision: PrecisionPolicy | str | None = None,
 ) -> tuple[Detect2DPipeline, ModelSpec, dict]:
     """YOLOv4 variant of the fused pipeline (reference contract:
     examples/YOLOv4/config.pbtxt confs+boxes; decode parity with
@@ -244,15 +280,18 @@ def build_yolov4_pipeline(
     from triton_client_tpu.models.yolov4 import YoloV4
     from triton_client_tpu.models.yolov4 import num_predictions as v4_num_predictions
 
+    policy, dtype = _resolve_precision(precision, dtype)
     model = YoloV4(num_classes=num_classes, width=width, dtype=dtype)
     if variables is None:
         if rng is None:
             rng = jax.random.PRNGKey(0)
         dummy = jnp.zeros((1, input_hw[0], input_hw[1], 3), jnp.float32)
         variables = model.init(rng, dummy, train=False)
+    cast_vars = policy.cast_params(variables)
 
     def forward(x: jnp.ndarray) -> jnp.ndarray:
-        return model.decode_flat(model.apply(variables, x, train=False))
+        raw = model.apply(realize(cast_vars), x, train=False)
+        return model.decode_flat(policy.boundary(raw))
 
     cfg = config or Detect2DConfig(
         model_name="yolov4",
@@ -261,8 +300,9 @@ def build_yolov4_pipeline(
         conf_thresh=0.4,
         iou_thresh=0.6,
     )
-    pipeline = Detect2DPipeline(cfg, forward)
+    pipeline = Detect2DPipeline(cfg, forward, precision=policy)
     spec = _detect2d_spec(cfg, v4_num_predictions(cfg.input_hw))
+    spec.extra.update(policy.spec_extra(cast_vars, KEEP_F32_2D))
     return pipeline, spec, variables
 
 
@@ -313,6 +353,7 @@ def build_retinanet_pipeline(
     variables=None,
     dtype: jnp.dtype = jnp.float32,
     config: Detect2DConfig | None = None,
+    precision: PrecisionPolicy | str | None = None,
 ) -> tuple[Detect2DPipeline, ModelSpec, dict]:
     """RetinaNet (detectron family) fused pipeline.
 
@@ -323,6 +364,7 @@ def build_retinanet_pipeline(
     """
     from triton_client_tpu.models.retinanet import RetinaNet
 
+    policy, dtype = _resolve_precision(precision, dtype)
     model = RetinaNet(
         num_classes=num_classes, depth=depth, input_hw=input_hw, dtype=dtype
     )
@@ -331,9 +373,13 @@ def build_retinanet_pipeline(
             rng = jax.random.PRNGKey(0)
         dummy = jnp.zeros((1, *input_hw, 3), jnp.float32)
         variables = model.init(rng, dummy, train=False)
+    cast_vars = policy.cast_params(variables)
 
     def forward(x: jnp.ndarray):
-        return model.decode(model.apply(variables, x, train=False))
+        # decode runs inside model.decode here (anchors -> boxes): feed
+        # it f32 heads per the keep-list
+        raw = model.apply(realize(cast_vars), x, train=False)
+        return model.decode(policy.boundary(raw))
 
     cfg = config or Detect2DConfig(
         model_name="retinanet",
@@ -346,8 +392,10 @@ def build_retinanet_pipeline(
         multi_label=True,
         head_style="scored",
     )
-    pipeline = Detect2DPipeline(cfg, forward)
-    return pipeline, _detectron_spec(cfg), variables
+    pipeline = Detect2DPipeline(cfg, forward, precision=policy)
+    spec = _detectron_spec(cfg)
+    spec.extra.update(policy.spec_extra(cast_vars, KEEP_F32_2D))
+    return pipeline, spec, variables
 
 
 def build_fcos_pipeline(
@@ -358,10 +406,12 @@ def build_fcos_pipeline(
     variables=None,
     dtype: jnp.dtype = jnp.float32,
     config: Detect2DConfig | None = None,
+    precision: PrecisionPolicy | str | None = None,
 ) -> tuple[Detect2DPipeline, ModelSpec, dict]:
     """FCOS (anchor-free detectron family; the reference's FCOS_client)."""
     from triton_client_tpu.models.retinanet import FCOS
 
+    policy, dtype = _resolve_precision(precision, dtype)
     model = FCOS(
         num_classes=num_classes, depth=depth, input_hw=input_hw, dtype=dtype
     )
@@ -370,9 +420,11 @@ def build_fcos_pipeline(
             rng = jax.random.PRNGKey(0)
         dummy = jnp.zeros((1, *input_hw, 3), jnp.float32)
         variables = model.init(rng, dummy, train=False)
+    cast_vars = policy.cast_params(variables)
 
     def forward(x: jnp.ndarray):
-        return model.decode(model.apply(variables, x, train=False))
+        raw = model.apply(realize(cast_vars), x, train=False)
+        return model.decode(policy.boundary(raw))
 
     cfg = config or Detect2DConfig(
         model_name="fcos",
@@ -385,8 +437,10 @@ def build_fcos_pipeline(
         multi_label=True,
         head_style="scored",
     )
-    pipeline = Detect2DPipeline(cfg, forward)
-    return pipeline, _detectron_spec(cfg), variables
+    pipeline = Detect2DPipeline(cfg, forward, precision=policy)
+    spec = _detectron_spec(cfg)
+    spec.extra.update(policy.spec_extra(cast_vars, KEEP_F32_2D))
+    return pipeline, spec, variables
 
 
 def detectron_infer_fn(pipeline: Detect2DPipeline):

@@ -37,6 +37,12 @@ from triton_client_tpu.ops.detect3d_postprocess import (
     nms_pack_3d,
 )
 from triton_client_tpu.ops.voxelize import pad_points, voxelize
+from triton_client_tpu.runtime.precision import (
+    KEEP_F32_3D,
+    PrecisionPolicy,
+    realize,
+    resolve_policy,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -79,10 +85,22 @@ class Detect3DPipeline:
         config: Detect3DConfig,
         model: PointPillars,
         variables,
+        precision: PrecisionPolicy | str | None = None,
     ) -> None:
         self.config = config
         self.model = model
         self.variables = variables
+        # KEEP_F32_3D contract: the raw cloud stays f32 on the wire no
+        # matter the policy — voxelize derives integer cell coords from
+        # point xyz, and a bf16/int8 coordinate flips cells. int8
+        # activation quantization is therefore a no-op for 3D (weights
+        # still quantize); bf16 narrows the model, not the points.
+        policy = PrecisionPolicy.parse(precision)
+        if "points" not in policy.keep_f32_inputs:
+            policy = dataclasses.replace(
+                policy, keep_f32_inputs=policy.keep_f32_inputs + ("points",)
+            )
+        self.precision = policy
         if config.vfe not in ("auto", "grouped"):
             raise ValueError(f"unknown vfe mode {config.vfe!r} (auto|grouped)")
         # pillar scatter VFE is nz == 1 only (a taller grid's z cells
@@ -111,22 +129,29 @@ class Detect3DPipeline:
     def _pipeline(self, points: jnp.ndarray, count: jnp.ndarray):
         cfg = self.config
         use_scatter = self.use_scatter
+        # int8 kernels dequantize inside the trace (runtime/precision.py
+        # realize — HBM reads stay int8); voxelize below always sees the
+        # f32 cloud (KEEP_F32_3D: cell coords are precision-sensitive)
+        variables = realize(self.variables)
         if use_scatter:
             # sort-free path: pillar mean/max as dense-grid scatters,
             # no (V, K) grouping (see PointPillars.from_points)
             heads = self.model.apply(
-                self.variables, points, count, train=False,
+                variables, points, count, train=False,
                 method=self.model.from_points,
             )
         else:
             vox = voxelize(points, count, self.model.cfg.voxel)
             heads = self.model.apply(
-                self.variables,
+                variables,
                 vox["voxels"][None],
                 vox["num_points_per_voxel"][None],
                 vox["coords"][None],
                 train=False,
             )
+        # keep-list boundary: box decode and NMS scoring below run in
+        # f32 regardless of the model compute dtype
+        heads = self.precision.boundary(heads)
         if hasattr(self.model, "decode_topk"):
             # Fast path: gate + top-k on raw logits BEFORE box decode —
             # only pre_max boxes are ever decoded (see decode_topk).
@@ -288,7 +313,9 @@ def build_pointpillars_pipeline(
     config: Detect3DConfig | None = None,
     variables=None,
     dtype: jnp.dtype = jnp.float32,
+    precision: PrecisionPolicy | str | None = None,
 ) -> tuple[Detect3DPipeline, ModelSpec, dict]:
+    policy, dtype = resolve_policy(precision, dtype)
     model_cfg = model_cfg or PointPillarsConfig()
     if variables is None:
         model, variables = init_pointpillars(
@@ -296,9 +323,16 @@ def build_pointpillars_pipeline(
         )
     else:
         model = PointPillars(model_cfg, dtype=dtype)
+    # pipeline serves the cast tree; the UNCAST tree returns as the
+    # weight-loading template (disk_repository)
+    cast_vars = policy.cast_params(variables)
     cfg = config or Detect3DConfig()
-    pipeline = Detect3DPipeline(cfg, model, variables)
-    return pipeline, _detect3d_spec(cfg, model_cfg), variables
+    pipeline = Detect3DPipeline(cfg, model, cast_vars, precision=policy)
+    spec = _detect3d_spec(cfg, model_cfg)
+    spec.extra.update(
+        pipeline.precision.spec_extra(cast_vars, KEEP_F32_3D)
+    )
+    return pipeline, spec, variables
 
 
 def build_second_pipeline(
@@ -307,6 +341,7 @@ def build_second_pipeline(
     config: Detect3DConfig | None = None,
     variables=None,
     dtype: jnp.dtype = jnp.float32,
+    precision: PrecisionPolicy | str | None = None,
 ) -> tuple[Detect3DPipeline, ModelSpec, dict]:
     """SECOND-IoU over the same seam as PointPillars (the reference
     serves both from the same Triton python backend shape,
@@ -314,6 +349,7 @@ def build_second_pipeline(
     apply/decode surfaces."""
     from triton_client_tpu.models.second import SECONDConfig, SECONDIoU, init_second
 
+    policy, dtype = resolve_policy(precision, dtype)
     model_cfg = model_cfg or SECONDConfig()
     if variables is None:
         model, variables = init_second(
@@ -321,9 +357,13 @@ def build_second_pipeline(
         )
     else:
         model = SECONDIoU(model_cfg, dtype=dtype)
+    cast_vars = policy.cast_params(variables)
     cfg = config or Detect3DConfig(model_name="second_iou")
-    pipeline = Detect3DPipeline(cfg, model, variables)
+    pipeline = Detect3DPipeline(cfg, model, cast_vars, precision=policy)
     spec = _detect3d_spec(cfg, model_cfg, {"iou_alpha": model_cfg.iou_alpha})
+    spec.extra.update(
+        pipeline.precision.spec_extra(cast_vars, KEEP_F32_3D)
+    )
     return pipeline, spec, variables
 
 
@@ -333,6 +373,7 @@ def build_centerpoint_pipeline(
     config: Detect3DConfig | None = None,
     variables=None,
     dtype: jnp.dtype = jnp.float32,
+    precision: PrecisionPolicy | str | None = None,
 ) -> tuple[Detect3DPipeline, ModelSpec, dict]:
     """CenterPoint-pillar, nuScenes config (the reference's det3d path,
     clients/preprocess/voxelize.py + data/nusc_centerpoint_pp...py).
@@ -348,6 +389,7 @@ def build_centerpoint_pipeline(
         init_centerpoint,
     )
 
+    policy, dtype = resolve_policy(precision, dtype)
     model_cfg = model_cfg or CenterPointConfig()
     if variables is None:
         model, variables = init_centerpoint(
@@ -361,8 +403,12 @@ def build_centerpoint_pipeline(
     # predictions (pred_labels range over model_cfg.class_names).
     if tuple(cfg.class_names) != tuple(model_cfg.class_names):
         cfg = dataclasses.replace(cfg, class_names=tuple(model_cfg.class_names))
-    pipeline = Detect3DPipeline(cfg, model, variables)
+    cast_vars = policy.cast_params(variables)
+    pipeline = Detect3DPipeline(cfg, model, cast_vars, precision=policy)
     spec = _detect3d_spec(cfg, model_cfg, {"with_velocity": model_cfg.with_velocity})
+    spec.extra.update(
+        pipeline.precision.spec_extra(cast_vars, KEEP_F32_3D)
+    )
     return pipeline, spec, variables
 
 

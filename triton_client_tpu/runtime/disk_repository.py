@@ -128,6 +128,19 @@ def _build_2d(family: str, doc: Mapping[str, Any], model_dir: pathlib.Path):
         from triton_client_tpu.config import parse_compute_dtype
 
         model_kwargs["dtype"] = parse_compute_dtype(model_kwargs["dtype"])
+    if "precision" in model_kwargs:
+        # validate at scan time so a typo'd policy fails at startup,
+        # not at first inference (fail-loudly policy)
+        from triton_client_tpu.runtime.precision import PrecisionPolicy
+
+        model_kwargs["precision"] = PrecisionPolicy.parse(
+            model_kwargs["precision"]
+        )
+
+    if family == "preprocess":
+        # paramless host-prep pipeline: nothing to cast/quantize, so a
+        # repository-wide --precision override passes it by
+        model_kwargs.pop("precision", None)
 
     pipe_d = dict(doc.get("pipeline", {}))
     names_file = pipe_d.pop("class_names_file", None)
@@ -168,8 +181,10 @@ def _build_3d(family: str, doc: Mapping[str, Any], model_dir: pathlib.Path):
     builders = detect3d.BUILDERS_3D
     model_doc = dict(doc.get("model", {}))
     from triton_client_tpu.config import parse_compute_dtype
+    from triton_client_tpu.runtime.precision import PrecisionPolicy
 
     dtype = parse_compute_dtype(model_doc.pop("dtype", "fp32"))
+    precision = PrecisionPolicy.parse(model_doc.pop("precision", None))
     if "dataset" in doc:
         got_family, model_cfg, pipe_cfg = detect3d_from_yaml(
             _resolve(doc["dataset"], model_dir)
@@ -189,7 +204,7 @@ def _build_3d(family: str, doc: Mapping[str, Any], model_dir: pathlib.Path):
     def build(variables=None, config=pipe_cfg):
         return builders[family](
             rng=jax.random.PRNGKey(0), model_cfg=model_cfg, config=config,
-            variables=variables, dtype=dtype,
+            variables=variables, dtype=dtype, precision=precision,
         )
 
     return build, lambda _default: pipe_cfg
@@ -206,11 +221,19 @@ class _Entry:
         self,
         model_dir: str | pathlib.Path,
         doc: Mapping[str, Any] | None = None,
+        precision: str | None = None,
     ) -> None:
         self.model_dir = pathlib.Path(model_dir)
         if doc is None:
             doc = load_yaml(str(self.model_dir / "config.yaml"))
         doc = dict(doc)
+        if precision:
+            # serve --precision: a repository-wide override of each
+            # entry's config.yaml model.precision (both select the same
+            # policy machinery, runtime/precision.py)
+            doc["model"] = {
+                **dict(doc.get("model", {})), "precision": precision,
+            }
         unknown = set(doc) - _TOP_KEYS
         if unknown:
             raise KeyError(
@@ -279,6 +302,9 @@ class _Entry:
                 if hasattr(pipeline, "device_fn")
                 else None
             ),
+            # the serving channels read the policy off the registered
+            # model for the wire half (host narrowing + int8 ingest)
+            precision=getattr(pipeline, "precision", None),
         )
 
 
@@ -381,6 +407,7 @@ def find_weights(version_dir: pathlib.Path) -> pathlib.Path:
 def scan_disk(
     root: str | pathlib.Path,
     repository: ModelRepository | None = None,
+    precision: str | None = None,
 ) -> ModelRepository:
     """Load every ``<root>/<model>/config.yaml`` entry into a repository.
 
@@ -390,7 +417,8 @@ def scan_disk(
     compiles at scan time; every model also carries a warmup callable
     for serve --warmup. Broken entries raise — a serving process should
     fail loudly at startup, not skip models (the reference's Triton does
-    the same for malformed config.pbtxt).
+    the same for malformed config.pbtxt). ``precision`` overrides every
+    entry's ``model.precision`` policy (the serve --precision flag).
     """
     root = pathlib.Path(root)
     repo = repository or ModelRepository()
@@ -402,9 +430,11 @@ def scan_disk(
         doc = dict(load_yaml(str(model_dir / "config.yaml")))
         if doc.get("family") == "ensemble":
             # composed over member models — register after them all
+            # (steps inherit their members' precision, or override per
+            # stage via a step-level ``precision`` key)
             ensembles.append((model_dir, doc))
             continue
-        entry = _Entry(model_dir, doc=doc)
+        entry = _Entry(model_dir, doc=doc, precision=precision)
         versions = version_dirs(model_dir)
         pairs = (
             [(v.name, find_weights(v)) for v in versions]
@@ -415,7 +445,7 @@ def scan_disk(
             rm = entry.registered(version, weights)
             repo.register(
                 rm.spec, rm.infer_fn, warmup=rm.warmup,
-                device_fn=rm.device_fn,
+                device_fn=rm.device_fn, precision=rm.precision,
             )
             if entry.doc.get("warmup"):
                 rm.warmup()

@@ -63,13 +63,27 @@ class EnsembleStep:
     input_map: Mapping[str, str]
     output_map: Mapping[str, str]
     version: str = ""
+    # serving precision for THIS stage (runtime/precision.py policy
+    # name). "" inherits the member's registered policy; an explicit
+    # value casts the stage's pool inputs to that policy's compute
+    # dtype on the device paths (a bf16 stage consumes upstream f32
+    # intermediates as bf16 without a host round-trip). Weight format
+    # is fixed at member registration — a step override only moves the
+    # stage-boundary activation dtype.
+    precision: str = ""
+
+
+# step-level precision values accepted by parse_steps ("" = inherit).
+_STEP_PRECISIONS = ("", "f32", "bf16", "int8w", "int8")
 
 
 def parse_steps(doc_steps: Sequence[Mapping]) -> list[EnsembleStep]:
     steps = []
     for i, d in enumerate(doc_steps):
         d = dict(d)
-        unknown = set(d) - {"model", "version", "input_map", "output_map"}
+        unknown = set(d) - {
+            "model", "version", "input_map", "output_map", "precision",
+        }
         if unknown:
             raise KeyError(
                 f"ensemble step {i}: unknown keys {sorted(unknown)}"
@@ -77,12 +91,19 @@ def parse_steps(doc_steps: Sequence[Mapping]) -> list[EnsembleStep]:
         for key in ("model", "input_map", "output_map"):
             if key not in d:
                 raise KeyError(f"ensemble step {i}: missing '{key}'")
+        precision = str(d.get("precision", ""))
+        if precision not in _STEP_PRECISIONS:
+            raise ValueError(
+                f"ensemble step {i}: precision must be one of "
+                f"{[p for p in _STEP_PRECISIONS if p]} (got {precision!r})"
+            )
         steps.append(
             EnsembleStep(
                 model=str(d["model"]),
                 version=str(d.get("version", "")),
                 input_map=dict(d["input_map"]),
                 output_map=dict(d["output_map"]),
+                precision=precision,
             )
         )
     if not steps:
@@ -181,6 +202,12 @@ def build_ensemble(
 
     step_list = list(zip(steps, members))
     output_names = tuple(outputs)
+    # effective per-stage precision: an explicit step key overrides,
+    # "" inherits whatever policy the member registered with (round 10)
+    step_precision = [
+        s.precision or str(m.spec.extra.get("precision", "f32"))
+        for s, m in step_list
+    ]
 
     if fuse not in ("auto", "always", "never"):
         raise ValueError(
@@ -223,6 +250,10 @@ def build_ensemble(
             "data_path": (
                 "fused" if fused else "device-resident" if mixed else "host"
             ),
+            # effective (post-inheritance) policy per stage, in step
+            # order; the ensemble's own wire stays f32 — outputs cast
+            # back at the boundary like any other pipeline
+            "step_precision": step_precision,
         },
     )
 
@@ -239,6 +270,23 @@ def build_ensemble(
         return {o: pool[o] for o in output_names}
 
     ensemble_device_fn = None
+    if fused or mixed:
+        import jax.numpy as jnp
+
+        from triton_client_tpu.runtime.precision import PrecisionPolicy
+
+        # per-stage activation dtype at the step boundary (bf16 stages
+        # take bf16 intermediates; everything else stays f32). Integer
+        # tensors (num_points, labels) pass through untouched.
+        _step_dtype = [
+            PrecisionPolicy.parse(p).compute_dtype for p in step_precision
+        ]
+
+        def _stage_cast(x, dt):
+            if jnp.issubdtype(x.dtype, jnp.floating) and x.dtype != dt:
+                return x.astype(dt)
+            return x
+
     if fused:
         import jax
         import jax.numpy as jnp
@@ -254,10 +302,10 @@ def build_ensemble(
             # PARENT ensemble can compose this ensemble as a member
             # (nested fusion) under its own jit.
             pool = dict(pool_in)
-            for step, member in step_list:
+            for (step, member), dt in zip(step_list, _step_dtype):
                 result = member.device_fn(
                     {
-                        step_in: pool[pool_name]
+                        step_in: _stage_cast(pool[pool_name], dt)
                         for step_in, pool_name in step.input_map.items()
                     }
                 )
@@ -314,7 +362,10 @@ def build_ensemble(
                 jitted = member_jit.get(i)
                 if jitted is not None:
                     result = jitted(
-                        {k: jnp.asarray(v) for k, v in step_inputs.items()}
+                        {
+                            k: _stage_cast(jnp.asarray(v), _step_dtype[i])
+                            for k, v in step_inputs.items()
+                        }
                     )
                 else:
                     result = member.infer_fn(

@@ -52,11 +52,22 @@ def bucket_for(n: int, multiple: int = 1) -> int:
 
 
 def pad_rows(parts: list[np.ndarray], pad: int) -> list[np.ndarray]:
-    """Append ``pad`` replicated rows (copies of the first part's first
-    row) to a list of batch fragments about to be concatenated."""
+    """Append ``pad`` replicated rows (copies of the first non-empty
+    part's first row) to a list of batch fragments about to be
+    concatenated.
+
+    Replicating from a 0-row fragment would contribute ``0`` pad rows
+    (``empty[:1]`` is empty) and the concatenated batch silently
+    under-pads — a shape-mismatch launch downstream. An all-empty
+    fragment list has no real row to copy, so it zero-fills."""
     if pad <= 0:
         return parts
-    return list(parts) + [np.repeat(parts[0][:1], pad, axis=0)]
+    for p in parts:
+        if p.shape[0]:
+            return list(parts) + [np.repeat(p[:1], pad, axis=0)]
+    return list(parts) + [
+        np.zeros((pad, *parts[0].shape[1:]), parts[0].dtype)
+    ]
 
 
 def pad_batch(arr: np.ndarray, target: int) -> np.ndarray:

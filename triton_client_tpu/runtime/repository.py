@@ -55,6 +55,13 @@ class RegisteredModel:
     # host constants per executable. None keeps the closure-captured
     # convention every in-tree pipeline uses today.
     params: object | None = None
+    # Optional serving PrecisionPolicy (runtime/precision.py), applied
+    # at registration: the builder already cast/quantized the param
+    # tree; the serving channels consult this for the WIRE half of the
+    # policy (host-side narrowing in staged.cast_wire_input, int8
+    # dequant inside the cached launcher). None serves the legacy f32
+    # wire unchanged.
+    precision: object | None = None
 
 
 class ModelRepository:
@@ -71,10 +78,11 @@ class ModelRepository:
         warmup: Callable[[], None] | None = None,
         device_fn: InferFn | None = None,
         params: object | None = None,
+        precision: object | None = None,
     ) -> None:
         with self._lock:
             self._models.setdefault(spec.name, {})[spec.version] = RegisteredModel(
-                spec, infer_fn, warmup, device_fn, params
+                spec, infer_fn, warmup, device_fn, params, precision
             )
 
     def unregister(self, name: str, version: str = "") -> None:

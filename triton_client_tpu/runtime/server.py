@@ -509,7 +509,8 @@ class InferenceServer:
                     capacity=trace_capacity, profiler=profiler
                 )
             self.collector = RuntimeCollector(
-                channel=channel, tracer=self.tracer, registry=registry
+                channel=channel, tracer=self.tracer, registry=registry,
+                repository=repository,
             )
             try:
                 from triton_client_tpu.obs.http import TelemetryServer
