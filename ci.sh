@@ -55,6 +55,16 @@ python -m pytest tests/test_precision.py -q \
     --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly
 
+echo "== SLO observability shard (histograms, deadlines, open-loop) =="
+# the tail-latency contract (obs/histogram.py, obs/slo.py, open-loop
+# loadgen): quantile accuracy, CO-safe percentiles, deadline scoring,
+# violator export — named by its shard so an SLO-ring regression is
+# visible before the tier-1 wall. Includes the slow-marked open-loop
+# window (a ~2 s live-server drive) tier-1 deselects.
+python -m pytest tests/test_slo.py -q -m '' \
+    --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+
 echo "== tier-1 pytest =="
 exec python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors \

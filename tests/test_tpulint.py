@@ -451,6 +451,53 @@ class TestTelemetryRules:
         )
         assert lint_source(src, codes=["TPL502"]) == []
 
+    def test_slo_observe_outside_finally_positive(self):
+        # the classic miss: score only on the happy path — exceptions
+        # return unscored and the missed counter undercounts
+        src = (
+            "def issue(self, model, t0):\n"
+            "    result = dispatch()\n"
+            "    self._slo.observe_request(model, wall_s=now() - t0)\n"
+            "    return result\n"
+        )
+        found = lint_source(src, codes=["TPL503"])
+        assert len(found) == 1 and "finally" in found[0].message
+
+    def test_slo_observe_in_finally_negative(self):
+        src = (
+            "def issue(self, model, t0):\n"
+            "    try:\n"
+            "        return dispatch()\n"
+            "    finally:\n"
+            "        self._slo.observe_request(model, wall_s=now() - t0)\n"
+        )
+        assert lint_source(src, codes=["TPL503"]) == []
+
+    def test_slo_observe_via_helper_called_in_finally(self):
+        # the server.py shape: _account() holds the observe and is
+        # invoked from the finisher's finally
+        src = (
+            "def finish(self):\n"
+            "    try:\n"
+            "        return result()\n"
+            "    finally:\n"
+            "        self._account()\n"
+            "def _account(self):\n"
+            "    self._slo.observe_request('m', wall_s=1.0)\n"
+        )
+        assert lint_source(src, codes=["TPL503"]) == []
+
+    def test_slo_observe_definer_module_skipped(self):
+        # obs/slo.py defines observe_request; its own body is exempt
+        src = (
+            "class SLOTracker:\n"
+            "    def observe_request(self, model, wall_s):\n"
+            "        self.met += 1\n"
+            "def helper(t):\n"
+            "    t.observe_request('m', wall_s=1.0)\n"
+        )
+        assert lint_source(src, codes=["TPL503"]) == []
+
     def test_pragma_suppresses(self):
         src = (
             "def issue(trace):\n"

@@ -13,6 +13,11 @@ fiction. Both bugs are structural:
           a ``finally`` block nor inside a function that is itself
           called from a ``finally`` — an exception between the two
           leaks the gauge upward forever.
+  TPL503  an SLO scoring call (``observe_request``) that is neither
+          inside a ``finally`` block nor inside a function a
+          ``finally`` calls — error paths return unscored, so the
+          met/missed counters undercount exactly the requests most
+          likely to have missed.
 
 Pairs are matched by convention: (``begin``/``end``), (``inc``/``dec``),
 (``request_started``/``request_finished``), (``acquire``/``release`` is
@@ -164,6 +169,72 @@ class GaugeLeakRule(Rule):
                         f"`{inc_name}()` has no `{dec_name}()` reachable "
                         "from a `finally` in this module (gauge leaks on "
                         "exceptions)",
+                        context=_ctx_of(module, node, contexts),
+                    )
+
+
+@register
+class SLOExitPathRule(Rule):
+    code = "TPL503"
+    name = "slo-observe-not-on-exit-path"
+    doc = (
+        "An SLO scoring call (`observe_request`) is not in a `finally` "
+        "block and not in a helper that a `finally` calls — exception "
+        "paths return unscored and the met/missed counters undercount "
+        "the requests most likely to have missed."
+    )
+
+    def check(self, package: Package) -> Iterator[Finding]:
+        for module in package.modules:
+            defines = {
+                node.name
+                for node in ast.walk(module.tree)
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            }
+            if "observe_request" in defines:
+                # the tracker itself (obs/slo.py) defines the method;
+                # its body is the counter's own contract, not a caller
+                continue
+            contexts = qualname_contexts(module.tree)
+            in_finally: set[int] = set()
+            finally_calls: set[str] = set()
+            for node in ast.walk(module.tree):
+                if isinstance(node, ast.Try) and node.finalbody:
+                    for stmt in node.finalbody:
+                        for sub in ast.walk(stmt):
+                            if not isinstance(sub, ast.Call):
+                                continue
+                            in_finally.add(id(sub))
+                            if isinstance(sub.func, ast.Attribute):
+                                finally_calls.add(sub.func.attr)
+                            elif isinstance(sub.func, ast.Name):
+                                finally_calls.add(sub.func.id)
+            fn_spans = [
+                (fn.name, fn.lineno, getattr(fn, "end_lineno", fn.lineno))
+                for fn in ast.walk(module.tree)
+                if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+            ]
+            for node in ast.walk(module.tree):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "observe_request"
+                ):
+                    continue
+                line = node.lineno
+                enclosing = {
+                    name for name, lo, hi in fn_spans if lo <= line <= hi
+                }
+                ok = id(node) in in_finally or bool(
+                    enclosing & finally_calls
+                )
+                if not ok:
+                    yield self.finding(
+                        module,
+                        node,
+                        "`observe_request()` is not reachable from a "
+                        "`finally` in this module (error exits go "
+                        "unscored; SLO counters undercount misses)",
                         context=_ctx_of(module, node, contexts),
                     )
 

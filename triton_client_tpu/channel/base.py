@@ -33,6 +33,17 @@ class InferRequest:
     trace: object | None = dataclasses.field(
         default=None, repr=False, compare=False
     )
+    # SLO deadline plane (obs.slo.SLOTracker): the absolute
+    # perf_counter deadline stamped at admission, carried through the
+    # batcher (a merged group takes the min of its members') to the
+    # staged launchers, which count launches past it. None = no SLO.
+    deadline_s: float | None = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
+    # scheduling/reporting class: attainment counters split on it, and
+    # the continuous-batching scheduler (ROADMAP item 1) will order on
+    # it. Higher = more important.
+    priority: int = dataclasses.field(default=0, repr=False, compare=False)
 
 
 @dataclasses.dataclass

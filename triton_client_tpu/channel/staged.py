@@ -156,6 +156,11 @@ class StagedChannel(BaseChannel):
             "launched": 0,
             "donated_launches": 0,
             "stage_slot_waits": 0,
+            # launches whose request deadline (obs.slo deadline plane)
+            # had already passed at enqueue time: sustained growth means
+            # the queue ahead of the device eats the whole SLO budget —
+            # the capacity-search saturation signal, visible live
+            "deadline_expired_launches": 0,
         }
         # (name, version) -> (model identity, launcher, donate_names,
         # output wire dtypes); rebuilt when the repository reloads the
@@ -391,11 +396,14 @@ class StagedChannel(BaseChannel):
         t_launched = time.perf_counter()
         if tr is not None:
             tr.add("launch", t0, t_launched)
+        deadline = request.deadline_s
         with self._slot_cv:
             self._inflight.append(rec)
             self._stats["launched"] += 1
             if donate_names:
                 self._stats["donated_launches"] += 1
+            if deadline is not None and t_launched > deadline:
+                self._stats["deadline_expired_launches"] += 1
             self._slot_occupancy[len(self._inflight)] += 1
 
         def resolve() -> InferResponse:

@@ -83,6 +83,18 @@ def main(argv=None) -> None:
         "(`trace-dump`); 0 disables request-scoped spans",
     )
     p.add_argument(
+        "--slo-ms", type=float, default=0.0,
+        help="per-request latency SLO: requests are deadline-stamped at "
+        "admission and scored met/missed per model+priority "
+        "(tpu_serving_slo_requests_total); violating traces export at "
+        "/traces?slo_violations=1. 0 disables scoring (latency "
+        "histograms still export). Requires --metrics-port.",
+    )
+    p.add_argument(
+        "--slo-tail-capacity", type=int, default=64,
+        help="bounded ring of SLO-violating / p99+ exemplar traces",
+    )
+    p.add_argument(
         "--warmup", action="store_true",
         help="compile every registered model before accepting requests",
     )
@@ -171,6 +183,8 @@ def build_server(args):
         max_workers=args.max_workers,
         metrics_port=args.metrics_port,
         trace_capacity=getattr(args, "trace_capacity", 256),
+        slo_ms=getattr(args, "slo_ms", 0.0),
+        slo_tail_capacity=getattr(args, "slo_tail_capacity", 64),
     )
 
 

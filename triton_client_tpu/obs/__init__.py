@@ -31,16 +31,30 @@ from triton_client_tpu.obs.collector import (
     CompileEvents,
     RuntimeCollector,
 )
+from triton_client_tpu.obs.histogram import (
+    DEFAULT_BUCKETS,
+    SLO_STAGES,
+    HistogramFamily,
+    LatencyHistogram,
+    quantile_from_snapshot,
+)
 from triton_client_tpu.obs.http import TelemetryServer
+from triton_client_tpu.obs.slo import SLOTracker
 
 __all__ = [
+    "DEFAULT_BUCKETS",
     "METRIC_TYPES",
+    "SLO_STAGES",
     "CompileEvents",
+    "HistogramFamily",
+    "LatencyHistogram",
     "MultiTrace",
     "RequestTrace",
     "RuntimeCollector",
+    "SLOTracker",
     "Span",
     "TelemetryServer",
     "Tracer",
     "chrome_trace",
+    "quantile_from_snapshot",
 ]

@@ -71,6 +71,14 @@ METRIC_TYPES: dict[str, str] = {
     # tracer ring buffer
     "tpu_serving_traces_finished_total": "counter",
     "tpu_serving_trace_buffered": "gauge",
+    # SLO observability ring (round 11): per model x stage latency
+    # histograms fed from finished trace spans, attainment counters per
+    # (model, priority, outcome), the tail-exemplar ring depth, and
+    # launches whose request deadline had already expired at launch time
+    "tpu_serving_latency_seconds": "histogram",
+    "tpu_serving_slo_requests_total": "counter",
+    "tpu_serving_slo_tail_buffered": "gauge",
+    "tpu_serving_deadline_expired_launches_total": "counter",
 }
 
 _HBM_KINDS = ("bytes_in_use", "bytes_limit", "peak_bytes_in_use")
@@ -155,10 +163,19 @@ class RuntimeCollector:
         namespace: str = "tpu_serving",
         registry=None,
         repository=None,
+        histograms=None,
+        slo=None,
     ) -> None:
+        """``histograms``: an obs.histogram.HistogramFamily of per
+        (model, stage) latency histograms; ``slo``: an obs.slo.
+        SLOTracker. Both optional — their metric families export empty
+        (HELP/TYPE only) when absent, so the family inventory test
+        keeps pinning the series names either way."""
         self._batching, self._tpu = _split_channel(channel)
         self._tracer = tracer
         self._repository = repository
+        self._histograms = histograms
+        self._slo = slo
         self._ns = namespace
         self._compile = CompileEvents.install()
         self._lock = threading.Lock()
@@ -202,6 +219,15 @@ class RuntimeCollector:
         }
         if self._tracer is not None:
             snap["tracer"] = self._tracer.stats()
+        if self._histograms is not None:
+            # numeric-leaved per-(model|stage) bucket counts + sum:
+            # delta() of two snapshots is the WINDOW's histogram, and
+            # obs.histogram.quantile_from_snapshot reads percentiles
+            # off either form — perf scripts get p99 through the same
+            # path as every counter
+            snap["histograms"] = self._histograms.snapshot()
+        if self._slo is not None:
+            snap["slo"] = self._slo.stats()
         models = self._models()
         if models is not None:
             snap["models"] = models
@@ -290,6 +316,7 @@ class RuntimeCollector:
         from prometheus_client.core import (
             CounterMetricFamily,
             GaugeMetricFamily,
+            HistogramMetricFamily,
         )
 
         snap = self.snapshot()
@@ -489,6 +516,52 @@ class RuntimeCollector:
             f"{ns}_trace_buffered",
             "request traces held in the export ring buffer",
             tr.get("buffered", 0),
+        )
+
+        # SLO observability ring: per model x stage latency histograms
+        # (fed from finished trace spans) and attainment counters. The
+        # families export even when the components are absent so the
+        # series names stay pinned by the telemetry smoke test.
+        lat = HistogramMetricFamily(
+            f"{ns}_latency_seconds",
+            "request latency per model and pipeline stage "
+            "(queue_delay/merge_wait/device_execute/readback/e2e)",
+            labels=["model", "stage"],
+        )
+        for key, h in (snap.get("histograms") or {}).items():
+            model, _, stage = key.partition("|")
+            cum, cum_buckets = 0, []
+            for bound, c in sorted(
+                (float(b), n)
+                for b, n in h["buckets"].items()
+                if b != "inf"
+            ):
+                cum += c
+                cum_buckets.append((repr(bound), cum))
+            cum_buckets.append(("+Inf", h["count"]))
+            lat.add_metric([model, stage], cum_buckets, h["sum"])
+        yield lat
+        slo = snap.get("slo") or {}
+        yield counter(
+            f"{ns}_slo_requests_total",
+            "requests scored against their latency SLO, by outcome",
+            0,
+            labels=["model", "priority", "outcome"],
+            samples=[
+                (key.split("|", 1) + [outcome], cell[outcome])
+                for key, cell in (slo.get("requests") or {}).items()
+                for outcome in ("met", "missed")
+            ],
+        )
+        yield gauge(
+            f"{ns}_slo_tail_buffered",
+            "SLO-violating / p99+ exemplar traces held in the tail ring",
+            slo.get("tail_buffered", 0),
+        )
+        yield counter(
+            f"{ns}_deadline_expired_launches_total",
+            "batches launched after their request deadline had passed",
+            chan.get("deadline_expired_launches", 0),
         )
 
         # device HBM (absent on backends without memory_stats)

@@ -8,10 +8,14 @@ traces and the raw collector snapshot:
   GET /metrics   Prometheus exposition of the server's registry
   GET /traces    Chrome-trace JSON of the tracer ring buffer
                  (?n=K limits to the K most recent; load in Perfetto)
+                 (?slo_violations=1 serves the SLO tail-sampler ring
+                 instead: only exemplars that missed their deadline or
+                 landed at/above the live per-model p99)
   GET /snapshot  RuntimeCollector.snapshot() as JSON (debug/automation)
 
 Paths degrade independently: without prometheus_client /metrics is 503
-but traces still export; without a tracer /traces is 404.
+but traces still export; without a tracer /traces is 404 (and without
+an SLO tracker, ?slo_violations=1 is 404).
 """
 
 from __future__ import annotations
@@ -36,10 +40,12 @@ class TelemetryServer:
         tracer=None,
         collector=None,
         host: str = "0.0.0.0",
+        slo=None,
     ) -> None:
         self._registry = registry
         self._tracer = tracer
         self._collector = collector
+        self._slo = slo
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -83,15 +89,24 @@ class TelemetryServer:
             body = prometheus_client.generate_latest(self._registry)
             self._send(req, 200, body, prometheus_client.CONTENT_TYPE_LATEST)
         elif path in ("/traces", "/trace"):
-            if self._tracer is None:
-                self._send(req, 404, b"tracing disabled\n")
-                return
             q = parse_qs(parsed.query)
             try:
                 n = int(q.get("n", ["0"])[0])
             except ValueError:
                 n = 0
-            body = json.dumps(self._tracer.chrome_trace(n)).encode()
+            if q.get("slo_violations", ["0"])[0] not in ("0", ""):
+                if self._slo is None:
+                    self._send(req, 404, b"slo tracking disabled\n")
+                    return
+                from triton_client_tpu.obs.trace import chrome_trace
+
+                payload = chrome_trace(self._slo.violations(n))
+            elif self._tracer is None:
+                self._send(req, 404, b"tracing disabled\n")
+                return
+            else:
+                payload = self._tracer.chrome_trace(n)
+            body = json.dumps(payload).encode()
             self._send(req, 200, body, "application/json")
         elif path == "/snapshot":
             if self._collector is None:

@@ -199,6 +199,13 @@ class Tracer:
     StageProfiler) receives each finished span as a ``span_<name>``
     stage sample, which the Prometheus stage-histogram family exports —
     per-stage span histograms under the existing ``stage`` label.
+
+    ``histograms`` (an obs.histogram.HistogramFamily) additionally
+    receives per-model SLO-stage samples at finish: each span named in
+    ``SLO_STAGES`` lands as (model, stage), and the whole request wall
+    lands as (model, "e2e") — the single feed point for the
+    ``tpu_serving_latency_seconds`` family, riding the spans the
+    pipeline already records instead of new instrumentation.
     """
 
     def __init__(
@@ -206,10 +213,12 @@ class Tracer:
         enabled: bool = True,
         capacity: int = 256,
         profiler=None,
+        histograms=None,
     ) -> None:
         self.enabled = bool(enabled) and capacity > 0
         self.capacity = int(capacity)
         self._profiler = profiler
+        self._histograms = histograms
         self._ring: collections.deque[RequestTrace] = collections.deque(
             maxlen=max(1, self.capacity)
         )
@@ -233,6 +242,17 @@ class Tracer:
         if self._profiler is not None:
             for s in list(trace.spans):
                 self._profiler.record(f"span_{s.name}", s.duration_s)
+        if self._histograms is not None:
+            from triton_client_tpu.obs.histogram import SLO_STAGES
+
+            model = trace.model or ""
+            for s in list(trace.spans):
+                stage = SLO_STAGES.get(s.name)
+                if stage is not None:
+                    self._histograms.observe(model, stage, s.duration_s)
+            self._histograms.observe(
+                model, "e2e", trace.t_end - trace.t_start
+            )
 
     def recent(self, n: int = 0) -> list[RequestTrace]:
         """Most recent ``n`` finished traces (0 = everything buffered),

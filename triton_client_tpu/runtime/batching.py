@@ -355,6 +355,14 @@ class BatchingChannel(BaseChannel):
                     self._decomp["queue_wait_s"] += t_run - min(
                         it[4] for it in g
                     )
+                    # PER-MEMBER queue delay, not just the merged
+                    # batch's (which MultiTrace would fan out as one
+                    # shared number): each member's own staging
+                    # timestamp to this dispatch
+                    self._decomp["members"] += len(g)
+                    self._decomp["member_wait_s"] += sum(
+                        t_run - it[4] for it in g
+                    )
                 # the slot frees the moment the group LAUNCHES (inputs
                 # staged, compute enqueued on the inner channel) — the
                 # dispatcher can then form the next batch against
@@ -374,8 +382,11 @@ class BatchingChannel(BaseChannel):
                     self._inflight.release()
 
                 try:
+                    # (t_staged, request, future): the staging timestamp
+                    # rides along so each member gets its own merge_wait
+                    # span (staged -> this group's dispatch)
                     self._run_group(
-                        [(None, it[2], it[3]) for it in g], free_slot
+                        [(it[4], it[2], it[3]) for it in g], free_slot
                     )
                 except Exception as e:
                     # No exception may escape: an unresolved future
@@ -432,12 +443,20 @@ class BatchingChannel(BaseChannel):
         launched — inputs staged, compute enqueued — so the dispatcher
         slot frees before the readback/split work."""
         if len(group) == 1 and not self._pad_to_buckets:
-            _, request, future = group[0]
-            self._run_solo(request, future, free_slot)
+            t_staged, request, future = group[0]
+            self._run_solo(request, future, free_slot, t_staged=t_staged)
             return
         requests = [g[1] for g in group]
         futures = [g[2] for g in group]
         traces = [r.trace for r in requests]
+        t_dispatch = time.perf_counter()
+        for (t_staged, r, _f) in group:
+            if r.trace is not None and t_staged is not None:
+                # per-member ready-queue residence: own staging
+                # timestamp -> this group's dispatch (the merge_wait
+                # SLO stage; batch_queue still covers the whole
+                # admission+queue+slot window around it)
+                r.trace.add("merge_wait", t_staged, t_dispatch)
         for tr in traces:
             if tr is not None:
                 tr.end("batch_queue")
@@ -480,6 +499,9 @@ class BatchingChannel(BaseChannel):
                 # merged batch and enqueued the compute — the slot can
                 # free NOW; result() below pays the device wait +
                 # host copy outside the permit
+                deadlines = [
+                    r.deadline_s for r in requests if r.deadline_s is not None
+                ]
                 fut = self._inner.do_inference_async(
                     InferRequest(
                         model_name=requests[0].model_name,
@@ -492,6 +514,11 @@ class BatchingChannel(BaseChannel):
                             if any(t is not None for t in traces)
                             else None
                         ),
+                        # the merged batch inherits its TIGHTEST
+                        # member's deadline and HIGHEST priority: the
+                        # batch is late the moment any member is
+                        deadline_s=min(deadlines) if deadlines else None,
+                        priority=max(r.priority for r in requests),
                     )
                 )
                 if free_slot is not None:
@@ -591,8 +618,16 @@ class BatchingChannel(BaseChannel):
                     return out
         return np.concatenate(parts)
 
-    def _run_solo(self, request: InferRequest, future, free_slot=None) -> None:
+    def _run_solo(
+        self, request: InferRequest, future, free_slot=None, t_staged=None
+    ) -> None:
         if request.trace is not None:
+            if t_staged is not None:
+                # solo dispatches report merge_wait too (a group of
+                # one), so queue-delay attribution covers every path;
+                # None on the merged-failure retry path, whose wait was
+                # already recorded by the group dispatch
+                request.trace.add("merge_wait", t_staged, time.perf_counter())
             request.trace.end("batch_queue")  # no-op on the retry path
         try:
             fut = self._inner.do_inference_async(request)
@@ -629,6 +664,15 @@ class BatchingChannel(BaseChannel):
                     )
                 }
                 out["decomp_batches"] = int(n)
+            members = self._decomp.get("members", 0.0)
+            if members:
+                # mean PER-MEMBER ready-queue wait (merge_wait), vs
+                # decomp_ms.queue_wait which is per merged batch from
+                # its earliest member
+                out["member_queue_delay_ms"] = round(
+                    self._decomp["member_wait_s"] / members * 1e3, 2
+                )
+                out["merge_members"] = int(members)
             if self._arena is not None:
                 out["arena_free_slots"] = self._arena.free_slots()
         return out

@@ -76,6 +76,7 @@ class _Servicer(service.GRPCInferenceServiceServicer):
         stream_pipeline_depth: int = 2,
         tracer=None,
         collector=None,
+        slo=None,
     ) -> None:
         self._repo = repository
         self._channel = channel
@@ -84,6 +85,7 @@ class _Servicer(service.GRPCInferenceServiceServicer):
         self._stream_depth = max(1, int(stream_pipeline_depth))
         self._tracer = tracer
         self._collector = collector
+        self._slo = slo
 
     # -- health ---------------------------------------------------------------
 
@@ -243,7 +245,13 @@ class _Servicer(service.GRPCInferenceServiceServicer):
         parse/queue/stage/launch/device/readback/encode spans; the
         per-model latency histogram sample is recorded in a finally so
         FAILING requests are measured and counted too (they previously
-        vanished from the metrics entirely)."""
+        vanished from the metrics entirely).
+
+        SLO plane: when a tracker with a budget is wired, the request's
+        absolute deadline is stamped HERE — at admission, before parse —
+        and rides the InferRequest through the batcher (a merge takes
+        the min of its members') to the staged launchers; _account
+        scores met/missed on every exit path."""
         t0 = time.perf_counter()
         trace = (
             self._tracer.start(
@@ -252,6 +260,15 @@ class _Servicer(service.GRPCInferenceServiceServicer):
             if self._tracer is not None
             else None
         )
+        deadline_s, priority = None, 0
+        if self._slo is not None:
+            deadline_s = self._slo.deadline_for(request.model_name, t0)
+            try:
+                params = request.parameters
+                if params and "priority" in params:
+                    priority = int(params["priority"].int64_param)
+            except (AttributeError, TypeError, ValueError):
+                priority = 0  # malformed parameter: never fail the request
         if self._collector is not None:
             self._collector.request_started()
         try:
@@ -273,6 +290,8 @@ class _Servicer(service.GRPCInferenceServiceServicer):
                     inputs=inputs,
                     request_id=request.id,
                     trace=trace,
+                    deadline_s=deadline_s,
+                    priority=priority,
                 )
             )
             # overlapped with device execution: shm placement parsing
@@ -285,7 +304,10 @@ class _Servicer(service.GRPCInferenceServiceServicer):
         except BaseException as e:
             # parse/dispatch failed before a finisher existed: close out
             # the request's accounting here (finish() will never run)
-            self._account(request.model_name, t0, trace, error=e)
+            self._account(
+                request.model_name, t0, trace, error=e,
+                deadline_s=deadline_s, priority=priority,
+            )
             raise
 
         def finish():
@@ -318,21 +340,41 @@ class _Servicer(service.GRPCInferenceServiceServicer):
                 error = e
                 raise
             finally:
-                self._account(request.model_name, t0, trace, error=error)
+                self._account(
+                    request.model_name, t0, trace, error=error,
+                    deadline_s=deadline_s, priority=priority,
+                )
 
         return finish
 
-    def _account(self, model_name, t0, trace, error=None) -> None:
+    def _account(
+        self, model_name, t0, trace, error=None, deadline_s=None, priority=0
+    ) -> None:
         """Per-request bookkeeping, success or failure: latency sample
         (the Triton :8002 serving-metrics role, README.md:88-95), error
         counter with a gRPC status-code label, in-flight gauge, trace
-        finish."""
+        finish, SLO attainment score. Reached from a ``finally`` on
+        every request path (tpulint TPL503 pins that), so the
+        deadline-missed and error paths are scored too."""
+        now = time.perf_counter()
         if self._tracer is not None:
             # close the trace FIRST: everything below is bookkeeping
             # that would otherwise show up as an uncovered tail on the
-            # request wall
+            # request wall. Finishing also feeds the per-(model, stage)
+            # latency histograms, so the SLO tracker's p99 tail
+            # criterion below sees this request's e2e sample.
             self._tracer.finish(
                 trace, status="ok" if error is None else _grpc_code(error)
+            )
+        if self._slo is not None:
+            self._slo.observe_request(
+                model_name,
+                wall_s=now - t0,
+                deadline_s=deadline_s,
+                priority=priority,
+                status="ok" if error is None else _grpc_code(error),
+                trace=trace,
+                now=now,
             )
         if self._profiler is not None:
             self._profiler.record(
@@ -454,6 +496,9 @@ class InferenceServer:
         metrics_port: int | str = 0,
         stream_pipeline_depth: int = 2,
         trace_capacity: int = 256,
+        slo_ms: float = 0.0,
+        slo_per_model: dict | None = None,
+        slo_tail_capacity: int = 64,
     ) -> None:
         """``metrics_port``: serve the telemetry endpoint — Prometheus
         exposition on ``/metrics`` (Triton's :8002 role), Chrome-trace
@@ -467,7 +512,15 @@ class InferenceServer:
         1 = strictly serial, the pre-round-6 behavior).
         ``trace_capacity``: bounded ring of recent request traces kept
         for export (0 disables request tracing; spans then cost one
-        attribute read per pipeline phase)."""
+        attribute read per pipeline phase).
+        ``slo_ms``: default per-request latency budget — requests are
+        deadline-stamped at admission and scored met/missed on every
+        exit path (0 = no SLO; histograms and the tail sampler's p99
+        criterion still run). ``slo_per_model`` overrides budgets per
+        model name; ``slo_tail_capacity`` bounds the ring of
+        SLO-violating / p99+ exemplar traces exported at
+        ``/traces?slo_violations=1``. The SLO ring requires
+        ``metrics_port`` (it lives on the telemetry plane)."""
         if metrics_port and profiler is None:
             from triton_client_tpu.utils.profiling import StageProfiler
 
@@ -475,6 +528,8 @@ class InferenceServer:
         self.profiler = profiler
         self.tracer = None
         self.collector = None
+        self.histograms = None
+        self.slo = None
         self.metrics_enabled = False
         self._telemetry = None
         if metrics_port:
@@ -502,15 +557,32 @@ class InferenceServer:
                     "disabled (traces still export)", metrics_port,
                 )
             from triton_client_tpu.obs.collector import RuntimeCollector
+            from triton_client_tpu.obs.histogram import HistogramFamily
+            from triton_client_tpu.obs.slo import SLOTracker
             from triton_client_tpu.obs.trace import Tracer
 
+            # the SLO ring: per-(model, stage) latency histograms fed
+            # from finished traces, and the deadline/attainment tracker
+            # whose tail sampler keeps slow-request exemplars. Built
+            # whenever telemetry is on — with no slo_ms the histograms
+            # and tail p99 criterion still run, only met/missed scoring
+            # waits for a budget.
+            self.histograms = HistogramFamily()
+            self.slo = SLOTracker(
+                slo_ms=slo_ms,
+                per_model=slo_per_model,
+                tail_capacity=slo_tail_capacity,
+                histograms=self.histograms,
+            )
             if trace_capacity > 0:
                 self.tracer = Tracer(
-                    capacity=trace_capacity, profiler=profiler
+                    capacity=trace_capacity, profiler=profiler,
+                    histograms=self.histograms,
                 )
             self.collector = RuntimeCollector(
                 channel=channel, tracer=self.tracer, registry=registry,
-                repository=repository,
+                repository=repository, histograms=self.histograms,
+                slo=self.slo,
             )
             try:
                 from triton_client_tpu.obs.http import TelemetryServer
@@ -520,6 +592,7 @@ class InferenceServer:
                     registry=registry,
                     tracer=self.tracer,
                     collector=self.collector,
+                    slo=self.slo,
                 )
                 self.metrics_enabled = registry is not None
             except OSError as e:
@@ -549,6 +622,7 @@ class InferenceServer:
                 stream_pipeline_depth=stream_pipeline_depth,
                 tracer=self.tracer,
                 collector=self.collector,
+                slo=self.slo,
             ),
             self._server,
         )

@@ -1,4 +1,4 @@
-"""Closed-loop gRPC load generator for serving benchmarks.
+"""Closed- and open-loop gRPC load generators for serving benchmarks.
 
 The role Triton's ``perf_analyzer`` plays in the reference's ecosystem
 (its README benchmarks the server with concurrent closed-loop clients):
@@ -19,6 +19,22 @@ Client lifecycle per thread:
 ``run_pool`` returns after EVERY client thread has fully exited — a
 straggler blocked on a slow request is waited out (bounded by the
 request deadline), never left running into a subsequent measurement.
+
+Open-loop mode (round 11, the MLPerf-Inference "server scenario"
+discipline): ``run_pool``'s closed loop is the wrong instrument for
+capacity questions — each client waits for its response before sending
+the next request, so when the server slows down the offered load
+politely slows down with it and queueing collapse is invisible
+(coordinated omission). ``run_open_loop`` issues requests on a SEEDED
+Poisson schedule that does not care how the server is doing: arrivals
+are pre-generated (``poisson_schedule``), the dispatcher never blocks
+on a response, and every latency is measured from the request's
+SCHEDULED arrival time — a request issued late because the dispatcher
+fell behind still charges the server for the wait. Unanswered or
+failed requests score as +Inf in the percentile math
+(``co_percentile``), so saturation reads as a blown p99, never as a
+quietly shrunk sample set. ``slo_capacity_search`` binary-searches the
+offered rate for the MLPerf headline number: max qps at p99 <= SLO.
 """
 
 from __future__ import annotations
@@ -221,3 +237,290 @@ def run_pool(
         latencies_ms=latencies,
         errors=errors,
     )
+
+
+# -- open-loop (MLPerf server-scenario) driver --------------------------------
+
+
+def poisson_schedule(
+    rate_qps: float,
+    duration_s: float,
+    seed: int = 0,
+    weights=None,
+):
+    """Seeded Poisson arrival plan: ``(offsets_s, scenario_idx)``.
+
+    ``offsets_s`` are arrival times relative to window start
+    (exponential inter-arrival gaps at ``rate_qps``); ``scenario_idx``
+    picks a traffic-mix entry per arrival, proportional to ``weights``
+    (all zeros when no mix). Pure function of its arguments — the same
+    seed replays the identical request timeline, which is what makes an
+    open-loop capacity number reproducible and the determinism test
+    possible."""
+    import numpy as np
+
+    rate = float(rate_qps)
+    if rate <= 0 or duration_s <= 0:
+        empty = np.zeros(0)
+        return empty, np.zeros(0, dtype=int)
+    rng = np.random.default_rng(int(seed))
+    offsets = np.zeros(0)
+    draw = max(16, int(rate * duration_s * 1.5) + 32)
+    last = 0.0
+    while last < duration_s:
+        gaps = rng.exponential(1.0 / rate, size=draw)
+        offsets = np.concatenate([offsets, last + np.cumsum(gaps)])
+        last = float(offsets[-1])
+    offsets = offsets[offsets < duration_s]
+    if weights is not None and len(weights) > 1:
+        w = np.asarray(weights, dtype=float)
+        picks = rng.choice(len(w), size=len(offsets), p=w / w.sum())
+    else:
+        picks = np.zeros(len(offsets), dtype=int)
+    return offsets, picks
+
+
+@dataclass
+class OpenLoopResult:
+    offered_qps: float
+    scheduled: int
+    completed: int
+    wall_s: float
+    # completion - SCHEDULED arrival (not actual send): a request the
+    # dispatcher issued late still charges the server for the backlog
+    latencies_ms: list = field(default_factory=list)
+    errors: list = field(default_factory=list)
+
+    @property
+    def achieved_qps(self) -> float:
+        return self.completed / self.wall_s if self.wall_s > 0 else 0.0
+
+    def percentile(self, q: float) -> float:
+        """Coordinated-omission-safe percentile over the SCHEDULED
+        population: requests that never completed (errors, drops) rank
+        as +Inf, so a saturated probe cannot launder its tail by
+        shedding samples."""
+        return co_percentile(self.latencies_ms, self.scheduled, q)
+
+    def attainment(self, slo_ms: float) -> float:
+        """Fraction of SCHEDULED requests that completed within
+        ``slo_ms``."""
+        if self.scheduled <= 0:
+            return 1.0
+        ok = sum(1 for v in self.latencies_ms if v <= slo_ms)
+        return ok / self.scheduled
+
+
+def co_percentile(latencies_ms, scheduled: int, q: float) -> float:
+    """Percentile ``q`` (0..100) of ``latencies_ms`` ranked within a
+    population of ``scheduled`` requests; the missing tail is +Inf."""
+    n = max(int(scheduled), len(latencies_ms))
+    if n <= 0:
+        return 0.0
+    import math
+
+    rank = min(n, max(1, math.ceil(q / 100.0 * n)))
+    lats = sorted(latencies_ms)
+    return lats[rank - 1] if rank <= len(lats) else float("inf")
+
+
+def run_open_loop(
+    address: str,
+    scenarios,
+    rate_qps: float,
+    duration_s: float,
+    seed: int = 0,
+    deadline_s: float = 60.0,
+    warm: bool = True,
+    resolvers: int = 16,
+) -> OpenLoopResult:
+    """Drive one open-loop window against a KServe v2 endpoint.
+
+    ``scenarios``: the traffic mix — a list of ``(model_name, inputs)``
+    or ``(model_name, inputs, weight)`` tuples; arrivals pick a
+    scenario proportionally to weight (seeded, like the schedule).
+
+    Dispatch discipline: ONE thread walks the pre-generated schedule,
+    sleeping to each arrival and issuing via the non-blocking gRPC call
+    future — it never waits for a response, so the offered rate is
+    independent of server health. A bounded pool of resolver threads
+    drains completions and records latency from the scheduled arrival.
+    At heavy overload the pool itself queues, which can only OVERSTATE
+    tail latency — the conservative direction for a capacity search.
+    Completions after the window still count (with their true
+    latency); ``wall_s`` is the scheduled window."""
+    import queue as _q
+
+    from triton_client_tpu.channel.base import InferRequest
+    from triton_client_tpu.channel.grpc_channel import GRPCChannel
+
+    scenarios = [
+        (s[0], s[1], float(s[2]) if len(s) > 2 else 1.0) for s in scenarios
+    ]
+    if not scenarios:
+        raise ValueError("run_open_loop needs at least one scenario")
+    offsets, picks = poisson_schedule(
+        rate_qps, duration_s, seed=seed, weights=[s[2] for s in scenarios]
+    )
+    latencies: list = []
+    errors: list = []
+    completed = [0]
+    lock = threading.Lock()
+    pending: _q.Queue = _q.Queue()
+
+    def resolve_loop() -> None:
+        while True:
+            item = pending.get()
+            if item is None:
+                return
+            t_sched, fut = item
+            try:
+                fut.result()
+            except Exception as e:
+                with lock:
+                    errors.append(repr(e))
+                continue
+            lat_ms = (time.perf_counter() - t_sched) * 1e3
+            with lock:
+                latencies.append(lat_ms)
+                completed[0] += 1
+
+    chan = GRPCChannel(address, timeout_s=deadline_s)
+    try:
+        requests = [
+            InferRequest(model_name=m, inputs=inputs)
+            for m, inputs, _w in scenarios
+        ]
+        if warm:
+            for req in requests:
+                chan.do_inference(req)
+        workers = [
+            threading.Thread(
+                target=resolve_loop, daemon=True, name=f"openloop-res-{i}"
+            )
+            for i in range(max(1, int(resolvers)))
+        ]
+        for w in workers:
+            w.start()
+        t_base = time.perf_counter()
+        for off, pick in zip(offsets, picks):
+            target = t_base + float(off)
+            delay = target - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            # behind schedule: issue immediately, latency still counts
+            # from `target` — the CO-safe accounting
+            pending.put((target, chan.do_inference_async(requests[pick])))
+        for _ in workers:
+            pending.put(None)
+        for w in workers:
+            # a straggler is bounded by the gRPC deadline
+            w.join(timeout=deadline_s + 30.0)
+        alive = [w for w in workers if w.is_alive()]
+        if alive:
+            errors.append(f"{len(alive)} resolver threads still alive")
+    finally:
+        try:
+            chan.close()
+        except Exception:
+            pass
+    return OpenLoopResult(
+        offered_qps=float(rate_qps),
+        scheduled=len(offsets),
+        completed=completed[0],
+        wall_s=float(duration_s),
+        latencies_ms=latencies,
+        errors=errors,
+    )
+
+
+def slo_capacity_search(
+    address: str,
+    scenarios,
+    slo_ms: float,
+    duration_s: float = 5.0,
+    seed: int = 0,
+    qps_lo: float = 1.0,
+    qps_hi: float = 512.0,
+    iters: int = 5,
+    percentile: float = 99.0,
+    deadline_s: float | None = None,
+) -> dict:
+    """Max offered qps with ``percentile`` latency <= ``slo_ms``.
+
+    The MLPerf-Inference server-scenario headline: exponential growth
+    from ``qps_lo`` brackets the knee, then a geometric bisection
+    (``iters`` probes, or until hi/lo < 1.15) narrows it. Every probe
+    is one seeded open-loop window; probe seeds differ so schedules
+    are independent but the WHOLE search replays from ``seed``.
+    Returns the capacity plus the p50/p99/p999 measured AT capacity
+    and the full probe log."""
+    if deadline_s is None:
+        # the gRPC deadline must comfortably exceed the SLO so a miss
+        # is measured, not truncated into an error
+        deadline_s = max(30.0, slo_ms / 1e3 * 20.0)
+    probes: list[dict] = []
+    best: OpenLoopResult | None = None
+
+    def probe(qps: float):
+        res = run_open_loop(
+            address, scenarios, rate_qps=qps, duration_s=duration_s,
+            seed=seed + len(probes) + 1, deadline_s=deadline_s,
+            warm=len(probes) == 0,  # first probe warms the path
+        )
+        p = res.percentile(percentile)
+        probes.append(
+            {
+                "offered_qps": round(qps, 3),
+                "p_ms": round(p, 3) if p != float("inf") else None,
+                "scheduled": res.scheduled,
+                "completed": res.completed,
+                "errors": len(res.errors),
+            }
+        )
+        return p <= slo_ms, res
+
+    ok, res = probe(qps_lo)
+    if not ok:
+        return {
+            "slo_ms": slo_ms,
+            "percentile": percentile,
+            "slo_capacity_qps": 0.0,
+            "p50_ms": res.percentile(50.0),
+            "p99_ms": res.percentile(99.0),
+            "p999_ms": res.percentile(99.9),
+            "probes": probes,
+        }
+    lo, hi, best = qps_lo, None, res
+    q = qps_lo
+    while q < qps_hi:
+        q = min(qps_hi, q * 2.0)
+        ok, res = probe(q)
+        if ok:
+            lo, best = q, res
+        else:
+            hi = q
+            break
+    if hi is not None:
+        for _ in range(max(0, int(iters))):
+            if hi / lo < 1.15:
+                break
+            mid = (lo * hi) ** 0.5
+            ok, res = probe(mid)
+            if ok:
+                lo, best = mid, res
+            else:
+                hi = mid
+    p50 = best.percentile(50.0)
+    p99 = best.percentile(99.0)
+    p999 = best.percentile(99.9)
+    return {
+        "slo_ms": slo_ms,
+        "percentile": percentile,
+        "slo_capacity_qps": round(lo, 3),
+        "achieved_qps": round(best.achieved_qps, 3),
+        "p50_ms": round(p50, 3) if p50 != float("inf") else None,
+        "p99_ms": round(p99, 3) if p99 != float("inf") else None,
+        "p999_ms": round(p999, 3) if p999 != float("inf") else None,
+        "probes": probes,
+    }
