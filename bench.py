@@ -738,8 +738,14 @@ def measure_serving(
                 if latencies else None
             ),
             # filled on the wire row by the open-loop SLO search below
-            # (None = not searched: shm/3d rows, or budget ran out)
+            # (None = not searched: shm/3d rows, or budget ran out).
+            # goodput = SLO-met completions/sec AT capacity and
+            # shed_rate = deliberate RESOURCE_EXHAUSTED rejections /
+            # scheduled — the capacity story reports what was served
+            # within SLO, not just offered load survived
             "slo_capacity_qps": None,
+            "goodput_qps": None,
+            "shed_rate": None,
             "slo_ms": None,
             "tunnel_rtt_ms": round(rtt_ms, 3),
             "upload_mbps": round(upload_mbps, 1),
@@ -838,6 +844,8 @@ def measure_serving(
                             deadline_s=12.0,
                         )
                         row["slo_capacity_qps"] = cap["slo_capacity_qps"]
+                        row["goodput_qps"] = cap.get("goodput_qps")
+                        row["shed_rate"] = cap.get("shed_rate")
                         row["slo_ms"] = round(slo_ms, 2)
                         row["slo_p99_ms"] = cap["p99_ms"]
                     except Exception as e:
@@ -940,6 +948,8 @@ def _serve_3d_row(repo, batching, server, rtt_ms, duration_s: float) -> dict:
             if latencies else None
         ),
         "slo_capacity_qps": None,
+        "goodput_qps": None,
+        "shed_rate": None,
         "slo_ms": None,
         "tunnel_rtt_ms": round(rtt_ms, 3),
         "direct_scan_ms": round(direct_ms, 1),

@@ -65,6 +65,16 @@ python -m pytest tests/test_slo.py -q -m '' \
     --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly
 
+echo "== chaos shard (fault injection + overload control, seed 7) =="
+# the robustness contract (runtime/admission.py, runtime/faults.py,
+# breaker + drain): every FaultPlan point driven end-to-end under a
+# FIXED seed so injected-failure schedules are identical across runs.
+# Includes the slow-marked 2x-overload acceptance drive (sheds grow,
+# deadline-expired launches stay 0) tier-1 deselects.
+TPU_FAULT_SEED=7 python -m pytest tests/test_faults.py -q -m '' \
+    --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+
 echo "== tier-1 pytest =="
 exec python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors \

@@ -1,0 +1,918 @@
+"""Overload control, failure isolation, and deterministic fault
+injection (the robustness ring).
+
+Covers the PR's acceptance contract:
+  * every ``FaultPlan`` injection point (launch / readback /
+    slow_launch / codec_decode / batcher_stall) drives its failure
+    end-to-end over a live in-process server, deterministically — the
+    same seeded plan over the same request sequence replays the same
+    fault timeline;
+  * an injected launch/readback fault fails only its own batch's
+    members; subsequent requests on the SAME channel succeed, and the
+    surviving requests' outputs are bitwise identical to an unfaulted
+    run;
+  * the admission controller sheds at the door with RESOURCE_EXHAUSTED
+    (never retried by the client ladder — shedding must not amplify
+    load), the bounded batcher queue fail-fasts instead of blocking,
+    and with ``shed_expired`` armed a request whose deadline already
+    passed NEVER executes (``deadline_expired_launches`` stays 0 while
+    the shed counters grow);
+  * the per-model circuit breaker walks closed -> open (launch cache
+    invalidated) -> half-open (single probe) -> closed;
+  * ``drain()`` flips health not-ready, refuses new work with
+    UNAVAILABLE, and completes in-flight requests inside the timeout.
+"""
+
+import concurrent.futures
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from triton_client_tpu.runtime import faults
+from triton_client_tpu.runtime.admission import (
+    AdmissionController,
+    AdmissionRejectedError,
+    CircuitBreaker,
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+)
+from triton_client_tpu.runtime.faults import (
+    FaultPlan,
+    FaultRule,
+    InjectedFault,
+    install_fault_plan,
+)
+
+jax = pytest.importorskip("jax")
+
+# the chaos CI shard pins this (ci.sh: TPU_FAULT_SEED=7) so the whole
+# suite's fault timeline is one reproducible artifact
+SEED = int(os.environ.get("TPU_FAULT_SEED", "7"))
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    """Every test starts and ends with no process-wide fault plan."""
+    prev = install_fault_plan(None)
+    yield
+    install_fault_plan(prev)
+
+
+# -- helpers ------------------------------------------------------------------
+
+
+def _repo(name="double", sleep_s=0.0, with_device_fn=False):
+    from triton_client_tpu.config import ModelSpec, TensorSpec
+    from triton_client_tpu.runtime.repository import ModelRepository
+
+    spec = ModelSpec(
+        name=name,
+        version="1",
+        inputs=(TensorSpec("x", (-1, 4), "FP32"),),
+        outputs=(TensorSpec("y", (-1, 4), "FP32"),),
+    )
+
+    def infer(inputs):
+        if sleep_s:
+            time.sleep(sleep_s)
+        return {"y": np.asarray(inputs["x"]) * 2.0}
+
+    def device_fn(inputs):
+        return {"y": inputs["x"] * 2.0}
+
+    repo = ModelRepository()
+    repo.register(
+        spec, infer, device_fn=device_fn if with_device_fn else None
+    )
+    return repo, spec
+
+
+def _stack(repo, batching=True, shed_expired=False, breaker_threshold=0,
+           breaker_reset_s=10.0, max_batch=4, merge_hold_us=2000,
+           **server_kw):
+    from triton_client_tpu.channel.tpu_channel import TPUChannel
+    from triton_client_tpu.runtime.batching import BatchingChannel
+    from triton_client_tpu.runtime.server import InferenceServer
+
+    chan = TPUChannel(
+        repo,
+        shed_expired=shed_expired,
+        breaker_threshold=breaker_threshold,
+        breaker_reset_s=breaker_reset_s,
+    )
+    if batching:
+        chan = BatchingChannel(
+            chan, max_batch=max_batch, timeout_us=2000,
+            merge_hold_us=merge_hold_us, shed_expired=shed_expired,
+        )
+    server = InferenceServer(
+        repo, chan, address="127.0.0.1:0", metrics_port="auto", **server_kw
+    )
+    server.start()
+    return chan, server
+
+
+def _client(server, **kw):
+    from triton_client_tpu.channel.grpc_channel import GRPCChannel
+
+    kw.setdefault("timeout_s", 30.0)
+    return GRPCChannel(f"127.0.0.1:{server.port}", **kw)
+
+
+X = np.arange(8, dtype=np.float32).reshape(2, 4)
+
+
+def _infer(chan, model="double", x=X):
+    from triton_client_tpu.channel.base import InferRequest
+
+    return chan.do_inference(InferRequest(model, {"x": x}))
+
+
+# -- FaultPlan unit contract --------------------------------------------------
+
+
+class TestFaultPlan:
+    def test_probe_is_noop_without_plan(self):
+        faults.probe("launch", "double")  # must not raise
+        assert faults.active_plan() is None
+
+    def test_count_window(self):
+        plan = FaultPlan(
+            [FaultRule(point="launch", after=2, count=2)], seed=SEED
+        )
+        for n in range(6):
+            if 2 <= n < 4:
+                with pytest.raises(InjectedFault):
+                    plan.check("launch")
+            else:
+                assert plan.check("launch") == 0.0
+        assert plan.stats()["fired"] == 2
+
+    def test_model_filter(self):
+        plan = FaultPlan(
+            [FaultRule(point="launch", model="a", count=10)], seed=SEED
+        )
+        assert plan.check("launch", "b") == 0.0  # other model untouched
+        with pytest.raises(InjectedFault):
+            plan.check("launch", "a")
+        assert plan.check("readback", "a") == 0.0  # other point untouched
+
+    def test_latency_rule_sleeps_not_raises(self):
+        plan = FaultPlan(
+            [FaultRule(point="slow_launch", latency_s=0.05, count=1)],
+            seed=SEED,
+        )
+        assert plan.check("slow_launch") == pytest.approx(0.05)
+        assert plan.check("slow_launch") == 0.0  # window consumed
+        install_fault_plan(plan)
+        plan2 = FaultPlan(
+            [FaultRule(point="slow_launch", latency_s=0.05, count=1)],
+            seed=SEED,
+        )
+        install_fault_plan(plan2)
+        t0 = time.perf_counter()
+        faults.probe("slow_launch")
+        assert time.perf_counter() - t0 >= 0.045
+
+    def test_seeded_probabilistic_replay(self):
+        def timeline(seed):
+            plan = FaultPlan(
+                [FaultRule(point="launch", count=10_000, prob=0.5)],
+                seed=seed,
+            )
+            fired = []
+            for _ in range(64):
+                try:
+                    plan.check("launch")
+                    fired.append(0)
+                except InjectedFault:
+                    fired.append(1)
+            return fired
+
+        assert timeline(SEED) == timeline(SEED)  # deterministic replay
+        assert timeline(SEED) != timeline(SEED + 1)  # seed matters
+        assert sum(timeline(SEED)) > 0  # actually fires
+
+    def test_from_json_round_trip(self):
+        doc = {
+            "seed": SEED,
+            "rules": [
+                {"point": "launch", "model": "m", "after": 1, "count": 3},
+                {"point": "slow_launch", "latency_s": 0.01, "count": 2},
+            ],
+        }
+        plan = FaultPlan.from_json(json.dumps(doc))
+        assert plan.seed == SEED
+        assert [r.point for r in plan.rules] == ["launch", "slow_launch"]
+        assert plan.rules[0].after == 1 and plan.rules[0].count == 3
+
+
+# -- AdmissionController unit contract ----------------------------------------
+
+
+class TestAdmissionController:
+    def test_depth_knee(self):
+        adm = AdmissionController(max_queue=2)
+        adm.admit("m")
+        adm.admit("m")
+        with pytest.raises(AdmissionRejectedError):
+            adm.admit("m")
+        adm.finished("m")
+        adm.admit("m")  # slot freed -> admissible again
+        assert adm.stats()["rejects"] == {"m|0": 1}
+
+    def test_per_model_isolation(self):
+        adm = AdmissionController(max_queue=1)
+        adm.admit("a")
+        with pytest.raises(AdmissionRejectedError):
+            adm.admit("a")
+        adm.admit("b")  # model b has its own queue
+
+    def test_low_priority_sheds_first(self):
+        adm = AdmissionController(max_queue=4, low_priority_fraction=0.5)
+        adm.admit("m")
+        adm.admit("m")
+        # depth 2 >= knee 2 for the background class, < 4 for priority 0
+        with pytest.raises(AdmissionRejectedError):
+            adm.admit("m", priority=-1)
+        adm.admit("m", priority=0)
+
+    def test_estimated_wait_vs_deadline_budget(self):
+        adm = AdmissionController(max_queue=64, concurrency=1)
+        for _ in range(3):
+            adm.admit("m")
+        # EWMA seeds at 100 ms -> est wait = 3 x 0.1 / 1 = 300 ms
+        adm.finished("m", service_s=0.1)
+        adm.admit("m")  # replace the finished slot (depth back to 3)
+        now = time.perf_counter()
+        assert adm.estimated_wait_s("m") == pytest.approx(0.3)
+        with pytest.raises(AdmissionRejectedError):
+            adm.admit("m", deadline_s=now + 0.05, now=now)  # 50ms budget
+        adm.admit("m", deadline_s=now + 10.0, now=now)  # plenty of budget
+
+    def test_finished_underflow_is_safe(self):
+        adm = AdmissionController(max_queue=2)
+        adm.finished("m")  # never admitted: must not go negative
+        assert adm.stats()["inflight"].get("m", 0) == 0
+
+
+# -- CircuitBreaker unit contract ---------------------------------------------
+
+
+class TestCircuitBreaker:
+    def test_full_state_walk(self):
+        br = CircuitBreaker(threshold=2, reset_s=10.0)
+        t = 100.0
+        assert br.allow("m", t)
+        assert br.record_failure("m", t) is False  # 1/2: still closed
+        assert br.state("m") == CLOSED
+        assert br.record_failure("m", t) is True  # 2/2: OPENS now
+        assert br.state("m") == OPEN
+        assert not br.allow("m", t + 5.0)  # inside the window
+        assert br.allow("m", t + 11.0)  # window over: the probe
+        assert br.state("m") == HALF_OPEN
+        assert not br.allow("m", t + 11.0)  # one probe at a time
+        br.record_success("m")
+        assert br.state("m") == CLOSED
+        assert br.allow("m", t + 11.1)
+
+    def test_probe_failure_reopens(self):
+        br = CircuitBreaker(threshold=1, reset_s=10.0)
+        br.record_failure("m", 0.0)
+        assert br.allow("m", 20.0)  # half-open probe
+        # the probe failing re-opens the window; that IS a fresh open
+        # transition (the caller re-invalidates its launch cache — the
+        # probe just proved the rebuilt state is still bad)
+        assert br.record_failure("m", 20.0) is True
+        assert br.state("m") == OPEN
+        assert not br.allow("m", 25.0)
+        assert br.states()["m"]["opens"] == 2
+
+    def test_success_resets_consecutive(self):
+        br = CircuitBreaker(threshold=3)
+        br.record_failure("m")
+        br.record_failure("m")
+        br.record_success("m")
+        assert br.record_failure("m") is False  # streak restarted
+        assert br.state("m") == CLOSED
+
+
+# -- channel-level isolation and shedding -------------------------------------
+
+
+class TestChannelIsolation:
+    def test_launch_fault_fails_only_its_request(self):
+        from triton_client_tpu.channel.tpu_channel import TPUChannel
+
+        repo, _ = _repo()
+        chan = TPUChannel(repo)
+        unfaulted = _infer(chan, x=X)  # the parity reference
+        install_fault_plan(
+            FaultPlan([FaultRule(point="launch", count=1)], seed=SEED)
+        )
+        with pytest.raises(InjectedFault):
+            _infer(chan, x=X)
+        # the SAME channel serves the next request, bitwise identical
+        resp = _infer(chan, x=X)
+        np.testing.assert_array_equal(
+            resp.outputs["y"], unfaulted.outputs["y"]
+        )
+        assert chan.stats()["launch_failures"] == 1
+        assert chan.stats()["slots_active"] == 0  # slot freed on failure
+
+    def test_readback_fault_fails_only_its_request(self):
+        from triton_client_tpu.channel.tpu_channel import TPUChannel
+
+        repo, _ = _repo()
+        chan = TPUChannel(repo)
+        install_fault_plan(
+            FaultPlan([FaultRule(point="readback", count=1)], seed=SEED)
+        )
+        with pytest.raises(InjectedFault):
+            _infer(chan, x=X)
+        resp = _infer(chan, x=X)
+        np.testing.assert_array_equal(resp.outputs["y"], X * 2.0)
+        assert chan.stats()["slots_active"] == 0
+
+    def test_shed_expired_never_launches(self):
+        from triton_client_tpu.channel.base import InferRequest
+        from triton_client_tpu.channel.tpu_channel import TPUChannel
+        from triton_client_tpu.runtime.admission import DeadlineExpiredError
+
+        repo, _ = _repo()
+        chan = TPUChannel(repo, shed_expired=True)
+        expired = InferRequest(
+            "double", {"x": X},
+            deadline_s=time.perf_counter() - 1.0, priority=-1,
+        )
+        with pytest.raises(DeadlineExpiredError):
+            chan.do_inference(expired)
+        stats = chan.stats()
+        # the acceptance invariant: shed, not launched-after-deadline
+        assert stats["deadline_expired_launches"] == 0
+        assert stats["shed"] == {"double|-1|launch": 1}
+        assert stats["launched"] == 0
+        assert stats["slots_active"] == 0
+        # a live request on the same channel is untouched
+        resp = _infer(chan)
+        np.testing.assert_array_equal(resp.outputs["y"], X * 2.0)
+
+    def test_count_only_without_shed_expired(self):
+        """PR 6 compatibility: shedding off -> expired launches still
+        EXECUTE and are only counted."""
+        from triton_client_tpu.channel.base import InferRequest
+        from triton_client_tpu.channel.tpu_channel import TPUChannel
+
+        repo, _ = _repo()
+        chan = TPUChannel(repo)  # shed_expired defaults off
+        resp = chan.do_inference(
+            InferRequest(
+                "double", {"x": X}, deadline_s=time.perf_counter() - 1.0
+            )
+        )
+        np.testing.assert_array_equal(resp.outputs["y"], X * 2.0)
+        assert chan.stats()["deadline_expired_launches"] == 1
+        assert chan.stats()["shed"] == {}
+
+    def test_breaker_opens_invalidates_cache_and_recovers(self):
+        from triton_client_tpu.channel.tpu_channel import TPUChannel
+        from triton_client_tpu.runtime.admission import CircuitOpenError
+
+        repo, _ = _repo(with_device_fn=True)
+        chan = TPUChannel(repo, breaker_threshold=2, breaker_reset_s=0.2)
+        _infer(chan)  # healthy: populates the launch cache
+        assert ("double", "1") in chan._launch_cache
+        install_fault_plan(
+            FaultPlan([FaultRule(point="launch", count=2)], seed=SEED)
+        )
+        for _ in range(2):
+            with pytest.raises(InjectedFault):
+                _infer(chan)
+        # threshold consecutive failures: open + cache invalidated
+        assert chan.stats()["breaker"]["double"]["state"] == OPEN
+        assert chan.stats()["breaker"]["double"]["opens"] == 1
+        assert ("double", "1") not in chan._launch_cache
+        with pytest.raises(CircuitOpenError):
+            _infer(chan)  # fail-fast inside the window, no device touch
+        assert chan.stats()["shed"]["double|0|breaker"] == 1
+        time.sleep(0.25)
+        # the timed probe (fault window exhausted) succeeds -> closed,
+        # launcher rebuilt from the repository
+        resp = _infer(chan)
+        np.testing.assert_array_equal(resp.outputs["y"], X * 2.0)
+        assert chan.stats()["breaker"]["double"]["state"] == CLOSED
+        assert ("double", "1") in chan._launch_cache
+
+    def test_breaker_half_open_admits_single_probe(self):
+        from triton_client_tpu.channel.tpu_channel import TPUChannel
+        from triton_client_tpu.runtime.admission import CircuitOpenError
+
+        repo, _ = _repo(sleep_s=0.1)
+        chan = TPUChannel(repo, breaker_threshold=1, breaker_reset_s=0.05)
+        install_fault_plan(
+            FaultPlan([FaultRule(point="launch", count=1)], seed=SEED)
+        )
+        with pytest.raises(InjectedFault):
+            _infer(chan)
+        time.sleep(0.1)  # window over: next caller is the probe
+        errs = []
+
+        def call():
+            try:
+                _infer(chan)
+            except Exception as e:  # noqa: BLE001 — collected for assert
+                errs.append(e)
+
+        threads = [threading.Thread(target=call) for _ in range(3)]
+        for t in threads:
+            t.start()
+            time.sleep(0.02)  # probe first, peers while it's in flight
+        for t in threads:
+            t.join()
+        # exactly one probe ran; concurrent peers failed fast
+        assert all(isinstance(e, CircuitOpenError) for e in errs)
+        assert len(errs) == 2
+        assert chan.stats()["breaker"]["double"]["state"] == CLOSED
+
+
+# -- batcher-level shedding ---------------------------------------------------
+
+
+class _SlowInner:
+    """Minimal BaseChannel stand-in whose do_inference blocks."""
+
+    def __init__(self, sleep_s=0.2):
+        self.sleep_s = sleep_s
+
+    def register_channel(self):
+        pass
+
+    def do_inference_async(self, request):
+        from triton_client_tpu.channel.base import InferFuture, InferResponse
+
+        def resolve():
+            time.sleep(self.sleep_s)
+            return InferResponse(
+                model_name=request.model_name,
+                model_version="1",
+                outputs={
+                    "y": np.asarray(request.inputs["x"]) * 2.0
+                },
+                request_id=request.request_id,
+            )
+
+        return InferFuture(resolve)
+
+    def do_inference(self, request):
+        return self.do_inference_async(request).result()
+
+    def stats(self):
+        return {}
+
+    def close(self):
+        pass
+
+
+class TestBatcherShedding:
+    def test_queue_full_fail_fast(self):
+        from triton_client_tpu.runtime.admission import QueueFullError
+        from triton_client_tpu.runtime.batching import BatchingChannel
+
+        chan = BatchingChannel(
+            _SlowInner(sleep_s=0.3), max_batch=1, timeout_us=100,
+            capacity=1, pipeline_depth=1,
+        )
+        try:
+            results = []
+
+            def call():
+                try:
+                    _infer(chan)
+                    results.append("ok")
+                except QueueFullError:
+                    results.append("shed")
+
+            t0 = time.perf_counter()
+            threads = [threading.Thread(target=call) for _ in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            assert "shed" in results  # the bounded queue rejected
+            assert "ok" in results  # and still served
+            # fail-fast contract: sheds returned in microseconds — the
+            # wall is a few service times, not 8 serialized ones
+            assert wall < 8 * 0.3
+            shed = chan.stats()["shed"]
+            assert shed.get("double|0|queue", 0) >= results.count("shed")
+        finally:
+            chan.close()
+
+    def test_merge_shed_expired_members(self):
+        from triton_client_tpu.channel.base import InferRequest
+        from triton_client_tpu.runtime.admission import DeadlineExpiredError
+        from triton_client_tpu.runtime.batching import BatchingChannel
+
+        chan = BatchingChannel(
+            _SlowInner(sleep_s=0.0), max_batch=4, timeout_us=5000,
+            merge_hold_us=5000, shed_expired=True,
+        )
+        try:
+            outcomes = {}
+
+            def call(tag, deadline_s):
+                try:
+                    resp = chan.do_inference(
+                        InferRequest(
+                            "double", {"x": X}, deadline_s=deadline_s
+                        )
+                    )
+                    outcomes[tag] = resp
+                except DeadlineExpiredError as e:
+                    outcomes[tag] = e
+
+            live_deadline = time.perf_counter() + 30.0
+            threads = [
+                threading.Thread(
+                    target=call, args=("dead", time.perf_counter() - 1.0)
+                ),
+                threading.Thread(target=call, args=("live", live_deadline)),
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            # the expired member shed at merge; its batch-mate executed
+            assert isinstance(outcomes["dead"], DeadlineExpiredError)
+            np.testing.assert_array_equal(
+                outcomes["live"].outputs["y"], X * 2.0
+            )
+            assert chan.stats()["shed"].get("double|0|merge", 0) == 1
+        finally:
+            chan.close()
+
+    def test_priority_orders_staged_window(self):
+        from triton_client_tpu.channel.base import InferRequest
+        from triton_client_tpu.runtime.batching import BatchingChannel
+
+        chan = BatchingChannel(
+            _SlowInner(), max_batch=4, timeout_us=100, shed_expired=True
+        )
+        chan.close()  # stop the dispatcher so _ready stays inspectable
+        for i, prio in enumerate([0, 5, -1, 1]):
+            req = InferRequest("double", {"x": X}, priority=prio)
+            with chan._lock:
+                chan._pending[i] = (req, concurrent.futures.Future())
+        chan._on_batch([0, 1, 2, 3])
+        order = [item[2].priority for item in chan._ready]
+        # high priority dispatches first; the background class queues
+        # longest and therefore sheds first under a backlog
+        assert order == [5, 1, 0, -1]
+
+
+# -- live-server end-to-end ---------------------------------------------------
+
+
+def _grpc_code_of(exc):
+    import grpc
+
+    assert isinstance(exc, grpc.RpcError)
+    return exc.code()
+
+
+class TestLiveServer:
+    def test_admission_sheds_resource_exhausted_and_client_never_retries(self):
+        import grpc
+
+        repo, _ = _repo(sleep_s=0.3)
+        chan, server = _stack(
+            repo, batching=False, admission_max_queue=1, slo_ms=10_000.0
+        )
+        try:
+            client = _client(server, retries=3, backoff_s=0.05)
+            try:
+                codes, lock = [], threading.Lock()
+
+                def call():
+                    t0 = time.perf_counter()
+                    try:
+                        _infer(client)
+                        out = ("ok", time.perf_counter() - t0)
+                    except grpc.RpcError as e:
+                        out = (e.code(), time.perf_counter() - t0)
+                    with lock:
+                        codes.append(out)
+
+                threads = [threading.Thread(target=call) for _ in range(6)]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                shed = [
+                    c for c in codes
+                    if c[0] == grpc.StatusCode.RESOURCE_EXHAUSTED
+                ]
+                served = [c for c in codes if c[0] == "ok"]
+                assert shed and served
+                # non-retryable: a shed returns in far less than one
+                # backoff ladder (3 retries x >=50ms would be visible)
+                assert all(w < 0.25 for _c, w in shed)
+                stats = client.stats()
+                assert stats["infer_rejections"] == len(shed)
+                assert stats["retries"] == 0
+                # the shed ledger and the admission gauge export
+                snap = server.collector.snapshot()
+                assert snap["shed"].get("double|0|admission", 0) == len(shed)
+                assert snap["admission"]["rejects"]["double|0"] == len(shed)
+            finally:
+                client.close()
+            scrape = urllib.request.urlopen(
+                f"http://127.0.0.1:{server.metrics_port}/metrics", timeout=5
+            ).read().decode()
+            assert (
+                'tpu_serving_shed_total{model="double",priority="0",'
+                'stage="admission"}' in scrape
+            )
+            assert "tpu_serving_admission_queue_depth" in scrape
+            assert "tpu_serving_draining 0.0" in scrape
+        finally:
+            server.stop()
+
+    def test_launch_fault_member_only_over_merged_batch(self):
+        import grpc
+
+        repo, _ = _repo()
+        members = 3
+        # parity reference: the SAME request sequence, unfaulted
+        chan0, server0 = _stack(repo, max_batch=members)
+        try:
+            c0 = _client(server0)
+            reference = [
+                _infer(c0, x=X + i).outputs["y"] for i in range(members + 2)
+            ]
+            c0.close()
+        finally:
+            server0.stop()
+
+        chan, server = _stack(repo, max_batch=members)
+        try:
+            # every launch during the faulted phase fails, however the
+            # batcher happens to group the concurrent senders (one
+            # merged batch + solo retries, or several smaller groups);
+            # 2 probes per member covers the worst-case topology
+            install_fault_plan(
+                FaultPlan(
+                    [FaultRule(point="launch", count=2 * members)],
+                    seed=SEED,
+                )
+            )
+            outcomes = {}
+
+            def call(i):
+                client = _client(server)
+                try:
+                    outcomes[i] = _infer(client, x=X + i).outputs["y"]
+                except grpc.RpcError as e:
+                    outcomes[i] = e
+                finally:
+                    client.close()
+
+            threads = [
+                threading.Thread(target=call, args=(i,))
+                for i in range(members)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            failed = [
+                v for v in outcomes.values() if isinstance(v, Exception)
+            ]
+            assert len(failed) == members  # the whole faulted batch...
+            assert all(
+                _grpc_code_of(e) == grpc.StatusCode.INTERNAL for e in failed
+            )
+            assert all("injected" in str(e.details()) for e in failed)
+            assert faults.active_plan().stats()["fired"] >= members
+            install_fault_plan(None)
+            # ...and ONLY those members: the same channel serves the
+            # next requests, bitwise identical to the unfaulted run
+            client = _client(server)
+            try:
+                for i in range(members, members + 2):
+                    got = _infer(client, x=X + i).outputs["y"]
+                    np.testing.assert_array_equal(got, reference[i])
+            finally:
+                client.close()
+        finally:
+            server.stop()
+
+    def test_readback_fault_isolated_live(self):
+        import grpc
+
+        repo, _ = _repo()
+        chan, server = _stack(repo, batching=False)
+        try:
+            install_fault_plan(
+                FaultPlan([FaultRule(point="readback", count=1)], seed=SEED)
+            )
+            client = _client(server)
+            try:
+                with pytest.raises(grpc.RpcError) as ei:
+                    _infer(client)
+                assert ei.value.code() == grpc.StatusCode.INTERNAL
+                resp = _infer(client)
+                np.testing.assert_array_equal(resp.outputs["y"], X * 2.0)
+            finally:
+                client.close()
+        finally:
+            server.stop()
+
+    def test_codec_decode_fault_isolated_live(self):
+        import grpc
+
+        repo, _ = _repo()
+        chan, server = _stack(repo, batching=False)
+        try:
+            install_fault_plan(
+                FaultPlan(
+                    [FaultRule(point="codec_decode", count=1)], seed=SEED
+                )
+            )
+            client = _client(server)
+            try:
+                with pytest.raises(grpc.RpcError):
+                    _infer(client)
+                resp = _infer(client)
+                np.testing.assert_array_equal(resp.outputs["y"], X * 2.0)
+            finally:
+                client.close()
+        finally:
+            server.stop()
+
+    def test_batcher_stall_slows_but_serves(self):
+        repo, _ = _repo()
+        chan, server = _stack(repo)
+        try:
+            install_fault_plan(
+                FaultPlan(
+                    [
+                        FaultRule(
+                            point="batcher_stall", latency_s=0.15, count=1
+                        )
+                    ],
+                    seed=SEED,
+                )
+            )
+            client = _client(server)
+            try:
+                t0 = time.perf_counter()
+                resp = _infer(client)
+                wall = time.perf_counter() - t0
+                np.testing.assert_array_equal(resp.outputs["y"], X * 2.0)
+                assert wall >= 0.14  # the stall actually held dispatch
+                assert faults.active_plan().stats()["fired"] == 1
+            finally:
+                client.close()
+        finally:
+            server.stop()
+
+    def test_breaker_surfaces_unavailable_live(self):
+        import grpc
+
+        repo, _ = _repo()
+        chan, server = _stack(
+            repo, batching=False, breaker_threshold=2, breaker_reset_s=30.0
+        )
+        try:
+            install_fault_plan(
+                FaultPlan([FaultRule(point="launch", count=2)], seed=SEED)
+            )
+            client = _client(server, retries=0)
+            try:
+                for _ in range(2):
+                    with pytest.raises(grpc.RpcError) as ei:
+                        _infer(client)
+                    assert ei.value.code() == grpc.StatusCode.INTERNAL
+                # breaker open: fail-fast UNAVAILABLE without a launch
+                with pytest.raises(grpc.RpcError) as ei:
+                    _infer(client)
+                assert ei.value.code() == grpc.StatusCode.UNAVAILABLE
+                assert chan.stats()["breaker"]["double"]["state"] == OPEN
+            finally:
+                client.close()
+            scrape = urllib.request.urlopen(
+                f"http://127.0.0.1:{server.metrics_port}/metrics", timeout=5
+            ).read().decode()
+            assert 'tpu_serving_breaker_state{model="double"} 2.0' in scrape
+            assert (
+                'tpu_serving_breaker_opens_total{model="double"} 1.0'
+                in scrape
+            )
+        finally:
+            server.stop()
+
+    def test_drain_under_load(self):
+        import grpc
+
+        repo, _ = _repo(sleep_s=0.5)
+        chan, server = _stack(repo, batching=False)
+        try:
+            inflight = {}
+
+            def call():
+                client = _client(server)
+                try:
+                    inflight["resp"] = _infer(client)
+                except Exception as e:  # noqa: BLE001 — asserted below
+                    inflight["resp"] = e
+                finally:
+                    client.close()
+
+            t = threading.Thread(target=call)
+            t.start()
+            time.sleep(0.15)  # the request is on the device now
+
+            drained = {}
+            dt = threading.Thread(
+                target=lambda: drained.update(ok=server.drain(timeout_s=5.0))
+            )
+            dt.start()
+            time.sleep(0.05)
+            # while draining: not-ready, new requests refused
+            assert server.draining
+            probe = _client(server, retries=0)
+            try:
+                assert probe.server_ready() is False
+                with pytest.raises(grpc.RpcError) as ei:
+                    _infer(probe)
+                assert ei.value.code() == grpc.StatusCode.UNAVAILABLE
+            finally:
+                probe.close()
+            t.join(timeout=10.0)
+            dt.join(timeout=10.0)
+            # the in-flight request COMPLETED during the drain
+            resp = inflight["resp"]
+            assert not isinstance(resp, Exception), resp
+            np.testing.assert_array_equal(resp.outputs["y"], X * 2.0)
+            assert drained["ok"] is True
+        finally:
+            server.stop()
+
+
+# -- the acceptance run: open-loop overload with shedding armed ---------------
+
+
+@pytest.mark.slow
+def test_overload_run_sheds_instead_of_late_launches():
+    """Offered load >> capacity with the full overload plane armed:
+    no request executes after its deadline expired at launch
+    (deadline_expired_launches stays 0 while shed grows), and the p99
+    of ACCEPTED requests stays within the armed SLO."""
+    from triton_client_tpu.utils.loadgen import run_open_loop
+
+    slo_ms = 1000.0
+    repo, _ = _repo(sleep_s=0.1)
+    chan, server = _stack(
+        repo,
+        shed_expired=True,
+        max_batch=2,
+        merge_hold_us=0,
+        admission_max_queue=4,
+        slo_ms=slo_ms,
+    )
+    try:
+        # capacity ~= max_batch x pipeline / 0.1s service; offer far
+        # above it so the door must shed
+        res = run_open_loop(
+            f"127.0.0.1:{server.port}",
+            [("double", {"x": X})],
+            rate_qps=120.0,
+            duration_s=2.0,
+            seed=SEED,
+            deadline_s=30.0,
+        )
+        snap = server.collector.snapshot()
+        shed_total = sum(snap["shed"].values())
+        assert shed_total > 0, snap["shed"]
+        assert res.shed_count > 0  # the client saw RESOURCE_EXHAUSTED
+        assert snap["channel"]["deadline_expired_launches"] == 0
+        # accepted requests (completions) stayed inside the SLO
+        assert res.completed > 0
+        p99_accepted = float(
+            np.percentile(np.asarray(res.latencies_ms), 99.0)
+        )
+        assert p99_accepted <= slo_ms, (p99_accepted, res.completed)
+        # goodput accounting: SLO-met completions/sec is positive and
+        # no larger than raw completion throughput
+        assert 0.0 < res.goodput_qps(slo_ms) <= res.achieved_qps + 1e-9
+        assert 0.0 < res.shed_rate < 1.0
+    finally:
+        server.stop()

@@ -69,6 +69,14 @@ HOT_PATH_ROOTS = (
     "BatchingChannel._run_solo",
     "BatchingChannel._merge_parts",
     "_Servicer._issue",
+    # round-12 overload control: the admission gate and breaker check
+    # run per request inside _issue/launch, but live on foreign objects
+    # the call graph cannot follow through `self._admission.admit(...)`
+    # — root them explicitly so a host sync there is still a finding
+    "AdmissionController.admit",
+    "AdmissionController.finished",
+    "CircuitBreaker.allow",
+    "CircuitBreaker.record_success",
 )
 
 # module-level call targets that force a host sync

@@ -23,6 +23,7 @@ from __future__ import annotations
 import itertools
 import logging
 import os
+import random
 import time
 
 import grpc
@@ -45,7 +46,15 @@ _RETRYABLE = (
 )
 # ModelInfer may have executed server-side when the deadline fires, so
 # only connection-level failures are safe to re-issue automatically.
+# RESOURCE_EXHAUSTED is additionally a DELIBERATE server decision (the
+# admission controller shed the request); re-issuing it would feed the
+# exact overload the server is shedding — clients must back off or
+# drop, so it is surfaced immediately and counted (stats()).
 _INFER_RETRYABLE = (grpc.StatusCode.UNAVAILABLE,)
+
+# retry backoff ceiling: with jitter, retries from a client fleet decor-
+# relate instead of arriving in synchronized waves at each 2^n step
+_BACKOFF_CAP_S = 5.0
 
 # shared-memory region-name tag: process-wide monotonic so no two
 # channel instances (live or dead) ever share a name prefix
@@ -94,6 +103,11 @@ class GRPCChannel(BaseChannel):
         self._shm_tag = next(_SHM_CHANNEL_SEQ)
         self._shm_lock = None
         self._shm_async_warned = False
+        # client-side overload ledger: sheds the server sent back
+        # (RESOURCE_EXHAUSTED on ModelInfer — never retried) vs
+        # transient retries the ladder absorbed
+        self._infer_rejections = 0
+        self._retries_total = 0
         if use_shared_memory:
             import threading
 
@@ -160,7 +174,13 @@ class GRPCChannel(BaseChannel):
             request_id=request.request_id,
         )
         t0 = time.perf_counter()
-        resp = self._call(self._stub.ModelInfer, wire, retryable=_INFER_RETRYABLE)
+        try:
+            resp = self._call(
+                self._stub.ModelInfer, wire, retryable=_INFER_RETRYABLE
+            )
+        except grpc.RpcError as e:
+            self._record_infer_error(e)
+            raise
         return InferResponse(
             model_name=resp.model_name,
             model_version=resp.model_version,
@@ -340,6 +360,7 @@ class GRPCChannel(BaseChannel):
             try:
                 resp = call.result()
             except grpc.RpcError as e:
+                self._record_infer_error(e)
                 code = e.code() if hasattr(e, "code") else None
                 # Only connection-level failures (UNAVAILABLE) are
                 # re-issued automatically — the code least likely to mean
@@ -374,6 +395,17 @@ class GRPCChannel(BaseChannel):
             return self._call(
                 self._stub.ServerLive, pb.ServerLiveRequest()
             ).live
+        except grpc.RpcError:
+            return False
+
+    def server_ready(self) -> bool:
+        """Readiness (vs liveness): a DRAINING server stays live but
+        flips not-ready first, so orchestrators pull it from rotation
+        before its in-flight work finishes."""
+        try:
+            return self._call(
+                self._stub.ServerReady, pb.ServerReadyRequest()
+            ).ready
         except grpc.RpcError:
             return False
 
@@ -460,14 +492,38 @@ class GRPCChannel(BaseChannel):
 
     # -- internals ------------------------------------------------------------
 
+    def _record_infer_error(self, e) -> None:
+        """Count server sheds distinctly: a RESOURCE_EXHAUSTED on
+        ModelInfer is the admission controller rejecting on purpose —
+        load the client should drop or defer, not a fault to retry."""
+        try:
+            if e.code() == grpc.StatusCode.RESOURCE_EXHAUSTED:
+                self._infer_rejections += 1
+        except (AttributeError, ValueError):
+            pass
+
+    def stats(self) -> dict:
+        """Client-side counters: ``infer_rejections`` (ModelInfer
+        requests the server shed with RESOURCE_EXHAUSTED — never
+        retried) and ``retries`` (transient failures the backoff ladder
+        re-issued)."""
+        return {
+            "infer_rejections": self._infer_rejections,
+            "retries": self._retries_total,
+        }
+
     def _call(self, method, request, retryable=_RETRYABLE):
-        """Retry ladder with exponential backoff. ``retryable`` is the
-        set of status codes safe to re-issue for THIS method: idempotent
-        queries (metadata, liveness, index) retry on the full set, while
-        ModelInfer must pass only connection-level codes (UNAVAILABLE) —
-        a DEADLINE_EXCEEDED/RESOURCE_EXHAUSTED request may have executed
+        """Retry ladder with capped exponential backoff and full
+        jitter. ``retryable`` is the set of status codes safe to
+        re-issue for THIS method: idempotent queries (metadata,
+        liveness, index) retry on the full set, while ModelInfer must
+        pass only connection-level codes (UNAVAILABLE) — a
+        DEADLINE_EXCEEDED/RESOURCE_EXHAUSTED request may have executed
         server-side, and re-running it is unsafe for non-idempotent
-        models and doubles load exactly when the server is saturated."""
+        models and doubles load exactly when the server is saturated.
+        The jitter (uniform over (delay/2, delay]) decorrelates a fleet
+        of clients retrying against one recovering server, so the
+        retries do not arrive as synchronized 2^n waves."""
         delay = self._backoff_s
         for attempt in range(self._retries + 1):
             try:
@@ -476,13 +532,15 @@ class GRPCChannel(BaseChannel):
                 code = e.code() if hasattr(e, "code") else None
                 if attempt >= self._retries or code not in retryable:
                     raise
+                sleep_s = delay * random.uniform(0.5, 1.0)
                 log.warning(
                     "rpc %s failed (%s); retry %d/%d in %.2fs",
                     getattr(method, "_method", method),
                     code,
                     attempt + 1,
                     self._retries,
-                    delay,
+                    sleep_s,
                 )
-                time.sleep(delay)
-                delay *= 2
+                self._retries_total += 1
+                time.sleep(sleep_s)
+                delay = min(delay * 2, _BACKOFF_CAP_S)

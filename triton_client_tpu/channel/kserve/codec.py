@@ -19,6 +19,7 @@ import numpy as np
 
 from triton_client_tpu.channel.kserve import pb
 from triton_client_tpu.config import config_dtypes
+from triton_client_tpu.runtime import faults
 
 # KServe v2 datatype string <-> numpy dtype (little-endian wire order,
 # matching the reference's struct '<' formats, base_postprocess.py:20).
@@ -165,6 +166,7 @@ def parse_infer_request(
     read from ``shm`` (a SystemSharedMemoryRegistry) and consume NO
     raw_input_contents slot — the wire pairs raw buffers positionally
     with the non-shm inputs only (Triton semantics)."""
+    faults.probe("codec_decode", req.model_name)
     wire_inputs = [t for t in req.inputs if shm_params(t) is None]
     if len(req.raw_input_contents) != len(wire_inputs):
         raise ValueError(

@@ -46,6 +46,12 @@ from triton_client_tpu.channel.base import (
 )
 from triton_client_tpu.config import ModelSpec
 from triton_client_tpu.parallel.mesh import MeshConfig, make_mesh
+from triton_client_tpu.runtime import faults
+from triton_client_tpu.runtime.admission import (
+    CircuitBreaker,
+    CircuitOpenError,
+    DeadlineExpiredError,
+)
 from triton_client_tpu.runtime.repository import ModelRepository
 
 
@@ -131,12 +137,30 @@ class StagedChannel(BaseChannel):
         validate: bool = True,
         pipeline_depth: int = 2,
         donate: bool = True,
+        shed_expired: bool = False,
+        breaker_threshold: int = 5,
+        breaker_reset_s: float = 10.0,
     ) -> None:
         """``pipeline_depth``: launched-but-unretired batches allowed
         before ``stage`` blocks on the oldest batch's execution; 1 is
         the strictly serial legacy path. ``donate``: honor spec
         ``donatable`` marks (buffer reuse needs a ``device_fn``; on
-        backends without donation support jax falls back to a copy)."""
+        backends without donation support jax falls back to a copy).
+
+        ``shed_expired``: enforce the deadline plane at launch — a
+        request whose deadline already passed is FAILED with
+        ``DeadlineExpiredError`` instead of executed (PR 6 only counted
+        such launches; with shedding on, ``deadline_expired_launches``
+        stays 0 while ``shed`` grows). Off by default so an SLO-less
+        deployment keeps PR 6's count-only behavior.
+
+        ``breaker_threshold``/``breaker_reset_s``: the per-model
+        circuit breaker around launch+readback — ``threshold``
+        consecutive failures open the circuit (fail-fast
+        ``CircuitOpenError``, launch cache invalidated so recovery
+        rebuilds the jitted launcher), a timed probe after ``reset_s``
+        half-opens it, one success closes it. ``breaker_threshold=0``
+        disables the breaker."""
         self._repository = repository
         self._mesh_config = mesh_config
         self._devices = devices
@@ -161,7 +185,20 @@ class StagedChannel(BaseChannel):
             # the queue ahead of the device eats the whole SLO budget —
             # the capacity-search saturation signal, visible live
             "deadline_expired_launches": 0,
+            # launch/readback failures observed by the circuit breaker
+            "launch_failures": 0,
         }
+        self._shed_expired = bool(shed_expired)
+        self._breaker = (
+            CircuitBreaker(
+                threshold=breaker_threshold, reset_s=breaker_reset_s
+            )
+            if breaker_threshold > 0
+            else None
+        )
+        # per "model|priority|stage" shed counts, merged into the
+        # collector's tpu_serving_shed_total family at scrape time
+        self._shed: collections.Counter = collections.Counter()
         # (name, version) -> (model identity, launcher, donate_names,
         # output wire dtypes); rebuilt when the repository reloads the
         # model (identity mismatch)
@@ -276,6 +313,9 @@ class StagedChannel(BaseChannel):
             out["inflight"] = len(self._inflight)
             out["slots_active"] = self._slots_active
             out["pipeline_depth"] = self._pipeline_depth
+            out["shed"] = dict(self._shed)
+        if self._breaker is not None:
+            out["breaker"] = self._breaker.states()
         if self._mesh is not None:
             out["mesh_devices"] = int(self._mesh.devices.size)
             out["data_axis_size"] = int(self._mesh.shape["data"])
@@ -371,9 +411,34 @@ class StagedChannel(BaseChannel):
         finishes executing (whichever of a later ``stage`` or this
         future's resolution observes it first)."""
         model, request = staged.model, staged.request
+        name = model.spec.name
         tr = request.trace
         t0 = time.perf_counter()
+        deadline = request.deadline_s
+        if self._shed_expired and deadline is not None and t0 > deadline:
+            # shedding enforced: a request whose deadline already
+            # passed NEVER executes — fail its future in microseconds
+            # instead of burning a device slot on work nobody can use
+            self._release_slot()
+            self._count_shed(name, request.priority, "launch")
+            return InferFuture.failed(
+                DeadlineExpiredError(
+                    f"model '{name}': deadline expired "
+                    f"{(t0 - deadline) * 1e3:.1f}ms before launch"
+                )
+            )
+        if self._breaker is not None and not self._breaker.allow(name, t0):
+            self._release_slot()
+            self._count_shed(name, request.priority, "breaker")
+            return InferFuture.failed(
+                CircuitOpenError(
+                    f"model '{name}': circuit breaker open "
+                    "(recent consecutive launch failures)"
+                )
+            )
         try:
+            faults.probe("slow_launch", name)
+            faults.probe("launch", name)
             launcher, donate_names, out_dtype = self._launcher(model)
             if launcher is not None:
                 donated = {
@@ -390,13 +455,17 @@ class StagedChannel(BaseChannel):
             else:
                 outputs = model.infer_fn(staged.device_inputs)
         except Exception as e:
+            # fan the error to THIS request's future only; the slot
+            # frees, the channel and its caches stay serviceable for
+            # every other request (the breaker decides if the model
+            # itself needs a timeout)
             self._release_slot()
+            self._record_launch_failure(name)
             return InferFuture.failed(e)
         rec = _Inflight(outputs)
         t_launched = time.perf_counter()
         if tr is not None:
             tr.add("launch", t0, t_launched)
-        deadline = request.deadline_s
         with self._slot_cv:
             self._inflight.append(rec)
             self._stats["launched"] += 1
@@ -416,11 +485,20 @@ class StagedChannel(BaseChannel):
                     jax.block_until_ready(outputs)
                     t_ready = time.perf_counter()
                     tr.add("device_execute", t_launched, t_ready)
+                faults.probe("readback", name)
                 host = self._host_outputs(outputs, out_dtype, staged.meta)
                 if tr is not None:
                     tr.add("readback", t_ready, time.perf_counter())
+            except Exception:
+                # readback failure belongs to THIS batch's futures only
+                # (the batcher fans it to the members); the breaker
+                # aggregates consecutive failures into a model timeout
+                self._record_launch_failure(name)
+                raise
             finally:
                 self._retire(rec)
+            if self._breaker is not None:
+                self._breaker.record_success(name)
             return InferResponse(
                 model_name=request.model_name,
                 model_version=model.spec.version,
@@ -447,3 +525,33 @@ class StagedChannel(BaseChannel):
         with self._slot_cv:
             self._launch_cache[key] = (model, launcher, donate_names, out_dtype)
         return launcher, donate_names, out_dtype
+
+    # -- failure isolation ----------------------------------------------------
+
+    def _count_shed(self, model: str, priority: int, stage: str) -> None:
+        with self._slot_cv:
+            self._shed[f"{model}|{int(priority)}|{stage}"] += 1
+
+    def _record_launch_failure(self, model: str) -> None:
+        """One launch/readback failure for ``model``: feed the breaker;
+        when this failure OPENS the circuit, drop the cached launcher so
+        recovery (the half-open probe) rebuilds the jit wrapper from the
+        repository's current model instead of reusing state that may
+        have been poisoned by the failure."""
+        with self._slot_cv:
+            self._stats["launch_failures"] += 1
+        if self._breaker is None:
+            return
+        if self._breaker.record_failure(model):
+            self._invalidate_launcher(model)
+
+    def _invalidate_launcher(self, model: str) -> None:
+        with self._slot_cv:
+            for key in [k for k in self._launch_cache if k[0] == model]:
+                del self._launch_cache[key]
+
+    @property
+    def breaker(self):
+        """The per-model circuit breaker (None when disabled) — the
+        collector reads states() off it via stats()["breaker"]."""
+        return self._breaker

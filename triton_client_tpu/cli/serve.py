@@ -95,6 +95,52 @@ def main(argv=None) -> None:
         help="bounded ring of SLO-violating / p99+ exemplar traces",
     )
     p.add_argument(
+        "--admission", type=int, default=0,
+        help="per-model admitted-but-unfinished request cap: beyond it "
+        "(or when the estimated queue wait already exceeds a request's "
+        "deadline budget) new requests are rejected with "
+        "RESOURCE_EXHAUSTED before parse. Enabling admission also arms "
+        "deadline shedding in the batcher and staged channels (see "
+        "--shed-expired). 0 = no admission control",
+    )
+    p.add_argument(
+        "--admission-concurrency", type=int, default=4,
+        help="assumed per-model service concurrency for the "
+        "estimated-wait admission math (batcher width x pipeline "
+        "depth, roughly)",
+    )
+    p.add_argument(
+        "--shed-expired", action="store_true",
+        help="fail requests whose deadline already expired at "
+        "batcher-merge and pre-launch with DEADLINE_EXCEEDED instead "
+        "of executing them (deadline_expired_launches stays 0 while "
+        "tpu_serving_shed_total grows); implied by --admission > 0",
+    )
+    p.add_argument(
+        "--breaker-threshold", type=int, default=5,
+        help="consecutive launch/readback failures that open a "
+        "model's circuit breaker (fail-fast UNAVAILABLE, launch cache "
+        "invalidated; a timed probe half-opens it). 0 disables",
+    )
+    p.add_argument(
+        "--breaker-reset-s", type=float, default=10.0,
+        help="seconds an open circuit waits before admitting one "
+        "half-open probe request",
+    )
+    p.add_argument(
+        "--drain-timeout", type=float, default=10.0,
+        help="graceful-shutdown budget (SIGTERM): health flips "
+        "not-ready, new requests get UNAVAILABLE, in-flight work "
+        "completes up to this many seconds before teardown",
+    )
+    p.add_argument(
+        "--fault-plan", default="",
+        help="JSON fault-injection plan file (runtime/faults.py) "
+        "installed process-wide — CHAOS TESTING ONLY: injects "
+        "launch/readback/codec failures and latency on a seeded, "
+        "deterministic schedule",
+    )
+    p.add_argument(
         "--warmup", action="store_true",
         help="compile every registered model before accepting requests",
     )
@@ -111,6 +157,26 @@ def main(argv=None) -> None:
             f"telemetry on :{server.metrics_port} "
             "(/metrics /traces /snapshot)", flush=True,
         )
+
+    import signal
+
+    def _sigterm(signum, frame):
+        # orchestrator shutdown: drain instead of dropping in-flight
+        # work on the floor. The handler interrupts wait() on the main
+        # thread; drain() flips not-ready, waits out the building, and
+        # stops the transport — wait() then returns and main exits.
+        print(
+            f"SIGTERM: draining (timeout {args.drain_timeout:.1f}s)",
+            flush=True,
+        )
+        drained = server.drain(timeout_s=args.drain_timeout)
+        print(
+            "drain complete" if drained
+            else "drain timeout: stragglers cancelled",
+            flush=True,
+        )
+
+    signal.signal(signal.SIGTERM, _sigterm)
     try:
         server.wait()
     except KeyboardInterrupt:
@@ -141,18 +207,46 @@ def build_server(args):
         if args.warmup and model.warmup is not None:
             model.warmup()
 
+    if getattr(args, "fault_plan", ""):
+        # CHAOS TESTING ONLY: a seeded, deterministic fault timeline
+        # installed process-wide before the channel stack is built
+        from triton_client_tpu.runtime.faults import (
+            FaultPlan,
+            install_fault_plan,
+        )
+
+        with open(args.fault_plan) as fh:
+            plan = FaultPlan.from_json(fh.read())
+        install_fault_plan(plan)
+        print(
+            f"FAULT PLAN ACTIVE (seed {plan.seed}, "
+            f"{len(plan.rules)} rule(s)) — chaos testing only",
+            flush=True,
+        )
+
+    # admission implies deadline shedding: an overload plane that
+    # rejects at the door but still executes expired work would shed
+    # the wrong requests
+    shed = bool(getattr(args, "shed_expired", False)) or (
+        getattr(args, "admission", 0) > 0
+    )
+    chan_kw = dict(
+        shed_expired=shed,
+        breaker_threshold=getattr(args, "breaker_threshold", 5),
+        breaker_reset_s=getattr(args, "breaker_reset_s", 10.0),
+    )
     mesh_config = parse_mesh(args.mesh)
     if args.mesh:
         # explicit --mesh: serve the whole mesh data-parallel — params
         # replicated, request batches sharded over the data axis
-        channel = ShardedTPUChannel(repo, mesh_config=mesh_config)
+        channel = ShardedTPUChannel(repo, mesh_config=mesh_config, **chan_kw)
         print(
             f"mesh serving: {channel.stats()['mesh_devices']} devices, "
             f"data axis {channel.batch_multiple} "
             f"(batches shard over 'data'; params replicated)", flush=True,
         )
     else:
-        channel = TPUChannel(repo, mesh_config=mesh_config)
+        channel = TPUChannel(repo, mesh_config=mesh_config, **chan_kw)
     if args.batching:
         from triton_client_tpu.runtime.batching import BatchingChannel
 
@@ -166,6 +260,7 @@ def build_server(args):
             max_merge=getattr(args, "max_merge", None),
             pad_to_buckets=getattr(args, "pad_buckets", False),
             merge_hold_us=getattr(args, "merge_hold_us", 0),
+            shed_expired=shed,
         )
         print(
             f"micro-batching: max_batch={args.max_batch} "
@@ -185,6 +280,8 @@ def build_server(args):
         trace_capacity=getattr(args, "trace_capacity", 256),
         slo_ms=getattr(args, "slo_ms", 0.0),
         slo_tail_capacity=getattr(args, "slo_tail_capacity", 64),
+        admission_max_queue=getattr(args, "admission", 0),
+        admission_concurrency=getattr(args, "admission_concurrency", 4),
     )
 
 

@@ -79,6 +79,16 @@ METRIC_TYPES: dict[str, str] = {
     "tpu_serving_slo_requests_total": "counter",
     "tpu_serving_slo_tail_buffered": "gauge",
     "tpu_serving_deadline_expired_launches_total": "counter",
+    # overload-control plane (round 12): requests deliberately shed at
+    # each stage of the pipeline (admission door / bounded queue /
+    # batch merge / pre-launch / breaker), per-model circuit-breaker
+    # state (0 closed, 1 half-open, 2 open) and cumulative opens,
+    # admission queue depth, and the drain flag orchestrators watch
+    "tpu_serving_shed_total": "counter",
+    "tpu_serving_breaker_state": "gauge",
+    "tpu_serving_breaker_opens_total": "counter",
+    "tpu_serving_admission_queue_depth": "gauge",
+    "tpu_serving_draining": "gauge",
 }
 
 _HBM_KINDS = ("bytes_in_use", "bytes_limit", "peak_bytes_in_use")
@@ -165,22 +175,29 @@ class RuntimeCollector:
         repository=None,
         histograms=None,
         slo=None,
+        admission=None,
     ) -> None:
         """``histograms``: an obs.histogram.HistogramFamily of per
         (model, stage) latency histograms; ``slo``: an obs.slo.
-        SLOTracker. Both optional — their metric families export empty
-        (HELP/TYPE only) when absent, so the family inventory test
-        keeps pinning the series names either way."""
+        SLOTracker; ``admission``: a runtime.admission.
+        AdmissionController. All optional — their metric families
+        export empty (HELP/TYPE only) when absent, so the family
+        inventory test keeps pinning the series names either way."""
         self._batching, self._tpu = _split_channel(channel)
         self._tracer = tracer
         self._repository = repository
         self._histograms = histograms
         self._slo = slo
+        self._admission = admission
         self._ns = namespace
         self._compile = CompileEvents.install()
         self._lock = threading.Lock()
         self._inflight_requests = 0
         self._errors: dict[tuple[str, str], int] = {}
+        # admission-door sheds ("model|priority|stage"); the channel
+        # and batcher keep their own stage sheds, merged at snapshot
+        self._shed: dict[str, int] = {}
+        self._draining = False
         self._registry = None
         if registry is not None:
             registry.register(self)
@@ -201,12 +218,26 @@ class RuntimeCollector:
             key = (model, code)
             self._errors[key] = self._errors.get(key, 0) + 1
 
+    def record_shed(self, model: str, priority: int, stage: str) -> None:
+        """One request deliberately rejected at ``stage`` (the server
+        calls this for admission-door sheds; channel/batcher stages
+        count their own and are merged at snapshot time)."""
+        with self._lock:
+            key = f"{model}|{int(priority)}|{stage}"
+            self._shed[key] = self._shed.get(key, 0) + 1
+
+    def set_draining(self, draining: bool) -> None:
+        with self._lock:
+            self._draining = bool(draining)
+
     # -- snapshot API (perf scripts + scrape share this) ----------------------
 
     def snapshot(self) -> dict:
         with self._lock:
             inflight = self._inflight_requests
             errors = {f"{m}|{c}": n for (m, c), n in self._errors.items()}
+            shed = dict(self._shed)
+            draining = self._draining
         snap = {
             "channel": self._tpu.stats() if self._tpu is not None else None,
             "batching": (
@@ -217,6 +248,16 @@ class RuntimeCollector:
             "compile": self._compile.snapshot(),
             "memory": self._memory(),
         }
+        # one shed ledger across the whole pipeline: admission-door
+        # sheds (recorded here) + the queue/merge/launch/breaker stages
+        # the batcher and staged channel count in their own stats()
+        for src in (snap["channel"], snap["batching"]):
+            for key, n in ((src or {}).get("shed") or {}).items():
+                shed[key] = shed.get(key, 0) + n
+        snap["shed"] = shed
+        snap["draining"] = int(draining)
+        if self._admission is not None:
+            snap["admission"] = self._admission.stats()
         if self._tracer is not None:
             snap["tracer"] = self._tracer.stats()
         if self._histograms is not None:
@@ -562,6 +603,52 @@ class RuntimeCollector:
             f"{ns}_deadline_expired_launches_total",
             "batches launched after their request deadline had passed",
             chan.get("deadline_expired_launches", 0),
+        )
+
+        # overload-control plane: sheds by pipeline stage, breaker
+        # state machine, admission queue depth, drain flag
+        yield counter(
+            f"{ns}_shed_total",
+            "requests deliberately rejected, by model, priority, and "
+            "pipeline stage (admission/queue/merge/launch/breaker)",
+            0,
+            labels=["model", "priority", "stage"],
+            samples=[
+                (key.split("|", 2), n)
+                for key, n in (snap.get("shed") or {}).items()
+            ],
+        )
+        breaker = chan.get("breaker") or {}
+        yield gauge(
+            f"{ns}_breaker_state",
+            "per-model circuit-breaker state "
+            "(0 closed, 1 half-open, 2 open)",
+            0,
+            labels=["model"],
+            samples=[([m], c["state"]) for m, c in breaker.items()],
+        )
+        yield counter(
+            f"{ns}_breaker_opens_total",
+            "circuit-breaker open transitions per model",
+            0,
+            labels=["model"],
+            samples=[([m], c["opens"]) for m, c in breaker.items()],
+        )
+        adm = snap.get("admission") or {}
+        yield gauge(
+            f"{ns}_admission_queue_depth",
+            "admitted-but-unfinished requests per model "
+            "(the admission controller's queue-depth knee input)",
+            0,
+            labels=["model"],
+            samples=[
+                ([m], d) for m, d in (adm.get("inflight") or {}).items()
+            ],
+        )
+        yield gauge(
+            f"{ns}_draining",
+            "1 while the server is draining (SIGTERM / drain())",
+            snap.get("draining", 0),
         )
 
         # device HBM (absent on backends without memory_stats)

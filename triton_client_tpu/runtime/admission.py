@@ -1,0 +1,293 @@
+"""Admission control, load shedding, and failure isolation primitives.
+
+PR 6 built the SLO *observability* plane — deadlines stamped at
+admission, attainment scored on every exit path — but nothing
+*enforced* it: a request whose deadline had already expired still
+launched on the device (``staged.py`` only counted it), and the
+batcher's bounded queue blocked the submitting RPC thread instead of
+shedding. This module is the enforcement half:
+
+  * **typed overload errors** — one exception per degradation decision,
+    each mapping to the gRPC status code the client retry ladder keys
+    on (``RESOURCE_EXHAUSTED`` is non-retryable for ModelInfer, so
+    shedding never amplifies load);
+  * :class:`AdmissionController` — per-model queue-depth and
+    estimated-wait accounting. A request is rejected AT THE DOOR when
+    the queue ahead of it already eats its whole deadline budget:
+    rejecting in microseconds is strictly better than timing out after
+    consuming a device slot. Low-priority requests hit a lower
+    queue-depth knee, so they shed first under pressure;
+  * :class:`CircuitBreaker` — the closed -> open -> half-open machine
+    the staged channels wrap around launch/readback: consecutive
+    failures open the circuit (fail-fast ``UNAVAILABLE``, launch cache
+    invalidated), a timed probe half-opens it, one success closes it.
+
+Everything here is stdlib-only and lock-cheap: admit() is a dict read
+plus two comparisons on the RPC thread.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class OverloadError(RuntimeError):
+    """Base for every deliberate degradation decision (vs a bug)."""
+
+
+class AdmissionRejectedError(OverloadError):
+    """Shed at the door: queue depth or estimated wait already exceeds
+    the request's deadline budget. Maps to ``RESOURCE_EXHAUSTED``."""
+
+
+class QueueFullError(AdmissionRejectedError):
+    """The batcher's bounded admission queue is full — fail-fast
+    rejection instead of blocking the submitting RPC thread. Maps to
+    ``RESOURCE_EXHAUSTED`` like any other shed."""
+
+
+class DeadlineExpiredError(OverloadError):
+    """The request's deadline passed while it was queued; it was shed
+    before touching the device. Maps to ``DEADLINE_EXCEEDED``."""
+
+
+class CircuitOpenError(OverloadError):
+    """The model's circuit breaker is open (recent consecutive
+    failures); fail-fast until the timed probe. Maps to
+    ``UNAVAILABLE`` — connection-class, safe for clients to retry
+    elsewhere."""
+
+
+class ServerDrainingError(OverloadError):
+    """The server is draining (SIGTERM / ``drain()``): in-flight work
+    completes, new work is refused. Maps to ``UNAVAILABLE``."""
+
+
+class AdmissionController:
+    """Per-model bounded queue-depth / estimated-wait admission.
+
+    ``max_queue``: hard cap on per-model admitted-but-unfinished
+    requests (the knee for priority >= 0; lower priorities hit
+    ``max_queue * low_priority_fraction``). ``concurrency``: how many
+    requests the serving stack works concurrently per model (batcher
+    merge width x pipeline depth, roughly) — divides the estimated
+    wait so a healthy batched server is not over-shed. The service-time
+    estimate is an EWMA over completed requests, seeded by the first
+    completion; until then only the depth knee applies.
+    """
+
+    def __init__(
+        self,
+        max_queue: int = 64,
+        concurrency: int = 4,
+        low_priority_fraction: float = 0.5,
+        ewma_alpha: float = 0.2,
+    ) -> None:
+        self._max_queue = max(1, int(max_queue))
+        self._concurrency = max(1, int(concurrency))
+        self._low_frac = min(1.0, max(0.05, float(low_priority_fraction)))
+        self._alpha = min(1.0, max(0.01, float(ewma_alpha)))
+        self._lock = threading.Lock()
+        self._inflight: dict[str, int] = {}
+        self._ewma_s: dict[str, float] = {}
+        self._rejects: dict[tuple[str, int], int] = {}
+        self._admitted = 0
+
+    # -- accounting hooks (server request lifecycle) --------------------------
+
+    def admit(
+        self,
+        model: str,
+        deadline_s: float | None = None,
+        priority: int = 0,
+        now: float | None = None,
+    ) -> None:
+        """Admit or raise :class:`AdmissionRejectedError`. On admission
+        the request counts against the model's queue until
+        :meth:`finished`. Callers MUST pair a successful admit with
+        finished() on every exit path (the server does both in its
+        ``finally``-rooted accounting)."""
+        with self._lock:
+            depth = self._inflight.get(model, 0)
+            limit = self._max_queue
+            if priority < 0:
+                # low-priority knee: shed the background class first,
+                # long before the interactive class feels the queue
+                limit = max(1, int(limit * self._low_frac))
+            reason = None
+            if depth >= limit:
+                reason = (
+                    f"queue depth {depth} >= limit {limit} "
+                    f"(priority {priority})"
+                )
+            elif deadline_s is not None:
+                ewma = self._ewma_s.get(model)
+                if ewma is not None:
+                    if now is None:
+                        now = time.perf_counter()
+                    est_wait = depth * ewma / self._concurrency
+                    budget = deadline_s - now
+                    if est_wait > budget:
+                        reason = (
+                            f"estimated queue wait {est_wait * 1e3:.1f}ms "
+                            f"exceeds deadline budget {budget * 1e3:.1f}ms"
+                        )
+            if reason is not None:
+                key = (model, int(priority))
+                self._rejects[key] = self._rejects.get(key, 0) + 1
+                raise AdmissionRejectedError(
+                    f"model '{model}' overloaded: {reason}"
+                )
+            self._inflight[model] = depth + 1
+            self._admitted += 1
+
+    def finished(self, model: str, service_s: float | None = None) -> None:
+        """One admitted request left the building (any outcome).
+        ``service_s`` (wall seconds, successful requests only) feeds
+        the EWMA the estimated-wait check divides by."""
+        with self._lock:
+            depth = self._inflight.get(model, 0)
+            if depth > 0:
+                self._inflight[model] = depth - 1
+            if service_s is not None and service_s >= 0:
+                prev = self._ewma_s.get(model)
+                self._ewma_s[model] = (
+                    service_s
+                    if prev is None
+                    else prev + self._alpha * (service_s - prev)
+                )
+
+    # -- reading --------------------------------------------------------------
+
+    def estimated_wait_s(self, model: str) -> float:
+        with self._lock:
+            ewma = self._ewma_s.get(model)
+            if ewma is None:
+                return 0.0
+            return self._inflight.get(model, 0) * ewma / self._concurrency
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "max_queue": self._max_queue,
+                "concurrency": self._concurrency,
+                "admitted": self._admitted,
+                "inflight": dict(self._inflight),
+                "ewma_ms": {
+                    m: round(v * 1e3, 3) for m, v in self._ewma_s.items()
+                },
+                "rejects": {
+                    f"{m}|{p}": n for (m, p), n in self._rejects.items()
+                },
+            }
+
+
+# breaker states, exported as the tpu_serving_breaker_state gauge value
+CLOSED, HALF_OPEN, OPEN = 0, 1, 2
+
+
+class _BreakerCell:
+    __slots__ = ("state", "consecutive", "opens", "open_until", "probing")
+
+    def __init__(self) -> None:
+        self.state = CLOSED
+        self.consecutive = 0
+        self.opens = 0
+        self.open_until = 0.0
+        self.probing = False
+
+
+class CircuitBreaker:
+    """Per-key (model) closed -> open -> half-open circuit breaker.
+
+    ``threshold`` consecutive failures open the circuit for
+    ``reset_s`` seconds; the first :meth:`allow` after the window
+    half-opens it and admits exactly ONE probe (other callers keep
+    failing fast); the probe's success closes the circuit, its failure
+    re-opens the window. The staged channels call this around every
+    launch/readback and invalidate their launch cache on open, so a
+    recovery recompiles from a clean slate."""
+
+    def __init__(self, threshold: int = 3, reset_s: float = 30.0) -> None:
+        self._threshold = max(1, int(threshold))
+        self._reset_s = max(0.0, float(reset_s))
+        self._lock = threading.Lock()
+        self._cells: dict[str, _BreakerCell] = {}
+
+    def _cell(self, key: str) -> _BreakerCell:
+        cell = self._cells.get(key)
+        if cell is None:
+            cell = self._cells[key] = _BreakerCell()
+        return cell
+
+    def allow(self, key: str, now: float | None = None) -> bool:
+        """May a request for ``key`` proceed right now? False means
+        fail fast with :class:`CircuitOpenError` — the caller must not
+        touch the device."""
+        if now is None:
+            now = time.perf_counter()
+        with self._lock:
+            # materialize the cell even while healthy so states() (and
+            # the tpu_serving_breaker_state gauge) report an explicit
+            # CLOSED for every model this breaker guards — a dashboard
+            # distinguishes "closed" from "never served"
+            cell = self._cell(key)
+            if cell.state == CLOSED:
+                return True
+            if cell.state == OPEN:
+                if now < cell.open_until:
+                    return False
+                cell.state = HALF_OPEN
+                cell.probing = True
+                return True  # this caller IS the probe
+            # HALF_OPEN: one probe in flight at a time
+            if cell.probing:
+                return False
+            cell.probing = True
+            return True
+
+    def record_success(self, key: str) -> None:
+        with self._lock:
+            cell = self._cells.get(key)
+            if cell is None:
+                return
+            cell.state = CLOSED
+            cell.consecutive = 0
+            cell.probing = False
+
+    def record_failure(self, key: str, now: float | None = None) -> bool:
+        """Count one failure; returns True when this failure OPENED the
+        circuit (the caller then invalidates its launch cache)."""
+        if now is None:
+            now = time.perf_counter()
+        with self._lock:
+            cell = self._cell(key)
+            cell.consecutive += 1
+            was_open = cell.state == OPEN
+            if cell.state == HALF_OPEN or cell.consecutive >= self._threshold:
+                cell.state = OPEN
+                cell.open_until = now + self._reset_s
+                cell.probing = False
+                if not was_open:
+                    cell.opens += 1
+                    return True
+        return False
+
+    def state(self, key: str) -> int:
+        with self._lock:
+            cell = self._cells.get(key)
+            return CLOSED if cell is None else cell.state
+
+    def states(self) -> dict:
+        """{key: {"state": 0|1|2, "opens": n, "consecutive": n}} for
+        the collector's breaker gauges."""
+        with self._lock:
+            return {
+                k: {
+                    "state": c.state,
+                    "opens": c.opens,
+                    "consecutive": c.consecutive,
+                }
+                for k, c in self._cells.items()
+            }

@@ -44,6 +44,11 @@ import numpy as np
 
 from triton_client_tpu.channel.base import BaseChannel, InferRequest, InferResponse
 from triton_client_tpu.obs.trace import MultiTrace
+from triton_client_tpu.runtime import faults
+from triton_client_tpu.runtime.admission import (
+    DeadlineExpiredError,
+    QueueFullError,
+)
 from triton_client_tpu.runtime.padding import bucket, bucket_for, pad_rows
 
 log = logging.getLogger(__name__)
@@ -77,6 +82,7 @@ class BatchingChannel(BaseChannel):
         pad_to_buckets: bool = False,
         merge_hold_us: int = 0,
         arena_slots: int = 0,
+        shed_expired: bool = False,
     ) -> None:
         """``pipeline_depth``: formed batches executing concurrently
         against the inner channel. At the default 2, batch N+1's
@@ -114,6 +120,15 @@ class BatchingChannel(BaseChannel):
         batch per input name; oversized batches and exhausted pools
         fall back to the allocating path. Requires the native library;
         silently off when it cannot build.
+
+        ``shed_expired`` (round 12 — overload control): at dispatch
+        time, members whose deadline already passed are FAILED with
+        ``DeadlineExpiredError`` and never reach the device — the
+        merged batch would otherwise inherit the expired member's
+        deadline and be shed whole by the inner channel. Staged windows
+        are also ordered highest-priority-first, so under a backlog the
+        low-priority class queues longest and sheds first. Off by
+        default (PR 6's count-only behavior).
 
         Slot lifetime (round 6 — overlapped dispatch): an execution
         slot frees at *launch*, not at readback. Each group dispatches
@@ -159,6 +174,11 @@ class BatchingChannel(BaseChannel):
             "merges": 0, "merged_frames": 0, "padded_frames": 0,
             "launch_frees": 0,
         }
+        self._shed_expired = bool(shed_expired)
+        # per "model|priority|stage" shed counts ("queue" = admission
+        # queue full, "merge" = deadline expired at dispatch), merged
+        # into the collector's tpu_serving_shed_total family
+        self._shed: collections.Counter = collections.Counter()
         self._merge_occupancy: collections.Counter = collections.Counter()
         # per-slot occupancy: concurrently-active execution slots
         # observed at each group launch (1..pipeline_depth)
@@ -242,7 +262,17 @@ class BatchingChannel(BaseChannel):
         if not admitted:
             with self._lock:
                 self._pending.pop(rid, None)
-            raise RuntimeError("inference queue full")
+            # fail-fast, never block the submitting RPC thread: the
+            # server surfaces this as RESOURCE_EXHAUSTED, which the
+            # client retry ladder treats as non-retryable for
+            # ModelInfer — shedding must not amplify offered load
+            with self._ready_cv:
+                self._shed[
+                    f"{request.model_name}|{request.priority}|queue"
+                ] += 1
+            raise QueueFullError(
+                f"model '{request.model_name}': inference queue full"
+            )
         return future.result()
 
     # -- admission release (runs on the batcher thread) -----------------------
@@ -266,6 +296,13 @@ class BatchingChannel(BaseChannel):
             staged.append((key, size, request, future, t_now))
         if not staged:
             return
+        if self._shed_expired and len(staged) > 1:
+            # priority-aware ordering: within the released window the
+            # high-priority class stages (and therefore dispatches)
+            # first; under a backlog the low-priority tail queues
+            # longest and its deadlines expire — shed — first. Stable
+            # sort keeps arrival order within a class.
+            staged.sort(key=lambda it: -it[2].priority)
         with self._ready_cv:
             self._ready.extend(staged)
             self._ready_cv.notify()
@@ -437,11 +474,43 @@ class BatchingChannel(BaseChannel):
 
     # -- batch execution (runs on the executor threads) -----------------------
 
+    def _shed_expired_members(self, group) -> list:
+        """Fail members whose deadline already passed (the batcher-merge
+        shed point) and return the still-live remainder. A merged batch
+        inherits its tightest member's deadline, so ONE expired member
+        left in place would get the whole group shed at launch."""
+        now = time.perf_counter()
+        live = []
+        for item in group:
+            t_staged, request, future = item
+            deadline = request.deadline_s
+            if deadline is None or now <= deadline:
+                live.append(item)
+                continue
+            if request.trace is not None:
+                request.trace.end("batch_queue")
+            with self._ready_cv:
+                self._shed[
+                    f"{request.model_name}|{request.priority}|merge"
+                ] += 1
+            future.set_exception(
+                DeadlineExpiredError(
+                    f"model '{request.model_name}': deadline expired "
+                    f"{(now - deadline) * 1e3:.1f}ms before dispatch"
+                )
+            )
+        return live
+
     def _run_group(self, group, free_slot=None) -> None:
         """Execute one formed group. ``free_slot`` (when given) is
         called exactly once, as soon as the group's device work is
         launched — inputs staged, compute enqueued — so the dispatcher
         slot frees before the readback/split work."""
+        faults.probe("batcher_stall", group[0][1].model_name)
+        if self._shed_expired:
+            group = self._shed_expired_members(group)
+            if not group:
+                return  # every member expired; caller's finally frees
         if len(group) == 1 and not self._pad_to_buckets:
             t_staged, request, future = group[0]
             self._run_solo(request, future, free_slot, t_staged=t_staged)
@@ -652,6 +721,7 @@ class BatchingChannel(BaseChannel):
             out["slot_occupancy"] = dict(sorted(self._slot_occupancy.items()))
             out["active_slots"] = self._active_slots
             out["ready_depth"] = len(self._ready)
+            out["shed"] = dict(self._shed)
             out["max_merge"] = self._max_merge
             out["batch_multiple"] = self._batch_multiple
             out["pipeline_depth"] = self._pipeline_depth

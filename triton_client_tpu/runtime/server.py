@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import concurrent.futures
 import logging
+import threading
 import time
 
 import grpc
@@ -30,6 +31,14 @@ from triton_client_tpu import __version__
 from triton_client_tpu.channel.base import BaseChannel, InferRequest
 from triton_client_tpu.channel.kserve import codec, pb, service
 from triton_client_tpu.config import FRAMING_BYTES
+from triton_client_tpu.runtime.admission import (
+    AdmissionController,
+    AdmissionRejectedError,
+    CircuitOpenError,
+    DeadlineExpiredError,
+    OverloadError,
+    ServerDrainingError,
+)
 from triton_client_tpu.runtime.repository import ModelRepository
 
 log = logging.getLogger(__name__)
@@ -58,12 +67,30 @@ def message_limit(repository: ModelRepository) -> int:
 
 def _grpc_code(exc: BaseException) -> str:
     """gRPC status-code label for the per-model error counter, matching
-    the codes ModelInfer aborts with."""
+    the codes ModelInfer aborts with. The overload family is mapped
+    deliberately: RESOURCE_EXHAUSTED is non-retryable for ModelInfer
+    clients (shedding must not amplify load), DEADLINE_EXCEEDED tells
+    the caller its budget — not the server — killed the request, and
+    UNAVAILABLE (breaker open / draining) is the connection-class code
+    retry ladders and load balancers key on to go elsewhere."""
+    if isinstance(exc, AdmissionRejectedError):  # incl. QueueFullError
+        return "RESOURCE_EXHAUSTED"
+    if isinstance(exc, DeadlineExpiredError):
+        return "DEADLINE_EXCEEDED"
+    if isinstance(exc, (CircuitOpenError, ServerDrainingError)):
+        return "UNAVAILABLE"
     if isinstance(exc, KeyError):
         return "NOT_FOUND"
     if isinstance(exc, ValueError):
         return "INVALID_ARGUMENT"
     return "INTERNAL"
+
+
+_GRPC_STATUS = {
+    "RESOURCE_EXHAUSTED": grpc.StatusCode.RESOURCE_EXHAUSTED,
+    "DEADLINE_EXCEEDED": grpc.StatusCode.DEADLINE_EXCEEDED,
+    "UNAVAILABLE": grpc.StatusCode.UNAVAILABLE,
+}
 
 
 class _Servicer(service.GRPCInferenceServiceServicer):
@@ -77,6 +104,8 @@ class _Servicer(service.GRPCInferenceServiceServicer):
         tracer=None,
         collector=None,
         slo=None,
+        admission: AdmissionController | None = None,
+        draining: threading.Event | None = None,
     ) -> None:
         self._repo = repository
         self._channel = channel
@@ -86,6 +115,19 @@ class _Servicer(service.GRPCInferenceServiceServicer):
         self._tracer = tracer
         self._collector = collector
         self._slo = slo
+        self._admission = admission
+        self._draining = draining
+        # in-flight request count independent of the (optional)
+        # collector — drain() polls it to know when the building is empty
+        self._active = 0
+        self._active_lock = threading.Lock()
+
+    def active_requests(self) -> int:
+        with self._active_lock:
+            return self._active
+
+    def _draining_now(self) -> bool:
+        return self._draining is not None and self._draining.is_set()
 
     # -- health ---------------------------------------------------------------
 
@@ -93,9 +135,13 @@ class _Servicer(service.GRPCInferenceServiceServicer):
         return pb.ServerLiveResponse(live=True)
 
     def ServerReady(self, request, context):
-        return pb.ServerReadyResponse(ready=True)
+        # a draining server flips not-ready FIRST so orchestrators pull
+        # it from rotation before in-flight work finishes
+        return pb.ServerReadyResponse(ready=not self._draining_now())
 
     def ModelReady(self, request, context):
+        if self._draining_now():
+            return pb.ModelReadyResponse(ready=False)
         try:
             self._repo.get(request.name, request.version)
             ready = True
@@ -271,7 +317,33 @@ class _Servicer(service.GRPCInferenceServiceServicer):
                 priority = 0  # malformed parameter: never fail the request
         if self._collector is not None:
             self._collector.request_started()
+        with self._active_lock:
+            self._active += 1
+        admitted = False
         try:
+            # overload plane, cheapest checks first, BEFORE parse: a
+            # shed request must cost microseconds, not a deserialize.
+            # Raising from inside this try routes through _account, so
+            # sheds are traced, error-counted, and SLO-scored as missed.
+            if self._draining_now():
+                raise ServerDrainingError(
+                    "server is draining; retry against another replica"
+                )
+            if self._admission is not None:
+                try:
+                    self._admission.admit(
+                        request.model_name,
+                        deadline_s=deadline_s,
+                        priority=priority,
+                        now=t0,
+                    )
+                except AdmissionRejectedError:
+                    if self._collector is not None:
+                        self._collector.record_shed(
+                            request.model_name, priority, "admission"
+                        )
+                    raise
+                admitted = True
             if trace is not None:
                 with trace.span("parse"):
                     inputs = codec.parse_infer_request(request, shm=self._shm)
@@ -307,6 +379,7 @@ class _Servicer(service.GRPCInferenceServiceServicer):
             self._account(
                 request.model_name, t0, trace, error=e,
                 deadline_s=deadline_s, priority=priority,
+                admitted=admitted,
             )
             raise
 
@@ -343,12 +416,14 @@ class _Servicer(service.GRPCInferenceServiceServicer):
                 self._account(
                     request.model_name, t0, trace, error=error,
                     deadline_s=deadline_s, priority=priority,
+                    admitted=admitted,
                 )
 
         return finish
 
     def _account(
-        self, model_name, t0, trace, error=None, deadline_s=None, priority=0
+        self, model_name, t0, trace, error=None, deadline_s=None, priority=0,
+        admitted=False,
     ) -> None:
         """Per-request bookkeeping, success or failure: latency sample
         (the Triton :8002 serving-metrics role, README.md:88-95), error
@@ -384,6 +459,15 @@ class _Servicer(service.GRPCInferenceServiceServicer):
             if error is not None:
                 self._collector.record_error(model_name, _grpc_code(error))
             self._collector.request_finished()
+        if self._admission is not None and admitted:
+            # successful requests feed the EWMA the estimated-wait
+            # check divides by; failures only release their slot
+            self._admission.finished(
+                model_name,
+                service_s=(now - t0) if error is None else None,
+            )
+        with self._active_lock:
+            self._active -= 1
 
     def _infer(self, request):
         return self._issue(request)()
@@ -399,10 +483,18 @@ class _Servicer(service.GRPCInferenceServiceServicer):
             self._require_local(context)
         try:
             return self._infer(request)
+        except OverloadError as e:
+            context.abort(_GRPC_STATUS[_grpc_code(e)], str(e))
         except KeyError as e:
             context.abort(grpc.StatusCode.NOT_FOUND, str(e))
         except ValueError as e:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        except Exception as e:
+            # launch/readback faults (incl. injected ones) abort as
+            # INTERNAL — matching the _grpc_code error-counter label —
+            # instead of grpc's opaque UNKNOWN, so clients can key
+            # retry-elsewhere policy on a stable code
+            context.abort(grpc.StatusCode.INTERNAL, str(e))
 
     def ModelStreamInfer(self, request_iterator, context):
         """Pipelined stream serving: up to ``stream_pipeline_depth``
@@ -422,7 +514,7 @@ class _Servicer(service.GRPCInferenceServiceServicer):
                     yield pb.ModelStreamInferResponse(
                         infer_response=self._infer(request)
                     )
-                except (KeyError, ValueError) as e:
+                except (KeyError, ValueError, OverloadError) as e:
                     yield pb.ModelStreamInferResponse(error_message=str(e))
             return
 
@@ -445,7 +537,7 @@ class _Servicer(service.GRPCInferenceServiceServicer):
                         return
                     try:
                         finish = self._issue(request)
-                    except (KeyError, ValueError) as e:
+                    except (KeyError, ValueError, OverloadError) as e:
                         q.put(("error", str(e)))
                         continue
                     q.put(("finish", finish))
@@ -468,7 +560,7 @@ class _Servicer(service.GRPCInferenceServiceServicer):
                         yield pb.ModelStreamInferResponse(
                             infer_response=payload()
                         )
-                    except (KeyError, ValueError) as e:
+                    except (KeyError, ValueError, OverloadError) as e:
                         yield pb.ModelStreamInferResponse(
                             error_message=str(e)
                         )
@@ -499,6 +591,8 @@ class InferenceServer:
         slo_ms: float = 0.0,
         slo_per_model: dict | None = None,
         slo_tail_capacity: int = 64,
+        admission_max_queue: int = 0,
+        admission_concurrency: int = 4,
     ) -> None:
         """``metrics_port``: serve the telemetry endpoint — Prometheus
         exposition on ``/metrics`` (Triton's :8002 role), Chrome-trace
@@ -520,7 +614,23 @@ class InferenceServer:
         model name; ``slo_tail_capacity`` bounds the ring of
         SLO-violating / p99+ exemplar traces exported at
         ``/traces?slo_violations=1``. The SLO ring requires
-        ``metrics_port`` (it lives on the telemetry plane)."""
+        ``metrics_port`` (it lives on the telemetry plane).
+        ``admission_max_queue``: per-model admitted-but-unfinished cap
+        for the admission controller (0 = no admission control, the
+        pre-round-7 behavior); requests beyond it — or whose estimated
+        queue wait exceeds their deadline budget — are rejected with
+        RESOURCE_EXHAUSTED before parse. ``admission_concurrency``:
+        assumed per-model service concurrency for the estimated-wait
+        math (batcher width x pipeline depth, roughly)."""
+        self.admission = (
+            AdmissionController(
+                max_queue=admission_max_queue,
+                concurrency=admission_concurrency,
+            )
+            if admission_max_queue > 0
+            else None
+        )
+        self._draining = threading.Event()
         if metrics_port and profiler is None:
             from triton_client_tpu.utils.profiling import StageProfiler
 
@@ -582,7 +692,7 @@ class InferenceServer:
             self.collector = RuntimeCollector(
                 channel=channel, tracer=self.tracer, registry=registry,
                 repository=repository, histograms=self.histograms,
-                slo=self.slo,
+                slo=self.slo, admission=self.admission,
             )
             try:
                 from triton_client_tpu.obs.http import TelemetryServer
@@ -613,19 +723,19 @@ class InferenceServer:
         )
 
         self.shm_registry = SystemSharedMemoryRegistry()
-        service.add_servicer_to_server(
-            _Servicer(
-                repository,
-                channel,
-                profiler=profiler,
-                shm_registry=self.shm_registry,
-                stream_pipeline_depth=stream_pipeline_depth,
-                tracer=self.tracer,
-                collector=self.collector,
-                slo=self.slo,
-            ),
-            self._server,
+        self._servicer = _Servicer(
+            repository,
+            channel,
+            profiler=profiler,
+            shm_registry=self.shm_registry,
+            stream_pipeline_depth=stream_pipeline_depth,
+            tracer=self.tracer,
+            collector=self.collector,
+            slo=self.slo,
+            admission=self.admission,
+            draining=self._draining,
         )
+        service.add_servicer_to_server(self._servicer, self._server)
         self._port = self._server.add_insecure_port(address)
         if self._port == 0:
             raise RuntimeError(f"could not bind {address}")
@@ -667,6 +777,37 @@ class InferenceServer:
 
     def wait(self) -> None:
         self._server.wait_for_termination()
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    def drain(self, timeout_s: float = 10.0, poll_s: float = 0.02) -> bool:
+        """Graceful shutdown (the SIGTERM path): flip health not-ready
+        and refuse NEW requests with UNAVAILABLE, let in-flight work
+        complete up to ``timeout_s``, then tear down in order — gRPC
+        transport, telemetry endpoint, collector, shared-memory
+        mappings, and finally the channel stack (batcher dispatcher /
+        executors / arena, via its ``close()``). Returns True when the
+        building emptied inside the timeout, False when stragglers were
+        force-cancelled. Idempotent with :meth:`stop`."""
+        self._draining.set()
+        if self.collector is not None:
+            self.collector.set_draining(True)
+        deadline = time.monotonic() + max(0.0, float(timeout_s))
+        drained = False
+        while time.monotonic() < deadline:
+            if self._servicer.active_requests() <= 0:
+                drained = True
+                break
+            time.sleep(poll_s)
+        # stop(grace) rejects anything new at the transport and waits
+        # out stragglers up to the remaining budget before cancelling
+        self.stop(grace=max(0.0, deadline - time.monotonic()) + 0.1)
+        close = getattr(self.channel, "close", None)
+        if close is not None:
+            close()
+        return drained
 
     def stop(self, grace: float = 1.0) -> None:
         self._server.stop(grace).wait()

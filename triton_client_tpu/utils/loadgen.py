@@ -310,6 +310,30 @@ class OpenLoopResult:
         ok = sum(1 for v in self.latencies_ms if v <= slo_ms)
         return ok / self.scheduled
 
+    def goodput_qps(self, slo_ms: float) -> float:
+        """SLO-met completions per second of the scheduled window —
+        the capacity number that matters under shedding: offered load
+        the server *served within budget*, not load it survived."""
+        if self.wall_s <= 0:
+            return 0.0
+        return sum(1 for v in self.latencies_ms if v <= slo_ms) / self.wall_s
+
+    @property
+    def shed_count(self) -> int:
+        """Requests the server deliberately rejected with
+        RESOURCE_EXHAUSTED (admission door / bounded queue) — distinct
+        from transport faults in the same ``errors`` list."""
+        return sum(
+            1 for e in self.errors if "RESOURCE_EXHAUSTED" in str(e)
+        )
+
+    @property
+    def shed_rate(self) -> float:
+        """Shed fraction of the SCHEDULED population."""
+        if self.scheduled <= 0:
+            return 0.0
+        return self.shed_count / self.scheduled
+
 
 def co_percentile(latencies_ms, scheduled: int, q: float) -> float:
     """Percentile ``q`` (0..100) of ``latencies_ms`` ranked within a
@@ -486,6 +510,8 @@ def slo_capacity_search(
             "slo_ms": slo_ms,
             "percentile": percentile,
             "slo_capacity_qps": 0.0,
+            "goodput_qps": round(res.goodput_qps(slo_ms), 3),
+            "shed_rate": round(res.shed_rate, 4),
             "p50_ms": res.percentile(50.0),
             "p99_ms": res.percentile(99.0),
             "p999_ms": res.percentile(99.9),
@@ -518,6 +544,8 @@ def slo_capacity_search(
         "slo_ms": slo_ms,
         "percentile": percentile,
         "slo_capacity_qps": round(lo, 3),
+        "goodput_qps": round(best.goodput_qps(slo_ms), 3),
+        "shed_rate": round(best.shed_rate, 4),
         "achieved_qps": round(best.achieved_qps, 3),
         "p50_ms": round(p50, 3) if p50 != float("inf") else None,
         "p99_ms": round(p99, 3) if p99 != float("inf") else None,
