@@ -520,7 +520,9 @@ def measure_serving(
     from triton_client_tpu.channel.base import InferRequest
     from triton_client_tpu.channel.tpu_channel import TPUChannel
     from triton_client_tpu.pipelines.detect2d import build_yolov5_pipeline
-    from triton_client_tpu.runtime.batching import BatchingChannel
+    from triton_client_tpu.runtime.continuous import (
+        ContinuousBatchingChannel,
+    )
     from triton_client_tpu.runtime.repository import ModelRepository
     from triton_client_tpu.runtime.server import InferenceServer
 
@@ -660,12 +662,13 @@ def measure_serving(
     # into an error count instead of a rate
     deadline_s = max(180.0, direct_batch_ms / 1e3 * clients * 20)
 
-    batching = BatchingChannel(
-        inner, max_batch=max_batch, timeout_us=3000,
+    # continuous scheduler (ISSUE 8): windowless EDF admission, dense
+    # fallback padded to live-occupancy buckets — the merge-hold knob
+    # the window batcher needed to fill merges is obsolete (arrivals
+    # pool while device work is in flight)
+    batching = ContinuousBatchingChannel(
+        inner, max_batch=max_batch,
         max_merge=max_merge, pad_to_buckets=True,
-        # ~4% of a measured ~0.6 s batch: converts the closed-loop
-        # clients' staggered-arrival fragments into full merges
-        merge_hold_us=25_000,
     )
     server = InferenceServer(
         repo, batching, address="127.0.0.1:0", max_workers=clients + 8
@@ -710,6 +713,15 @@ def measure_serving(
             "merged_frames", 0
         )
         d_merges = stats.get("merges", 0) - stats0.get("merges", 0)
+        d_padded = stats.get("padded_frames", 0) - stats0.get(
+            "padded_frames", 0
+        )
+        d_ragged_rows = stats.get("ragged_rows", 0) - stats0.get(
+            "ragged_rows", 0
+        )
+        d_ragged_pad = stats.get("ragged_pad_rows", 0) - stats0.get(
+            "ragged_pad_rows", 0
+        )
         mean_batch = (d_frames / d_merges) if d_merges else 0.0
         suffix = "_shm" if use_shm else ""
         row = {
@@ -763,6 +775,17 @@ def measure_serving(
             "mean_batch": round(float(mean_batch), 2),
             "padded_frames": stats.get("padded_frames", 0)
             - stats0.get("padded_frames", 0),
+            # padding-tax headline for the window: pad rows (dense
+            # bucket pad + ragged alignment slack) over all device rows
+            "pad_fraction": round(
+                (d_padded + d_ragged_pad)
+                / max(1, d_frames + d_padded + d_ragged_rows + d_ragged_pad),
+                4,
+            ),
+            "ragged_batches": stats.get("ragged_batches", 0)
+            - stats0.get("ragged_batches", 0),
+            "ragged_rows": d_ragged_rows,
+            "ragged_pad_rows": d_ragged_pad,
             "batch_occupancy": {
                 str(k): occupancy[k] for k in sorted(occupancy)
             },

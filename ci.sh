@@ -65,6 +65,17 @@ python -m pytest tests/test_slo.py -q -m '' \
     --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly
 
+echo "== continuous-batching shard (EDF, ragged packing, pad tax) =="
+# the windowless-scheduler contract (runtime/continuous.py,
+# parallel/ragged_kernels.py): EDF ordering, packed-ragged parity vs
+# solo on both channel shapes, dense bitwise parity vs the window
+# batcher — plus the slow-marked seeded open-loop drives that hold the
+# served pad fraction under the 5% acceptance bar (tier-1 deselects
+# them, this shard runs them)
+python -m pytest tests/test_continuous_batching.py -q -m '' \
+    --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+
 echo "== chaos shard (fault injection + overload control, seed 7) =="
 # the robustness contract (runtime/admission.py, runtime/faults.py,
 # breaker + drain): every FaultPlan point driven end-to-end under a

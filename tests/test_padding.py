@@ -51,6 +51,39 @@ def test_bucket_for_batch_larger_than_largest_common_bucket():
     assert bucket_for(1000, multiple=8) == 1024
 
 
+@pytest.mark.parametrize(
+    "n,multiple,expected",
+    [
+        # data=6 mesh (paired trays): cover bucket(n), then round up to
+        # the axis — NOT 6 * bucket(ceil(n/6)), which overshoots
+        (1, 6, 6),
+        (5, 6, 6),
+        (6, 6, 6),
+        (7, 6, 12),     # bucket(7)=8 -> next multiple of 6
+        (13, 6, 18),    # the docstring case: 18, not the old 24
+        (17, 6, 36),    # bucket(17)=32 -> 36
+        (33, 6, 66),    # bucket(33)=64 -> 66
+        # data=3
+        (2, 3, 3),
+        (4, 3, 6),      # bucket(4)=4 -> 6
+        (9, 3, 18),     # bucket(9)=16 -> 18
+        # data=12
+        (11, 12, 12),
+        (13, 12, 24),   # bucket(13)=16 -> 24
+        (50, 12, 72),   # bucket(50)=64 -> 72
+    ],
+)
+def test_bucket_for_non_pow2_multiple_matrix(n, multiple, expected):
+    padded = bucket_for(n, multiple=multiple)
+    assert padded == expected
+    assert padded >= n and padded % multiple == 0
+    # minimality within the contract: the next-lower axis multiple
+    # would no longer cover the classic bucket (or drop below the
+    # one-row-per-shard floor)
+    lower = padded - multiple
+    assert lower < multiple or lower < bucket(n)
+
+
 def test_bucket_for_agrees_with_bucket_on_pow2_meshes():
     # the docstring claim: for power-of-two meshes the mesh-aware table
     # coincides with the classic table at every size >= the axis width

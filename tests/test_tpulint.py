@@ -323,6 +323,51 @@ class TestHostSyncRules:
         assert len(found) == 1 and found[0].code == "TPL301"
         assert list(check_reachable(pkg, ["other_root"])) == []
 
+    def test_continuous_dispatch_roots_are_hot(self):
+        # ISSUE 8: the windowless scheduler's ragged dispatch is a root
+        # — a sync in a helper it calls is a finding even though no
+        # window/admission thread ever reaches it
+        src = (
+            "import numpy as np\n"
+            "class ContinuousBatchingChannel:\n"
+            "    def _run_ragged_group(self, group):\n"
+            "        return _pack(group)\n"
+            "def _pack(group):\n"
+            "    return np.asarray(group)\n"
+        )
+        found = lint_source(src, codes=["TPL3"])
+        assert len(found) == 1 and found[0].context.endswith("_pack")
+
+    def test_segment_pack_placement_roots_are_hot(self):
+        # the ragged placement/launcher hooks are the packed-batch
+        # equivalents of _place_inputs/_make_launcher: a device fence
+        # inside one is a finding
+        src = (
+            "import jax\n"
+            "class StagedChannel:\n"
+            "    def _place_ragged(self, model, request):\n"
+            "        jax.block_until_ready(request)\n"
+            "        return request\n"
+            "class ShardedTPUChannel:\n"
+            "    def _make_ragged_launcher(self, model, n):\n"
+            "        jax.block_until_ready(model)\n"
+            "        return model\n"
+        )
+        assert codes(lint_source(src, codes=["TPL3"])) == ["TPL302"]
+        assert len(lint_source(src, codes=["TPL3"])) == 2
+
+    def test_real_ragged_pack_path_reachable_from_roots(self):
+        # the actual package: the segment-pack helpers the ragged
+        # dispatch calls must sit in the reachable-from-hot-roots set
+        from triton_client_tpu.analysis.rules.hostsync import HOT_PATH_ROOTS
+
+        package = analysis.load_package([PKG], root=REPO)
+        hot = package.callgraph.reachable(list(HOT_PATH_ROOTS))
+        names = {q.rsplit(".", 1)[-1] for q in hot}
+        assert "_run_ragged_group" in names
+        assert "pack_rows" in names
+        assert "shard_pack_rows" in names
+
 
 # -- TPL4xx lock discipline -------------------------------------------------
 

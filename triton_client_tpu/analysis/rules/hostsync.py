@@ -77,6 +77,20 @@ HOT_PATH_ROOTS = (
     "AdmissionController.finished",
     "CircuitBreaker.allow",
     "CircuitBreaker.record_success",
+    # ISSUE 8 continuous batching: the windowless scheduler's admission
+    # and packed-ragged dispatch run per request / per formed batch, and
+    # the segment-pack placement/launcher hooks are the ragged
+    # equivalents of _place_inputs/_make_launcher — all hot
+    "ContinuousBatchingChannel.do_inference",
+    "ContinuousBatchingChannel._form_group_locked",
+    "ContinuousBatchingChannel._run_group",
+    "ContinuousBatchingChannel._run_ragged_group",
+    "ContinuousBatchingChannel._pad_target",
+    "StagedChannel._place_ragged",
+    "StagedChannel._ragged_launcher",
+    "StagedChannel._make_ragged_launcher",
+    "ShardedTPUChannel._place_ragged",
+    "ShardedTPUChannel._make_ragged_launcher",
 )
 
 # module-level call targets that force a host sync

@@ -44,6 +44,14 @@ class InferRequest:
     # the continuous-batching scheduler (ROADMAP item 1) will order on
     # it. Higher = more important.
     priority: int = dataclasses.field(default=0, repr=False, compare=False)
+    # packed-ragged marker (parallel.ragged_kernels.RaggedLayout): set
+    # by the continuous batcher when this request's inputs are a packed
+    # concatenation of several member requests' rows. None on every
+    # dense request — channels guard on the attribute, so the dense
+    # path pays one attribute read.
+    ragged: object | None = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
 
 
 @dataclasses.dataclass

@@ -43,11 +43,20 @@ from __future__ import annotations
 import jax
 import numpy as np
 
-from triton_client_tpu.channel.staged import StagedChannel, cast_wire_input
+from triton_client_tpu.channel.staged import (
+    SEGMENT_IDS_KEY,
+    StagedChannel,
+    cast_wire_input,
+)
 from triton_client_tpu.parallel.mesh import (
     data_axis_size,
     replicate_params,
     serving_shardings,
+)
+from triton_client_tpu.parallel.ragged_kernels import (
+    ShardedRaggedLayout,
+    shard_segment_ids,
+    unshard_segments,
 )
 from triton_client_tpu.runtime.padding import bucket_for, pad_batch, unpad_rows
 
@@ -112,7 +121,71 @@ class ShardedTPUChannel(StagedChannel):
         meta = (n, target) if n is not None and target != n else None
         return device_inputs, meta
 
+    def _place_ragged(self, model, request):
+        """Packed-ragged placement over the mesh: the continuous
+        batcher packed this request SHARD-MAJOR (``request.ragged`` is
+        a :class:`ShardedRaggedLayout` built at ``batch_multiple``
+        shards — every input's leading dim is ``n_shards * per_shard``),
+        so one batch-sharded ``device_put`` hands each device exactly
+        its contiguous segment group. Segment ids are shard-LOCAL: no
+        segment straddles a device, so the launched body needs no
+        cross-device collectives."""
+        sl = request.ragged
+        batch_s, repl_s = serving_shardings(self._mesh)
+        w = sl.n_shards
+        device_inputs = {}
+        for name, arr in request.inputs.items():
+            arr = cast_wire_input(model, name, np.asarray(arr))
+            use = (
+                batch_s
+                if arr.ndim > 0 and arr.shape[0] % w == 0
+                else repl_s
+            )
+            device_inputs[name] = jax.device_put(arr, use)
+        device_inputs[SEGMENT_IDS_KEY] = jax.device_put(
+            shard_segment_ids(sl), batch_s
+        )
+        return device_inputs, sl
+
     # -- launch ---------------------------------------------------------------
+
+    def _make_ragged_launcher(self, model, num_segments: int):
+        """Sharded ragged launcher: reshape every shard-major input to
+        ``(n_shards, per_shard, ...)`` and ``vmap`` the model's
+        segment-aware body over the shard axis — under the batch
+        sharding each device then runs ONLY its own shard's segments
+        (the shard-local ids keep every reduce device-local, the SPMD
+        partitioner never inserts a collective). ``num_segments`` is
+        the per-shard capacity (:attr:`ShardedRaggedLayout.seg_pad`)."""
+        from triton_client_tpu.config import config_dtypes
+
+        batch_s, _ = serving_shardings(self._mesh)
+        w = data_axis_size(self._mesh)
+        ragged_fn = model.ragged_fn
+
+        # named distinctly from the dense `launcher`: this jit does NOT
+        # donate, and tpulint's donor index pools jit-bound names
+        # module-wide
+        @jax.jit
+        def ragged_launcher(device_inputs):
+            inputs = dict(device_inputs)
+            ids = inputs.pop(SEGMENT_IDS_KEY).reshape(w, -1)
+            sharded = {
+                k: v.reshape(w, v.shape[0] // w, *v.shape[1:])
+                for k, v in inputs.items()
+            }
+            out = jax.vmap(
+                lambda inp, i: ragged_fn(inp, i, num_segments)
+            )(sharded, ids)
+            return {
+                k: v.reshape(w * v.shape[1], *v.shape[2:])
+                for k, v in out.items()
+            }
+
+        out_dtype = {
+            t.name: config_dtypes().get(t.dtype) for t in model.spec.outputs
+        }
+        return ragged_launcher, out_dtype
 
     def _make_launcher(self, model):
         """Cached sharded launcher: donated arg carries the batched
@@ -165,6 +238,17 @@ class ShardedTPUChannel(StagedChannel):
         """Slice pad rows off batch-leading outputs (lazy device slice —
         the host copy only ever pays for real rows), then the base
         wire-dtype readback."""
+        if isinstance(meta, ShardedRaggedLayout):
+            # gather real segments per shard back into request order
+            # (lazy per-shard slices; dead seg_pad slots never copy)
+            outputs = {
+                k: unshard_segments(v, meta)
+                if getattr(v, "ndim", 0) >= 1
+                and v.shape[0] == meta.n_shards * meta.seg_pad
+                else v
+                for k, v in outputs.items()
+            }
+            return StagedChannel._host_outputs(self, outputs, out_dtype, None)
         if meta is not None:
             n, target = meta
             outputs = {

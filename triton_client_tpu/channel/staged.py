@@ -52,7 +52,15 @@ from triton_client_tpu.runtime.admission import (
     CircuitOpenError,
     DeadlineExpiredError,
 )
+from triton_client_tpu.parallel.ragged_kernels import (
+    RaggedLayout,
+    ShardedRaggedLayout,
+)
 from triton_client_tpu.runtime.repository import ModelRepository
+
+#: Reserved device-input key carrying the packed batch's row->segment
+#: table (parallel/ragged_kernels.py). Never a wire tensor name.
+SEGMENT_IDS_KEY = "__segment_ids__"
 
 
 def cast_wire_input(model, name: str, arr: np.ndarray) -> np.ndarray:
@@ -253,6 +261,59 @@ class StagedChannel(BaseChannel):
         identity (cached by :meth:`_launcher`)."""
         raise NotImplementedError
 
+    def _place_ragged(self, model, request: InferRequest):
+        """Place a PACKED ragged request (``request.ragged`` is a
+        :class:`RaggedLayout`): packed inputs and the segment-id table
+        upload with default placement (the ragged body's segment math
+        is global — XLA partitions it), per-segment inputs ride along
+        unchanged. Subclasses with explicit shardings override."""
+        layout = request.ragged
+        device_inputs = {
+            name: jax.device_put(cast_wire_input(model, name, np.asarray(arr)))
+            for name, arr in request.inputs.items()
+        }
+        device_inputs[SEGMENT_IDS_KEY] = jax.device_put(layout.segment_ids)
+        return device_inputs, layout
+
+    def _make_ragged_launcher(self, model, num_segments: int):
+        """Build ``(launcher, out_dtypes)`` for a model's segment-aware
+        body at a STATIC bucketed segment capacity. No donation: packed
+        shapes recur less often than dense buckets and a donated packed
+        buffer would alias the replicated-row pad region."""
+        from triton_client_tpu.config import config_dtypes
+
+        ragged_fn = model.ragged_fn
+
+        # named distinctly from the dense `launcher`: this jit does NOT
+        # donate, and tpulint's donor index pools jit-bound names
+        # module-wide
+        @jax.jit
+        def ragged_launcher(device_inputs):
+            inputs = dict(device_inputs)
+            ids = inputs.pop(SEGMENT_IDS_KEY)
+            return ragged_fn(inputs, ids, num_segments)
+
+        out_dtype = {
+            t.name: config_dtypes().get(t.dtype) for t in model.spec.outputs
+        }
+        return ragged_launcher, out_dtype
+
+    def _ragged_launcher(self, model, num_segments: int):
+        """The ragged analogue of :meth:`_launcher`: cached per
+        ``(model identity, segment bucket)`` — the segment capacity is
+        static in the traced program, so the executable set stays
+        log-bounded in segments (and jit's own shape cache bounds it in
+        packed rows)."""
+        key = (model.spec.name, model.spec.version, "ragged", num_segments)
+        with self._slot_cv:
+            cached = self._launch_cache.get(key)
+            if cached is not None and cached[0] is model:
+                return cached[1], cached[2]
+        launcher, out_dtype = self._make_ragged_launcher(model, num_segments)
+        with self._slot_cv:
+            self._launch_cache[key] = (model, launcher, out_dtype)
+        return launcher, out_dtype
+
     def _device_body(self, model):
         """The traced body both launcher implementations jit: the
         model's ``device_fn``, wrapped with the registered precision
@@ -274,6 +335,16 @@ class StagedChannel(BaseChannel):
         """Device outputs -> host numpy dict at the wire dtypes. The
         designed deferred-readback sync point (tpulint TPL301 baseline);
         subclasses slice off pad rows here before the copy."""
+        if isinstance(meta, RaggedLayout):
+            # drop the dead segment slots (lazy slice — the host copy
+            # below pays only for real segments)
+            outputs = {
+                k: v[: meta.n_segments]
+                if getattr(v, "ndim", 0) >= 1
+                and v.shape[0] == meta.seg_bucket
+                else v
+                for k, v in outputs.items()
+            }
         host = {}
         for k, v in outputs.items():
             # wire-contract dtypes at the host boundary: device traces
@@ -333,7 +404,12 @@ class StagedChannel(BaseChannel):
         tr = request.trace
         t_s0 = time.perf_counter() if tr is not None else 0.0
         model = self._repository.get(request.model_name, request.model_version)
-        if self._validate:
+        ragged = request.ragged is not None
+        if self._validate and not ragged:
+            # ragged requests carry PACKED shapes (rows concatenated
+            # across members, padded to the layout bucket) that the
+            # per-tensor wire spec cannot describe; the continuous
+            # batcher validated each member at admission
             for tensor_spec in model.spec.inputs:
                 if tensor_spec.name not in request.inputs:
                     raise ValueError(
@@ -349,7 +425,10 @@ class StagedChannel(BaseChannel):
         else:
             self._acquire_slot()
         try:
-            device_inputs, meta = self._place_inputs(model, request)
+            if ragged:
+                device_inputs, meta = self._place_ragged(model, request)
+            else:
+                device_inputs, meta = self._place_inputs(model, request)
         except Exception:
             self._release_slot()
             raise
@@ -439,21 +518,32 @@ class StagedChannel(BaseChannel):
         try:
             faults.probe("slow_launch", name)
             faults.probe("launch", name)
-            launcher, donate_names, out_dtype = self._launcher(model)
-            if launcher is not None:
-                donated = {
-                    k: v
-                    for k, v in staged.device_inputs.items()
-                    if k in donate_names
-                }
-                kept = {
-                    k: v
-                    for k, v in staged.device_inputs.items()
-                    if k not in donate_names
-                }
-                outputs = launcher(donated, kept)
+            if request.ragged is not None:
+                # packed-ragged launch: one jitted segment-aware body at
+                # a static segment bucket; no donation split (see
+                # _make_ragged_launcher), hence the distinct name — the
+                # dense branch's `launcher` is a donating callable
+                ragged_launcher, out_dtype = self._ragged_launcher(
+                    model, request.ragged.launch_segments
+                )
+                donate_names = frozenset()
+                outputs = ragged_launcher(staged.device_inputs)
             else:
-                outputs = model.infer_fn(staged.device_inputs)
+                launcher, donate_names, out_dtype = self._launcher(model)
+                if launcher is not None:
+                    donated = {
+                        k: v
+                        for k, v in staged.device_inputs.items()
+                        if k in donate_names
+                    }
+                    kept = {
+                        k: v
+                        for k, v in staged.device_inputs.items()
+                        if k not in donate_names
+                    }
+                    outputs = launcher(donated, kept)
+                else:
+                    outputs = model.infer_fn(staged.device_inputs)
         except Exception as e:
             # fan the error to THIS request's future only; the slot
             # frees, the channel and its caches stay serviceable for

@@ -40,12 +40,22 @@ def main(argv=None) -> None:
     p.add_argument(
         "--batching", action="store_true",
         help="micro-batch concurrent requests before dispatch (Triton's "
-        "dynamic batcher role; native C++ batcher with python fallback)",
+        "dynamic batcher role; see --batcher for the scheduler)",
+    )
+    p.add_argument(
+        "--batcher", default="continuous", choices=["continuous", "window"],
+        help="batch scheduler: 'continuous' (default) admits while device "
+        "work is in flight — EDF-ordered ready queue, packed ragged "
+        "execution for models registered with a ragged_fn, live "
+        "occupancy-driven pad buckets; 'window' is the legacy "
+        "admission-window merge (native C++ batcher with python fallback)",
     )
     p.add_argument("--max-batch", type=int, default=8)
     p.add_argument(
         "--batch-timeout-us", type=int, default=2000,
-        help="max time a request waits for batch-mates",
+        help="max time a request waits for batch-mates "
+        "(window batcher only; the continuous scheduler has no "
+        "admission window and ignores this)",
     )
     p.add_argument(
         "--pipeline-depth", type=int, default=2,
@@ -249,27 +259,48 @@ def build_server(args):
         channel = TPUChannel(repo, mesh_config=mesh_config, **chan_kw)
     if args.batching:
         from triton_client_tpu.runtime.batching import BatchingChannel
+        from triton_client_tpu.runtime.continuous import (
+            ContinuousBatchingChannel,
+        )
 
-        channel = BatchingChannel(
+        # getattr: embedders build the args Namespace by hand
+        # (tests/test_serve_cli.py) and may predate these knobs
+        batcher = getattr(args, "batcher", "continuous")
+        cls = (
+            ContinuousBatchingChannel if batcher == "continuous"
+            else BatchingChannel
+        )
+        channel = cls(
             channel,
             max_batch=args.max_batch,
             timeout_us=args.batch_timeout_us,
             pipeline_depth=args.pipeline_depth,
-            # getattr: embedders build the args Namespace by hand
-            # (tests/test_serve_cli.py) and may predate these knobs
             max_merge=getattr(args, "max_merge", None),
-            pad_to_buckets=getattr(args, "pad_buckets", False),
+            # continuous always bucket-pads its dense fallback — the
+            # buckets come from the live occupancy table, so the pad
+            # tax is bounded without the static pow2 ladder
+            pad_to_buckets=(
+                batcher == "continuous"
+                or getattr(args, "pad_buckets", False)
+            ),
             merge_hold_us=getattr(args, "merge_hold_us", 0),
             shed_expired=shed,
         )
+        timeout_note = (
+            "windowless" if batcher == "continuous"
+            else f"timeout={args.batch_timeout_us}us"
+        )
         print(
-            f"micro-batching: max_batch={args.max_batch} "
-            f"timeout={args.batch_timeout_us}us "
+            f"micro-batching[{batcher}]: max_batch={args.max_batch} "
+            f"{timeout_note} "
             f"pipeline_depth={args.pipeline_depth} "
             # default merge cap scales with the inner channel's data
             # axis: max_batch frames per device
             f"max_merge={getattr(args, 'max_merge', None) or args.max_batch * getattr(channel.inner, 'batch_multiple', 1)} "
-            f"pad_buckets={getattr(args, 'pad_buckets', False)}", flush=True,
+            # the EFFECTIVE value: continuous always bucket-pads its
+            # dense fallback regardless of the flag
+            f"pad_buckets={batcher == 'continuous' or getattr(args, 'pad_buckets', False)}",
+            flush=True,
         )
     return InferenceServer(
         repo,

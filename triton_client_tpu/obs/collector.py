@@ -60,6 +60,16 @@ METRIC_TYPES: dict[str, str] = {
     "tpu_serving_padded_frames_total": "counter",
     "tpu_serving_batch_launch_frees_total": "counter",
     "tpu_serving_merge_occupancy_total": "counter",
+    # padding-tax plane (ISSUE 8): pad_fraction is the headline share
+    # of device rows that were padding; batch_occupancy is the merge
+    # occupancy as a real histogram (the BENCH_r05 smear, live);
+    # ragged_* count the packed-batch path where padding is replaced by
+    # a segment table (pad rows there are alignment slack only)
+    "tpu_serving_pad_fraction": "gauge",
+    "tpu_serving_batch_occupancy": "histogram",
+    "tpu_serving_ragged_batches_total": "counter",
+    "tpu_serving_ragged_rows_total": "counter",
+    "tpu_serving_ragged_pad_rows_total": "counter",
     # per-model precision policy + quantized param footprint (round 10:
     # a bf16/int8 registration should visibly shrink param_bytes — the
     # HBM-occupancy regression check in tests/test_precision.py)
@@ -487,10 +497,17 @@ class RuntimeCollector:
             "frames merged into device batches",
             bat.get("merged_frames", 0),
         )
+        by_model = bat.get("padded_by_model")
+        if by_model is None and bat.get("padded_frames"):
+            # a duck-typed batcher without the per-model ledger: keep
+            # the total visible rather than dropping the series
+            by_model = {"unknown": bat["padded_frames"]}
         yield counter(
             f"{ns}_padded_frames_total",
-            "pad rows added by bucket padding",
-            bat.get("padded_frames", 0),
+            "pad rows added by bucket padding, per model",
+            0,
+            labels=["model"],
+            samples=[([m], n) for m, n in (by_model or {}).items()],
         )
         yield counter(
             f"{ns}_batch_launch_frees_total",
@@ -506,6 +523,45 @@ class RuntimeCollector:
                 ([str(k)], v)
                 for k, v in (bat.get("merge_occupancy") or {}).items()
             ],
+        )
+        # the padding-tax plane (ISSUE 8): headline pad share + the
+        # occupancy distribution as a real histogram, so dashboards get
+        # quantiles without scraping the labeled counter above
+        yield gauge(
+            f"{ns}_pad_fraction",
+            "share of device rows shipped as padding "
+            "(dense bucket pad + ragged alignment slack)",
+            bat.get("pad_fraction", 0.0),
+        )
+        occ_hist = HistogramMetricFamily(
+            f"{ns}_batch_occupancy",
+            "real frames per formed device batch",
+            labels=[],
+        )
+        occ = {int(k): v for k, v in (bat.get("merge_occupancy") or {}).items()}
+        cum, cum_buckets = 0, []
+        for bound in (1, 2, 4, 8, 16, 32, 64, 128):
+            cum += sum(v for k, v in occ.items() if bound / 2 < k <= bound)
+            cum_buckets.append((repr(float(bound)), cum))
+        cum_buckets.append(("+Inf", sum(occ.values())))
+        occ_hist.add_metric(
+            [], cum_buckets, float(sum(k * v for k, v in occ.items()))
+        )
+        yield occ_hist
+        yield counter(
+            f"{ns}_ragged_batches_total",
+            "packed ragged batches dispatched (segment-table execution)",
+            bat.get("ragged_batches", 0),
+        )
+        yield counter(
+            f"{ns}_ragged_rows_total",
+            "real rows executed through packed ragged batches",
+            bat.get("ragged_rows", 0),
+        )
+        yield counter(
+            f"{ns}_ragged_pad_rows_total",
+            "alignment pad rows shipped with packed ragged batches",
+            bat.get("ragged_pad_rows", 0),
         )
 
         # per-model precision + param footprint (empty families when no

@@ -174,6 +174,10 @@ class BatchingChannel(BaseChannel):
             "merges": 0, "merged_frames": 0, "padded_frames": 0,
             "launch_frees": 0,
         }
+        # padding-tax attribution (ISSUE 8 satellite): pad frames per
+        # MODEL, so the Prometheus counter can carry a model label and
+        # an operator can see WHICH model's buckets waste device rows
+        self._padded_by_model: collections.Counter = collections.Counter()
         self._shed_expired = bool(shed_expired)
         # per "model|priority|stage" shed counts ("queue" = admission
         # queue full, "merge" = deadline expired at dispatch), merged
@@ -202,6 +206,19 @@ class BatchingChannel(BaseChannel):
                 inner.pipeline_depth = max(1, int(pipeline_depth))
             except (AttributeError, TypeError):
                 pass  # read-only attribute on a custom channel
+        self._start_admission(use_native, max_batch, timeout_us, capacity)
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, daemon=True, name="batch-dispatch"
+        )
+        self._dispatcher.start()
+
+    def _start_admission(
+        self, use_native: bool, max_batch: int, timeout_us: int, capacity: int
+    ) -> None:
+        """Bring up the admission window (native C++ server or the
+        Python fallback). The continuous scheduler
+        (runtime/continuous.py) overrides this to run WITHOUT a window
+        — requests stage straight into the ready set."""
         if use_native:
             try:
                 from triton_client_tpu.native import NativeBatchServer
@@ -219,10 +236,6 @@ class BatchingChannel(BaseChannel):
         if self._impl is None:
             self._py = _PyBatcher(self._on_batch, max_batch, timeout_us, capacity)
             self._py.start()
-        self._dispatcher = threading.Thread(
-            target=self._dispatch_loop, daemon=True, name="batch-dispatch"
-        )
-        self._dispatcher.start()
 
     # -- BaseChannel ----------------------------------------------------------
 
@@ -472,6 +485,14 @@ class BatchingChannel(BaseChannel):
         self._ready.extendleft(reversed(skipped))
         return group
 
+    def _pad_target(self, total: int) -> int:
+        """Padded device-batch size for a merged total: the static
+        power-of-two table, kept divisible by a sharded inner channel's
+        data axis. The continuous scheduler overrides this with a
+        live-occupancy-driven table (runtime/continuous.py) so buckets
+        track the sizes traffic actually produces."""
+        return bucket_for(total, self._batch_multiple)
+
     # -- batch execution (runs on the executor threads) -----------------------
 
     def _shed_expired_members(self, group) -> list:
@@ -541,8 +562,10 @@ class BatchingChannel(BaseChannel):
             # inner channel precompiled. Oversized single requests
             # (> max_merge) pass through unpadded for the same reason.
             # bucket_for keeps the padded size divisible by a sharded
-            # inner channel's data axis (== _bucket at multiple 1).
-            rounded = bucket_for(total, self._batch_multiple)
+            # inner channel's data axis (== _bucket at multiple 1); the
+            # continuous scheduler overrides _pad_target with a
+            # live-occupancy table
+            rounded = self._pad_target(total)
             pad = (
                 rounded - total
                 if self._pad_to_buckets and rounded <= self._max_merge
@@ -562,6 +585,26 @@ class BatchingChannel(BaseChannel):
             for tr in traces:
                 if tr is not None:
                     tr.add("batch_merge", t_stage0, t_disp)
+            if self._shed_expired:
+                # second deadline pass AFTER the pack (ISSUE 8
+                # satellite): the host merge build above takes real
+                # time under load, so a member that was live at group
+                # formation can be expired by now — launching would
+                # hand the inner channel a batch whose inherited
+                # min-deadline is already past (shed whole at launch,
+                # failing every live member). Shed the stragglers and
+                # rebuild from the survivors (rare path; t_staged=None
+                # so merge_wait is not double-recorded).
+                live = self._shed_expired_members(group)
+                if len(live) != len(group):
+                    if arena_held and self._arena is not None:
+                        for arr in arena_held:
+                            self._arena.release(arr)
+                    if live:
+                        self._run_group(
+                            [(None, r, f) for (_t, r, f) in live], free_slot
+                        )
+                    return
             try:
                 # async launch + deferred readback: by the time the
                 # call returns, the inner channel has device_put the
@@ -609,6 +652,7 @@ class BatchingChannel(BaseChannel):
                 # threads race here at pipeline_depth >= 2)
                 with self._ready_cv:
                     self._merge_stats["padded_frames"] += pad
+                    self._padded_by_model[requests[0].model_name] += pad
         except Exception:
             # A merged failure must not take down unrelated requests:
             # fall back to per-request execution.
@@ -709,11 +753,23 @@ class BatchingChannel(BaseChannel):
     # -- stats / lifecycle ----------------------------------------------------
 
     def stats(self) -> dict:
-        out = self._impl.stats() if self._impl is not None else self._py.stats()
+        if self._impl is not None:
+            out = self._impl.stats()
+        elif self._py is not None:
+            out = self._py.stats()
+        else:  # windowless scheduler (runtime/continuous.py)
+            out = {}
         with self._ready_cv:
             out.update(self._merge_stats)
             out["merge_occupancy"] = dict(
                 sorted(self._merge_occupancy.items())
+            )
+            out["padded_by_model"] = dict(sorted(self._padded_by_model.items()))
+            shipped = out["merged_frames"] + out["padded_frames"]
+            # share of device rows that were padding — the headline
+            # padding-tax number (ISSUE 8: was ~32% under BENCH_r05)
+            out["pad_fraction"] = (
+                out["padded_frames"] / shipped if shipped else 0.0
             )
             # concurrently-active execution slots observed at each
             # group launch: {slots_active: launches} — 2s and above mean

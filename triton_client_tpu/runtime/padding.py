@@ -39,16 +39,28 @@ def bucket_for(n: int, multiple: int = 1) -> int:
     ``multiple`` shards (the mesh data-axis width).
 
     ``multiple=1`` is exactly :func:`bucket`. For ``multiple=m`` the
-    table is ``m * 2**k``: still log2-bounded, and every entry splits
-    evenly over the axis — required before ``jax.device_put`` with a
-    batch sharding can place the array at all. For power-of-two meshes
-    the two tables agree at every size >= m, so stacking the batcher's
-    padding in front of a sharded channel never double-pads.
+    padded size is the smallest multiple of ``m`` that covers
+    ``bucket(n)`` — i.e. round to the classic power-of-two table first,
+    then up to the next axis multiple. The size set stays log2-bounded
+    (one entry per power of two), every entry splits evenly over the
+    axis — required before ``jax.device_put`` with a batch sharding can
+    place the array at all — and for power-of-two meshes the table
+    coincides with :func:`bucket` at every size >= m, so stacking the
+    batcher's padding in front of a sharded channel never double-pads.
+
+    Non-power-of-two axes (a data=6 mesh of paired trays) used to go
+    through ``m * bucket(ceil(n/m))``, which jumps past valid sizes:
+    13 rows on 6 shards padded to 24 when 18 (= 6 * ceil(16/6)) already
+    covers the classic bucket — an extra 46% of pad work for nothing.
     """
     if multiple <= 1:
         return bucket(n)
-    shards = bucket(max(1, -(-n // multiple)))  # ceil-div, then pow2
-    return multiple * shards
+    if n <= multiple:
+        # one row per shard is the floor: a 1-row request on a 6-wide
+        # mesh still ships 6 rows
+        return multiple
+    b = bucket(n)
+    return multiple * -(-b // multiple)  # ceil to the next axis multiple
 
 
 def pad_rows(parts: list[np.ndarray], pad: int) -> list[np.ndarray]:

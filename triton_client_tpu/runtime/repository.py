@@ -62,6 +62,16 @@ class RegisteredModel:
     # dequant inside the cached launcher). None serves the legacy f32
     # wire unchanged.
     precision: object | None = None
+    # Optional segment-aware form of the model for packed-ragged
+    # batches (runtime/continuous.py): ``ragged_fn(inputs, segment_ids,
+    # num_segments) -> outputs`` where each input named in
+    # ``spec.extra["ragged_inputs"]`` is a packed (R, ...) row
+    # concatenation, ``segment_ids`` is the (R,) int32 row->request
+    # table (pad rows carry an out-of-range id), ``num_segments`` is a
+    # STATIC python int, and every output has leading dim
+    # ``num_segments`` (request-major). None means the model only runs
+    # dense.
+    ragged_fn: object | None = None
 
 
 class ModelRepository:
@@ -79,10 +89,11 @@ class ModelRepository:
         device_fn: InferFn | None = None,
         params: object | None = None,
         precision: object | None = None,
+        ragged_fn: object | None = None,
     ) -> None:
         with self._lock:
             self._models.setdefault(spec.name, {})[spec.version] = RegisteredModel(
-                spec, infer_fn, warmup, device_fn, params, precision
+                spec, infer_fn, warmup, device_fn, params, precision, ragged_fn
             )
 
     def unregister(self, name: str, version: str = "") -> None:
