@@ -987,6 +987,143 @@ def _serve_3d_row(repo, batching, server, rtt_ms, duration_s: float) -> dict:
     return row
 
 
+def _serve_multitenant_row(duration_s: float) -> dict:
+    """ISSUE 9 multi-tenant lifecycle under pressure: five synthetic
+    models (distinct multipliers, synthetic 100-byte HBM costs) over a
+    budget that admits two, split across three tenants with 8/2/1
+    shares. Three concurrent closed-loop pools (one per tenant) force
+    paging and fair-share arbitration at once; the row reports
+    promotion latency quantiles from the lifecycle histogram and
+    per-tenant goodput from the scheduler's DRR accounting. Synthetic
+    on purpose — the row measures the paging/fair-share machinery, not
+    model math."""
+    import threading as _threading
+
+    from triton_client_tpu.channel.base import InferRequest
+    from triton_client_tpu.channel.tpu_channel import TPUChannel
+    from triton_client_tpu.config import ModelSpec, TensorSpec
+    from triton_client_tpu.obs.histogram import quantile_from_snapshot
+    from triton_client_tpu.runtime.continuous import (
+        ContinuousBatchingChannel,
+    )
+    from triton_client_tpu.runtime.lifecycle import (
+        ModelLifecycleManager,
+        TenantPolicy,
+        TenantTable,
+    )
+    from triton_client_tpu.runtime.repository import ModelRepository
+    from triton_client_tpu.runtime.server import InferenceServer
+    from triton_client_tpu.utils.loadgen import run_pool
+
+    repo = ModelRepository()
+    models = [("mt_a", 2.0), ("mt_b", 3.0), ("mt_c", 4.0),
+              ("mt_d", 5.0), ("mt_e", 6.0)]
+    for name, k in models:
+        spec = ModelSpec(
+            name=name, version="1", max_batch_size=8,
+            inputs=(TensorSpec("x", (-1, 64), "FP32"),),
+            outputs=(TensorSpec("y", (-1, 64), "FP32"),),
+            extra={"param_bytes": 100},
+        )
+        repo.register(
+            spec,
+            lambda inputs, k=k: {
+                "y": np.asarray(inputs["x"], np.float32) * k
+            },
+            device_fn=lambda inputs, k=k: {"y": inputs["x"] * k},
+        )
+    table = TenantTable([
+        TenantPolicy(name="gold", share=8, models=("mt_a", "mt_b"),
+                     pinned=("mt_a",)),
+        TenantPolicy(name="silver", share=2, models=("mt_c",)),
+        TenantPolicy(name="bronze", share=1, models=("mt_d", "mt_e")),
+    ])
+    base = TPUChannel(repo)
+    lifecycle = ModelLifecycleManager(repo, budget_bytes=250, tenants=table)
+    base.attach_lifecycle(lifecycle)
+    batching = ContinuousBatchingChannel(base, max_batch=8)
+    batching.attach_tenants(table)
+    server = InferenceServer(
+        repo, batching, address="127.0.0.1:0", metrics_port=0,
+        lifecycle=lifecycle, tenants=table,
+    )
+    server.start()
+    try:
+        addr = f"127.0.0.1:{server.port}"
+        feed = {"x": np.ones((2, 64), np.float32)}
+        # one pool per tenant, concurrently: gold/silver/bronze each
+        # hammer one of their models; bronze's model set also rotates
+        # residency pressure through the 250-byte budget
+        results = {}
+
+        def pool(tenant, model):
+            results[tenant] = run_pool(
+                addr, model, feed, clients=4,
+                duration_s=duration_s, deadline_s=60.0,
+            )
+
+        threads = [
+            _threading.Thread(target=pool, args=(t, m), daemon=True)
+            for t, m in (("gold", "mt_a"), ("silver", "mt_c"),
+                         ("bronze", "mt_d"))
+        ]
+        for t in threads:
+            t.start()
+        # a low-rate scan over every model keeps cold ones promoting
+        t_end = time.perf_counter() + duration_s
+        scans = 0
+        while time.perf_counter() < t_end:
+            for name, _ in models:
+                try:
+                    batching.do_inference(
+                        InferRequest(model_name=name, inputs=feed)
+                    )
+                    scans += 1
+                except Exception:
+                    pass
+            time.sleep(0.05)
+        for t in threads:
+            t.join(timeout=duration_s + 60.0)
+        lc = lifecycle.stats()
+        promo = lc["promotion_latency"]
+        served = batching.stats().get("tenant_served_frames", {})
+        total_fps = sum(
+            r.fps for r in results.values() if r is not None
+        )
+        row = {
+            "metric": "multitenant_served_fps",
+            "value": round(total_fps, 2),
+            "unit": "frames/sec",
+            "models_registered": len(models),
+            "hbm_budget_bytes": lc["budget_bytes"],
+            "hbm_resident_bytes": lc["resident_bytes"],
+            "promotions": lc.get("promotions", 0),
+            "evictions": lc.get("evictions", 0),
+            "promotion_p50_ms": (
+                round(quantile_from_snapshot(promo, 0.50) * 1e3, 3)
+                if promo.get("count") else None
+            ),
+            "promotion_p99_ms": (
+                round(quantile_from_snapshot(promo, 0.99) * 1e3, 3)
+                if promo.get("count") else None
+            ),
+            "tenant_goodput_fps": {
+                t: round(r.fps, 2) for t, r in results.items()
+                if r is not None
+            },
+            "tenant_served_frames": {k: int(v) for k, v in served.items()},
+            "tenant_shares": {"gold": 8, "silver": 2, "bronze": 1},
+            "scan_requests": scans,
+            "precision": "f32",
+        }
+        if not results:
+            row["degraded"] = "no tenant pool completed"
+        return row
+    finally:
+        server.stop()
+        batching.close()
+
+
 def validate_pallas_nms() -> dict:
     """Once per bench session: run the Pallas NMS kernel and the XLA
     loop on the LIVE backend on the same inputs and require identical
@@ -1376,6 +1513,22 @@ def main() -> None:
         except Exception as e:
             print(f"serving bench failed: {e}", file=sys.stderr)
         _write_local()
+        # multi-tenant lifecycle row: synthetic and cheap (~10 s), but
+        # only with budget left after the real serving windows
+        if _remaining() > 40.0:
+            try:
+                row = _serve_multitenant_row(
+                    duration_s=min(10.0, max(5.0, _remaining() - 30.0))
+                )
+                _emit_row(row, primary=False)
+                _write_local()
+            except Exception as e:
+                print(f"multitenant bench failed: {e}", file=sys.stderr)
+        else:
+            print(
+                f"multitenant row skipped: {_remaining():.0f}s left",
+                file=sys.stderr,
+            )
     else:
         print(
             f"serving stage skipped: {_remaining():.0f}s left of "

@@ -76,6 +76,16 @@ python -m pytest tests/test_continuous_batching.py -q -m '' \
     --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly
 
+echo "== lifecycle shard (HBM paging, tenants, fair share) =="
+# the multi-tenant contract (runtime/lifecycle.py): warm/cold paging
+# with bitwise promotion parity, LRU×priority×pin eviction, tenant
+# quotas/caps, DRR fair share in the EDF key — plus the slow-marked
+# 2x-overload fairness drive (a low-share flood cannot push the
+# high-share tenant's accepted p99 past SLO) tier-1 deselects
+python -m pytest tests/test_lifecycle.py -q -m '' \
+    --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+
 echo "== chaos shard (fault injection + overload control, seed 7) =="
 # the robustness contract (runtime/admission.py, runtime/faults.py,
 # breaker + drain): every FaultPlan point driven end-to-end under a

@@ -78,3 +78,81 @@ def test_serve_builds_and_answers_over_grpc(tmp_path):
 def test_serve_rejects_missing_repository(tmp_path):
     with pytest.raises(FileNotFoundError):
         serve.build_server(_args(model_repository=str(tmp_path / "nope")))
+
+
+def test_batch_timeout_deprecation_warns_once_on_continuous(
+    tmp_path, caplog
+):
+    import logging
+    import shutil
+
+    # camera_preprocess: the cheapest servable entry — these tests
+    # exercise flag plumbing, not model math, and the tier-1 wall is
+    # close to its cap
+    shutil.copytree(
+        "examples/camera_preprocess", tmp_path / "camera_preprocess"
+    )
+    serve._timeout_warned = False  # reset the once-latch for the test
+    try:
+        with caplog.at_level(logging.WARNING, logger=serve.__name__):
+            server = serve.build_server(
+                _args(
+                    model_repository=str(tmp_path),
+                    batching=True,
+                    batch_timeout_us=3000,
+                )
+            )
+            server.stop()
+            warnings = [
+                r for r in caplog.records
+                if "window-timeout knob" in r.getMessage()
+            ]
+            assert len(warnings) == 1
+            assert "--batch-timeout-us" in warnings[0].getMessage()
+            # second build: the latch keeps the log noise-free
+            server = serve.build_server(
+                _args(
+                    model_repository=str(tmp_path),
+                    batching=True,
+                    batch_timeout_us=3000,
+                )
+            )
+            server.stop()
+            warnings = [
+                r for r in caplog.records
+                if "window-timeout knob" in r.getMessage()
+            ]
+            assert len(warnings) == 1
+    finally:
+        serve._timeout_warned = False
+
+
+def test_serve_builds_lifecycle_from_flags(tmp_path):
+    import shutil
+
+    shutil.copytree(
+        "examples/camera_preprocess", tmp_path / "camera_preprocess"
+    )
+    (tmp_path / "tenants.yaml").write_text(
+        "tenants:\n"
+        "  vision:\n"
+        "    share: 4\n"
+        "    models: [camera_preprocess]\n"
+        "    pinned: [camera_preprocess]\n"
+    )
+    server = serve.build_server(
+        _args(
+            model_repository=str(tmp_path),
+            batching=True,
+            hbm_budget=512.0,
+            tenants=str(tmp_path / "tenants.yaml"),
+        )
+    )
+    try:
+        assert server.lifecycle is not None
+        assert server.lifecycle.stats()["budget_bytes"] == 512 << 20
+        assert server.tenants is not None
+        assert server.tenants.tenant_of("camera_preprocess") == "vision"
+        assert server.tenants.pinned("camera_preprocess")
+    finally:
+        server.stop()

@@ -91,6 +91,15 @@ HOT_PATH_ROOTS = (
     "StagedChannel._make_ragged_launcher",
     "ShardedTPUChannel._place_ragged",
     "ShardedTPUChannel._make_ragged_launcher",
+    # ISSUE 9 multi-tenant lifecycle: acquire/release run per request
+    # (RPC thread and stage), note_cost inside the launcher build, and
+    # the DRR key/charge run under _ready_cv on every insort/group —
+    # a host sync in any of them stalls every tenant at once
+    "ModelLifecycleManager.acquire",
+    "ModelLifecycleManager.release",
+    "ModelLifecycleManager.note_cost",
+    "ContinuousBatchingChannel._edf_key",
+    "ContinuousBatchingChannel._charge_tenants_locked",
 )
 
 # module-level call targets that force a host sync

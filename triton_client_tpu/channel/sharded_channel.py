@@ -213,6 +213,18 @@ class ShardedTPUChannel(StagedChannel):
         }
         if model.params is not None:
             placed = replicate_params(model.params, self._mesh)
+            if self._lifecycle is not None:
+                # refine the lifecycle manager's HBM accounting with the
+                # measured per-device bytes of the placed tree (.nbytes
+                # is sharding metadata — no host sync)
+                nbytes = sum(
+                    int(x.nbytes)
+                    for x in jax.tree_util.tree_leaves(placed)
+                    if hasattr(x, "nbytes")
+                )
+                self._lifecycle.note_cost(
+                    model.spec.name, model.spec.version, nbytes
+                )
             jitted = jax.jit(
                 lambda params, batched, rest: device_fn(
                     {**batched, **rest}, params

@@ -100,7 +100,10 @@ class StagedRequest:
     channel records the real row count so resolve can slice the pad
     rows back off)."""
 
-    __slots__ = ("model", "device_inputs", "request", "t_stage", "meta")
+    __slots__ = (
+        "model", "device_inputs", "request", "t_stage", "meta",
+        "lifecycle_key",
+    )
 
     def __init__(self, model, device_inputs, request, t_stage, meta=None) -> None:
         self.model = model
@@ -108,6 +111,11 @@ class StagedRequest:
         self.request = request
         self.t_stage = t_stage
         self.meta = meta
+        # (name, version) in-flight reference on the lifecycle manager
+        # (None when no manager is attached); dropped exactly once when
+        # the request resolves or fails, so eviction can never reclaim
+        # a model whose batch is still staged/executing
+        self.lifecycle_key = None
 
 
 class _Inflight:
@@ -211,6 +219,17 @@ class StagedChannel(BaseChannel):
         # output wire dtypes); rebuilt when the repository reloads the
         # model (identity mismatch)
         self._launch_cache: dict = {}
+        # optional ModelLifecycleManager (runtime/lifecycle.py): when
+        # attached, stage() blocks until the model is WARM and holds an
+        # in-flight reference through resolve
+        self._lifecycle = None
+        # unregister must drop the cached launcher too — the cached
+        # closure pins replicated params in HBM and would otherwise
+        # leak until a same-named model happens to fail the identity
+        # check; same invalidation path the circuit breaker uses
+        subscribe = getattr(repository, "add_unregister_listener", None)
+        if subscribe is not None:
+            subscribe(self._on_unregister)
         self.register_channel()
 
     # -- BaseChannel protocol -------------------------------------------------
@@ -418,6 +437,24 @@ class StagedChannel(BaseChannel):
                         f"{sorted(request.inputs)}"
                     )
                 tensor_spec.validate(np.asarray(request.inputs[tensor_spec.name]))
+        lifecycle_key = None
+        if self._lifecycle is not None:
+            # block until the model is WARM (a cold model promotes on
+            # demand here — first request pays the page-in, peers queue
+            # behind it with a deadline-aware bound) and take the
+            # in-flight reference that shields it from eviction
+            t_p0 = time.perf_counter()
+            try:
+                lifecycle_key = self._lifecycle.acquire(
+                    model.spec.name,
+                    model.spec.version,
+                    deadline_s=request.deadline_s,
+                )
+            except Exception:
+                self._count_shed(model.spec.name, request.priority, "lifecycle")
+                raise
+            if tr is not None:
+                tr.add("lifecycle", t_p0, time.perf_counter())
         if tr is not None:
             t_w0 = time.perf_counter()
             self._acquire_slot()
@@ -431,6 +468,8 @@ class StagedChannel(BaseChannel):
                 device_inputs, meta = self._place_inputs(model, request)
         except Exception:
             self._release_slot()
+            if lifecycle_key is not None:
+                self._lifecycle.release(*lifecycle_key)
             raise
         with self._slot_cv:
             self._stats["staged"] += 1
@@ -438,7 +477,9 @@ class StagedChannel(BaseChannel):
         if tr is not None:
             # the whole stage phase: validate + slot admission + H2D
             tr.add("stage", t_s0, t_staged)
-        return StagedRequest(model, device_inputs, request, t_staged, meta)
+        staged = StagedRequest(model, device_inputs, request, t_staged, meta)
+        staged.lifecycle_key = lifecycle_key
+        return staged
 
     def _acquire_slot(self) -> None:
         waited = False
@@ -499,6 +540,7 @@ class StagedChannel(BaseChannel):
             # passed NEVER executes — fail its future in microseconds
             # instead of burning a device slot on work nobody can use
             self._release_slot()
+            self._release_lifecycle(staged)
             self._count_shed(name, request.priority, "launch")
             return InferFuture.failed(
                 DeadlineExpiredError(
@@ -508,6 +550,7 @@ class StagedChannel(BaseChannel):
             )
         if self._breaker is not None and not self._breaker.allow(name, t0):
             self._release_slot()
+            self._release_lifecycle(staged)
             self._count_shed(name, request.priority, "breaker")
             return InferFuture.failed(
                 CircuitOpenError(
@@ -550,6 +593,7 @@ class StagedChannel(BaseChannel):
             # every other request (the breaker decides if the model
             # itself needs a timeout)
             self._release_slot()
+            self._release_lifecycle(staged)
             self._record_launch_failure(name)
             return InferFuture.failed(e)
         rec = _Inflight(outputs)
@@ -587,6 +631,7 @@ class StagedChannel(BaseChannel):
                 raise
             finally:
                 self._retire(rec)
+                self._release_lifecycle(staged)
             if self._breaker is not None:
                 self._breaker.record_success(name)
             return InferResponse(
@@ -615,6 +660,60 @@ class StagedChannel(BaseChannel):
         with self._slot_cv:
             self._launch_cache[key] = (model, launcher, donate_names, out_dtype)
         return launcher, donate_names, out_dtype
+
+    # -- model lifecycle (runtime/lifecycle.py) -------------------------------
+
+    def attach_lifecycle(self, manager) -> None:
+        """Attach a ModelLifecycleManager: stage() then blocks until the
+        model is WARM (promoting it on demand) and brackets each request
+        with acquire/release so eviction never reclaims a model with
+        in-flight work. The manager's page-in hook builds this channel's
+        cached launcher; its page-out hook drops it (freeing the
+        replicated params the launcher closure pins in HBM)."""
+        self._lifecycle = manager
+        manager.set_hooks(warmer=self._warm_model, evictor=self._evict_model)
+
+    @property
+    def lifecycle(self):
+        return self._lifecycle
+
+    def _warm_model(self, name: str, version: str) -> None:
+        """Lifecycle page-in hook: build + cache the jitted launcher (the
+        sharded subclass replicates the param tree here — the actual HBM
+        page-in) so the promoting request pays compile+placement once and
+        everything queued behind it launches hot."""
+        model = self._repository.get(name, version)
+        if model.device_fn is not None:
+            self._launcher(model)
+
+    def _evict_model(self, name: str, version: str) -> None:
+        """Lifecycle page-out hook: drop the cached launcher so XLA frees
+        the replicated params its closure holds."""
+        self._invalidate_model(name, version)
+
+    def _on_unregister(self, name: str, version: str) -> None:
+        # repository listener (registered in __init__): an unregistered
+        # model must not keep serving from — or pinning HBM through —
+        # a stale cached launcher
+        self._invalidate_model(name, version)
+
+    def _invalidate_model(self, name: str, version: str) -> None:
+        """Drop every cached launcher for one (name, version): the dense
+        entry plus all ragged segment buckets."""
+        with self._slot_cv:
+            for key in [
+                k
+                for k in self._launch_cache
+                if k[0] == name and k[1] == version
+            ]:
+                del self._launch_cache[key]
+
+    def _release_lifecycle(self, staged: StagedRequest) -> None:
+        """Drop the in-flight lifecycle reference exactly once (every
+        launch failure path and resolve's finally funnel here)."""
+        key, staged.lifecycle_key = staged.lifecycle_key, None
+        if key is not None and self._lifecycle is not None:
+            self._lifecycle.release(*key)
 
     # -- failure isolation ----------------------------------------------------
 

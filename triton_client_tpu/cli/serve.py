@@ -13,6 +13,12 @@ from __future__ import annotations
 import argparse
 import logging
 
+log = logging.getLogger(__name__)
+
+# one-time deprecation warning for --batch-timeout-us on the continuous
+# path (the flag is window-batcher-only; see build_server)
+_timeout_warned = False
+
 
 def main(argv=None) -> None:
     p = argparse.ArgumentParser(description="TPU inference server")
@@ -52,10 +58,11 @@ def main(argv=None) -> None:
     )
     p.add_argument("--max-batch", type=int, default=8)
     p.add_argument(
-        "--batch-timeout-us", type=int, default=2000,
-        help="max time a request waits for batch-mates "
-        "(window batcher only; the continuous scheduler has no "
-        "admission window and ignores this)",
+        "--batch-timeout-us", type=int, default=None,
+        help="max time a request waits for batch-mates (window batcher "
+        "only, default 2000; DEPRECATED on the continuous scheduler, "
+        "which has no admission window — see docs/OPERATIONS.md "
+        "'Migration — the window-timeout knob')",
     )
     p.add_argument(
         "--pipeline-depth", type=int, default=2,
@@ -149,6 +156,20 @@ def main(argv=None) -> None:
         "installed process-wide — CHAOS TESTING ONLY: injects "
         "launch/readback/codec failures and latency on a seeded, "
         "deterministic schedule",
+    )
+    p.add_argument(
+        "--hbm-budget", type=float, default=0.0,
+        help="HBM paging budget in MB for model params "
+        "(runtime/lifecycle.py): models start COLD, page in on first "
+        "request, and evict LRU-within-priority under pressure — "
+        "register more models than fit at once. 0 = every model stays "
+        "resident (legacy behavior)",
+    )
+    p.add_argument(
+        "--tenants", default="",
+        help="tenants.yaml path mapping models to tenants with HBM "
+        "quotas, fair-share weights, and in-flight caps (see "
+        "docs/OPERATIONS.md 'Multi-tenant serving')",
     )
     p.add_argument(
         "--warmup", action="store_true",
@@ -257,6 +278,33 @@ def build_server(args):
         )
     else:
         channel = TPUChannel(repo, mesh_config=mesh_config, **chan_kw)
+    base_channel = channel
+
+    # multi-tenant model lifecycle: HBM-budgeted paging + tenant policy
+    tenants = None
+    tenants_path = getattr(args, "tenants", "") or ""
+    if tenants_path:
+        from triton_client_tpu.runtime.lifecycle import load_tenants
+
+        tenants = load_tenants(tenants_path)
+    lifecycle = None
+    budget_mb = float(getattr(args, "hbm_budget", 0.0) or 0.0)
+    if budget_mb > 0 or tenants is not None:
+        from triton_client_tpu.runtime.lifecycle import ModelLifecycleManager
+
+        lifecycle = ModelLifecycleManager(
+            repo,
+            budget_bytes=int(budget_mb * (1 << 20)),
+            tenants=tenants,
+        )
+        base_channel.attach_lifecycle(lifecycle)
+        print(
+            f"model lifecycle: hbm_budget="
+            f"{f'{budget_mb:g}MB' if budget_mb > 0 else 'unlimited'} "
+            f"tenants={len(tenants.tenants()) if tenants else 0} "
+            "(models page in on demand, evict LRU-within-priority)",
+            flush=True,
+        )
     if args.batching:
         from triton_client_tpu.runtime.batching import BatchingChannel
         from triton_client_tpu.runtime.continuous import (
@@ -270,10 +318,24 @@ def build_server(args):
             ContinuousBatchingChannel if batcher == "continuous"
             else BatchingChannel
         )
+        # --batch-timeout-us: None means "not given" (window default
+        # 2000us). An EXPLICIT value on the continuous path used to be
+        # silently ignored; warn once instead, pointing at the doc
+        timeout_us = getattr(args, "batch_timeout_us", None)
+        if timeout_us is not None and batcher == "continuous":
+            global _timeout_warned
+            if not _timeout_warned:
+                _timeout_warned = True
+                log.warning(
+                    "--batch-timeout-us is deprecated with the "
+                    "continuous scheduler and has no effect (there is "
+                    "no admission window); see docs/OPERATIONS.md "
+                    "section 'Migration — the window-timeout knob'"
+                )
         channel = cls(
             channel,
             max_batch=args.max_batch,
-            timeout_us=args.batch_timeout_us,
+            timeout_us=timeout_us if timeout_us is not None else 2000,
             pipeline_depth=args.pipeline_depth,
             max_merge=getattr(args, "max_merge", None),
             # continuous always bucket-pads its dense fallback — the
@@ -286,9 +348,13 @@ def build_server(args):
             merge_hold_us=getattr(args, "merge_hold_us", 0),
             shed_expired=shed,
         )
+        if tenants is not None and batcher == "continuous":
+            # deficit-round-robin fair share folded into the EDF ready
+            # ordering, weighted by each tenant's share
+            channel.attach_tenants(tenants)
         timeout_note = (
             "windowless" if batcher == "continuous"
-            else f"timeout={args.batch_timeout_us}us"
+            else f"timeout={timeout_us if timeout_us is not None else 2000}us"
         )
         print(
             f"micro-batching[{batcher}]: max_batch={args.max_batch} "
@@ -313,6 +379,8 @@ def build_server(args):
         slo_tail_capacity=getattr(args, "slo_tail_capacity", 64),
         admission_max_queue=getattr(args, "admission", 0),
         admission_concurrency=getattr(args, "admission_concurrency", 4),
+        lifecycle=lifecycle,
+        tenants=tenants,
     )
 
 

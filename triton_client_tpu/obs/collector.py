@@ -99,6 +99,22 @@ METRIC_TYPES: dict[str, str] = {
     "tpu_serving_breaker_opens_total": "counter",
     "tpu_serving_admission_queue_depth": "gauge",
     "tpu_serving_draining": "gauge",
+    # multi-tenant lifecycle plane (round 13): the HBM paging budget
+    # and what currently occupies it (total + per tenant), model counts
+    # per lifecycle state, promotion/eviction churn with the promotion
+    # latency distribution (the cold-start tax a capacity plan must
+    # price), per-tenant admission sheds and served frames (fair-share
+    # goodput per tenant, the Gemma-comparison discipline: capacity is
+    # a number per tenant at SLO)
+    "tpu_serving_hbm_budget_bytes": "gauge",
+    "tpu_serving_hbm_resident_bytes": "gauge",
+    "tpu_serving_tenant_hbm_bytes": "gauge",
+    "tpu_serving_lifecycle_models": "gauge",
+    "tpu_serving_model_promotions_total": "counter",
+    "tpu_serving_model_evictions_total": "counter",
+    "tpu_serving_promotion_seconds": "histogram",
+    "tpu_serving_tenant_shed_total": "counter",
+    "tpu_serving_tenant_served_frames_total": "counter",
 }
 
 _HBM_KINDS = ("bytes_in_use", "bytes_limit", "peak_bytes_in_use")
@@ -186,11 +202,13 @@ class RuntimeCollector:
         histograms=None,
         slo=None,
         admission=None,
+        lifecycle=None,
     ) -> None:
         """``histograms``: an obs.histogram.HistogramFamily of per
         (model, stage) latency histograms; ``slo``: an obs.slo.
         SLOTracker; ``admission``: a runtime.admission.
-        AdmissionController. All optional — their metric families
+        AdmissionController; ``lifecycle``: a runtime.lifecycle.
+        ModelLifecycleManager. All optional — their metric families
         export empty (HELP/TYPE only) when absent, so the family
         inventory test keeps pinning the series names either way."""
         self._batching, self._tpu = _split_channel(channel)
@@ -199,6 +217,7 @@ class RuntimeCollector:
         self._histograms = histograms
         self._slo = slo
         self._admission = admission
+        self._lifecycle = lifecycle
         self._ns = namespace
         self._compile = CompileEvents.install()
         self._lock = threading.Lock()
@@ -268,6 +287,8 @@ class RuntimeCollector:
         snap["draining"] = int(draining)
         if self._admission is not None:
             snap["admission"] = self._admission.stats()
+        if self._lifecycle is not None:
+            snap["lifecycle"] = self._lifecycle.stats()
         if self._tracer is not None:
             snap["tracer"] = self._tracer.stats()
         if self._histograms is not None:
@@ -705,6 +726,101 @@ class RuntimeCollector:
             f"{ns}_draining",
             "1 while the server is draining (SIGTERM / drain())",
             snap.get("draining", 0),
+        )
+
+        # multi-tenant lifecycle plane: HBM budget/residency, lifecycle
+        # state counts, promotion/eviction churn + promotion latency,
+        # per-tenant sheds and served frames. Families export empty
+        # when no lifecycle manager is wired.
+        lc = snap.get("lifecycle") or {}
+        yield gauge(
+            f"{ns}_hbm_budget_bytes",
+            "configured HBM paging budget (0 = unbudgeted)",
+            lc.get("budget_bytes", 0),
+        )
+        yield gauge(
+            f"{ns}_hbm_resident_bytes",
+            "estimated bytes of WARM model params under the budget",
+            lc.get("resident_bytes", 0),
+        )
+        yield gauge(
+            f"{ns}_tenant_hbm_bytes",
+            "resident model bytes billed to each tenant",
+            0,
+            labels=["tenant"],
+            samples=[
+                ([t], b)
+                for t, b in (lc.get("tenant_resident_bytes") or {}).items()
+            ],
+        )
+        yield gauge(
+            f"{ns}_lifecycle_models",
+            "registered models per lifecycle state "
+            "(cold/warming/warm/evicting)",
+            0,
+            labels=["state"],
+            samples=[([s], n) for s, n in (lc.get("states") or {}).items()],
+        )
+        lc_models = [
+            (key.partition(":"), row)
+            for key, row in (lc.get("models") or {}).items()
+        ]
+        yield counter(
+            f"{ns}_model_promotions_total",
+            "COLD -> WARM promotions per model",
+            0,
+            labels=["model", "version"],
+            samples=[
+                ([name, version], row["promotions"])
+                for (name, _, version), row in lc_models
+            ],
+        )
+        yield counter(
+            f"{ns}_model_evictions_total",
+            "WARM -> COLD evictions per model",
+            0,
+            labels=["model", "version"],
+            samples=[
+                ([name, version], row["evictions"])
+                for (name, _, version), row in lc_models
+            ],
+        )
+        promo = HistogramMetricFamily(
+            f"{ns}_promotion_seconds",
+            "COLD -> WARM promotion latency (make-room + page-in)",
+            labels=[],
+        )
+        ph = lc.get("promotion_latency") or {"buckets": {}, "sum": 0.0,
+                                             "count": 0}
+        cum, cum_buckets = 0, []
+        for bound, c in sorted(
+            (float(b), n) for b, n in ph["buckets"].items() if b != "inf"
+        ):
+            cum += c
+            cum_buckets.append((repr(bound), cum))
+        cum_buckets.append(("+Inf", ph["count"]))
+        promo.add_metric([], cum_buckets, ph["sum"])
+        yield promo
+        yield counter(
+            f"{ns}_tenant_shed_total",
+            "requests shed at the admission door per tenant "
+            "(in-flight cap + per-model knees)",
+            0,
+            labels=["tenant"],
+            samples=[
+                ([t], n)
+                for t, n in (adm.get("tenant_rejects") or {}).items()
+            ],
+        )
+        yield counter(
+            f"{ns}_tenant_served_frames_total",
+            "frames dispatched per tenant by the fair-share scheduler",
+            0,
+            labels=["tenant"],
+            samples=[
+                ([t], n)
+                for t, n in (bat.get("tenant_served_frames") or {}).items()
+            ],
         )
 
         # device HBM (absent on backends without memory_stats)

@@ -83,16 +83,30 @@ class AdmissionController:
         concurrency: int = 4,
         low_priority_fraction: float = 0.5,
         ewma_alpha: float = 0.2,
+        tenants=None,
     ) -> None:
+        """``tenants``: optional TenantTable (runtime/lifecycle.py).
+        When set, a tenant's ``max_inflight`` caps admitted-but-
+        unfinished requests ACROSS its models, layered on the per-model
+        knees — one tenant flooding its model set sheds at its own cap
+        instead of consuming the whole server's queue."""
         self._max_queue = max(1, int(max_queue))
         self._concurrency = max(1, int(concurrency))
         self._low_frac = min(1.0, max(0.05, float(low_priority_fraction)))
         self._alpha = min(1.0, max(0.01, float(ewma_alpha)))
+        self._tenants = tenants
         self._lock = threading.Lock()
         self._inflight: dict[str, int] = {}
+        self._tenant_inflight: dict[str, int] = {}
+        self._tenant_rejects: dict[str, int] = {}
         self._ewma_s: dict[str, float] = {}
         self._rejects: dict[tuple[str, int], int] = {}
         self._admitted = 0
+
+    def _tenant_of(self, model: str) -> str | None:
+        return (
+            None if self._tenants is None else self._tenants.tenant_of(model)
+        )
 
     # -- accounting hooks (server request lifecycle) --------------------------
 
@@ -108,6 +122,7 @@ class AdmissionController:
         :meth:`finished`. Callers MUST pair a successful admit with
         finished() on every exit path (the server does both in its
         ``finally``-rooted accounting)."""
+        tenant = self._tenant_of(model)
         with self._lock:
             depth = self._inflight.get(model, 0)
             limit = self._max_queue
@@ -121,7 +136,15 @@ class AdmissionController:
                     f"queue depth {depth} >= limit {limit} "
                     f"(priority {priority})"
                 )
-            elif deadline_s is not None:
+            if reason is None and tenant is not None:
+                cap = self._tenants.max_inflight(tenant)
+                t_depth = self._tenant_inflight.get(tenant, 0)
+                if cap > 0 and t_depth >= cap:
+                    reason = (
+                        f"tenant '{tenant}' in-flight {t_depth} >= "
+                        f"cap {cap}"
+                    )
+            if reason is None and deadline_s is not None:
                 ewma = self._ewma_s.get(model)
                 if ewma is not None:
                     if now is None:
@@ -136,20 +159,33 @@ class AdmissionController:
             if reason is not None:
                 key = (model, int(priority))
                 self._rejects[key] = self._rejects.get(key, 0) + 1
+                if tenant is not None:
+                    self._tenant_rejects[tenant] = (
+                        self._tenant_rejects.get(tenant, 0) + 1
+                    )
                 raise AdmissionRejectedError(
                     f"model '{model}' overloaded: {reason}"
                 )
             self._inflight[model] = depth + 1
+            if tenant is not None:
+                self._tenant_inflight[tenant] = (
+                    self._tenant_inflight.get(tenant, 0) + 1
+                )
             self._admitted += 1
 
     def finished(self, model: str, service_s: float | None = None) -> None:
         """One admitted request left the building (any outcome).
         ``service_s`` (wall seconds, successful requests only) feeds
         the EWMA the estimated-wait check divides by."""
+        tenant = self._tenant_of(model)
         with self._lock:
             depth = self._inflight.get(model, 0)
             if depth > 0:
                 self._inflight[model] = depth - 1
+            if tenant is not None:
+                t_depth = self._tenant_inflight.get(tenant, 0)
+                if t_depth > 0:
+                    self._tenant_inflight[tenant] = t_depth - 1
             if service_s is not None and service_s >= 0:
                 prev = self._ewma_s.get(model)
                 self._ewma_s[model] = (
@@ -180,6 +216,8 @@ class AdmissionController:
                 "rejects": {
                     f"{m}|{p}": n for (m, p), n in self._rejects.items()
                 },
+                "tenant_inflight": dict(self._tenant_inflight),
+                "tenant_rejects": dict(self._tenant_rejects),
             }
 
 

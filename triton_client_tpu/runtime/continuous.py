@@ -168,6 +168,13 @@ class ContinuousBatchingChannel(BatchingChannel):
             "ragged_rows": 0,
             "ragged_pad_rows": 0,
         }
+        # optional multi-tenant fair share (runtime/lifecycle.py
+        # TenantTable): deficit-round-robin virtual time folded into the
+        # EDF key — set via attach_tenants(); None keeps pure EDF
+        self._tenant_table = None
+        self._fair_quantum_s = 0.005
+        self._vtime: dict[str, float] = {}
+        self._tenant_frames: collections.Counter = collections.Counter()
         super().__init__(
             inner,
             max_batch=max_batch,
@@ -197,16 +204,68 @@ class ContinuousBatchingChannel(BatchingChannel):
         """No admission window: requests stage in ``do_inference``."""
         # _impl/_py stay None; close() and stats() branch on that
 
-    @staticmethod
-    def _edf_key(item):
+    def attach_tenants(self, table, quantum_s: float = 0.005) -> None:
+        """Fold deficit-round-robin fair share over a TenantTable
+        (runtime/lifecycle.py) into the ready ordering. Each tenant
+        accrues virtual time ``frames / share`` as its work dispatches;
+        a tenant ahead of the pack (``lag`` = its vtime minus the
+        minimum) has its requests' effective deadlines pushed back by
+        ``lag * quantum_s``, so a low-share tenant flooding the queue
+        cannot starve a high-share tenant's SLO — the backlogged
+        tenant's own requests sort later, they are not dropped.
+
+        Ordering is approximate by design: ``insort`` re-evaluates the
+        key against items placed under older vtimes, so the ready set
+        drifts slightly as lags move. DRR only needs the drift to be
+        bounded (it is — charges are applied at group formation under
+        ``_ready_cv`` and lags renormalize), not a total order."""
+        with self._ready_cv:
+            self._tenant_table = table
+            self._fair_quantum_s = float(quantum_s)
+
+    def _edf_key(self, item):
         """Sort key over staged items: earliest deadline first,
         deadline-less requests last; higher priority breaks ties and
-        ``insort`` keeps arrival order inside a class."""
+        ``insort`` keeps arrival order inside a class. With a tenant
+        table attached, a tenant's DRR lag pushes its effective
+        deadline back (deadline-less items order by lag directly)."""
         request = item[2]
-        return (
-            request.deadline_s if request.deadline_s is not None else math.inf,
-            -request.priority,
+        deadline = (
+            request.deadline_s if request.deadline_s is not None else math.inf
         )
+        table = self._tenant_table
+        if table is None:
+            return (deadline, -request.priority, 0.0)
+        lag = 0.0
+        if self._vtime:
+            floor = min(self._vtime.values())
+            lag = max(
+                0.0,
+                self._vtime.get(table.tenant_of(request.model_name), floor)
+                - floor,
+            )
+        return (deadline + lag * self._fair_quantum_s, -request.priority, lag)
+
+    def _charge_tenants_locked(self, group) -> None:
+        """DRR accounting at group formation (caller holds
+        ``_ready_cv``): each dispatched frame charges its tenant
+        ``1 / share`` virtual time, so equal traffic advances a
+        share-4 tenant's clock 4x slower than a share-1 tenant's."""
+        table = self._tenant_table
+        floor = min(self._vtime.values()) if self._vtime else 0.0
+        for item in group:
+            request, frames = item[2], item[1]
+            tenant = table.tenant_of(request.model_name)
+            self._vtime[tenant] = self._vtime.get(tenant, floor) + (
+                frames / table.share(tenant)
+            )
+            self._tenant_frames[tenant] += frames
+        # renormalize so vtimes (and the lags derived from them) stay
+        # bounded over long uptimes
+        floor = min(self._vtime.values())
+        if floor > 1e6:
+            for tenant in self._vtime:
+                self._vtime[tenant] -= floor
 
     def do_inference(self, request: InferRequest):
         future: concurrent.futures.Future = concurrent.futures.Future()
@@ -267,6 +326,8 @@ class ContinuousBatchingChannel(BatchingChannel):
                 frames += item[1]
             else:
                 i += 1
+        if self._tenant_table is not None:
+            self._charge_tenants_locked(group)
         return group
 
     # -- dense pad targets from the live histogram ----------------------------
@@ -469,6 +530,9 @@ class ContinuousBatchingChannel(BatchingChannel):
             out.update(self._ragged_stats)
             if self._live_buckets is not None:
                 out["live_bucket_table"] = list(self._live_buckets.table)
+            if self._tenant_table is not None:
+                out["tenant_served_frames"] = dict(self._tenant_frames)
+                out["tenant_vtime"] = dict(self._vtime)
         shipped = (
             out["merged_frames"]
             + out["padded_frames"]

@@ -80,6 +80,21 @@ class ModelRepository:
     def __init__(self) -> None:
         self._models: dict[str, dict[str, RegisteredModel]] = {}
         self._lock = threading.Lock()
+        # unregister listeners: fn(name, version), called once per
+        # removed version OUTSIDE the registry lock. Serving channels
+        # subscribe so a dropped model also drops its cached launcher
+        # (and the replicated params that closure pins in HBM) — the
+        # same invalidation path the circuit breaker uses.
+        self._unregister_listeners: list[Callable[[str, str], None]] = []
+        # access accounting for lifecycle LRU: per-name hit count and
+        # last-touch monotonic sequence, maintained by get().
+        self._access_count: dict[str, int] = {}
+        self._access_seq: dict[str, int] = {}
+        self._seq = 0
+
+    def add_unregister_listener(self, fn: Callable[[str, str], None]) -> None:
+        with self._lock:
+            self._unregister_listeners.append(fn)
 
     def register(
         self,
@@ -97,17 +112,31 @@ class ModelRepository:
             )
 
     def unregister(self, name: str, version: str = "") -> None:
+        removed: list[tuple[str, str]] = []
         with self._lock:
             if version:
-                self._models.get(name, {}).pop(version, None)
+                if self._models.get(name, {}).pop(version, None) is not None:
+                    removed.append((name, version))
+                if not self._models.get(name):
+                    self._models.pop(name, None)
             else:
-                self._models.pop(name, None)
+                for v in self._models.pop(name, {}):
+                    removed.append((name, v))
+            listeners = list(self._unregister_listeners)
+        # notify outside the lock: listeners take channel locks of
+        # their own and must be free to call back into the repository
+        for n, v in removed:
+            for fn in listeners:
+                fn(n, v)
 
     def get(self, name: str, version: str = "") -> RegisteredModel:
         with self._lock:
             versions = self._models.get(name)
             if not versions:
                 raise KeyError(f"model '{name}' is not registered")
+            self._seq += 1
+            self._access_count[name] = self._access_count.get(name, 0) + 1
+            self._access_seq[name] = self._seq
             if version:
                 if version not in versions:
                     raise KeyError(f"model '{name}' has no version '{version}'")
@@ -129,3 +158,15 @@ class ModelRepository:
     def versions(self, name: str) -> list[str]:
         with self._lock:
             return sorted(self._models.get(name, {}), key=_version_key)
+
+    def access_stats(self) -> dict[str, dict[str, int]]:
+        """Per-name get() hit count and last-touch sequence (monotonic,
+        repository-wide) — the lifecycle manager's LRU raw material."""
+        with self._lock:
+            return {
+                name: {
+                    "count": self._access_count.get(name, 0),
+                    "last_seq": self._access_seq.get(name, 0),
+                }
+                for name in self._models
+            }

@@ -106,9 +106,11 @@ class _Servicer(service.GRPCInferenceServiceServicer):
         slo=None,
         admission: AdmissionController | None = None,
         draining: threading.Event | None = None,
+        lifecycle=None,
     ) -> None:
         self._repo = repository
         self._channel = channel
+        self._lifecycle = lifecycle
         self._profiler = profiler
         self._shm = shm_registry
         self._stream_depth = max(1, int(stream_pipeline_depth))
@@ -320,6 +322,7 @@ class _Servicer(service.GRPCInferenceServiceServicer):
         with self._active_lock:
             self._active += 1
         admitted = False
+        lifecycle_key = None
         try:
             # overload plane, cheapest checks first, BEFORE parse: a
             # shed request must cost microseconds, not a deserialize.
@@ -344,6 +347,25 @@ class _Servicer(service.GRPCInferenceServiceServicer):
                         )
                     raise
                 admitted = True
+            if self._lifecycle is not None:
+                # promotion wait happens HERE, on the RPC thread: a
+                # request for a cold model blocks (deadline-aware) while
+                # the model pages in, so the batcher's single dispatcher
+                # never head-of-line blocks on a warming model. The
+                # reference is dropped in _account; the channel holds its
+                # own acquire across the device window.
+                try:
+                    lifecycle_key = self._lifecycle.acquire(
+                        request.model_name,
+                        request.model_version,
+                        deadline_s=deadline_s,
+                    )
+                except OverloadError:
+                    if self._collector is not None:
+                        self._collector.record_shed(
+                            request.model_name, priority, "lifecycle"
+                        )
+                    raise
             if trace is not None:
                 with trace.span("parse"):
                     inputs = codec.parse_infer_request(request, shm=self._shm)
@@ -379,7 +401,7 @@ class _Servicer(service.GRPCInferenceServiceServicer):
             self._account(
                 request.model_name, t0, trace, error=e,
                 deadline_s=deadline_s, priority=priority,
-                admitted=admitted,
+                admitted=admitted, lifecycle_key=lifecycle_key,
             )
             raise
 
@@ -416,14 +438,14 @@ class _Servicer(service.GRPCInferenceServiceServicer):
                 self._account(
                     request.model_name, t0, trace, error=error,
                     deadline_s=deadline_s, priority=priority,
-                    admitted=admitted,
+                    admitted=admitted, lifecycle_key=lifecycle_key,
                 )
 
         return finish
 
     def _account(
         self, model_name, t0, trace, error=None, deadline_s=None, priority=0,
-        admitted=False,
+        admitted=False, lifecycle_key=None,
     ) -> None:
         """Per-request bookkeeping, success or failure: latency sample
         (the Triton :8002 serving-metrics role, README.md:88-95), error
@@ -466,6 +488,8 @@ class _Servicer(service.GRPCInferenceServiceServicer):
                 model_name,
                 service_s=(now - t0) if error is None else None,
             )
+        if self._lifecycle is not None and lifecycle_key is not None:
+            self._lifecycle.release(*lifecycle_key)
         with self._active_lock:
             self._active -= 1
 
@@ -593,6 +617,8 @@ class InferenceServer:
         slo_tail_capacity: int = 64,
         admission_max_queue: int = 0,
         admission_concurrency: int = 4,
+        lifecycle=None,
+        tenants=None,
     ) -> None:
         """``metrics_port``: serve the telemetry endpoint — Prometheus
         exposition on ``/metrics`` (Triton's :8002 role), Chrome-trace
@@ -621,11 +647,22 @@ class InferenceServer:
         queue wait exceeds their deadline budget — are rejected with
         RESOURCE_EXHAUSTED before parse. ``admission_concurrency``:
         assumed per-model service concurrency for the estimated-wait
-        math (batcher width x pipeline depth, roughly)."""
+        math (batcher width x pipeline depth, roughly).
+        ``lifecycle``: a ModelLifecycleManager (runtime/lifecycle.py,
+        already attached to the serving channel) — requests for COLD
+        models then block on the RPC thread with a deadline-aware bound
+        while the model pages in, instead of erroring.
+        ``tenants``: a TenantTable mapping models to tenants; feeds the
+        admission controller's per-tenant in-flight caps (fair-share
+        ready ordering is attached on the batcher via
+        ``attach_tenants``)."""
+        self.lifecycle = lifecycle
+        self.tenants = tenants
         self.admission = (
             AdmissionController(
                 max_queue=admission_max_queue,
                 concurrency=admission_concurrency,
+                tenants=tenants,
             )
             if admission_max_queue > 0
             else None
@@ -693,6 +730,7 @@ class InferenceServer:
                 channel=channel, tracer=self.tracer, registry=registry,
                 repository=repository, histograms=self.histograms,
                 slo=self.slo, admission=self.admission,
+                lifecycle=lifecycle,
             )
             try:
                 from triton_client_tpu.obs.http import TelemetryServer
@@ -734,6 +772,7 @@ class InferenceServer:
             slo=self.slo,
             admission=self.admission,
             draining=self._draining,
+            lifecycle=lifecycle,
         )
         service.add_servicer_to_server(self._servicer, self._server)
         self._port = self._server.add_insecure_port(address)
