@@ -96,6 +96,17 @@ TPU_FAULT_SEED=7 python -m pytest tests/test_faults.py -q -m '' \
     --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly
 
+echo "== router chaos shard (replicated front door, seed 7) =="
+# the replication contract (runtime/router.py): health probing,
+# outlier ejection, p2c, hedges, retry budgets, the replica_down
+# fault point, deadline-capped channel retries, and the dispatcher
+# stall watchdog — plus the slow-marked kill-one/drain-one open-loop
+# acceptance drive (zero lost responses, goodput recovers to >=90%
+# of steady state) tier-1 deselects.
+TPU_FAULT_SEED=7 python -m pytest tests/test_router.py -q -m '' \
+    --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+
 echo "== tier-1 pytest =="
 exec python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors \

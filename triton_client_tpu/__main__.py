@@ -16,6 +16,8 @@ Commands map 1:1 onto the reference's entry scripts:
                process's telemetry port (serve --metrics-port)
   lint       — tpulint AST hazard analysis (recompilation / donation /
                host-sync / lock / telemetry rules; docs/LINTING.md)
+  route      — probe a replica set (health/readiness/labels per
+               endpoint — the FrontDoorRouter's rotation view)
 """
 
 from __future__ import annotations
@@ -36,6 +38,7 @@ COMMANDS = (
     "repo-index",
     "trace-dump",
     "lint",
+    "route",
 )
 
 
@@ -71,6 +74,8 @@ def main() -> None:
         from triton_client_tpu.cli.tools import trace_dump as run
     elif cmd == "lint":
         from triton_client_tpu.cli.tools import lint as run
+    elif cmd == "route":
+        from triton_client_tpu.cli.tools import route as run
     else:
         print(f"unknown command '{cmd}'; commands: {', '.join(COMMANDS)}")
         raise SystemExit(2)

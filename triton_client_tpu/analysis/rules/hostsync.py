@@ -100,6 +100,17 @@ HOT_PATH_ROOTS = (
     "ModelLifecycleManager.note_cost",
     "ContinuousBatchingChannel._edf_key",
     "ContinuousBatchingChannel._charge_tenants_locked",
+    # ISSUE 10 replicated front door: the router's pick/record/accounting
+    # run per request (and per retry/hedge) on the caller's thread; a
+    # host sync in any of them stalls every request through the fleet
+    "FrontDoorRouter.do_inference",
+    "FrontDoorRouter._launch",
+    "ReplicaSet.pick",
+    "ReplicaSet.release",
+    "ReplicaSet.record_success",
+    "ReplicaSet.record_failure",
+    "RetryBudget.deposit",
+    "RetryBudget.try_spend",
 )
 
 # module-level call targets that force a host sync

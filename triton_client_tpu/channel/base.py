@@ -74,15 +74,27 @@ class InferFuture:
     keeps several requests in flight, overlapping host preprocess with
     device/remote compute. Resolution is single-consumer: the driver
     retires each future exactly once, in issue order.
+
+    Transports whose underlying handle can signal completion or be
+    abandoned (gRPC call futures) wire the optional ``cancel`` /
+    ``subscribe`` hooks; the front-door router (runtime/router.py)
+    uses them to take the first hedged winner and cancel the loser.
+    Lazy futures (the base-channel fallback, deferred TPU readback)
+    leave them unset: ``cancel()`` is then a no-op returning False, and
+    ``add_done_callback`` fires immediately — meaning only "result()
+    may be called", which for a lazy future is always true.
     """
 
-    __slots__ = ("_resolve", "_done", "_value", "_error")
+    __slots__ = ("_resolve", "_done", "_value", "_error", "_cancel",
+                 "_subscribe")
 
-    def __init__(self, resolve) -> None:
+    def __init__(self, resolve, cancel=None, subscribe=None) -> None:
         self._resolve = resolve
         self._done = False
         self._value = None
         self._error: BaseException | None = None
+        self._cancel = cancel
+        self._subscribe = subscribe
 
     @classmethod
     def completed(cls, value) -> "InferFuture":
@@ -112,6 +124,39 @@ class InferFuture:
     def map(self, fn) -> "InferFuture":
         """A future whose result is ``fn(self.result())`` (lazy)."""
         return InferFuture(lambda: fn(self.result()))
+
+    def cancel(self) -> bool:
+        """Best-effort abandon of the in-flight work. Returns True only
+        when the transport accepted the cancellation (the gRPC call had
+        not completed); a lazy or already-retired future returns False.
+        After a successful cancel, result() raises the transport's
+        CANCELLED error — the caller must not expect a value."""
+        if self._done or self._cancel is None:
+            return False
+        try:
+            return bool(self._cancel())
+        except Exception:
+            return False
+
+    def add_done_callback(self, fn) -> None:
+        """Run ``fn()`` (no arguments) once result() will no longer
+        block. Transport-backed futures invoke it from the transport's
+        completion thread — keep it tiny and non-blocking (the router
+        posts to a queue). Lazy futures invoke it immediately on the
+        calling thread: their result() is always callable, it just does
+        the work inline. fn must not raise; a raise is swallowed after
+        logging nothing (completion threads must never die)."""
+        sub = self._subscribe
+        if sub is not None and not self._done:
+            try:
+                sub(fn)
+                return
+            except Exception:
+                pass
+        try:
+            fn()
+        except Exception:
+            pass
 
 
 class BaseChannel(abc.ABC):

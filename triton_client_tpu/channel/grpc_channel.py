@@ -56,6 +56,27 @@ _INFER_RETRYABLE = (grpc.StatusCode.UNAVAILABLE,)
 # relate instead of arriving in synchronized waves at each 2^n step
 _BACKOFF_CAP_S = 5.0
 
+
+class DeadlineExceededRpcError(grpc.RpcError):
+    """Client-local deadline failure, raised WITHOUT touching the wire.
+
+    The retry ladder synthesizes this when the request's remaining
+    deadline budget is gone — either already expired, or so short the
+    next backoff sleep would expire it. It subclasses grpc.RpcError and
+    answers code()/details() so every caller's status-code dispatch
+    (the router, _record_infer_error, tests) handles it exactly like a
+    server-sent DEADLINE_EXCEEDED."""
+
+    def __init__(self, details: str) -> None:
+        super().__init__(details)
+        self._details = details
+
+    def code(self) -> grpc.StatusCode:
+        return grpc.StatusCode.DEADLINE_EXCEEDED
+
+    def details(self) -> str:
+        return self._details
+
 # shared-memory region-name tag: process-wide monotonic so no two
 # channel instances (live or dead) ever share a name prefix
 _SHM_CHANNEL_SEQ = itertools.count()
@@ -176,7 +197,10 @@ class GRPCChannel(BaseChannel):
         t0 = time.perf_counter()
         try:
             resp = self._call(
-                self._stub.ModelInfer, wire, retryable=_INFER_RETRYABLE
+                self._stub.ModelInfer,
+                wire,
+                retryable=_INFER_RETRYABLE,
+                deadline_s=request.deadline_s,
             )
         except grpc.RpcError as e:
             self._record_infer_error(e)
@@ -342,7 +366,15 @@ class GRPCChannel(BaseChannel):
         path): the RPC is on the wire when this returns; result() parses
         the response. A connection-level failure (UNAVAILABLE — the only
         code safe to re-issue, see _call) falls back to the sync retry
-        ladder at resolution time; all other errors surface at result()."""
+        ladder at resolution time; all other errors surface at result().
+
+        The returned future is cancellable and subscribable (see
+        InferFuture): cancel() abandons the wire call, and
+        add_done_callback fires on the gRPC completion thread — the
+        router's hedging relies on both to take the first winner and
+        release the loser's replica slot. The resolution-time retry
+        fallback honors request.deadline_s, so a failover retry never
+        sleeps past the caller's budget."""
         self._warn_shm_wire_fallback()
         try:
             wire = codec.build_infer_request(
@@ -352,7 +384,15 @@ class GRPCChannel(BaseChannel):
                 request_id=request.request_id,
             )
             t0 = time.perf_counter()
-            call = self._stub.ModelInfer.future(wire, timeout=self._timeout_s)
+            timeout = self._timeout_s
+            if request.deadline_s is not None:
+                remaining = request.deadline_s - t0
+                if remaining <= 0:
+                    raise DeadlineExceededRpcError(
+                        "deadline expired before async ModelInfer was issued"
+                    )
+                timeout = min(timeout, remaining)
+            call = self._stub.ModelInfer.future(wire, timeout=timeout)
         except Exception as e:  # async contract: errors surface at result()
             return InferFuture.failed(e)
 
@@ -368,7 +408,8 @@ class GRPCChannel(BaseChannel):
                 # guarantees it). DEADLINE_EXCEEDED/RESOURCE_EXHAUSTED
                 # requests frequently HAVE executed, so re-running those
                 # is unsafe for non-idempotent models and doubles load
-                # exactly when the server is saturated.
+                # exactly when the server is saturated. CANCELLED means
+                # our own cancel() won the race — never re-issue it.
                 if code not in _INFER_RETRYABLE:
                     raise
                 log.warning(
@@ -376,7 +417,10 @@ class GRPCChannel(BaseChannel):
                     "sync retry path", code,
                 )
                 resp = self._call(
-                    self._stub.ModelInfer, wire, retryable=_INFER_RETRYABLE
+                    self._stub.ModelInfer,
+                    wire,
+                    retryable=_INFER_RETRYABLE,
+                    deadline_s=request.deadline_s,
                 )
             return InferResponse(
                 model_name=resp.model_name,
@@ -386,25 +430,53 @@ class GRPCChannel(BaseChannel):
                 latency_s=time.perf_counter() - t0,
             )
 
-        return InferFuture(resolve)
+        return InferFuture(
+            resolve,
+            cancel=call.cancel,
+            subscribe=lambda fn: call.add_done_callback(lambda _c: fn()),
+        )
 
     # -- extras ---------------------------------------------------------------
 
-    def server_live(self) -> bool:
+    def server_live(self, timeout_s: float | None = None) -> bool:
         try:
             return self._call(
-                self._stub.ServerLive, pb.ServerLiveRequest()
+                self._stub.ServerLive, pb.ServerLiveRequest(),
+                timeout_s=timeout_s,
             ).live
         except grpc.RpcError:
             return False
 
-    def server_ready(self) -> bool:
+    def server_ready(self, timeout_s: float | None = None) -> bool:
         """Readiness (vs liveness): a DRAINING server stays live but
         flips not-ready first, so orchestrators pull it from rotation
-        before its in-flight work finishes."""
+        before its in-flight work finishes. ``timeout_s`` overrides the
+        channel deadline for this probe — the router's health loop
+        probes every replica each interval and must not hang an
+        interval's budget on one dead endpoint."""
         try:
             return self._call(
-                self._stub.ServerReady, pb.ServerReadyRequest()
+                self._stub.ServerReady, pb.ServerReadyRequest(),
+                timeout_s=timeout_s,
+            ).ready
+        except grpc.RpcError:
+            return False
+
+    def model_ready(
+        self,
+        model_name: str,
+        model_version: str = "",
+        timeout_s: float | None = None,
+    ) -> bool:
+        """Per-model readiness (KServe ModelReady): the router probes
+        this for its configured model set so a replica that is live but
+        has not yet loaded/warmed the model stays out of rotation."""
+        try:
+            return self._call(
+                self._stub.ModelReady,
+                pb.ModelReadyRequest(name=model_name, version=model_version),
+                retryable=(),
+                timeout_s=timeout_s,
             ).ready
         except grpc.RpcError:
             return False
@@ -512,7 +584,14 @@ class GRPCChannel(BaseChannel):
             "retries": self._retries_total,
         }
 
-    def _call(self, method, request, retryable=_RETRYABLE):
+    def _call(
+        self,
+        method,
+        request,
+        retryable=_RETRYABLE,
+        deadline_s: float | None = None,
+        timeout_s: float | None = None,
+    ):
         """Retry ladder with capped exponential backoff and full
         jitter. ``retryable`` is the set of status codes safe to
         re-issue for THIS method: idempotent queries (metadata,
@@ -523,16 +602,54 @@ class GRPCChannel(BaseChannel):
         models and doubles load exactly when the server is saturated.
         The jitter (uniform over (delay/2, delay]) decorrelates a fleet
         of clients retrying against one recovering server, so the
-        retries do not arrive as synchronized 2^n waves."""
+        retries do not arrive as synchronized 2^n waves.
+
+        ``deadline_s`` is the request's ABSOLUTE perf_counter deadline
+        (InferRequest.deadline_s). It caps every attempt's wire timeout
+        to the remaining budget AND caps the cumulative backoff sleep:
+        if the budget is spent, or the next sleep would spend it, the
+        ladder fails fast with a client-local DeadlineExceededRpcError
+        instead of sleeping past a deadline nobody is waiting on.
+        ``timeout_s`` overrides the channel's per-attempt timeout for
+        THIS call (the router's health probes want a short one without
+        re-dialing a second channel)."""
         delay = self._backoff_s
+        per_attempt = self._timeout_s if timeout_s is None else timeout_s
         for attempt in range(self._retries + 1):
+            timeout = per_attempt
+            if deadline_s is not None:
+                remaining = deadline_s - time.perf_counter()
+                if remaining <= 0:
+                    raise DeadlineExceededRpcError(
+                        "deadline expired before attempt %d of rpc %s"
+                        % (attempt + 1, getattr(method, "_method", method))
+                    )
+                timeout = min(per_attempt, remaining)
             try:
-                return method(request, timeout=self._timeout_s)
+                return method(request, timeout=timeout)
             except grpc.RpcError as e:
                 code = e.code() if hasattr(e, "code") else None
                 if attempt >= self._retries or code not in retryable:
                     raise
                 sleep_s = delay * random.uniform(0.5, 1.0)
+                if (
+                    deadline_s is not None
+                    and time.perf_counter() + sleep_s >= deadline_s
+                ):
+                    # the backoff sleep would outlive the caller's
+                    # deadline: every further attempt is wasted work
+                    # delivered to nobody — fail fast instead
+                    raise DeadlineExceededRpcError(
+                        "remaining deadline %.3fs < backoff %.3fs after "
+                        "%s (attempt %d/%d)"
+                        % (
+                            deadline_s - time.perf_counter(),
+                            sleep_s,
+                            code,
+                            attempt + 1,
+                            self._retries,
+                        )
+                    ) from e
                 log.warning(
                     "rpc %s failed (%s); retry %d/%d in %.2fs",
                     getattr(method, "_method", method),

@@ -172,6 +172,13 @@ def main(argv=None) -> None:
         "docs/OPERATIONS.md 'Multi-tenant serving')",
     )
     p.add_argument(
+        "--replica-of", default="",
+        help="replica-set label: this server is one replica of the named "
+        "fleet. Advertised via ServerMetadata extensions (the `route` "
+        "tool reads it back) and keys the replica_down fault point so a "
+        "chaos plan can kill one labeled replica",
+    )
+    p.add_argument(
         "--warmup", action="store_true",
         help="compile every registered model before accepting requests",
     )
@@ -381,6 +388,7 @@ def build_server(args):
         admission_concurrency=getattr(args, "admission_concurrency", 4),
         lifecycle=lifecycle,
         tenants=tenants,
+        replica_of=getattr(args, "replica_of", "") or None,
     )
 
 

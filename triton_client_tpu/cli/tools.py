@@ -14,6 +14,9 @@
   lint        — tpulint: AST hazard analysis of the serving stack
                 (recompilation/donation/host-sync/lock/telemetry rules;
                 docs/LINTING.md). The CI gate runs this before pytest.
+  route       — probe a replica set: liveness/readiness/labels per
+                endpoint, the operator view of FrontDoorRouter's
+                rotation decision (runtime/router.py).
 """
 
 from __future__ import annotations
@@ -304,3 +307,99 @@ def repo_index(argv=None) -> None:
                 f"{model_dir.name}:{vdir.name}  family={doc.get('family')}  "
                 f"{artifact}"
             )
+
+
+def route(argv=None) -> None:
+    """Probe a replica set the way the FrontDoorRouter sees it: one
+    health pass over every endpoint (ServerLive / ServerReady /
+    optional ModelReady), replica labels from ServerMetadata, and —
+    with ``--watch`` — a live rotation view, so an operator can answer
+    "which replicas would take traffic right now?" without standing up
+    a router."""
+    p = argparse.ArgumentParser(
+        description="probe a replica set (health / readiness / labels)"
+    )
+    p.add_argument(
+        "endpoints", nargs="+", help="replica endpoints (host:port ...)"
+    )
+    p.add_argument(
+        "-m", "--model", action="append", default=[],
+        help="also require ModelReady for this model (repeatable)",
+    )
+    p.add_argument(
+        "--timeout", type=float, default=2.0,
+        help="per-probe RPC deadline in seconds",
+    )
+    p.add_argument(
+        "--watch", type=float, default=0.0,
+        help="re-probe every N seconds until interrupted (0 = once)",
+    )
+    args = p.parse_args(argv)
+
+    import time as _time
+
+    from triton_client_tpu.channel.grpc_channel import GRPCChannel
+    from triton_client_tpu.channel.kserve import pb
+
+    channels = [
+        GRPCChannel(ep, timeout_s=args.timeout, retries=0)
+        for ep in args.endpoints
+    ]
+
+    def label_of(chan) -> str:
+        try:
+            meta = chan._call(
+                chan._stub.ServerMetadata, pb.ServerMetadataRequest(),
+                retryable=(), timeout_s=args.timeout,
+            )
+        except Exception:
+            return "-"
+        for ext in meta.extensions:
+            if ext.startswith("replica_of:"):
+                return ext.split(":", 1)[1]
+        return "-"
+
+    def pass_once() -> int:
+        in_rotation = 0
+        for ep, chan in zip(args.endpoints, channels):
+            live = chan.server_live(timeout_s=args.timeout)
+            ready = live and chan.server_ready(timeout_s=args.timeout)
+            models_ok = ready and all(
+                chan.model_ready(m, timeout_s=args.timeout)
+                for m in args.model
+            )
+            ok = ready and models_ok
+            in_rotation += 1 if ok else 0
+            state = (
+                "IN-ROTATION" if ok
+                else "NOT-READY" if live
+                else "DEAD"
+            )
+            detail = "" if models_ok or not ready else " (model not ready)"
+            print(
+                f"{ep:<28} {state:<12} replica_of={label_of(chan)}{detail}",
+                flush=True,
+            )
+        print(
+            f"-- {in_rotation}/{len(args.endpoints)} in rotation",
+            flush=True,
+        )
+        return in_rotation
+
+    try:
+        ok = pass_once()
+        while args.watch > 0:
+            _time.sleep(args.watch)
+            print()
+            ok = pass_once()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        for chan in channels:
+            try:
+                chan.close()
+            except Exception:
+                pass
+    # scripting-friendly: exit nonzero when NOTHING would take traffic
+    if ok == 0:
+        raise SystemExit(1)

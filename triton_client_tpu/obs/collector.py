@@ -60,6 +60,11 @@ METRIC_TYPES: dict[str, str] = {
     "tpu_serving_padded_frames_total": "counter",
     "tpu_serving_batch_launch_frees_total": "counter",
     "tpu_serving_merge_occupancy_total": "counter",
+    # dispatcher stall watchdog (round 15): the heartbeat age and its
+    # thresholded boolean — a wedged dispatcher (batcher_stall fault, a
+    # hung device call) previously queued requests forever in silence
+    "tpu_serving_dispatcher_stalled": "gauge",
+    "tpu_serving_dispatcher_last_progress_seconds": "gauge",
     # padding-tax plane (ISSUE 8): pad_fraction is the headline share
     # of device rows that were padding; batch_occupancy is the merge
     # occupancy as a real histogram (the BENCH_r05 smear, live);
@@ -498,6 +503,17 @@ class RuntimeCollector:
             f"{ns}_batch_active_slots",
             "batcher execution slots currently active",
             bat.get("active_slots", 0),
+        )
+        yield gauge(
+            f"{ns}_dispatcher_stalled",
+            "1 when the dispatch loop's heartbeat is older than the "
+            "stall threshold (watchdog also logs the episode)",
+            bat.get("dispatcher_stalled", 0),
+        )
+        yield gauge(
+            f"{ns}_dispatcher_last_progress_seconds",
+            "seconds since the dispatch loop last made progress",
+            bat.get("dispatcher_last_progress_age_s", 0.0),
         )
         merges = bat.get("merges", 0)
         fill = 0.0

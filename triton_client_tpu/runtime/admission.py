@@ -64,6 +64,15 @@ class ServerDrainingError(OverloadError):
     completes, new work is refused. Maps to ``UNAVAILABLE``."""
 
 
+class ReplicaDownError(OverloadError):
+    """Injected replica death (the ``replica_down`` fault point): the
+    server answers as if its process were gone — UNAVAILABLE with no
+    drain marker, so a router treats it as a connection-class failure
+    (ejection streak, budgeted retry), unlike the orchestrated
+    :class:`ServerDrainingError`. Only fault plans raise this; real
+    death needs no error class."""
+
+
 class AdmissionController:
     """Per-model bounded queue-depth / estimated-wait admission.
 

@@ -170,6 +170,16 @@ class BatchingChannel(BaseChannel):
         self._ready: collections.deque = collections.deque()
         self._ready_cv = threading.Condition()
         self._dispatch_stop = False
+        # dispatcher heartbeat (stall watchdog): stamped every time the
+        # dispatch loop makes observable progress — top of each slot AND
+        # inside the idle cv-wait, so "idle" stays fresh and only a
+        # genuinely wedged dispatcher (batcher_stall exhausting the
+        # permit semaphore, a hung device call) goes stale. The
+        # watchdog thread logs loudly past stall_threshold_s and the
+        # age/stalled pair rides stats() into the collector.
+        self.stall_threshold_s = 5.0
+        self._hb_ts = time.perf_counter()
+        self._stall_logged = False
         self._merge_stats = {
             "merges": 0, "merged_frames": 0, "padded_frames": 0,
             "launch_frees": 0,
@@ -211,6 +221,11 @@ class BatchingChannel(BaseChannel):
             target=self._dispatch_loop, daemon=True, name="batch-dispatch"
         )
         self._dispatcher.start()
+        self._watchdog_stop = threading.Event()
+        self._watchdog = threading.Thread(
+            target=self._watchdog_loop, daemon=True, name="batch-watchdog"
+        )
+        self._watchdog.start()
 
     def _start_admission(
         self, use_native: bool, max_batch: int, timeout_us: int, capacity: int
@@ -335,18 +350,61 @@ class BatchingChannel(BaseChannel):
                 # failed by _dispatch_once.
                 log.exception("dispatcher slot failed; dispatcher continues")
 
+    def _beat(self) -> None:
+        """Stamp the dispatcher heartbeat. Single writer (the dispatch
+        thread); the watchdog and stats() only read, and a monotonic
+        float store is atomic in CPython — deliberately lock-free so
+        the heartbeat itself can never contend with dispatch."""
+        self._hb_ts = time.perf_counter()
+
+    def dispatcher_progress_age_s(self) -> float:
+        """Seconds since the dispatch loop last made progress (slot
+        start or idle wait). Small under load and at rest; grows only
+        when the dispatcher is wedged."""
+        return max(0.0, time.perf_counter() - self._hb_ts)
+
+    def _watchdog_loop(self) -> None:
+        """Stall watchdog: the batcher_stall fault (and any real hang —
+        a device call that never returns, a deadlocked executor) can
+        freeze the single dispatcher with NO signal: requests just
+        queue forever. Log loudly once per stall episode, and again on
+        recovery, so the operator sees the window edges."""
+        poll = max(0.25, self.stall_threshold_s / 4.0)
+        while not self._watchdog_stop.wait(poll):
+            age = self.dispatcher_progress_age_s()
+            if age >= self.stall_threshold_s:
+                if not self._stall_logged:
+                    self._stall_logged = True
+                    log.error(
+                        "dispatcher STALLED: no progress for %.1fs "
+                        "(threshold %.1fs) — ready_depth=%d, "
+                        "active_slots=%d; requests are queuing",
+                        age, self.stall_threshold_s,
+                        len(self._ready), self._active_slots,
+                    )
+            elif self._stall_logged:
+                self._stall_logged = False
+                log.warning("dispatcher recovered after stall")
+            poll = max(0.25, self.stall_threshold_s / 4.0)
+
     def _dispatch_once(self) -> bool:
         """One dispatcher slot: acquire a permit, form a group, submit.
         Returns True when the loop should exit (close() requested and
         the staging deque is drained). Any unexpected error fails the
         formed group's futures, releases the permit, and re-raises for
         the loop to log — the thread itself survives."""
+        self._beat()
         self._inflight.acquire()
+        self._beat()
         group = None
         try:
             with self._ready_cv:
                 while not self._ready and not self._dispatch_stop:
                     self._ready_cv.wait(timeout=0.1)
+                    # idle is progress: only a dispatcher that cannot
+                    # reach this loop (wedged on the permit semaphore or
+                    # a hung group) lets the heartbeat go stale
+                    self._beat()
                 if self._ready:
                     group = self._form_group_locked()
                     if (
@@ -781,6 +839,11 @@ class BatchingChannel(BaseChannel):
             out["max_merge"] = self._max_merge
             out["batch_multiple"] = self._batch_multiple
             out["pipeline_depth"] = self._pipeline_depth
+            age = self.dispatcher_progress_age_s()
+            out["dispatcher_last_progress_age_s"] = age
+            out["dispatcher_stalled"] = (
+                1 if age >= self.stall_threshold_s else 0
+            )
             n = self._decomp.get("n", 0.0)
             if n:
                 out["decomp_ms"] = {
@@ -804,6 +867,8 @@ class BatchingChannel(BaseChannel):
         return out
 
     def close(self) -> None:
+        # the watchdog first: a slow drain below is not a stall
+        self._watchdog_stop.set()
         # admission first: its close() drains every admitted id into
         # _on_batch, so by the time it returns all work is staged
         if self._impl is not None:

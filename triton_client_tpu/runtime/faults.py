@@ -16,7 +16,14 @@ probe named injection points:
   slow_launch     StagedChannel.launch, before the jit call  sleep
   codec_decode    codec.parse_infer_request                  raise
   batcher_stall   BatchingChannel dispatcher, slot time      sleep
+  replica_down    _Servicer ServerReady/ModelReady/_issue    flag
   ==============  ========================================== =========
+
+The ``replica_down`` point is flag-class (:func:`probe_flag`): the
+server consults it with its ``--replica-of`` label as the model key and
+simulates process death while the transport stays up — ServerReady
+answers not-ready and inference answers UNAVAILABLE (no drain marker) —
+so the router chaos shard can kill a replica deterministically.
 
 Determinism: rules fire by COUNT windows (requests ``after`` .. ``after
 + count`` at that point/model), and probabilistic rules draw from a
@@ -160,3 +167,20 @@ def probe(point: str, model: str | None = None) -> None:
     sleep_s = plan.check(point, model)
     if sleep_s > 0:
         time.sleep(sleep_s)
+
+
+def probe_flag(point: str, model: str | None = None) -> bool:
+    """Flag-class probe: True iff a rule fired, never raises or
+    sleeps. For injection points where the CALLER owns the failure
+    shape (``replica_down``: the servicer must answer a protocol-
+    correct not-ready / UNAVAILABLE, not leak an InjectedFault
+    traceback). Same counting/seeding discipline as :func:`probe`, so
+    flag rules replay identically too."""
+    plan = _ACTIVE
+    if plan is None:
+        return False
+    try:
+        plan.check(point, model)
+    except InjectedFault:
+        return True
+    return False

@@ -1,0 +1,801 @@
+"""Front-door router: health-aware replica routing with tail tolerance.
+
+The drain path has told clients "retry against another replica" since
+PR 7 (runtime/server.py) — this module is the thing that can actually
+do that. ``FrontDoorRouter`` owns N ``GRPCChannel`` endpoints (one
+serving replica each) and routes unary inference across them with the
+four disciplines a replicated front door needs, per *The Tail at
+Scale* and Envoy's outlier-detection model:
+
+  * **health** — an active probe loop calls ServerReady (plus
+    ModelReady for a configured model set) on every replica each
+    interval, and passive outlier ejection removes a replica after
+    consecutive connection-class failures for an exponentially growing
+    hold-down. Drain detection is distinct from death: a not-ready
+    probe or an UNAVAILABLE-with-"draining" response pulls the replica
+    from rotation WITHOUT abandoning its in-flight attempts (the
+    server finishes them; the router just stops sending new work) and
+    without charging the retry budget — a drain is an orchestrated
+    event, not a fault.
+  * **load** — power-of-two-choices over live per-replica in-flight
+    counts: pick two distinct candidates at random, send to the less
+    loaded. P2C gets within a constant factor of ideal least-loaded
+    while reading only two counters, and avoids the thundering-herd
+    flip-flop of deterministic least-loaded under many clients.
+  * **tail tolerance** — hedged requests: if the primary attempt has
+    not resolved after a hedge delay derived from the router's OWN
+    rolling latency quantile (a ``LatencyHistogram``, so the delay
+    tracks the workload), launch the same request on a second replica
+    and take the first winner, cancelling the loser. Hedges are capped
+    by a budget fraction of total traffic so tail-chasing can never
+    become a load amplifier.
+  * **retry discipline** — a token-bucket retry budget shared across
+    the replica set: each routed request deposits ``ratio`` tokens, a
+    failover retry spends one. When the fleet is failing faster than
+    the budget accrues, retries stop and errors surface — a retry
+    storm against a degraded fleet is how outages become cascades.
+    Every retry and hedge also respects the request's remaining
+    ``deadline_s``; the router never launches work nobody will wait
+    for.
+
+The router quacks like a ``BaseChannel`` (get_metadata /
+do_inference / do_inference_async / close), so ``utils/loadgen.py``
+drives a fleet exactly like a single server and capacity numbers
+become fleet numbers.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import random
+import threading
+import time
+from typing import Callable, Sequence
+
+from triton_client_tpu.channel.base import (
+    InferFuture,
+    InferRequest,
+    InferResponse,
+)
+from triton_client_tpu.obs.histogram import LatencyHistogram
+
+log = logging.getLogger(__name__)
+
+# gRPC status-code names the router classifies on. String names (not
+# grpc.StatusCode members) so classification works for any exception
+# exposing .code() — real RpcErrors, the channel's synthesized
+# DeadlineExceededRpcError, and test fakes alike.
+_CONNECTION_CLASS = ("UNAVAILABLE",)  # eject-worthy, retry-elsewhere
+_SHED = "RESOURCE_EXHAUSTED"          # deliberate server shed: NEVER retry
+_DEADLINE = "DEADLINE_EXCEEDED"       # caller budget gone: surface
+
+
+def _status_name(exc: BaseException) -> str | None:
+    code = getattr(exc, "code", None)
+    if not callable(code):
+        return None
+    try:
+        c = code()
+    except Exception:
+        return None
+    return getattr(c, "name", None) or (str(c) if c is not None else None)
+
+
+def _is_draining(exc: BaseException) -> bool:
+    details = getattr(exc, "details", None)
+    if not callable(details):
+        return False
+    try:
+        return "draining" in (details() or "")
+    except Exception:
+        return False
+
+
+class RetryBudget:
+    """Token-bucket retry budget shared across a replica set.
+
+    Envoy's retry-budget model: tokens accrue at ``ratio`` per routed
+    request (so sustainable retry traffic is a fixed fraction of real
+    traffic), a retry costs one token, and the bucket is capped so a
+    long quiet period cannot bank an unbounded burst. ``floor_hits``
+    counts denials — the observable signal that the budget is doing
+    its job under a failure storm."""
+
+    def __init__(
+        self, ratio: float = 0.2, cap: float = 10.0, initial: float = 3.0
+    ) -> None:
+        self._ratio = float(ratio)
+        self._cap = float(cap)
+        self._tokens = min(float(initial), self._cap)
+        self._floor_hits = 0
+        self._spent = 0
+
+    def deposit(self) -> None:
+        self._tokens = min(self._tokens + self._ratio, self._cap)
+
+    def try_spend(self) -> bool:
+        if self._tokens >= 1.0:
+            self._tokens -= 1.0
+            self._spent += 1
+            return True
+        self._floor_hits += 1
+        return False
+
+    @property
+    def tokens(self) -> float:
+        return self._tokens
+
+    @property
+    def floor_hits(self) -> int:
+        return self._floor_hits
+
+    @property
+    def spent(self) -> int:
+        return self._spent
+
+
+class Replica:
+    """One endpoint's routing state. All mutation happens under the
+    owning ReplicaSet's lock; the channel itself is thread-safe."""
+
+    __slots__ = (
+        "endpoint", "channel", "inflight", "consecutive_failures",
+        "ejected_until", "ejections", "probe_ready", "draining",
+        "successes", "failures",
+    )
+
+    def __init__(self, endpoint: str, channel) -> None:
+        self.endpoint = endpoint
+        self.channel = channel
+        self.inflight = 0
+        self.consecutive_failures = 0
+        self.ejected_until = 0.0
+        self.ejections = 0
+        # optimistic until the first probe says otherwise: a router in
+        # front of a healthy fleet must route before its first probe
+        self.probe_ready = True
+        self.draining = False
+        self.successes = 0
+        self.failures = 0
+
+    def ejected(self, now: float) -> bool:
+        return now < self.ejected_until
+
+    def available(self, now: float) -> bool:
+        return self.probe_ready and not self.draining and not self.ejected(now)
+
+
+class ReplicaSet:
+    """Owns the replicas: health probing, outlier ejection, p2c picks.
+
+    Separated from FrontDoorRouter so the membership/health machinery
+    is testable without the hedging state machine on top of it."""
+
+    def __init__(
+        self,
+        endpoints: Sequence[str],
+        *,
+        channel_factory: Callable[[str], object] | None = None,
+        models: Sequence[str] = (),
+        probe_interval_s: float = 0.5,
+        probe_timeout_s: float = 1.0,
+        eject_threshold: int = 3,
+        base_ejection_s: float = 1.0,
+        max_ejection_s: float = 30.0,
+        timeout_s: float = 30.0,
+    ) -> None:
+        if not endpoints:
+            raise ValueError("a replica set needs at least one endpoint")
+        if channel_factory is None:
+            from triton_client_tpu.channel.grpc_channel import GRPCChannel
+
+            # retries=0: the router IS the retry policy. A channel-level
+            # ladder under the router would retry the same dying replica
+            # while the router's budget thinks no retry happened.
+            channel_factory = lambda ep: GRPCChannel(  # noqa: E731
+                ep, timeout_s=timeout_s, retries=0
+            )
+        self._lock = threading.Lock()
+        self.replicas = [
+            Replica(ep, channel_factory(ep)) for ep in endpoints
+        ]
+        self._models = tuple(models)
+        self._probe_interval_s = float(probe_interval_s)
+        self._probe_timeout_s = float(probe_timeout_s)
+        self._eject_threshold = int(eject_threshold)
+        self._base_ejection_s = float(base_ejection_s)
+        self._max_ejection_s = float(max_ejection_s)
+        self._ejections_total = 0
+        self._rng = random.Random()
+        self._stop = threading.Event()
+        self._prober: threading.Thread | None = None
+        if self._probe_interval_s > 0:
+            self._prober = threading.Thread(
+                target=self._probe_loop,
+                name="router-prober",
+                daemon=True,
+            )
+            self._prober.start()
+
+    # -- health ---------------------------------------------------------------
+
+    def _probe_loop(self) -> None:
+        while not self._stop.wait(self._probe_interval_s):
+            try:
+                self.probe_once()
+            except Exception:
+                log.exception("router probe pass failed")
+
+    def probe_once(self) -> None:
+        """One active health pass over every replica (also callable
+        directly — tests drive it without the background thread)."""
+        for rep in self.replicas:
+            ready = rep.channel.server_ready(timeout_s=self._probe_timeout_s)
+            if ready:
+                for model in self._models:
+                    if not rep.channel.model_ready(
+                        model, timeout_s=self._probe_timeout_s
+                    ):
+                        ready = False
+                        break
+            with self._lock:
+                was = rep.probe_ready
+                rep.probe_ready = ready
+                if ready:
+                    # an affirmative probe supersedes stale passive
+                    # signals: the replica answered ServerReady, so a
+                    # drain flag or running failure streak is over
+                    rep.draining = False
+                    rep.consecutive_failures = 0
+                elif was:
+                    log.warning(
+                        "replica %s failed health probe; out of rotation",
+                        rep.endpoint,
+                    )
+
+    def record_success(self, rep: Replica) -> None:
+        with self._lock:
+            rep.successes += 1
+            rep.consecutive_failures = 0
+
+    def record_failure(self, rep: Replica, connection_class: bool) -> None:
+        """Passive outlier signal. Connection-class failures streak
+        toward ejection; others count but do not eject (a model bug
+        returning INTERNAL is not a reason to burn a replica)."""
+        with self._lock:
+            rep.failures += 1
+            if not connection_class:
+                return
+            rep.consecutive_failures += 1
+            if rep.consecutive_failures >= self._eject_threshold:
+                hold = min(
+                    self._base_ejection_s * (2.0 ** rep.ejections),
+                    self._max_ejection_s,
+                )
+                rep.ejected_until = time.perf_counter() + hold
+                rep.ejections += 1
+                rep.consecutive_failures = 0
+                self._ejections_total += 1
+                log.warning(
+                    "ejecting replica %s for %.1fs (%d consecutive "
+                    "connection failures, ejection #%d)",
+                    rep.endpoint, hold, self._eject_threshold, rep.ejections,
+                )
+
+    def mark_draining(self, rep: Replica) -> None:
+        with self._lock:
+            if not rep.draining:
+                log.info(
+                    "replica %s is draining; out of rotation", rep.endpoint
+                )
+            rep.draining = True
+
+    # -- load -----------------------------------------------------------------
+
+    def pick(self, exclude: Sequence[Replica] = ()) -> Replica | None:
+        """Power-of-two-choices over available replicas (minus
+        ``exclude`` — a hedge must land on a different replica than the
+        attempt it is hedging). Panic mode: if nothing is available
+        (all ejected / not-ready), fall back to the least-bad pool —
+        the zero-lost-responses contract says a request must always be
+        attempted somewhere rather than failed on the floor."""
+        now = time.perf_counter()
+        with self._lock:
+            pool = [
+                r for r in self.replicas
+                if r.available(now) and r not in exclude
+            ]
+            if not pool:
+                # panic ladder: non-draining first (they may have
+                # recovered), then literally anything not excluded
+                pool = [
+                    r for r in self.replicas
+                    if not r.draining and r not in exclude
+                ]
+            if not pool:
+                pool = [r for r in self.replicas if r not in exclude]
+            if not pool:
+                return None
+            if len(pool) == 1:
+                pick = pool[0]
+            else:
+                a, b = self._rng.sample(pool, 2)
+                pick = a if a.inflight <= b.inflight else b
+            pick.inflight += 1
+            return pick
+
+    def release(self, rep: Replica) -> None:
+        with self._lock:
+            rep.inflight -= 1
+
+    # -- surface --------------------------------------------------------------
+
+    def available_count(self) -> int:
+        now = time.perf_counter()
+        with self._lock:
+            return sum(1 for r in self.replicas if r.available(now))
+
+    def snapshot(self) -> list[dict]:
+        now = time.perf_counter()
+        with self._lock:
+            return [
+                {
+                    "endpoint": r.endpoint,
+                    "inflight": r.inflight,
+                    "probe_ready": r.probe_ready,
+                    "draining": r.draining,
+                    "ejected": r.ejected(now),
+                    "ejections": r.ejections,
+                    "consecutive_failures": r.consecutive_failures,
+                    "successes": r.successes,
+                    "failures": r.failures,
+                }
+                for r in self.replicas
+            ]
+
+    @property
+    def ejections_total(self) -> int:
+        with self._lock:
+            return self._ejections_total
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._prober is not None:
+            self._prober.join(timeout=2 * self._probe_interval_s + 2.0)
+        for rep in self.replicas:
+            try:
+                rep.channel.close()
+            except Exception:
+                pass
+
+
+class _Attempt:
+    __slots__ = ("replica", "future", "kind")
+
+    def __init__(self, replica: Replica, future: InferFuture, kind: str):
+        self.replica = replica
+        self.future = future
+        self.kind = kind  # "primary" | "retry" | "hedge"
+
+
+class FrontDoorRouter:
+    """Routes unary inference across a ReplicaSet with hedging and a
+    shared retry budget. Quacks like a BaseChannel.
+
+    Knobs (defaults tuned for the in-process chaos rig; production
+    fronts raise the timeouts):
+
+      hedge_quantile / hedge_min_samples — the hedge delay is the
+        router's own e2e latency quantile; no hedging until the
+        histogram has ``hedge_min_samples`` observations, so a cold
+        router never hedges on noise.
+      hedge_budget_fraction — hedges may never exceed this fraction of
+        routed requests (the Tail-at-Scale ~5% discipline).
+      max_attempts — total attempts per request (primary + failover
+        retries). Hedges do not count: a hedge is the same attempt
+        raced on two replicas.
+    """
+
+    def __init__(
+        self,
+        endpoints: Sequence[str],
+        *,
+        channel_factory: Callable[[str], object] | None = None,
+        models: Sequence[str] = (),
+        probe_interval_s: float = 0.5,
+        probe_timeout_s: float = 1.0,
+        eject_threshold: int = 3,
+        base_ejection_s: float = 1.0,
+        max_ejection_s: float = 30.0,
+        timeout_s: float = 30.0,
+        hedge_quantile: float = 0.95,
+        hedge_min_samples: int = 50,
+        hedge_budget_fraction: float = 0.05,
+        retry_budget_ratio: float = 0.2,
+        retry_budget_cap: float = 10.0,
+        max_attempts: int = 3,
+    ) -> None:
+        self.replica_set = ReplicaSet(
+            endpoints,
+            channel_factory=channel_factory,
+            models=models,
+            probe_interval_s=probe_interval_s,
+            probe_timeout_s=probe_timeout_s,
+            eject_threshold=eject_threshold,
+            base_ejection_s=base_ejection_s,
+            max_ejection_s=max_ejection_s,
+            timeout_s=timeout_s,
+        )
+        self._timeout_s = float(timeout_s)
+        self._hedge_quantile = float(hedge_quantile)
+        self._hedge_min_samples = int(hedge_min_samples)
+        self._hedge_budget_fraction = float(hedge_budget_fraction)
+        self._max_attempts = max(1, int(max_attempts))
+        self._latency = LatencyHistogram()
+        self._lock = threading.Lock()
+        self._budget = RetryBudget(
+            ratio=retry_budget_ratio, cap=retry_budget_cap
+        )
+        self._requests_total = 0
+        self._hedges_launched = 0
+        self._hedges_won = 0
+        self._hedges_lost = 0
+        self._hedges_denied = 0
+        self._failovers = 0
+        self._drain_failovers = 0
+        self._errors = 0
+
+    # -- BaseChannel quack ----------------------------------------------------
+
+    def register_channel(self) -> None:  # channels dialed in __init__
+        pass
+
+    def fetch_channel(self):
+        return self.replica_set
+
+    def get_metadata(self, model_name: str, model_version: str = ""):
+        """Model contract from any available replica (replicas serve
+        identical repositories; first answer wins, failures fall
+        through to the next replica)."""
+        last: Exception | None = None
+        now = time.perf_counter()
+        reps = sorted(
+            self.replica_set.replicas,
+            key=lambda r: not r.available(now),
+        )
+        for rep in reps:
+            try:
+                return rep.channel.get_metadata(model_name, model_version)
+            except Exception as e:
+                last = e
+        raise last if last is not None else RuntimeError("no replicas")
+
+    def do_inference_async(self, request: InferRequest) -> InferFuture:
+        """Lazy future over the routed call: the hedging state machine
+        runs on whichever thread resolves the future (loadgen's
+        resolver pool), so issue-side stays non-blocking."""
+        return InferFuture(lambda: self.do_inference(request))
+
+    # -- routing core ---------------------------------------------------------
+
+    def _hedge_delay_s(self) -> float | None:
+        """Current hedge trigger: the rolling e2e quantile, or None
+        (no hedging) until enough samples exist to trust it."""
+        snap = self._latency.snapshot()
+        if snap["count"] < self._hedge_min_samples:
+            return None
+        from triton_client_tpu.obs.histogram import quantile_from_snapshot
+
+        return quantile_from_snapshot(snap, self._hedge_quantile)
+
+    def _hedge_allowed(self) -> bool:
+        with self._lock:
+            allowed = (
+                self._hedges_launched + 1
+                <= self._hedge_budget_fraction * max(self._requests_total, 20)
+            )
+            if not allowed:
+                self._hedges_denied += 1
+            return allowed
+
+    def _launch(
+        self,
+        rep: Replica,
+        request: InferRequest,
+        done: "queue.SimpleQueue",
+        kind: str,
+    ) -> _Attempt:
+        """Issue one attempt on ``rep``. The done-callback releases the
+        replica's in-flight slot and posts completion — it runs on the
+        transport's completion thread, so it only queues."""
+        fut = rep.channel.do_inference_async(request)
+        att = _Attempt(rep, fut, kind)
+        released = []  # close over a once-flag; gRPC may double-fire
+
+        def _on_done() -> None:
+            if not released:
+                released.append(True)
+                self.replica_set.release(rep)
+                done.put(att)
+
+        fut.add_done_callback(_on_done)
+        return att
+
+    def do_inference(self, request: InferRequest) -> InferResponse:
+        t0 = time.perf_counter()
+        with self._lock:
+            self._requests_total += 1
+            self._budget.deposit()
+        deadline = request.deadline_s
+        done: queue.SimpleQueue = queue.SimpleQueue()
+        hedge_delay = self._hedge_delay_s()
+
+        rep = self.replica_set.pick()
+        if rep is None:
+            raise RuntimeError("replica set is empty")
+        outstanding = [self._launch(rep, request, done, "primary")]
+        attempts_made = 1
+        hedge_spent = False
+        last_error: BaseException | None = None
+
+        while True:
+            # -- wait for the next completion (or the hedge trigger) --
+            timeout: float | None = None
+            if deadline is not None:
+                timeout = max(deadline - time.perf_counter(), 0.001)
+            if (
+                hedge_delay is not None
+                and not hedge_spent
+                and len(outstanding) == 1
+            ):
+                until_hedge = max(t0 + hedge_delay - time.perf_counter(), 0.0)
+                timeout = (
+                    until_hedge if timeout is None
+                    else min(timeout, until_hedge)
+                )
+            try:
+                att = done.get(timeout=timeout)
+            except queue.Empty:
+                if (
+                    deadline is not None
+                    and time.perf_counter() >= deadline
+                ):
+                    # nobody is waiting anymore: abandon what's in
+                    # flight (their callbacks release the slots) and
+                    # surface the deadline
+                    for o in outstanding:
+                        o.future.cancel()
+                    self._count_error()
+                    raise _deadline_error(
+                        "router deadline expired with %d attempt(s) in "
+                        "flight" % len(outstanding)
+                    )
+                # hedge trigger
+                hedge_spent = True  # one hedge per request, win or lose
+                if self._hedge_allowed():
+                    hrep = self.replica_set.pick(
+                        exclude=[o.replica for o in outstanding]
+                    )
+                    if hrep is not None:
+                        with self._lock:
+                            self._hedges_launched += 1
+                        outstanding.append(
+                            self._launch(hrep, request, done, "hedge")
+                        )
+                continue
+
+            # -- one attempt resolved --
+            outstanding = [o for o in outstanding if o is not att]
+            try:
+                resp = att.future.result()
+            except BaseException as e:
+                last_error = e
+                handled_retry = self._on_attempt_failure(att, e)
+                if not handled_retry:
+                    # non-retryable (shed / deadline / unknown): losers
+                    # in flight can no longer change the outcome
+                    for o in outstanding:
+                        o.future.cancel()
+                    self._count_error()
+                    raise
+                if outstanding:
+                    # the raced hedge is already the retry
+                    continue
+                retry_rep = self._try_retry(att, e, attempts_made, deadline)
+                if retry_rep is None:
+                    self._count_error()
+                    raise
+                attempts_made += 1
+                outstanding.append(
+                    self._launch(retry_rep, request, done, "retry")
+                )
+                continue
+
+            # -- winner --
+            self.replica_set.record_success(att.replica)
+            hedge_in_flight = any(o.kind == "hedge" for o in outstanding)
+            for o in outstanding:
+                o.future.cancel()
+            with self._lock:
+                if att.kind == "hedge":
+                    self._hedges_won += 1
+                elif hedge_in_flight:
+                    self._hedges_lost += 1
+            self._latency.observe(time.perf_counter() - t0)
+            return resp
+
+    def _on_attempt_failure(self, att: _Attempt, exc: BaseException) -> bool:
+        """Classify one failed attempt; update health. Returns True if
+        the failure class is retryable on another replica."""
+        name = _status_name(exc)
+        if name in _CONNECTION_CLASS:
+            if _is_draining(exc):
+                # orchestrated drain: pull from rotation, no ejection
+                # streak, and the retry is free (not the fleet's fault)
+                self.replica_set.mark_draining(att.replica)
+            else:
+                self.replica_set.record_failure(
+                    att.replica, connection_class=True
+                )
+            return True
+        if name == _SHED:
+            # deliberate admission shed: retrying feeds the overload
+            # the server is shedding; surface it as an accounted shed
+            self.replica_set.record_failure(
+                att.replica, connection_class=False
+            )
+            return False
+        if name == _DEADLINE:
+            self.replica_set.record_failure(
+                att.replica, connection_class=False
+            )
+            return False
+        # unknown / application error: count, don't eject, don't retry
+        # (the model said no; another replica will say the same no)
+        self.replica_set.record_failure(att.replica, connection_class=False)
+        return False
+
+    def _try_retry(
+        self,
+        att: _Attempt,
+        exc: BaseException,
+        attempts_made: int,
+        deadline: float | None,
+    ) -> Replica | None:
+        """Gate + pick for a failover retry. Drain failovers skip the
+        budget (orchestrated, not a fault); everything else spends a
+        token. Returns the replica to retry on, or None to surface."""
+        if attempts_made >= self._max_attempts:
+            return None
+        if deadline is not None and time.perf_counter() >= deadline:
+            return None
+        draining = _is_draining(exc)
+        if not draining:
+            with self._lock:
+                if not self._budget.try_spend():
+                    log.warning(
+                        "retry budget at floor (%d denials); surfacing "
+                        "failure from %s",
+                        self._budget.floor_hits, att.replica.endpoint,
+                    )
+                    return None
+        rep = self.replica_set.pick(exclude=[att.replica])
+        if rep is None:
+            return None
+        with self._lock:
+            self._failovers += 1
+            if draining:
+                self._drain_failovers += 1
+        return rep
+
+    def _count_error(self) -> None:
+        with self._lock:
+            self._errors += 1
+
+    # -- surface --------------------------------------------------------------
+
+    def stats(self) -> dict:
+        """Flat counters, collector-style (tests and perf scripts diff
+        two of these)."""
+        hedge_delay = self._hedge_delay_s()
+        with self._lock:
+            return {
+                "requests_total": self._requests_total,
+                "errors_total": self._errors,
+                "hedges_launched": self._hedges_launched,
+                "hedges_won": self._hedges_won,
+                "hedges_lost": self._hedges_lost,
+                "hedges_denied": self._hedges_denied,
+                "failovers": self._failovers,
+                "drain_failovers": self._drain_failovers,
+                "retry_budget_tokens": self._budget.tokens,
+                "retry_budget_floor_hits": self._budget.floor_hits,
+                "retries_spent": self._budget.spent,
+                "ejections_total": self.replica_set.ejections_total,
+                "replicas_total": len(self.replica_set.replicas),
+                "replicas_available": self.replica_set.available_count(),
+                "hedge_delay_s": hedge_delay if hedge_delay else 0.0,
+            }
+
+    def snapshot(self) -> dict:
+        """stats() plus per-replica detail and the latency histogram —
+        the structured read the route CLI and the collector export."""
+        snap = self.stats()
+        snap["replicas"] = self.replica_set.snapshot()
+        snap["latency"] = self._latency.snapshot()
+        return snap
+
+    def close(self) -> None:
+        self.replica_set.close()
+
+
+def _deadline_error(msg: str):
+    """The channel's client-local DEADLINE_EXCEEDED, reused so callers
+    classify router deadline failures like any other."""
+    from triton_client_tpu.channel.grpc_channel import (
+        DeadlineExceededRpcError,
+    )
+
+    return DeadlineExceededRpcError(msg)
+
+
+class RouterCollector:
+    """Prometheus custom collector over a FrontDoorRouter snapshot.
+
+    Registered the same way RuntimeCollector is (obs/collector.py):
+    ``registry.register(RouterCollector(router))``. Import of
+    prometheus_client is deferred to collect() so the router works on
+    images without it."""
+
+    def __init__(self, router: FrontDoorRouter) -> None:
+        self._router = router
+
+    def collect(self):
+        from prometheus_client.core import (
+            CounterMetricFamily,
+            GaugeMetricFamily,
+        )
+
+        snap = self._router.snapshot()
+        counters = {
+            "tpu_router_requests_total": ("requests_total", "routed requests"),
+            "tpu_router_errors_total": ("errors_total", "surfaced errors"),
+            "tpu_router_hedges_total": ("hedges_launched", "hedges launched"),
+            "tpu_router_hedges_won_total": ("hedges_won", "hedges that won"),
+            "tpu_router_failovers_total": ("failovers", "failover retries"),
+            "tpu_router_ejections_total": ("ejections_total", "ejections"),
+            "tpu_router_retry_budget_floor_total": (
+                "retry_budget_floor_hits", "retries denied at budget floor"
+            ),
+        }
+        for fam, (key, help_) in counters.items():
+            c = CounterMetricFamily(fam, help_)
+            c.add_metric([], float(snap[key]))
+            yield c
+        g = GaugeMetricFamily(
+            "tpu_router_retry_budget_tokens", "retry-budget token level"
+        )
+        g.add_metric([], float(snap["retry_budget_tokens"]))
+        yield g
+        g = GaugeMetricFamily(
+            "tpu_router_hedge_delay_seconds", "current hedge trigger delay"
+        )
+        g.add_metric([], float(snap["hedge_delay_s"]))
+        yield g
+        healthy = GaugeMetricFamily(
+            "tpu_router_replica_available",
+            "1 if the replica is in rotation",
+            labels=["endpoint"],
+        )
+        inflight = GaugeMetricFamily(
+            "tpu_router_replica_inflight",
+            "live in-flight attempts on the replica",
+            labels=["endpoint"],
+        )
+        for r in snap["replicas"]:
+            ok = r["probe_ready"] and not r["draining"] and not r["ejected"]
+            healthy.add_metric([r["endpoint"]], 1.0 if ok else 0.0)
+            inflight.add_metric([r["endpoint"]], float(r["inflight"]))
+        yield healthy
+        yield inflight

@@ -31,12 +31,14 @@ from triton_client_tpu import __version__
 from triton_client_tpu.channel.base import BaseChannel, InferRequest
 from triton_client_tpu.channel.kserve import codec, pb, service
 from triton_client_tpu.config import FRAMING_BYTES
+from triton_client_tpu.runtime import faults
 from triton_client_tpu.runtime.admission import (
     AdmissionController,
     AdmissionRejectedError,
     CircuitOpenError,
     DeadlineExpiredError,
     OverloadError,
+    ReplicaDownError,
     ServerDrainingError,
 )
 from triton_client_tpu.runtime.repository import ModelRepository
@@ -77,7 +79,9 @@ def _grpc_code(exc: BaseException) -> str:
         return "RESOURCE_EXHAUSTED"
     if isinstance(exc, DeadlineExpiredError):
         return "DEADLINE_EXCEEDED"
-    if isinstance(exc, (CircuitOpenError, ServerDrainingError)):
+    if isinstance(
+        exc, (CircuitOpenError, ServerDrainingError, ReplicaDownError)
+    ):
         return "UNAVAILABLE"
     if isinstance(exc, KeyError):
         return "NOT_FOUND"
@@ -107,10 +111,16 @@ class _Servicer(service.GRPCInferenceServiceServicer):
         admission: AdmissionController | None = None,
         draining: threading.Event | None = None,
         lifecycle=None,
+        replica_of: str | None = None,
     ) -> None:
         self._repo = repository
         self._channel = channel
         self._lifecycle = lifecycle
+        # replica label (--replica-of): names the replica set this
+        # server belongs to. It keys the replica_down fault point so a
+        # chaos plan can kill ONE labeled replica in a fleet, and rides
+        # ServerMetadata.extensions so the route tool can display it.
+        self._replica_of = replica_of
         self._profiler = profiler
         self._shm = shm_registry
         self._stream_depth = max(1, int(stream_pipeline_depth))
@@ -136,13 +146,20 @@ class _Servicer(service.GRPCInferenceServiceServicer):
     def ServerLive(self, request, context):
         return pb.ServerLiveResponse(live=True)
 
+    def _replica_down_now(self) -> bool:
+        return faults.probe_flag("replica_down", self._replica_of)
+
     def ServerReady(self, request, context):
         # a draining server flips not-ready FIRST so orchestrators pull
-        # it from rotation before in-flight work finishes
-        return pb.ServerReadyResponse(ready=not self._draining_now())
+        # it from rotation before in-flight work finishes; an injected
+        # replica_down fault answers not-ready the same way a dead
+        # process would simply not answer
+        return pb.ServerReadyResponse(
+            ready=not self._draining_now() and not self._replica_down_now()
+        )
 
     def ModelReady(self, request, context):
-        if self._draining_now():
+        if self._draining_now() or self._replica_down_now():
             return pb.ModelReadyResponse(ready=False)
         try:
             self._repo.get(request.name, request.version)
@@ -154,14 +171,19 @@ class _Servicer(service.GRPCInferenceServiceServicer):
     # -- metadata -------------------------------------------------------------
 
     def ServerMetadata(self, request, context):
+        extensions = [
+            "model_repository",
+            "binary_tensor_data",
+            "system_shared_memory",
+        ]
+        if self._replica_of:
+            # replica-set label as a metadata extension: the route tool
+            # reads it back to confirm which fleet an endpoint claims
+            extensions.append(f"replica_of:{self._replica_of}")
         return pb.ServerMetadataResponse(
             name="triton_client_tpu",
             version=__version__,
-            extensions=[
-                "model_repository",
-                "binary_tensor_data",
-                "system_shared_memory",
-            ],
+            extensions=extensions,
         )
 
     def _spec_or_abort(self, name, version, context):
@@ -332,6 +354,10 @@ class _Servicer(service.GRPCInferenceServiceServicer):
                 raise ServerDrainingError(
                     "server is draining; retry against another replica"
                 )
+            if self._replica_down_now():
+                # simulated process death: UNAVAILABLE with NO drain
+                # marker, so routers run their ejection/budget path
+                raise ReplicaDownError("replica is down (injected)")
             if self._admission is not None:
                 try:
                     self._admission.admit(
@@ -619,6 +645,7 @@ class InferenceServer:
         admission_concurrency: int = 4,
         lifecycle=None,
         tenants=None,
+        replica_of: str | None = None,
     ) -> None:
         """``metrics_port``: serve the telemetry endpoint — Prometheus
         exposition on ``/metrics`` (Triton's :8002 role), Chrome-trace
@@ -655,9 +682,13 @@ class InferenceServer:
         ``tenants``: a TenantTable mapping models to tenants; feeds the
         admission controller's per-tenant in-flight caps (fair-share
         ready ordering is attached on the batcher via
-        ``attach_tenants``)."""
+        ``attach_tenants``).
+        ``replica_of``: replica-set label (``serve --replica-of``) —
+        keys the ``replica_down`` fault point and is advertised via
+        ServerMetadata.extensions for the route tool."""
         self.lifecycle = lifecycle
         self.tenants = tenants
+        self.replica_of = replica_of
         self.admission = (
             AdmissionController(
                 max_queue=admission_max_queue,
@@ -773,6 +804,7 @@ class InferenceServer:
             admission=self.admission,
             draining=self._draining,
             lifecycle=lifecycle,
+            replica_of=replica_of,
         )
         service.add_servicer_to_server(self._servicer, self._server)
         self._port = self._server.add_insecure_port(address)

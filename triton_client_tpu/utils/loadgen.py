@@ -348,8 +348,36 @@ def co_percentile(latencies_ms, scheduled: int, q: float) -> float:
     return lats[rank - 1] if rank <= len(lats) else float("inf")
 
 
+def _dial(target, deadline_s: float):
+    """Resolve a loadgen target into ``(channel, owned)``.
+
+    Three target shapes, so capacity numbers can be fleet numbers:
+      * ``"host:port"`` — one endpoint, a fresh ``GRPCChannel``
+        (owned: closed by the caller when the window ends);
+      * ``["host:port", ...]`` — a replica set: a fresh
+        ``FrontDoorRouter`` over the endpoints (owned);
+      * a channel-shaped object (anything with ``do_inference_async``)
+        — used as-is and NOT closed, so a caller-configured router
+        (custom hedge/budget knobs, warm latency histogram) can be
+        driven across several windows."""
+    if isinstance(target, str):
+        from triton_client_tpu.channel.grpc_channel import GRPCChannel
+
+        return GRPCChannel(target, timeout_s=deadline_s), True
+    if isinstance(target, (list, tuple)):
+        from triton_client_tpu.runtime.router import FrontDoorRouter
+
+        return FrontDoorRouter(list(target), timeout_s=deadline_s), True
+    if hasattr(target, "do_inference_async"):
+        return target, False
+    raise TypeError(
+        f"loadgen target must be an address, a list of addresses, or a "
+        f"channel, not {type(target).__name__}"
+    )
+
+
 def run_open_loop(
-    address: str,
+    address,
     scenarios,
     rate_qps: float,
     duration_s: float,
@@ -358,7 +386,12 @@ def run_open_loop(
     warm: bool = True,
     resolvers: int = 16,
 ) -> OpenLoopResult:
-    """Drive one open-loop window against a KServe v2 endpoint.
+    """Drive one open-loop window against a KServe v2 endpoint — or a
+    replica fleet.
+
+    ``address`` is a ``_dial`` target: one endpoint string, a list of
+    endpoint strings (routed through a ``FrontDoorRouter``), or an
+    already-built channel/router instance (driven, not closed).
 
     ``scenarios``: the traffic mix — a list of ``(model_name, inputs)``
     or ``(model_name, inputs, weight)`` tuples; arrivals pick a
@@ -376,7 +409,6 @@ def run_open_loop(
     import queue as _q
 
     from triton_client_tpu.channel.base import InferRequest
-    from triton_client_tpu.channel.grpc_channel import GRPCChannel
 
     scenarios = [
         (s[0], s[1], float(s[2]) if len(s) > 2 else 1.0) for s in scenarios
@@ -409,7 +441,7 @@ def run_open_loop(
                 latencies.append(lat_ms)
                 completed[0] += 1
 
-    chan = GRPCChannel(address, timeout_s=deadline_s)
+    chan, owned = _dial(address, deadline_s)
     try:
         requests = [
             InferRequest(model_name=m, inputs=inputs)
@@ -444,10 +476,11 @@ def run_open_loop(
         if alive:
             errors.append(f"{len(alive)} resolver threads still alive")
     finally:
-        try:
-            chan.close()
-        except Exception:
-            pass
+        if owned:
+            try:
+                chan.close()
+            except Exception:
+                pass
     return OpenLoopResult(
         offered_qps=float(rate_qps),
         scheduled=len(offsets),
@@ -459,7 +492,7 @@ def run_open_loop(
 
 
 def slo_capacity_search(
-    address: str,
+    address,
     scenarios,
     slo_ms: float,
     duration_s: float = 5.0,
@@ -478,17 +511,24 @@ def slo_capacity_search(
     is one seeded open-loop window; probe seeds differ so schedules
     are independent but the WHOLE search replays from ``seed``.
     Returns the capacity plus the p50/p99/p999 measured AT capacity
-    and the full probe log."""
+    and the full probe log.
+
+    ``address`` takes the same target shapes as ``run_open_loop``; a
+    list of endpoints dials ONE router shared across every probe, so
+    its rolling hedge quantile and health state carry over — the fleet
+    capacity number measures the steady-state front door, not a cold
+    one per probe."""
     if deadline_s is None:
         # the gRPC deadline must comfortably exceed the SLO so a miss
         # is measured, not truncated into an error
         deadline_s = max(30.0, slo_ms / 1e3 * 20.0)
+    chan, owned = _dial(address, deadline_s)
     probes: list[dict] = []
     best: OpenLoopResult | None = None
 
     def probe(qps: float):
         res = run_open_loop(
-            address, scenarios, rate_qps=qps, duration_s=duration_s,
+            chan, scenarios, rate_qps=qps, duration_s=duration_s,
             seed=seed + len(probes) + 1, deadline_s=deadline_s,
             warm=len(probes) == 0,  # first probe warms the path
         )
@@ -504,51 +544,58 @@ def slo_capacity_search(
         )
         return p <= slo_ms, res
 
-    ok, res = probe(qps_lo)
-    if not ok:
+    try:
+        ok, res = probe(qps_lo)
+        if not ok:
+            return {
+                "slo_ms": slo_ms,
+                "percentile": percentile,
+                "slo_capacity_qps": 0.0,
+                "goodput_qps": round(res.goodput_qps(slo_ms), 3),
+                "shed_rate": round(res.shed_rate, 4),
+                "p50_ms": res.percentile(50.0),
+                "p99_ms": res.percentile(99.0),
+                "p999_ms": res.percentile(99.9),
+                "probes": probes,
+            }
+        lo, hi, best = qps_lo, None, res
+        q = qps_lo
+        while q < qps_hi:
+            q = min(qps_hi, q * 2.0)
+            ok, res = probe(q)
+            if ok:
+                lo, best = q, res
+            else:
+                hi = q
+                break
+        if hi is not None:
+            for _ in range(max(0, int(iters))):
+                if hi / lo < 1.15:
+                    break
+                mid = (lo * hi) ** 0.5
+                ok, res = probe(mid)
+                if ok:
+                    lo, best = mid, res
+                else:
+                    hi = mid
+        p50 = best.percentile(50.0)
+        p99 = best.percentile(99.0)
+        p999 = best.percentile(99.9)
         return {
             "slo_ms": slo_ms,
             "percentile": percentile,
-            "slo_capacity_qps": 0.0,
-            "goodput_qps": round(res.goodput_qps(slo_ms), 3),
-            "shed_rate": round(res.shed_rate, 4),
-            "p50_ms": res.percentile(50.0),
-            "p99_ms": res.percentile(99.0),
-            "p999_ms": res.percentile(99.9),
+            "slo_capacity_qps": round(lo, 3),
+            "goodput_qps": round(best.goodput_qps(slo_ms), 3),
+            "shed_rate": round(best.shed_rate, 4),
+            "achieved_qps": round(best.achieved_qps, 3),
+            "p50_ms": round(p50, 3) if p50 != float("inf") else None,
+            "p99_ms": round(p99, 3) if p99 != float("inf") else None,
+            "p999_ms": round(p999, 3) if p999 != float("inf") else None,
             "probes": probes,
         }
-    lo, hi, best = qps_lo, None, res
-    q = qps_lo
-    while q < qps_hi:
-        q = min(qps_hi, q * 2.0)
-        ok, res = probe(q)
-        if ok:
-            lo, best = q, res
-        else:
-            hi = q
-            break
-    if hi is not None:
-        for _ in range(max(0, int(iters))):
-            if hi / lo < 1.15:
-                break
-            mid = (lo * hi) ** 0.5
-            ok, res = probe(mid)
-            if ok:
-                lo, best = mid, res
-            else:
-                hi = mid
-    p50 = best.percentile(50.0)
-    p99 = best.percentile(99.0)
-    p999 = best.percentile(99.9)
-    return {
-        "slo_ms": slo_ms,
-        "percentile": percentile,
-        "slo_capacity_qps": round(lo, 3),
-        "goodput_qps": round(best.goodput_qps(slo_ms), 3),
-        "shed_rate": round(best.shed_rate, 4),
-        "achieved_qps": round(best.achieved_qps, 3),
-        "p50_ms": round(p50, 3) if p50 != float("inf") else None,
-        "p99_ms": round(p99, 3) if p99 != float("inf") else None,
-        "p999_ms": round(p999, 3) if p999 != float("inf") else None,
-        "probes": probes,
-    }
+    finally:
+        if owned:
+            try:
+                chan.close()
+            except Exception:
+                pass
