@@ -107,6 +107,18 @@ TPU_FAULT_SEED=7 python -m pytest tests/test_router.py -q -m '' \
     --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly
 
+echo "== bench diff (optional shard: fresh bench vs BENCH_LOCAL.json) =="
+# perf-regression gate: compares a freshly produced bench results file
+# (BENCH_FRESH=<results.json>, written by a perf/ script on real
+# hardware) against the committed BENCH_LOCAL.json and fails on a >10%
+# throughput or MFU regression. Skipped — loudly — when no fresh row
+# exists: CI containers have no accelerator to produce one.
+if [[ -n "${BENCH_FRESH:-}" && -f "${BENCH_FRESH}" ]]; then
+    python perf/bench_diff.py "${BENCH_FRESH}" --baseline BENCH_LOCAL.json
+else
+    echo "no fresh bench results (set BENCH_FRESH=<results.json>); skipping"
+fi
+
 echo "== tier-1 pytest =="
 exec python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors \

@@ -1,0 +1,115 @@
+"""Bench regression gate: a fresh result row vs the committed baseline.
+
+Compares the ``results`` rows of a freshly produced bench JSON (any of
+the perf/ scripts' output, same shape as BENCH_LOCAL.json) against the
+committed BENCH_LOCAL.json, matched by ``metric`` name, and exits
+nonzero when either
+
+  * throughput (``value``, frames/scans per sec per chip) regressed by
+    more than the threshold (default 10%), or
+  * ``mfu`` dropped by more than the threshold
+
+— so a perf regression fails CI the same way a test failure does.
+ci.sh runs this as an OPTIONAL shard: only when a fresh row exists
+(``BENCH_FRESH=<results.json>``), because producing one needs the
+actual accelerator; the committed baseline alone proves nothing.
+
+Improvements never fail; metrics present on only one side are reported
+but not gated (a new bench row has no baseline yet, a retired one no
+fresh measurement).
+
+Usage:
+    python perf/bench_diff.py FRESH.json [--baseline BENCH_LOCAL.json]
+                              [--threshold 0.10]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_rows(path: str) -> dict[str, dict]:
+    """``metric name -> row`` from a bench JSON (tolerates both the
+    wrapped ``{"results": [...]}`` shape and a bare row list)."""
+    with open(path) as f:
+        doc = json.load(f)
+    rows = doc.get("results", doc) if isinstance(doc, dict) else doc
+    if not isinstance(rows, list):
+        raise SystemExit(f"{path}: expected a results list")
+    out = {}
+    for row in rows:
+        if isinstance(row, dict) and "metric" in row:
+            out[row["metric"]] = row
+    return out
+
+
+def diff_rows(
+    fresh: dict[str, dict],
+    baseline: dict[str, dict],
+    threshold: float = 0.10,
+) -> tuple[list[str], list[str]]:
+    """Compare fresh rows against baseline rows.
+
+    Returns ``(report_lines, failures)`` — ``failures`` nonempty means
+    the gate should exit nonzero."""
+    lines: list[str] = []
+    failures: list[str] = []
+    for metric in sorted(set(fresh) | set(baseline)):
+        f_row, b_row = fresh.get(metric), baseline.get(metric)
+        if f_row is None:
+            lines.append(f"  {metric}: baseline only (no fresh row)")
+            continue
+        if b_row is None:
+            lines.append(f"  {metric}: NEW (no baseline)")
+            continue
+        for key, label in (("value", "throughput"), ("mfu", "mfu")):
+            f_v, b_v = f_row.get(key), b_row.get(key)
+            if f_v is None or b_v is None or not b_v:
+                continue
+            rel = (float(f_v) - float(b_v)) / float(b_v)
+            tag = f"{label} {b_v:g} -> {f_v:g} ({rel:+.1%})"
+            if rel < -threshold:
+                failures.append(f"{metric}: {tag} exceeds -{threshold:.0%}")
+                lines.append(f"  {metric}: REGRESSED {tag}")
+            else:
+                lines.append(f"  {metric}: ok {tag}")
+    return lines, failures
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(
+        description="fail on >threshold throughput/MFU regression vs "
+        "the committed bench baseline"
+    )
+    p.add_argument("fresh", help="freshly produced bench results JSON")
+    p.add_argument(
+        "--baseline",
+        default=os.path.join(_REPO_ROOT, "BENCH_LOCAL.json"),
+        help="committed baseline (default: repo BENCH_LOCAL.json)",
+    )
+    p.add_argument(
+        "--threshold", type=float, default=0.10,
+        help="relative regression that fails the gate (default 0.10)",
+    )
+    args = p.parse_args(argv)
+
+    lines, failures = diff_rows(
+        load_rows(args.fresh), load_rows(args.baseline), args.threshold
+    )
+    print(f"bench diff vs {args.baseline} (threshold {args.threshold:.0%}):")
+    for line in lines:
+        print(line)
+    if failures:
+        for f in failures:
+            print(f"bench_diff: FAIL {f}", file=sys.stderr)
+        raise SystemExit(1)
+    print("bench_diff: no regressions")
+
+
+if __name__ == "__main__":
+    main()

@@ -14,6 +14,8 @@ Commands map 1:1 onto the reference's entry scripts:
   bag-info   — rosbag info equivalent
   trace-dump — Chrome-trace JSON of recent requests from a serving
                process's telemetry port (serve --metrics-port)
+  trace-join — merge client/router/replica trace dumps onto one
+               timeline (per-source pid rows + clock offsets)
   lint       — tpulint AST hazard analysis (recompilation / donation /
                host-sync / lock / telemetry rules; docs/LINTING.md)
   route      — probe a replica set (health/readiness/labels per
@@ -37,6 +39,7 @@ COMMANDS = (
     "bag-info",
     "repo-index",
     "trace-dump",
+    "trace-join",
     "lint",
     "route",
 )
@@ -72,6 +75,8 @@ def main() -> None:
         from triton_client_tpu.cli.tools import repo_index as run
     elif cmd == "trace-dump":
         from triton_client_tpu.cli.tools import trace_dump as run
+    elif cmd == "trace-join":
+        from triton_client_tpu.cli.tools import trace_join as run
     elif cmd == "lint":
         from triton_client_tpu.cli.tools import lint as run
     elif cmd == "route":

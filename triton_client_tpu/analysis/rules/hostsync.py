@@ -111,6 +111,17 @@ HOT_PATH_ROOTS = (
     "ReplicaSet.record_failure",
     "RetryBudget.deposit",
     "RetryBudget.try_spend",
+    # ISSUE 11 fleet tracing + device-time attribution: context
+    # encode/decode run per traced request on the RPC thread, the
+    # ledger accumulate runs inside the launch-resolve closure right
+    # after the deliberate device fence, and the router's routing core
+    # (attempt spans, summary grafting) runs on the caller's thread —
+    # a host sync in any of them taxes EVERY traced request
+    "TraceContext.encode",
+    "TraceContext.decode",
+    "DeviceTimeLedger.record",
+    "FrontDoorRouter._route",
+    "FrontDoorRouter._attempt_span",
 )
 
 # module-level call targets that force a host sync

@@ -62,6 +62,12 @@ class InferResponse:
     request_id: str = ""
     # device-side compute seconds, for the observability stack
     latency_s: float = 0.0
+    # response-level kserve parameters decoded off the wire (e.g. the
+    # server's compact span summary under obs.trace.SUMMARY_PARAM_KEY).
+    # None on in-process channels and un-traced responses.
+    parameters: dict | None = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
 
 
 class InferFuture:

@@ -36,8 +36,36 @@ from triton_client_tpu.channel.base import (
 )
 from triton_client_tpu.channel.kserve import codec, pb, service
 from triton_client_tpu.config import FRAMING_BYTES, ModelSpec, TensorSpec
+from triton_client_tpu.obs.trace import SUMMARY_PARAM_KEY, TraceContext
 
 log = logging.getLogger(__name__)
+
+
+def _wire_params(request: InferRequest) -> dict | None:
+    """Request-level kserve parameters for one outbound ModelInfer:
+    the W3C-style trace context (when the request's trace carries one)
+    and the scheduling priority. None on the common untraced path so
+    the codec skips the parameters map entirely."""
+    params = None
+    tr = request.trace
+    ctx = getattr(tr, "context", None) if tr is not None else None
+    if ctx is not None:
+        params = {TraceContext.PARAM_KEY: ctx.encode()}
+    if request.priority:
+        if params is None:
+            params = {}
+        params["priority"] = int(request.priority)
+    return params
+
+
+def _response_params(resp) -> dict | None:
+    """Response-level parameters decoded off the wire — today just the
+    server's compact span summary, which the router (or any tracing
+    client) grafts onto its own timeline."""
+    raw = codec.get_string_param(resp, SUMMARY_PARAM_KEY)
+    if raw is None:
+        return None
+    return {SUMMARY_PARAM_KEY: raw}
 
 _RETRYABLE = (
     grpc.StatusCode.UNAVAILABLE,
@@ -193,6 +221,7 @@ class GRPCChannel(BaseChannel):
             inputs=request.inputs,
             model_version=request.model_version,
             request_id=request.request_id,
+            parameters=_wire_params(request),
         )
         t0 = time.perf_counter()
         try:
@@ -211,6 +240,7 @@ class GRPCChannel(BaseChannel):
             outputs=codec.parse_infer_response(resp),
             request_id=resp.id,
             latency_s=time.perf_counter() - t0,
+            parameters=_response_params(resp),
         )
 
     # -- shared-memory transport ----------------------------------------------
@@ -292,6 +322,7 @@ class GRPCChannel(BaseChannel):
                 shm_inputs=shm_inputs,
                 model_version=request.model_version,
                 request_id=request.request_id,
+                parameters=_wire_params(request),
             )
             t0 = time.perf_counter()
             try:
@@ -359,6 +390,7 @@ class GRPCChannel(BaseChannel):
                 outputs=codec.parse_infer_response(resp),
                 request_id=resp.id,
                 latency_s=time.perf_counter() - t0,
+                parameters=_response_params(resp),
             )
 
     def do_inference_async(self, request: InferRequest) -> InferFuture:
@@ -382,6 +414,7 @@ class GRPCChannel(BaseChannel):
                 inputs=request.inputs,
                 model_version=request.model_version,
                 request_id=request.request_id,
+                parameters=_wire_params(request),
             )
             t0 = time.perf_counter()
             timeout = self._timeout_s
@@ -428,6 +461,7 @@ class GRPCChannel(BaseChannel):
                 outputs=codec.parse_infer_response(resp),
                 request_id=resp.id,
                 latency_s=time.perf_counter() - t0,
+                parameters=_response_params(resp),
             )
 
         return InferFuture(
@@ -510,6 +544,7 @@ class GRPCChannel(BaseChannel):
                     inputs=r.inputs,
                     model_version=r.model_version,
                     request_id=r.request_id,
+                    parameters=_wire_params(r),
                 )
 
         for resp in self._stub.ModelStreamInfer(
@@ -523,6 +558,7 @@ class GRPCChannel(BaseChannel):
                 model_version=inner.model_version,
                 outputs=codec.parse_infer_response(inner),
                 request_id=inner.id,
+                parameters=_response_params(inner),
             )
 
     def close(self) -> None:

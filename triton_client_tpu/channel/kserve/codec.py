@@ -76,15 +76,41 @@ def deserialize_tensor(raw: bytes, datatype: str, shape) -> np.ndarray:
     return arr.reshape(tuple(int(d) for d in shape))
 
 
+def set_request_params(msg, params: dict | None) -> None:
+    """Write request/response-level ``parameters`` (str -> str/int/bool)
+    onto a ModelInfer message: the side-channel trace context
+    (``traceparent``), priorities, and span summaries travel here."""
+    if not params:
+        return
+    for key, value in params.items():
+        if isinstance(value, bool):
+            msg.parameters[key].bool_param = value
+        elif isinstance(value, int):
+            msg.parameters[key].int64_param = value
+        else:
+            msg.parameters[key].string_param = str(value)
+
+
+def get_string_param(msg, key: str) -> str | None:
+    """Presence-checked read of a string parameter (bracket access on
+    a protobuf map INSERTS a default entry — never subscript blind)."""
+    p = msg.parameters
+    if key not in p:
+        return None
+    return p[key].string_param or None
+
+
 def build_infer_request(
     model_name: str,
     inputs: dict[str, np.ndarray],
     model_version: str = "",
     request_id: str = "",
+    parameters: dict | None = None,
 ) -> pb.ModelInferRequest:
     req = pb.ModelInferRequest(
         model_name=model_name, model_version=model_version, id=request_id
     )
+    set_request_params(req, parameters)
     # Sorted for a deterministic input<->raw_input_contents pairing
     # (the wire pairs them by position).
     for name in sorted(inputs):
@@ -100,6 +126,7 @@ def build_infer_request_shm(
     shm_inputs: dict[str, tuple[str, int, int]],
     model_version: str = "",
     request_id: str = "",
+    parameters: dict | None = None,
 ) -> pb.ModelInferRequest:
     """Like build_infer_request, but inputs named in ``shm_inputs``
     (name -> (region, offset, byte_size)) travel as metadata + shared-
@@ -108,6 +135,7 @@ def build_infer_request_shm(
     req = pb.ModelInferRequest(
         model_name=model_name, model_version=model_version, id=request_id
     )
+    set_request_params(req, parameters)
     for name in sorted(inputs):
         arr = np.asarray(inputs[name])
         t = req.inputs.add(
@@ -199,6 +227,7 @@ def build_infer_response(
     request_id: str = "",
     shm_outputs: dict[str, tuple[str, int, int]] | None = None,
     shm=None,
+    parameters: dict | None = None,
 ) -> pb.ModelInferResponse:
     """``shm_outputs`` maps output name -> (region, offset, byte_size):
     those tensors are written into the registry's region and travel as
@@ -207,6 +236,7 @@ def build_infer_response(
     resp = pb.ModelInferResponse(
         model_name=model_name, model_version=model_version, id=request_id
     )
+    set_request_params(resp, parameters)
     for name in sorted(outputs):
         arr = np.asarray(outputs[name])
         t = resp.outputs.add(

@@ -223,6 +223,10 @@ class StagedChannel(BaseChannel):
         # attached, stage() blocks until the model is WARM and holds an
         # in-flight reference through resolve
         self._lifecycle = None
+        # optional DeviceTimeLedger (obs/device_time.py): when attached,
+        # every launch's device-execute window accrues into per-
+        # model×tenant device-seconds + live MFU
+        self._device_time = None
         # unregister must drop the cached launcher too — the cached
         # closure pins replicated params in HBM and would otherwise
         # leak until a same-named model happens to fail the identity
@@ -609,16 +613,26 @@ class StagedChannel(BaseChannel):
                 self._stats["deadline_expired_launches"] += 1
             self._slot_occupancy[len(self._inflight)] += 1
 
+        ledger = self._device_time
+
         def resolve() -> InferResponse:
             try:
-                if tr is not None:
+                if tr is not None or ledger is not None:
                     # device window: enqueue -> execution complete.
                     # block_until_ready is what np.asarray would wait on
                     # anyway; forcing it here splits execute from the
-                    # device->host copy in the request timeline.
+                    # device->host copy in the request timeline. The
+                    # ledger accrues the SAME window the trace spans, so
+                    # its totals reconcile with the device_execute
+                    # histogram by construction.
                     jax.block_until_ready(outputs)
                     t_ready = time.perf_counter()
-                    tr.add("device_execute", t_launched, t_ready)
+                    if tr is not None:
+                        tr.add("device_execute", t_launched, t_ready)
+                    if ledger is not None:
+                        ledger.record(
+                            name, t_ready - t_launched, model.spec.extra
+                        )
                 faults.probe("readback", name)
                 host = self._host_outputs(outputs, out_dtype, staged.meta)
                 if tr is not None:
@@ -676,6 +690,18 @@ class StagedChannel(BaseChannel):
     @property
     def lifecycle(self):
         return self._lifecycle
+
+    # -- device-time attribution (obs/device_time.py) -------------------------
+
+    def attach_device_time(self, ledger) -> None:
+        """Attach a DeviceTimeLedger: every subsequent launch records
+        its device-execute window (t_launched -> block_until_ready)
+        into the ledger from the resolve path."""
+        self._device_time = ledger
+
+    @property
+    def device_time(self):
+        return self._device_time
 
     def _warm_model(self, name: str, version: str) -> None:
         """Lifecycle page-in hook: build + cache the jitted launcher (the

@@ -249,7 +249,7 @@ def make_profiler(args):
     """--profile -> StageProfiler (None when off)."""
     if not getattr(args, "profile", False):
         return None
-    from triton_client_tpu.utils.profiling import StageProfiler
+    from triton_client_tpu.obs.profiling import StageProfiler
 
     return StageProfiler()
 
@@ -261,7 +261,7 @@ def maybe_device_trace(args):
     log_dir = getattr(args, "profile_trace", "")
     if not log_dir:
         return contextlib.nullcontext()
-    from triton_client_tpu.utils.profiling import device_trace
+    from triton_client_tpu.obs.profiling import device_trace
 
     return device_trace(log_dir)
 

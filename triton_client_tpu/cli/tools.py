@@ -11,6 +11,13 @@
   trace-dump  — pull the request-trace ring buffer off a serving
                 process's telemetry port as Chrome-trace JSON
                 (open in Perfetto / chrome://tracing).
+  trace-join  — merge several Chrome-trace exports (client / router /
+                replica trace-dump outputs) onto ONE timeline: each
+                source becomes its own pid row, shifted by an explicit
+                per-source clock offset or one estimated from a probe
+                round-trip against the source's live telemetry port
+                (the same NTP-midpoint split obs.trace.graft_span_summary
+                applies per response).
   lint        — tpulint: AST hazard analysis of the serving stack
                 (recompilation/donation/host-sync/lock/telemetry rules;
                 docs/LINTING.md). The CI gate runs this before pytest.
@@ -144,6 +151,126 @@ def trace_dump(argv=None) -> None:
         print(
             f"wrote {n_req} request traces ({len(events)} events) -> "
             f"{args.output}", file=sys.stderr,
+        )
+
+
+def trace_join(argv=None) -> None:
+    """Merge per-process Chrome-trace exports onto one fleet timeline.
+
+    Each process's chrome_trace export rebases its own earliest trace
+    to t=0 on its own perf_counter clock, so client, router and replica
+    dumps of the SAME request land at unrelated timestamps. This joins
+    them: every input file becomes a distinct pid (Perfetto renders one
+    process track per source), with its events shifted by a per-source
+    clock offset — explicit (``--offset``), or estimated as half the
+    best-of-N probe round-trip against the source's live telemetry
+    port (``--probe``), the single-round-trip midpoint estimate NTP
+    uses and graft_span_summary applies per response."""
+    p = argparse.ArgumentParser(
+        description="join client/router/replica Chrome-trace dumps "
+        "onto one timeline"
+    )
+    p.add_argument(
+        "inputs", nargs="+", metavar="[NAME=]FILE",
+        help="Chrome-trace JSON files (trace-dump output); NAME labels "
+        "the source's process track (default: file basename)",
+    )
+    p.add_argument(
+        "--offset", action="append", default=[], metavar="NAME=US",
+        help="shift NAME's events by this many microseconds "
+        "(repeatable; positive = later on the joined timeline)",
+    )
+    p.add_argument(
+        "--probe", action="append", default=[], metavar="NAME=URL",
+        help="estimate NAME's offset as half the best-of-3 HTTP probe "
+        "round-trip against its telemetry URL (repeatable)",
+    )
+    p.add_argument(
+        "-o", "--output", default="-",
+        help="output file ('-' = stdout); load in Perfetto",
+    )
+    p.add_argument("--timeout", type=float, default=5.0)
+    args = p.parse_args(argv)
+
+    import json
+    import sys
+    import time as _time
+    import urllib.request
+
+    def parse_kv(items, what):
+        out = {}
+        for item in items:
+            name, sep, value = item.partition("=")
+            if not sep:
+                raise SystemExit(f"--{what} wants NAME=VALUE, got {item!r}")
+            out[name] = value
+        return out
+
+    offsets = {
+        name: float(us) for name, us in parse_kv(args.offset, "offset").items()
+    }
+    for name, url in parse_kv(args.probe, "probe").items():
+        best = None
+        for _ in range(3):
+            t0 = _time.perf_counter()
+            try:
+                with urllib.request.urlopen(url, timeout=args.timeout):
+                    pass
+            except Exception as e:
+                raise SystemExit(f"probe against {url} failed: {e}")
+            rtt = _time.perf_counter() - t0
+            best = rtt if best is None else min(best, rtt)
+        offsets[name] = offsets.get(name, 0.0) + best / 2.0 * 1e6
+        print(
+            f"probe {name}: rtt {best * 1e3:.3f} ms -> offset "
+            f"{best / 2.0 * 1e3:.3f} ms", file=sys.stderr,
+        )
+
+    events: list[dict] = []
+    for i, item in enumerate(args.inputs):
+        name, sep, path = item.partition("=")
+        if not sep:
+            name, path = "", item
+        if not name:
+            name = os.path.splitext(os.path.basename(path))[0]
+        with open(path) as f:
+            doc = json.load(f)
+        src = doc.get("traceEvents")
+        if src is None:
+            raise SystemExit(f"{path}: no traceEvents (not a trace dump?)")
+        pid = i + 1
+        shift = offsets.get(name, 0.0)
+        events.append(
+            {
+                "ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+                "args": {"name": name},
+            }
+        )
+        n = 0
+        for ev in src:
+            if ev.get("ph") == "M" and ev.get("name") == "process_name":
+                continue  # replaced by the source-labelled one above
+            ev = dict(ev)
+            ev["pid"] = pid
+            if "ts" in ev:
+                ev["ts"] = round(ev["ts"] + shift, 3)
+            events.append(ev)
+            n += 1
+        print(
+            f"{name}: {n} events, offset {shift / 1e3:+.3f} ms",
+            file=sys.stderr,
+        )
+
+    events.sort(key=lambda e: (e.get("ts", -1.0), e.get("pid", 0)))
+    body = json.dumps({"traceEvents": events, "displayTimeUnit": "ms"})
+    if args.output == "-":
+        print(body)
+    else:
+        with open(args.output, "w") as f:
+            f.write(body)
+        print(
+            f"wrote {len(events)} joined events -> {args.output}",
+            file=sys.stderr,
         )
 
 

@@ -120,6 +120,15 @@ METRIC_TYPES: dict[str, str] = {
     "tpu_serving_promotion_seconds": "histogram",
     "tpu_serving_tenant_shed_total": "counter",
     "tpu_serving_tenant_served_frames_total": "counter",
+    # device-time attribution plane (ISSUE 11): cumulative device-
+    # execute seconds per model×tenant (the standing account the trace
+    # plane's device_execute spans only showed per request), the
+    # rolling-window busy ratio over elapsed wall × devices, and live
+    # per-model MFU against the precision policy's analytic peak — the
+    # same per-chip accounting the bench records, now on the scrape
+    "tpu_serving_device_seconds_total": "counter",
+    "tpu_serving_device_utilization_ratio": "gauge",
+    "tpu_serving_mfu": "gauge",
 }
 
 _HBM_KINDS = ("bytes_in_use", "bytes_limit", "peak_bytes_in_use")
@@ -208,14 +217,16 @@ class RuntimeCollector:
         slo=None,
         admission=None,
         lifecycle=None,
+        device_time=None,
     ) -> None:
         """``histograms``: an obs.histogram.HistogramFamily of per
         (model, stage) latency histograms; ``slo``: an obs.slo.
         SLOTracker; ``admission``: a runtime.admission.
         AdmissionController; ``lifecycle``: a runtime.lifecycle.
-        ModelLifecycleManager. All optional — their metric families
-        export empty (HELP/TYPE only) when absent, so the family
-        inventory test keeps pinning the series names either way."""
+        ModelLifecycleManager; ``device_time``: an obs.device_time.
+        DeviceTimeLedger. All optional — their metric families export
+        empty (HELP/TYPE only) when absent, so the family inventory
+        test keeps pinning the series names either way."""
         self._batching, self._tpu = _split_channel(channel)
         self._tracer = tracer
         self._repository = repository
@@ -223,6 +234,7 @@ class RuntimeCollector:
         self._slo = slo
         self._admission = admission
         self._lifecycle = lifecycle
+        self._device_time = device_time
         self._ns = namespace
         self._compile = CompileEvents.install()
         self._lock = threading.Lock()
@@ -296,6 +308,8 @@ class RuntimeCollector:
             snap["lifecycle"] = self._lifecycle.stats()
         if self._tracer is not None:
             snap["tracer"] = self._tracer.stats()
+        if self._device_time is not None:
+            snap["device_time"] = self._device_time.snapshot()
         if self._histograms is not None:
             # numeric-leaved per-(model|stage) bucket counts + sum:
             # delta() of two snapshots is the WINDOW's histogram, and
@@ -836,6 +850,37 @@ class RuntimeCollector:
             samples=[
                 ([t], n)
                 for t, n in (bat.get("tenant_served_frames") or {}).items()
+            ],
+        )
+
+        # device-time attribution plane: cumulative device-seconds per
+        # model×tenant, rolling-window utilization, live per-model MFU
+        dt = snap.get("device_time") or {}
+        dt_window = dt.get("window") or {}
+        yield counter(
+            f"{ns}_device_seconds_total",
+            "cumulative device-execute seconds per model and tenant",
+            0,
+            labels=["model", "tenant"],
+            samples=[
+                (key.split("|", 1), v)
+                for key, v in (dt.get("device_seconds") or {}).items()
+            ],
+        )
+        yield gauge(
+            f"{ns}_device_utilization_ratio",
+            "rolling-window busy device-seconds over elapsed wall x "
+            "devices (the live device-time ceiling of ROADMAP item 1)",
+            dt_window.get("utilization", 0.0),
+        )
+        yield gauge(
+            f"{ns}_mfu",
+            "live model flops utilization over the rolling window, per "
+            "model (analytic flops against the precision policy peak)",
+            0,
+            labels=["model"],
+            samples=[
+                ([m], v) for m, v in (dt_window.get("mfu") or {}).items()
             ],
         )
 

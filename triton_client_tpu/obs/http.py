@@ -12,21 +12,32 @@ traces and the raw collector snapshot:
                  instead: only exemplars that missed their deadline or
                  landed at/above the live per-model p99)
   GET /snapshot  RuntimeCollector.snapshot() as JSON (debug/automation)
+  GET /profile   on-demand jax.profiler capture (?seconds=N, default 1,
+                 capped at 60): blocks for the window, writes the XLA +
+                 device timeline into a server-local directory, and
+                 returns its path as JSON. One capture at a time — a
+                 concurrent request gets 409 (jax.profiler is a
+                 process-global singleton; overlapping captures abort).
 
 Paths degrade independently: without prometheus_client /metrics is 503
 but traces still export; without a tracer /traces is 404 (and without
-an SLO tracker, ?slo_violations=1 is 404).
+an SLO tracker, ?slo_violations=1 is 404); without jax /profile is 503.
 """
 
 from __future__ import annotations
 
 import json
 import logging
+import tempfile
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 log = logging.getLogger(__name__)
+
+#: hard ceiling for one /profile capture window
+_PROFILE_MAX_S = 60.0
 
 
 class TelemetryServer:
@@ -46,6 +57,10 @@ class TelemetryServer:
         self._tracer = tracer
         self._collector = collector
         self._slo = slo
+        # /profile concurrency guard: jax.profiler keeps ONE process-
+        # global capture; a second start_trace raises mid-capture and
+        # would kill the first requester's window too
+        self._profile_lock = threading.Lock()
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -114,12 +129,52 @@ class TelemetryServer:
                 return
             body = json.dumps(self._collector.snapshot(), default=str).encode()
             self._send(req, 200, body, "application/json")
+        elif path == "/profile":
+            self._profile(req, parsed)
         elif path == "/":
             self._send(
-                req, 200, b"tpu_serving telemetry: /metrics /traces /snapshot\n"
+                req, 200,
+                b"tpu_serving telemetry: /metrics /traces /snapshot "
+                b"/profile\n",
             )
         else:
             self._send(req, 404, b"not found\n")
+
+    def _profile(self, req, parsed) -> None:
+        """Blocking jax.profiler capture window; refuses overlap."""
+        q = parse_qs(parsed.query)
+        try:
+            seconds = float(q.get("seconds", ["1"])[0])
+        except ValueError:
+            self._send(req, 400, b"seconds must be a number\n")
+            return
+        seconds = min(max(seconds, 0.05), _PROFILE_MAX_S)
+        try:
+            import jax
+        except ImportError:
+            self._send(req, 503, b"jax unavailable; /profile disabled\n")
+            return
+        if not self._profile_lock.acquire(blocking=False):
+            self._send(
+                req, 409, b"a profile capture is already in progress\n"
+            )
+            return
+        try:
+            log_dir = tempfile.mkdtemp(prefix="tpu_serving_profile_")
+            jax.profiler.start_trace(log_dir)
+            try:
+                time.sleep(seconds)
+            finally:
+                jax.profiler.stop_trace()
+            body = json.dumps(
+                {"log_dir": log_dir, "seconds": seconds}
+            ).encode()
+            self._send(req, 200, body, "application/json")
+        except Exception as e:
+            log.exception("profile capture failed")
+            self._send(req, 500, f"profile capture failed: {e}\n".encode())
+        finally:
+            self._profile_lock.release()
 
     @staticmethod
     def _send(req, code: int, body: bytes, ctype: str = "text/plain") -> None:

@@ -1,0 +1,56 @@
+"""Structured log correlation: trace/request tags on log lines.
+
+A request that crosses client -> router -> replica leaves log lines in
+three processes. Grepping a tail exemplar out of the SLO ring is only
+possible when those lines share a key — the distributed ``trace_id``
+the traceparent context carries (obs/trace.py), falling back to the
+process-local ring id for purely local traces. This module is the one
+place that formats the correlation tag, so server, router, and batcher
+lines agree on its shape:
+
+    dispatch failed ... [trace=4f2a... req=frame-17]
+
+``log_tag`` is pure string work (no locks, no syncs) and returns ""
+when there is nothing to correlate, so call sites can append it
+unconditionally.
+"""
+
+from __future__ import annotations
+
+import logging
+
+
+def log_tag(trace=None, request_id: str = "") -> str:
+    """Correlation suffix ``" [trace=... req=...]"`` for a log line.
+
+    ``trace`` is a RequestTrace (or None). A distributed context wins
+    (its hex trace_id greps across processes); a purely local trace
+    falls back to ``local:<ring id>``. Empty string when neither a
+    trace nor a request id is at hand."""
+    parts = []
+    rid = request_id
+    if trace is not None:
+        ctx = getattr(trace, "context", None)
+        if ctx is not None:
+            parts.append(f"trace={ctx.trace_id}")
+        else:
+            tid = getattr(trace, "trace_id", None)
+            if tid is not None:
+                parts.append(f"trace=local:{tid}")
+        rid = rid or getattr(trace, "request_id", "")
+    if rid:
+        parts.append(f"req={rid}")
+    return (" [" + " ".join(parts) + "]") if parts else ""
+
+
+class TraceLogAdapter(logging.LoggerAdapter):
+    """LoggerAdapter that appends one request's correlation tag to
+    every message — for code paths that emit several lines for the
+    same request and don't want to thread the tag by hand."""
+
+    def __init__(self, logger, trace=None, request_id: str = "") -> None:
+        super().__init__(logger, {})
+        self._tag = log_tag(trace, request_id)
+
+    def process(self, msg, kwargs):
+        return f"{msg}{self._tag}", kwargs

@@ -29,18 +29,84 @@ import itertools
 import json
 import threading
 import time
+import uuid
 from typing import Iterator
 
 
+class TraceContext:
+    """W3C-traceparent-style distributed context.
+
+    ``trace_id`` (32 hex chars) names the end-to-end request across
+    processes; ``parent_span_id`` (16 hex chars) names the hop that
+    issued this RPC (the router attempt, or the originating client);
+    ``sampled`` rides the flags byte. The wire form is the traceparent
+    string ``00-<trace_id>-<parent_span_id>-<flags>`` carried in the
+    kserve request ``parameters`` map — the same map the server already
+    reads ``priority`` from, so propagation adds no new proto surface.
+
+    Encode/decode are pure host-side string work (they sit on the
+    serving hot path and are rooted in tpulint's HOT_PATH_ROOTS — no
+    host syncs may creep in here).
+    """
+
+    __slots__ = ("trace_id", "parent_span_id", "sampled")
+
+    #: kserve parameters key the context travels under
+    PARAM_KEY = "traceparent"
+    _VERSION = "00"
+
+    def __init__(
+        self, trace_id: str, parent_span_id: str, sampled: bool = True
+    ) -> None:
+        self.trace_id = trace_id
+        self.parent_span_id = parent_span_id
+        self.sampled = bool(sampled)
+
+    @classmethod
+    def new(cls, sampled: bool = True) -> "TraceContext":
+        """Originate a fresh context (the router's front-door role)."""
+        return cls(uuid.uuid4().hex, uuid.uuid4().hex[:16], sampled)
+
+    def child(self) -> "TraceContext":
+        """Same trace, fresh parent span id — one per hedge/retry
+        attempt, so sibling attempts are distinguishable server-side."""
+        return TraceContext(self.trace_id, uuid.uuid4().hex[:16], self.sampled)
+
+    def encode(self) -> str:
+        flags = "01" if self.sampled else "00"
+        return f"{self._VERSION}-{self.trace_id}-{self.parent_span_id}-{flags}"
+
+    @classmethod
+    def decode(cls, value: str) -> "TraceContext | None":
+        """Tolerant parse: anything malformed returns None (a foreign
+        or corrupt header must never fail the request it rides on)."""
+        if not value or not isinstance(value, str):
+            return None
+        parts = value.split("-")
+        if len(parts) != 4 or not parts[1] or not parts[2]:
+            return None
+        return cls(parts[1], parts[2], sampled=parts[3] != "00")
+
+    def __repr__(self) -> str:
+        return f"TraceContext({self.encode()!r})"
+
+
 class Span:
-    """One named wall-clock interval on the perf_counter clock."""
+    """One named wall-clock interval on the perf_counter clock.
 
-    __slots__ = ("name", "t0", "t1")
+    ``attrs`` (optional dict) carries structured tags — the router
+    stamps attempt number / endpoint / cancelled on its per-attempt
+    spans and the Chrome export surfaces them as event ``args``."""
 
-    def __init__(self, name: str, t0: float, t1: float) -> None:
+    __slots__ = ("name", "t0", "t1", "attrs")
+
+    def __init__(
+        self, name: str, t0: float, t1: float, attrs: dict | None = None
+    ) -> None:
         self.name = name
         self.t0 = t0
         self.t1 = t1
+        self.attrs = attrs
 
     @property
     def duration_s(self) -> float:
@@ -68,11 +134,18 @@ class RequestTrace:
         "t_end",
         "status",
         "spans",
+        "context",
         "_open",
         "_lock",
     )
 
-    def __init__(self, trace_id: int, model: str = "", request_id: str = "") -> None:
+    def __init__(
+        self,
+        trace_id: int,
+        model: str = "",
+        request_id: str = "",
+        context: TraceContext | None = None,
+    ) -> None:
         self.trace_id = trace_id
         self.model = model
         self.request_id = request_id
@@ -80,14 +153,21 @@ class RequestTrace:
         self.t_end: float | None = None
         self.status = "ok"
         self.spans: list[Span] = []
+        # distributed context (TraceContext): None on purely local
+        # traces; set when the server adopts an inbound traceparent or
+        # the router originates one. The local int trace_id still keys
+        # the ring buffer — the context's hex trace_id keys the FLEET.
+        self.context = context
         self._open: dict[str, float] = {}
         self._lock = threading.Lock()
 
     # -- recording ------------------------------------------------------------
 
-    def add(self, name: str, t0: float, t1: float) -> None:
+    def add(
+        self, name: str, t0: float, t1: float, attrs: dict | None = None
+    ) -> None:
         with self._lock:
-            self.spans.append(Span(name, t0, t1))
+            self.spans.append(Span(name, t0, t1, attrs))
 
     @contextlib.contextmanager
     def span(self, name: str) -> Iterator[None]:
@@ -141,10 +221,11 @@ class RequestTrace:
                     "name": s.name,
                     "t0_s": s.t0 - self.t_start,
                     "dur_ms": s.duration_s * 1e3,
+                    **({"attrs": s.attrs} if s.attrs else {}),
                 }
                 for s in sorted(self.spans, key=lambda s: s.t0)
             ]
-        return {
+        out = {
             "trace_id": self.trace_id,
             "model": self.model,
             "request_id": self.request_id,
@@ -152,6 +233,9 @@ class RequestTrace:
             "wall_ms": self.wall_s() * 1e3,
             "spans": spans,
         }
+        if self.context is not None:
+            out["context"] = self.context.encode()
+        return out
 
 
 class MultiTrace:
@@ -226,10 +310,21 @@ class Tracer:
         self._ids = itertools.count(1)
         self._finished = 0
 
-    def start(self, model: str = "", request_id: str = "") -> RequestTrace | None:
+    def start(
+        self,
+        model: str = "",
+        request_id: str = "",
+        context: TraceContext | None = None,
+    ) -> RequestTrace | None:
+        """``context``: inbound distributed context to adopt (the
+        server's _issue passes the decoded traceparent; the router
+        passes the context it originated)."""
         if not self.enabled:
             return None
-        return RequestTrace(next(self._ids), model=model, request_id=request_id)
+        return RequestTrace(
+            next(self._ids), model=model, request_id=request_id,
+            context=context,
+        )
 
     def finish(self, trace: RequestTrace | None, status: str = "ok") -> None:
         if trace is None:
@@ -311,6 +406,14 @@ def chrome_trace(traces) -> dict:
             }
         )
         t_end = tr.t_end if tr.t_end is not None else time.perf_counter()
+        req_args = {
+            "model": tr.model,
+            "request_id": tr.request_id,
+            "status": tr.status,
+        }
+        ctx = getattr(tr, "context", None)
+        if ctx is not None:
+            req_args["traceparent"] = ctx.encode()
         events.append(
             {
                 "ph": "X",
@@ -320,25 +423,22 @@ def chrome_trace(traces) -> dict:
                 "tid": tid,
                 "ts": us(tr.t_start),
                 "dur": max(0.0, (t_end - tr.t_start) * 1e6),
-                "args": {
-                    "model": tr.model,
-                    "request_id": tr.request_id,
-                    "status": tr.status,
-                },
+                "args": req_args,
             }
         )
         for s in sorted(tr.spans, key=lambda s: s.t0):
-            events.append(
-                {
-                    "ph": "X",
-                    "name": s.name,
-                    "cat": "span",
-                    "pid": 1,
-                    "tid": tid,
-                    "ts": us(s.t0),
-                    "dur": max(0.0, s.duration_s * 1e6),
-                }
-            )
+            ev = {
+                "ph": "X",
+                "name": s.name,
+                "cat": "span",
+                "pid": 1,
+                "tid": tid,
+                "ts": us(s.t0),
+                "dur": max(0.0, s.duration_s * 1e6),
+            }
+            if s.attrs:
+                ev["args"] = dict(s.attrs)
+            events.append(ev)
     events.sort(key=lambda e: (e.get("ts", -1.0), e["tid"]))
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
@@ -346,3 +446,83 @@ def chrome_trace(traces) -> dict:
 def dump_chrome_trace(traces, path: str) -> None:
     with open(path, "w") as f:
         json.dump(chrome_trace(traces), f)
+
+
+# -- cross-process span summaries ---------------------------------------------
+
+#: kserve response parameters key the server's span summary rides under
+SUMMARY_PARAM_KEY = "trace_summary"
+
+
+def encode_span_summary(trace: RequestTrace) -> str:
+    """Compact server-side summary for the response ``parameters`` map.
+
+    Times are microseconds RELATIVE to the trace's own t_start (each
+    process has its own perf_counter epoch — absolute values would be
+    meaningless on the far side): ``{"w": wall_us, "st": status,
+    "s": [[name, t0_rel_us, dur_us], ...]}``. Kept deliberately terse:
+    this string rides every traced response."""
+    t_start = trace.t_start
+    with trace._lock:
+        spans = [
+            [s.name, round((s.t0 - t_start) * 1e6), round(s.duration_s * 1e6)]
+            for s in sorted(trace.spans, key=lambda s: s.t0)
+        ]
+    doc = {
+        "w": round(trace.wall_s() * 1e6),
+        "st": trace.status,
+        "s": spans,
+    }
+    if trace.context is not None:
+        doc["ctx"] = trace.context.encode()
+    return json.dumps(doc, separators=(",", ":"))
+
+
+def decode_span_summary(value: str) -> dict | None:
+    """Tolerant inverse of encode_span_summary (None on garbage)."""
+    if not value:
+        return None
+    try:
+        doc = json.loads(value)
+    except (ValueError, TypeError):
+        return None
+    if not isinstance(doc, dict) or "s" not in doc or "w" not in doc:
+        return None
+    return doc
+
+
+def graft_span_summary(
+    trace: RequestTrace,
+    summary: dict,
+    t_sent: float,
+    t_recv: float,
+    prefix: str = "srv.",
+    attrs: dict | None = None,
+) -> None:
+    """Place a far-side span summary onto the LOCAL clock.
+
+    The caller observed the RPC as [t_sent, t_recv] on its own
+    perf_counter clock; the summary says the server spent ``w``
+    microseconds of wall inside that window. The residue is wire +
+    router transit — split symmetrically (the same midpoint estimate
+    NTP uses for a single round trip), which also yields the clock
+    offset the trace-join CLI applies. Server spans land prefixed
+    (default ``srv.``) so local and remote phases stay distinguishable
+    in one timeline; the wire residue lands as ``wire_send`` /
+    ``wire_recv`` spans so the RTT of ROADMAP item 1 is a NAMED span."""
+    rtt = max(0.0, t_recv - t_sent)
+    server_wall = max(0.0, summary.get("w", 0) / 1e6)
+    residue = max(0.0, rtt - server_wall)
+    t_server_start = t_sent + residue / 2.0
+    if residue > 0:
+        trace.add("wire_send", t_sent, t_server_start, attrs)
+        trace.add(
+            "wire_recv", t_server_start + server_wall, t_recv, attrs
+        )
+    for row in summary.get("s", ()):
+        try:
+            name, t0_us, dur_us = row[0], float(row[1]), float(row[2])
+        except (IndexError, TypeError, ValueError):
+            continue
+        t0 = t_server_start + t0_us / 1e6
+        trace.add(f"{prefix}{name}", t0, t0 + dur_us / 1e6, attrs)

@@ -598,6 +598,18 @@ class BatchingChannel(BaseChannel):
         futures = [g[2] for g in group]
         traces = [r.trace for r in requests]
         t_dispatch = time.perf_counter()
+        if log.isEnabledFor(logging.DEBUG):
+            # correlated dispatch line: each member's trace/request tag,
+            # so a fleet trace_id greps straight to ITS device batch
+            from triton_client_tpu.obs.logs import log_tag
+
+            log.debug(
+                "dispatching merged batch of %d for model %s:%s",
+                len(requests), requests[0].model_name,
+                "".join(
+                    log_tag(r.trace, r.request_id) for r in requests
+                ) or " [untraced]",
+            )
         for (t_staged, r, _f) in group:
             if r.trace is not None and t_staged is not None:
                 # per-member ready-queue residence: own staging

@@ -46,6 +46,7 @@ become fleet numbers.
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import queue
 import random
@@ -59,6 +60,13 @@ from triton_client_tpu.channel.base import (
     InferResponse,
 )
 from triton_client_tpu.obs.histogram import LatencyHistogram
+from triton_client_tpu.obs.logs import log_tag
+from triton_client_tpu.obs.trace import (
+    SUMMARY_PARAM_KEY,
+    TraceContext,
+    decode_span_summary,
+    graft_span_summary,
+)
 
 log = logging.getLogger(__name__)
 
@@ -370,13 +378,47 @@ class ReplicaSet:
                 pass
 
 
-class _Attempt:
-    __slots__ = ("replica", "future", "kind")
+class _AttemptCarrier:
+    """Minimal trace stand-in for an outbound attempt's InferRequest.
 
-    def __init__(self, replica: Replica, future: InferFuture, kind: str):
+    The transport (grpc_channel._wire_params) reads only ``.context``
+    off ``request.trace`` — handing it the router's own RequestTrace
+    would let channel-side spans land on the router row AND every
+    raced sibling, double-counting device time. Each attempt instead
+    carries a fresh child context (sibling span ids under one
+    trace_id), and only the WINNER's server summary is grafted back."""
+
+    __slots__ = ("context",)
+
+    def __init__(self, context: TraceContext) -> None:
+        self.context = context
+
+
+class _Attempt:
+    __slots__ = ("replica", "future", "kind", "index", "t_sent")
+
+    def __init__(
+        self,
+        replica: Replica,
+        future: InferFuture,
+        kind: str,
+        index: int = 0,
+        t_sent: float = 0.0,
+    ):
         self.replica = replica
         self.future = future
         self.kind = kind  # "primary" | "retry" | "hedge"
+        self.index = index  # attempt ordinal within the request
+        self.t_sent = t_sent
+
+    def attrs(self, **extra) -> dict:
+        out = {
+            "attempt": self.index,
+            "endpoint": self.replica.endpoint,
+            "kind": self.kind,
+        }
+        out.update(extra)
+        return out
 
 
 class FrontDoorRouter:
@@ -395,6 +437,13 @@ class FrontDoorRouter:
       max_attempts — total attempts per request (primary + failover
         retries). Hedges do not count: a hedge is the same attempt
         raced on two replicas.
+      tracer — optional obs.trace.Tracer. When set, the router is the
+        trace ORIGIN: every routed request gets a TraceContext (or
+        forwards an inbound one from request.trace), each attempt
+        carries a child context on the wire, attempts land as sibling
+        spans tagged {attempt, endpoint, kind} (hedge losers get
+        cancelled=True), and the winning replica's span summary is
+        grafted onto the router trace — one end-to-end timeline.
     """
 
     def __init__(
@@ -415,6 +464,7 @@ class FrontDoorRouter:
         retry_budget_ratio: float = 0.2,
         retry_budget_cap: float = 10.0,
         max_attempts: int = 3,
+        tracer=None,
     ) -> None:
         self.replica_set = ReplicaSet(
             endpoints,
@@ -432,6 +482,7 @@ class FrontDoorRouter:
         self._hedge_min_samples = int(hedge_min_samples)
         self._hedge_budget_fraction = float(hedge_budget_fraction)
         self._max_attempts = max(1, int(max_attempts))
+        self._tracer = tracer
         self._latency = LatencyHistogram()
         self._lock = threading.Lock()
         self._budget = RetryBudget(
@@ -505,12 +556,22 @@ class FrontDoorRouter:
         request: InferRequest,
         done: "queue.SimpleQueue",
         kind: str,
+        index: int = 0,
+        ctx: TraceContext | None = None,
     ) -> _Attempt:
         """Issue one attempt on ``rep``. The done-callback releases the
         replica's in-flight slot and posts completion — it runs on the
-        transport's completion thread, so it only queues."""
-        fut = rep.channel.do_inference_async(request)
-        att = _Attempt(rep, fut, kind)
+        transport's completion thread, so it only queues. With a live
+        trace context, the attempt ships a fresh child context so the
+        far side's span summary names THIS attempt as its parent."""
+        out = request
+        if ctx is not None:
+            out = dataclasses.replace(
+                request, trace=_AttemptCarrier(ctx.child())
+            )
+        t_sent = time.perf_counter()
+        fut = rep.channel.do_inference_async(out)
+        att = _Attempt(rep, fut, kind, index, t_sent)
         released = []  # close over a once-flag; gRPC may double-fire
 
         def _on_done() -> None:
@@ -523,6 +584,51 @@ class FrontDoorRouter:
         return att
 
     def do_inference(self, request: InferRequest) -> InferResponse:
+        """Route one request, wrapped in the router-side trace (when a
+        tracer is configured). The router either FORWARDS an inbound
+        distributed context (request.trace.context — this process is a
+        middle hop) or ORIGINATES one (the front-door role)."""
+        trace = None
+        ctx: TraceContext | None = None
+        if self._tracer is not None:
+            inbound = (
+                getattr(request.trace, "context", None)
+                if request.trace is not None else None
+            )
+            ctx = inbound.child() if inbound is not None else TraceContext.new()
+            trace = self._tracer.start(
+                model=request.model_name,
+                request_id=request.request_id,
+                context=ctx,
+            )
+        if trace is None:
+            return self._route(request, None, None)
+        try:
+            resp = self._route(request, trace, ctx)
+        except BaseException as e:
+            self._tracer.finish(
+                trace, status=_status_name(e) or type(e).__name__
+            )
+            raise
+        self._tracer.finish(trace, status="ok")
+        return resp
+
+    @staticmethod
+    def _attempt_span(trace, att: _Attempt, **extra) -> None:
+        """Close ``att``'s sibling span on the router trace (no-op when
+        untraced): one ``attempt`` span per launch, siblings told apart
+        by their {attempt, endpoint, kind} tags."""
+        if trace is not None:
+            trace.add(
+                "attempt", att.t_sent, time.perf_counter(), att.attrs(**extra)
+            )
+
+    def _route(
+        self,
+        request: InferRequest,
+        trace,
+        ctx: TraceContext | None,
+    ) -> InferResponse:
         t0 = time.perf_counter()
         with self._lock:
             self._requests_total += 1
@@ -534,8 +640,9 @@ class FrontDoorRouter:
         rep = self.replica_set.pick()
         if rep is None:
             raise RuntimeError("replica set is empty")
-        outstanding = [self._launch(rep, request, done, "primary")]
+        outstanding = [self._launch(rep, request, done, "primary", 0, ctx)]
         attempts_made = 1
+        attempt_idx = 0  # span ordinal: hedges count, unlike attempts_made
         hedge_spent = False
         last_error: BaseException | None = None
 
@@ -566,6 +673,7 @@ class FrontDoorRouter:
                     # surface the deadline
                     for o in outstanding:
                         o.future.cancel()
+                        self._attempt_span(trace, o, cancelled=True)
                     self._count_error()
                     raise _deadline_error(
                         "router deadline expired with %d attempt(s) in "
@@ -580,8 +688,18 @@ class FrontDoorRouter:
                     if hrep is not None:
                         with self._lock:
                             self._hedges_launched += 1
+                        attempt_idx += 1
+                        if log.isEnabledFor(logging.DEBUG):
+                            log.debug(
+                                "hedging on %s after %.1f ms%s",
+                                hrep.endpoint, hedge_delay * 1e3,
+                                log_tag(trace, request.request_id),
+                            )
                         outstanding.append(
-                            self._launch(hrep, request, done, "hedge")
+                            self._launch(
+                                hrep, request, done, "hedge",
+                                attempt_idx, ctx,
+                            )
                         )
                 continue
 
@@ -591,32 +709,58 @@ class FrontDoorRouter:
                 resp = att.future.result()
             except BaseException as e:
                 last_error = e
+                self._attempt_span(
+                    trace, att, error=_status_name(e) or type(e).__name__
+                )
                 handled_retry = self._on_attempt_failure(att, e)
                 if not handled_retry:
                     # non-retryable (shed / deadline / unknown): losers
                     # in flight can no longer change the outcome
                     for o in outstanding:
                         o.future.cancel()
+                        self._attempt_span(trace, o, cancelled=True)
                     self._count_error()
                     raise
                 if outstanding:
                     # the raced hedge is already the retry
                     continue
-                retry_rep = self._try_retry(att, e, attempts_made, deadline)
+                retry_rep = self._try_retry(
+                    att, e, attempts_made, deadline,
+                    tag=log_tag(trace, request.request_id),
+                )
                 if retry_rep is None:
                     self._count_error()
                     raise
                 attempts_made += 1
+                attempt_idx += 1
                 outstanding.append(
-                    self._launch(retry_rep, request, done, "retry")
+                    self._launch(
+                        retry_rep, request, done, "retry", attempt_idx, ctx
+                    )
                 )
                 continue
 
             # -- winner --
+            t_recv = time.perf_counter()
             self.replica_set.record_success(att.replica)
             hedge_in_flight = any(o.kind == "hedge" for o in outstanding)
             for o in outstanding:
                 o.future.cancel()
+                # hedge losers stay visible: a sibling span tagged
+                # cancelled=True, with NO server summary grafted — the
+                # joined timeline counts device time exactly once
+                self._attempt_span(trace, o, cancelled=True)
+            if trace is not None:
+                self._attempt_span(trace, att)
+                summary = decode_span_summary(
+                    (resp.parameters or {}).get(SUMMARY_PARAM_KEY, "")
+                )
+                if summary is not None:
+                    graft_span_summary(
+                        trace, summary, att.t_sent, t_recv,
+                        attrs=att.attrs(),
+                    )
+                trace.add("route", t0, time.perf_counter())
             with self._lock:
                 if att.kind == "hedge":
                     self._hedges_won += 1
@@ -662,6 +806,7 @@ class FrontDoorRouter:
         exc: BaseException,
         attempts_made: int,
         deadline: float | None,
+        tag: str = "",
     ) -> Replica | None:
         """Gate + pick for a failover retry. Drain failovers skip the
         budget (orchestrated, not a fault); everything else spends a
@@ -676,8 +821,8 @@ class FrontDoorRouter:
                 if not self._budget.try_spend():
                     log.warning(
                         "retry budget at floor (%d denials); surfacing "
-                        "failure from %s",
-                        self._budget.floor_hits, att.replica.endpoint,
+                        "failure from %s%s",
+                        self._budget.floor_hits, att.replica.endpoint, tag,
                     )
                     return None
         rep = self.replica_set.pick(exclude=[att.replica])

@@ -41,6 +41,12 @@ from triton_client_tpu.runtime.admission import (
     ReplicaDownError,
     ServerDrainingError,
 )
+from triton_client_tpu.obs.logs import log_tag
+from triton_client_tpu.obs.trace import (
+    SUMMARY_PARAM_KEY,
+    TraceContext,
+    encode_span_summary,
+)
 from triton_client_tpu.runtime.repository import ModelRepository
 
 log = logging.getLogger(__name__)
@@ -323,13 +329,19 @@ class _Servicer(service.GRPCInferenceServiceServicer):
         the min of its members') to the staged launchers; _account
         scores met/missed on every exit path."""
         t0 = time.perf_counter()
-        trace = (
-            self._tracer.start(
-                model=request.model_name, request_id=request.id
+        trace = None
+        if self._tracer is not None:
+            # adopt the inbound distributed context (router- or client-
+            # originated traceparent in the request parameters) so this
+            # replica's spans join the fleet-wide trace; absent or
+            # malformed context degrades to a purely local trace
+            context = TraceContext.decode(
+                codec.get_string_param(request, TraceContext.PARAM_KEY) or ""
             )
-            if self._tracer is not None
-            else None
-        )
+            trace = self._tracer.start(
+                model=request.model_name, request_id=request.id,
+                context=context,
+            )
         deadline_s, priority = None, 0
         if self._slo is not None:
             deadline_s = self._slo.deadline_for(request.model_name, t0)
@@ -440,15 +452,24 @@ class _Servicer(service.GRPCInferenceServiceServicer):
                     if trace is not None:
                         trace.end("channel")
                 if trace is not None:
-                    with trace.span("encode"):
-                        return codec.build_infer_response(
-                            model_name=result.model_name,
-                            model_version=result.model_version,
-                            outputs=result.outputs,
-                            request_id=result.request_id,
-                            shm_outputs=shm_outputs,
-                            shm=self._shm,
-                        )
+                    t_e0 = time.perf_counter()
+                    resp = codec.build_infer_response(
+                        model_name=result.model_name,
+                        model_version=result.model_version,
+                        outputs=result.outputs,
+                        request_id=result.request_id,
+                        shm_outputs=shm_outputs,
+                        shm=self._shm,
+                    )
+                    trace.add("encode", t_e0, time.perf_counter())
+                    # compact span summary in the response parameters
+                    # (AFTER the encode span lands, so the far side's
+                    # grafted timeline includes it): the router/client
+                    # merges it onto the end-to-end trace
+                    codec.set_request_params(
+                        resp, {SUMMARY_PARAM_KEY: encode_span_summary(trace)}
+                    )
+                    return resp
                 return codec.build_infer_response(
                     model_name=result.model_name,
                     model_version=result.model_version,
@@ -480,6 +501,18 @@ class _Servicer(service.GRPCInferenceServiceServicer):
         every request path (tpulint TPL503 pins that), so the
         deadline-missed and error paths are scored too."""
         now = time.perf_counter()
+        if error is not None:
+            # correlated failure line: the trace tag greps across the
+            # router's and client's logs for the same request
+            log.debug(
+                "request for model %s failed with %s: %s%s",
+                model_name, _grpc_code(error), error, log_tag(trace),
+            )
+        elif log.isEnabledFor(logging.DEBUG):
+            log.debug(
+                "request for model %s served in %.1f ms%s",
+                model_name, (now - t0) * 1e3, log_tag(trace),
+            )
         if self._tracer is not None:
             # close the trace FIRST: everything below is bookkeeping
             # that would otherwise show up as an uncovered tail on the
@@ -700,7 +733,7 @@ class InferenceServer:
         )
         self._draining = threading.Event()
         if metrics_port and profiler is None:
-            from triton_client_tpu.utils.profiling import StageProfiler
+            from triton_client_tpu.obs.profiling import StageProfiler
 
             profiler = StageProfiler()
         self.profiler = profiler
@@ -708,6 +741,7 @@ class InferenceServer:
         self.collector = None
         self.histograms = None
         self.slo = None
+        self.device_time = None
         self.metrics_enabled = False
         self._telemetry = None
         if metrics_port:
@@ -719,7 +753,7 @@ class InferenceServer:
             try:
                 import prometheus_client
 
-                from triton_client_tpu.utils.profiling import (
+                from triton_client_tpu.obs.profiling import (
                     PrometheusStageExporter,
                 )
 
@@ -757,11 +791,33 @@ class InferenceServer:
                     capacity=trace_capacity, profiler=profiler,
                     histograms=self.histograms,
                 )
+            from triton_client_tpu.obs.device_time import DeviceTimeLedger
+
+            # device-time ledger on the innermost staged channel (walk
+            # one `inner` level for a batcher-wrapped stack): every
+            # launch's device-execute window then accrues into per-
+            # model×tenant device-seconds + live MFU, exported below
+            target = channel
+            if not hasattr(target, "attach_device_time"):
+                target = getattr(channel, "inner", None)
+            if target is not None and hasattr(target, "attach_device_time"):
+                devices = 1
+                try:
+                    devices = int(target.fetch_channel().devices.size)
+                except Exception:
+                    pass
+                tenant_table = tenants
+                if tenant_table is None and lifecycle is not None:
+                    tenant_table = getattr(lifecycle, "tenants", None)
+                self.device_time = DeviceTimeLedger(
+                    tenants=tenant_table, devices=devices
+                )
+                target.attach_device_time(self.device_time)
             self.collector = RuntimeCollector(
                 channel=channel, tracer=self.tracer, registry=registry,
                 repository=repository, histograms=self.histograms,
                 slo=self.slo, admission=self.admission,
-                lifecycle=lifecycle,
+                lifecycle=lifecycle, device_time=self.device_time,
             )
             try:
                 from triton_client_tpu.obs.http import TelemetryServer
