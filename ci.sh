@@ -15,8 +15,13 @@ cd "$(dirname "$0")"
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
 echo "== tpulint (serving-hazard analysis, gate) =="
+# file-parallel parse (--jobs), and the findings double as a SARIF
+# artifact (tpulint.sarif) for code-scanning dashboards — same
+# fingerprints as the baseline, so alert dedup and suppression agree
 python -m triton_client_tpu lint triton_client_tpu/ \
-    --baseline tpulint.baseline.json
+    --baseline tpulint.baseline.json \
+    --jobs "$(nproc 2>/dev/null || echo 4)" \
+    --sarif tpulint.sarif
 
 echo "== ruff (conventional lint, optional stage) =="
 if command -v ruff >/dev/null 2>&1; then

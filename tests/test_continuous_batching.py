@@ -490,3 +490,77 @@ def test_dense_occupancy_accounting_under_drive():
     assert sum(k * v for k, v in occ.items()) == stats["merged_frames"]
     assert sum(stats["padded_by_model"].values()) == stats["padded_frames"]
     assert 0.0 <= stats["pad_fraction"] < 1.0
+
+
+def test_ragged_names_cache_fill_is_locked_and_converges():
+    """Regression (TPL602): ``_ragged_inputs_cache`` used to be filled
+    check-then-act with no lock, from the caller's RPC thread AND the
+    dispatcher/executor threads. All fillers must now insert under
+    ``_ragged_cache_lock`` and converge on one value, with the metadata
+    RPC kept outside the lock."""
+
+    calls = []
+    gate = threading.Event()
+
+    class _Spec:
+        extra = {"ragged_inputs": ("points",)}
+
+    class _Inner:
+        batch_multiple = 1
+
+        def get_metadata(self, name, version=""):
+            calls.append(threading.current_thread().name)
+            assert gate.wait(timeout=30.0)
+            return _Spec()
+
+        def do_inference_async(self, request):
+            raise AssertionError("no inference in this test")
+
+        def close(self):
+            pass
+
+    chan = ContinuousBatchingChannel(
+        _Inner(), max_batch=1, pipeline_depth=1, live_buckets=False
+    )
+    try:
+        lock = chan._ragged_cache_lock
+
+        class _LockChecked(dict):
+            def __setitem__(self, key, value):
+                assert lock.locked(), "cache mutated without the lock"
+                dict.__setitem__(self, key, value)
+
+            def setdefault(self, key, default=None):
+                assert lock.locked(), "cache mutated without the lock"
+                return dict.setdefault(self, key, default)
+
+        chan._ragged_inputs_cache = _LockChecked()
+
+        results = []
+        workers = [
+            threading.Thread(
+                target=lambda: results.append(chan._ragged_names("m", "1"))
+            )
+            for _ in range(8)
+        ]
+        for t in workers:
+            t.start()
+        # every worker misses the empty cache and blocks inside the
+        # metadata RPC — the exact multi-filler window of the bug —
+        # then the gate opens and all 8 race to insert
+        for _ in range(200):
+            if len(calls) == len(workers):
+                break
+            time.sleep(0.01)
+        assert len(calls) == len(workers)
+        assert not lock.locked(), "metadata RPC must run outside the lock"
+        gate.set()
+        for t in workers:
+            t.join(timeout=30.0)
+        assert results == [frozenset({"points"})] * len(workers)
+        # the cache is warm: no further metadata calls
+        assert chan._ragged_names("m", "1") == frozenset({"points"})
+        assert len(calls) == len(workers)
+    finally:
+        gate.set()
+        chan.close()

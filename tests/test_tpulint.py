@@ -786,3 +786,585 @@ class TestPackageGate:
         doc = json.loads(fail.stdout)
         assert doc["summary"]["total"] == 1
         assert doc["findings"][0]["code"] == "TPL401"
+
+
+# -- TPL6xx whole-program concurrency (round 13) -----------------------------
+
+
+RACE_POSITIVE = (
+    "import threading\n"
+    "class C:\n"
+    "    def __init__(self):\n"
+    "        self._lock = threading.Lock()\n"
+    "        self._cache = {}\n"
+    "        threading.Thread(target=self._loop).start()\n"
+    "    def _loop(self):\n"
+    "        self._cache['k'] = 1\n"
+    "    def do_inference(self, req):\n"
+    "        self._cache['k'] = 2\n"
+)
+
+OPPOSITE_ORDER = (
+    "import threading\n"
+    "class C:\n"
+    "    def __init__(self):\n"
+    "        self._a = threading.Lock()\n"
+    "        self._b = threading.Lock()\n"
+    "    def one(self):\n"
+    "        with self._a:\n"
+    "            self._grab_b()\n"
+    "    def _grab_b(self):\n"
+    "        with self._b:\n"
+    "            pass\n"
+    "    def two(self):\n"
+    "        with self._b:\n"
+    "            with self._a:\n"
+    "                pass\n"
+)
+
+
+class TestLockOrderRules:
+    def test_interprocedural_cycle_positive(self):
+        # one() holds _a when _grab_b() takes _b; two() nests the other
+        # way — the cycle is only visible through the call edge
+        found = lint_source(OPPOSITE_ORDER, codes=["TPL601"])
+        assert found and all(f.code == "TPL601" for f in found)
+        assert any("lock-order cycle" in f.message for f in found)
+
+    def test_consistent_order_negative(self):
+        src = OPPOSITE_ORDER.replace(
+            "        with self._b:\n"
+            "            with self._a:\n",
+            "        with self._a:\n"
+            "            with self._b:\n",
+        )
+        assert lint_source(src, codes=["TPL601"]) == []
+
+    def test_self_deadlock_positive(self):
+        src = (
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "    def outer(self):\n"
+            "        with self._lock:\n"
+            "            self._inner()\n"
+            "    def _inner(self):\n"
+            "        with self._lock:\n"
+            "            pass\n"
+        )
+        found = lint_source(src, codes=["TPL601"])
+        assert len(found) == 1 and "self-deadlock" in found[0].message
+        assert found[0].context == "C._inner"
+
+    def test_rlock_reacquire_negative(self):
+        src = (
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.RLock()\n"
+            "    def outer(self):\n"
+            "        with self._lock:\n"
+            "            self._inner()\n"
+            "    def _inner(self):\n"
+            "        with self._lock:\n"
+            "            pass\n"
+        )
+        assert lint_source(src, codes=["TPL601"]) == []
+
+    def test_pragma_suppresses(self):
+        src = (
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "    def outer(self):\n"
+            "        with self._lock:\n"
+            "            self._inner()\n"
+            "    def _inner(self):\n"
+            "        with self._lock:  # tpulint: disable=TPL601\n"
+            "            pass\n"
+        )
+        assert lint_source(src, codes=["TPL601"]) == []
+
+
+class TestThreadEscapeRules:
+    def test_two_root_race_positive(self):
+        # `_cache` is written from a spawned thread AND the caller-side
+        # do_inference entry point, with no lock on either side
+        found = lint_source(RACE_POSITIVE, codes=["TPL602"])
+        assert len(found) == 2
+        assert {f.context for f in found} == {"C._loop", "C.do_inference"}
+        assert all("thread roots" in f.message for f in found)
+
+    def test_guarded_everywhere_negative(self):
+        src = RACE_POSITIVE.replace(
+            "    def _loop(self):\n"
+            "        self._cache['k'] = 1\n",
+            "    def _loop(self):\n"
+            "        with self._lock:\n"
+            "            self._cache['k'] = 1\n",
+        ).replace(
+            "    def do_inference(self, req):\n"
+            "        self._cache['k'] = 2\n",
+            "    def do_inference(self, req):\n"
+            "        with self._lock:\n"
+            "            self._cache['k'] = 2\n",
+        )
+        assert lint_source(src, codes=["TPL602"]) == []
+
+    def test_single_root_negative(self):
+        # only the spawned thread mutates; do_inference just reads
+        src = RACE_POSITIVE.replace(
+            "    def do_inference(self, req):\n"
+            "        self._cache['k'] = 2\n",
+            "    def do_inference(self, req):\n"
+            "        return self._cache\n",
+        )
+        assert lint_source(src, codes=["TPL602"]) == []
+
+    def test_class_without_locks_negative(self):
+        # a class that never promised mutual exclusion is out of scope
+        src = (
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._cache = {}\n"
+            "        threading.Thread(target=self._loop).start()\n"
+            "    def _loop(self):\n"
+            "        self._cache['k'] = 1\n"
+            "    def do_inference(self, req):\n"
+            "        self._cache['k'] = 2\n"
+        )
+        assert lint_source(src, codes=["TPL602"]) == []
+
+    def test_locked_helper_convention_negative(self):
+        # the mutation lives in a `*_locked` helper; every caller holds
+        # the lock, so the entry-held fixpoint must clear it
+        src = (
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._cache = {}\n"
+            "        threading.Thread(target=self._loop).start()\n"
+            "    def _put_locked(self):\n"
+            "        self._cache['k'] = 1\n"
+            "    def _loop(self):\n"
+            "        with self._lock:\n"
+            "            self._put_locked()\n"
+            "    def do_inference(self, req):\n"
+            "        with self._lock:\n"
+            "            self._put_locked()\n"
+        )
+        assert lint_source(src, codes=["TPL602"]) == []
+
+    def test_pragma_line_suppresses_one_site(self):
+        src = RACE_POSITIVE.replace(
+            "        self._cache['k'] = 1\n",
+            "        self._cache['k'] = 1  # tpulint: disable=TPL602\n",
+        )
+        found = lint_source(src, codes=["TPL602"])
+        assert [f.context for f in found] == ["C.do_inference"]
+
+
+class TestCheckThenActRules:
+    CTA = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._spec = None\n"
+        "    def fill(self, v):\n"
+        "        with self._lock:\n"
+        "            self._spec = v\n"
+        "    def get(self, v):\n"
+        "        if self._spec is None:\n"
+        "            with self._lock:\n"
+        "                self._spec = v\n"
+        "        return self._spec\n"
+    )
+
+    def test_check_then_act_positive(self):
+        found = lint_source(self.CTA, codes=["TPL603"])
+        assert len(found) == 1
+        assert found[0].context == "C.get"
+        assert "check-then-act" in found[0].message
+
+    def test_double_checked_negative(self):
+        # re-checking under the lock is the sanctioned pattern
+        src = self.CTA.replace(
+            "            with self._lock:\n"
+            "                self._spec = v\n",
+            "            with self._lock:\n"
+            "                if self._spec is None:\n"
+            "                    self._spec = v\n",
+        )
+        assert lint_source(src, codes=["TPL603"]) == []
+
+    def test_checked_under_lock_negative(self):
+        src = self.CTA.replace(
+            "    def get(self, v):\n"
+            "        if self._spec is None:\n"
+            "            with self._lock:\n"
+            "                self._spec = v\n"
+            "        return self._spec\n",
+            "    def get(self, v):\n"
+            "        with self._lock:\n"
+            "            if self._spec is None:\n"
+            "                self._spec = v\n"
+            "        return self._spec\n",
+        )
+        assert lint_source(src, codes=["TPL603"]) == []
+
+    def test_pragma_suppresses(self):
+        src = self.CTA.replace(
+            "            with self._lock:\n"
+            "                self._spec = v\n",
+            "            with self._lock:  # tpulint: disable=TPL603\n"
+            "                self._spec = v\n",
+        )
+        assert lint_source(src, codes=["TPL603"]) == []
+
+
+class TestThreadModel:
+    def _model(self, src):
+        return load_source(src, path="mod.py").threads
+
+    def test_thread_root_discovery(self):
+        src = (
+            "import signal\n"
+            "import threading\n"
+            "def _handler(signum, frame):\n"
+            "    pass\n"
+            "def install():\n"
+            "    signal.signal(15, _handler)\n"
+            "class C:\n"
+            "    def __init__(self, pool, fut):\n"
+            "        threading.Thread(target=self._loop).start()\n"
+            "        threading.Timer(0.1, self._tick)\n"
+            "        pool.submit(self._work)\n"
+            "        fut.add_done_callback(self._done)\n"
+            "    def _loop(self):\n"
+            "        pass\n"
+            "    def _tick(self):\n"
+            "        pass\n"
+            "    def _work(self):\n"
+            "        pass\n"
+            "    def _done(self, fut):\n"
+            "        pass\n"
+        )
+        model = self._model(src)
+        kinds = {r.kind for r in model.roots}
+        assert {
+            "thread", "timer", "executor", "callback", "signal", "declared",
+        } <= kinds
+        pats = {r.pattern for r in model.roots}
+        assert any(p.endswith("C._loop") for p in pats)
+        assert any(p.endswith("C._tick") for p in pats)
+        assert any(p.endswith("C._work") for p in pats)
+        assert any(p.endswith("C._done") for p in pats)
+        assert any(p.endswith("_handler") for p in pats)
+
+    def test_declared_roots_always_present(self):
+        model = self._model("def f():\n    pass\n")
+        declared = {
+            r.pattern for r in model.roots if r.kind == "declared"
+        }
+        assert {"_Servicer.*", "do_inference", "do_inference_async"} <= declared
+        groups = {r.group for r in model.roots if r.kind == "declared"}
+        assert groups == {"rpc", "caller"}
+
+    def test_held_lock_propagates_into_locked_helper(self):
+        src = (
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "    def push(self):\n"
+            "        with self._lock:\n"
+            "            self._push_locked()\n"
+            "    def _push_locked(self):\n"
+            "        self._count = 1\n"
+        )
+        model = self._model(src)
+        assert any(
+            q.endswith("C._push_locked") and h == frozenset({"C._lock"})
+            for q, h in model.entry_held.items()
+        )
+        (site,) = model.mutations[("C", "_count")]
+        assert model.held_at(site) == frozenset({"C._lock"})
+
+    def test_family_lock_unification_across_subclass(self):
+        src = (
+            "import threading\n"
+            "class Base:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "class Sub(Base):\n"
+            "    def bump(self):\n"
+            "        with self._lock:\n"
+            "            self._n = 1\n"
+        )
+        model = self._model(src)
+        assert model.lock_id("Sub", "_lock") == "Base._lock"
+        assert ("Base", "_n") in model.mutations
+
+    def test_lock_order_edges_and_reentrancy(self):
+        model = self._model(OPPOSITE_ORDER)
+        edges = set(model.lock_order)
+        assert ("C._a", "C._b") in edges and ("C._b", "C._a") in edges
+        assert model.lock_cycles()
+        assert not model.reentrant("C._a")
+        rl = self._model(
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.RLock()\n"
+        )
+        assert rl.reentrant("C._lock")
+
+
+# -- TPL7xx host-path zero-copy audit (round 13) -----------------------------
+
+
+HOT_STAGE = (
+    "import numpy as np\n"
+    "class StagedChannel:\n"
+    "    def stage(self, arr):\n"
+)
+
+
+class TestZeroCopyRules:
+    def test_ascontiguousarray_positive(self):
+        src = HOT_STAGE + "        return np.ascontiguousarray(arr)\n"
+        found = lint_source(src, codes=["TPL7"])
+        assert codes(found) == ["TPL701"]
+        assert "hot path" in found[0].message
+
+    def test_tobytes_positive(self):
+        src = HOT_STAGE + "        return arr.tobytes()\n"
+        assert codes(lint_source(src, codes=["TPL7"])) == ["TPL701"]
+
+    def test_array_local_copy_positive(self):
+        src = HOT_STAGE + (
+            "        a = np.asarray(arr)\n"
+            "        return a.copy()\n"
+        )
+        found = lint_source(src, codes=["TPL7"])
+        assert len(found) == 1 and found[0].code == "TPL701"
+
+    def test_dict_copy_negative(self):
+        # .copy() on a plain dict is not an array copy — local
+        # dataflow must keep the receiver out of the array set
+        src = HOT_STAGE + (
+            "        params = {}\n"
+            "        q = params.copy()\n"
+            "        return q\n"
+        )
+        assert lint_source(src, codes=["TPL7"]) == []
+
+    def test_astype_unguarded_positive(self):
+        src = HOT_STAGE + "        return arr.astype(np.float32)\n"
+        assert codes(lint_source(src, codes=["TPL7"])) == ["TPL702"]
+
+    def test_astype_dtype_guard_negative(self):
+        src = HOT_STAGE + (
+            "        if arr.dtype != np.float32:\n"
+            "            arr = arr.astype(np.float32)\n"
+            "        return arr\n"
+        )
+        assert lint_source(src, codes=["TPL7"]) == []
+
+    def test_astype_copy_false_negative(self):
+        src = HOT_STAGE + (
+            "        return arr.astype(np.float32, copy=False)\n"
+        )
+        assert lint_source(src, codes=["TPL7"]) == []
+
+    def test_frombuffer_materialized_positive(self):
+        src = (
+            "import numpy as np\n"
+            "class StagedChannel:\n"
+            "    def stage(self, raw):\n"
+            "        return np.array(np.frombuffer(raw, dtype=np.uint8))\n"
+        )
+        found = lint_source(src, codes=["TPL7"])
+        # the sharp TPL703 diagnosis subsumes the generic TPL701
+        assert len(found) == 1 and found[0].code == "TPL703"
+
+    def test_frombuffer_view_kept_negative(self):
+        src = (
+            "import numpy as np\n"
+            "class StagedChannel:\n"
+            "    def stage(self, raw):\n"
+            "        return np.frombuffer(raw, dtype=np.uint8).reshape(2, 2)\n"
+        )
+        assert lint_source(src, codes=["TPL7"]) == []
+
+    def test_per_element_loop_positive_no_double_report(self):
+        src = (
+            "import numpy as np\n"
+            "class StagedChannel:\n"
+            "    def stage(self, arrs):\n"
+            "        out = []\n"
+            "        for a in arrs:\n"
+            "            out.append(a.tobytes())\n"
+            "        return out\n"
+        )
+        found = lint_source(src, codes=["TPL7"])
+        # the loop finding swallows the per-call .tobytes() finding
+        assert len(found) == 1 and found[0].code == "TPL704"
+
+    def test_cold_path_negative(self):
+        src = (
+            "import numpy as np\n"
+            "def helper(arr):\n"
+            "    return np.ascontiguousarray(arr)\n"
+        )
+        assert lint_source(src, codes=["TPL7"]) == []
+
+    def test_pragma_suppresses(self):
+        src = HOT_STAGE + (
+            "        return arr.tobytes()  # tpulint: disable=TPL701\n"
+        )
+        assert lint_source(src, codes=["TPL7"]) == []
+
+
+# -- SARIF + baseline maintenance + CLI flags (round 13) ---------------------
+
+
+class TestSarifOutput:
+    def test_render_sarif_schema(self):
+        found = lint_source(LOCK_POSITIVE, path="fix.py")
+        doc = json.loads(analysis.render_sarif(found, errors=["boom"]))
+        assert doc["version"] == "2.1.0"
+        run = doc["runs"][0]
+        rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+        assert {
+            "TPL601", "TPL602", "TPL603",
+            "TPL701", "TPL702", "TPL703", "TPL704",
+        } <= rule_ids
+        results = run["results"]
+        assert results[0]["ruleId"] == "TPL401"
+        assert (
+            results[0]["partialFingerprints"]["tpulint/v1"]
+            == found[0].fingerprint()
+        )
+        loc = results[0]["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"] == "fix.py"
+        assert loc["region"]["startLine"] == found[0].line
+        # analysis errors ride along as TPL000
+        assert results[-1]["ruleId"] == "TPL000"
+        assert "boom" in results[-1]["message"]["text"]
+
+
+class TestBaselineMaintenance:
+    def test_from_findings_preserves_prior_justifications(self):
+        a = lint_source(DONATION_POSITIVE, path="fix.py")
+        b = lint_source(LOCK_POSITIVE, path="other.py")
+        prior = Baseline.from_findings(a, justification="reviewed: ok")
+        prior.entries["deadbeefdeadbeef"] = {
+            "code": "TPL999", "justification": "old",
+        }
+        merged = Baseline.from_findings(a + b, prior=prior)
+        assert (
+            merged.entries[a[0].fingerprint()]["justification"]
+            == "reviewed: ok"
+        )
+        assert (
+            merged.entries[b[0].fingerprint()]["justification"]
+            == analysis.baseline.UNJUSTIFIED
+        )
+        assert "deadbeefdeadbeef" not in merged.entries
+
+    def test_prune_drops_only_stale(self):
+        a = lint_source(DONATION_POSITIVE, path="fix.py")
+        bl = Baseline.from_findings(a, justification="ok")
+        bl.entries["feedfacefeedface"] = {
+            "code": "TPL101", "justification": "gone",
+        }
+        dropped = bl.prune(a)
+        assert dropped == ["feedfacefeedface"]
+        assert a[0].fingerprint() in bl.entries
+        assert bl.entries[a[0].fingerprint()]["justification"] == "ok"
+
+
+class TestLintCliFlags:
+    def _run(self, args):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        return subprocess.run(
+            [sys.executable, "-m", "triton_client_tpu", "lint", *args],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=300,
+        )
+
+    def test_sarif_written_on_failure(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text(LOCK_POSITIVE)
+        out = tmp_path / "out.sarif"
+        r = self._run([str(bad), "--sarif", str(out)])
+        assert r.returncode == 1
+        doc = json.loads(out.read_text())
+        assert [x["ruleId"] for x in doc["runs"][0]["results"]] == ["TPL401"]
+
+    def test_changed_scopes_report_to_given_files(self):
+        r = self._run([
+            "--changed", "triton_client_tpu/runtime/continuous.py",
+            "--baseline", "tpulint.baseline.json", "--json",
+        ])
+        assert r.returncode == 0, r.stdout + r.stderr
+        doc = json.loads(r.stdout)
+        assert doc["summary"]["total"] == 0
+
+    def test_changed_without_files_is_noop(self):
+        r = self._run(["--changed"])
+        assert r.returncode == 0
+        assert "nothing to do" in r.stderr
+
+    def test_write_baseline_preserves_and_prunes(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text(LOCK_POSITIVE)
+        bl = tmp_path / "bl.json"
+        r1 = self._run([str(bad), "--write-baseline", str(bl)])
+        assert r1.returncode == 0, r1.stdout + r1.stderr
+        doc = json.loads(bl.read_text())
+        (fp,) = doc["entries"]
+        doc["entries"][fp]["justification"] = "reviewed: fixture"
+        doc["entries"]["feedfacefeedface"] = {
+            "code": "TPL999", "justification": "stale",
+        }
+        bl.write_text(json.dumps(doc))
+        r2 = self._run([str(bad), "--write-baseline", str(bl)])
+        assert "1 justification(s) preserved" in r2.stderr
+        doc2 = json.loads(bl.read_text())
+        assert doc2["entries"][fp]["justification"] == "reviewed: fixture"
+        assert "feedfacefeedface" not in doc2["entries"]
+
+    def test_prune_stale_rewrites_baseline(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text(LOCK_POSITIVE)
+        bl = tmp_path / "bl.json"
+        self._run([str(bad), "--write-baseline", str(bl)])
+        doc = json.loads(bl.read_text())
+        (fp,) = doc["entries"]
+        doc["entries"][fp]["justification"] = "reviewed: fixture"
+        doc["entries"]["feedfacefeedface"] = {
+            "code": "TPL999", "justification": "stale",
+        }
+        bl.write_text(json.dumps(doc))
+        r = self._run([str(bad), "--baseline", str(bl), "--prune-stale"])
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "pruned 1 stale" in r.stderr
+        doc2 = json.loads(bl.read_text())
+        assert list(doc2["entries"]) == [fp]
+        assert doc2["entries"][fp]["justification"] == "reviewed: fixture"
+
+    def test_jobs_parallel_load_matches_serial(self):
+        serial = analysis.load_package([PKG], root=REPO)
+        par = analysis.load_package([PKG], root=REPO, jobs=4)
+        assert [m.relpath for m in par.modules] == [
+            m.relpath for m in serial.modules
+        ]
+        assert [f.fingerprint() for f in analysis.run_rules(par)] == [
+            f.fingerprint() for f in analysis.run_rules(serial)
+        ]

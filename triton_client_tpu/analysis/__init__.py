@@ -5,13 +5,16 @@ overlapped dispatch, cross-thread batching, request spans) each come
 with a failure mode that is invisible to CPU-only tests and shows up
 only as a production perf/correctness regression: use-after-donation,
 silent retraces, host syncs inside the overlap window, unguarded
-shared counters, unbalanced spans/gauges. All five are *structural* —
-visible in the syntax tree — so this package lints for them at review
-time. Five rule families:
+shared counters, unbalanced spans/gauges, cross-thread races, hidden
+request-sized copies. All are *structural* — visible in the syntax
+tree — so this package lints for them at review time. Seven rule
+families:
 
-  TPL1xx  recompilation hazards      TPL4xx  lock discipline
-  TPL2xx  donation misuse            TPL5xx  telemetry correctness
-  TPL3xx  host sync on the hot path
+  TPL1xx  recompilation hazards      TPL5xx  telemetry correctness
+  TPL2xx  donation misuse            TPL6xx  whole-program concurrency
+  TPL3xx  host sync on the hot path          (deadlock + race model,
+  TPL4xx  lock discipline                     analysis/threads.py)
+                                     TPL7xx  zero-copy / host path
 
 Entry points: ``python -m triton_client_tpu lint`` (CLI, see
 cli/tools.py), :func:`lint_paths` / :func:`lint_source` (library / test
@@ -32,6 +35,7 @@ from triton_client_tpu.analysis.engine import (
     load_source,
     registry,
     render_json,
+    render_sarif,
     render_text,
     run_rules,
 )
@@ -59,6 +63,7 @@ __all__ = [
     "load_source",
     "registry",
     "render_json",
+    "render_sarif",
     "render_text",
     "run_rules",
 ]

@@ -79,19 +79,36 @@ class Baseline:
         seen = {f.fingerprint() for f in findings}
         return sorted(fp for fp in self.entries if fp not in seen)
 
+    def prune(self, findings: Iterable[Finding]) -> list[str]:
+        """Drop entries whose fingerprints no current finding matches
+        (the stale set); returns the dropped fingerprints. Entries that
+        still match — and their justifications — are untouched."""
+        dropped = self.stale(findings)
+        for fp in dropped:
+            del self.entries[fp]
+        return dropped
+
     @classmethod
     def from_findings(
-        cls, findings: Iterable[Finding], justification: str = UNJUSTIFIED
+        cls,
+        findings: Iterable[Finding],
+        justification: str = UNJUSTIFIED,
+        prior: "Baseline | None" = None,
     ) -> "Baseline":
+        """Baseline covering exactly ``findings``. Entries whose
+        fingerprint survives from ``prior`` KEEP their reviewed
+        justification; entries prior did not know start as TODO; prior
+        entries nothing matches anymore are pruned (not carried)."""
+        old = prior.entries if prior is not None else {}
         entries: dict[str, dict] = {}
         for f in findings:
-            entries[f.fingerprint()] = {
+            fp = f.fingerprint()
+            kept = entries.get(fp, old.get(fp, {})).get("justification")
+            entries[fp] = {
                 "code": f.code,
                 "path": f.path,
                 "context": f.context,
                 "message": f.message,
-                "justification": entries.get(f.fingerprint(), {}).get(
-                    "justification", justification
-                ),
+                "justification": kept if kept else justification,
             }
         return cls(entries)

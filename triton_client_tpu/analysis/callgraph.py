@@ -59,6 +59,7 @@ class CallGraph:
         # simple method index: method name -> {qualnames} (fallback for
         # cross-class self-dispatch through base classes)
         self._methods: dict[str, set[str]] = collections.defaultdict(set)
+        self._import_cache: dict[int, dict[str, str]] = {}
         for m in self._modules:
             self._collect_functions(m)
         for m in self._modules:
@@ -105,44 +106,67 @@ class CallGraph:
                     out[a.asname or a.name] = f"{base}.{a.name}"
         return out
 
-    def _collect_edges(self, module: Module) -> None:
-        imports = self._imports(module)
-        mod_name = self._mod_names[module]
+    def imports_of(self, module: Module) -> dict[str, str]:
+        """Cached alias map for ``module`` (threads.py resolves spawn
+        targets with the same import model the edge builder uses)."""
+        cached = self._import_cache.get(id(module))
+        if cached is None:
+            cached = self._imports(module)
+            self._import_cache[id(module)] = cached
+        return cached
 
-        def resolve(call: ast.Call, enclosing_class: str) -> set[str]:
-            name = dotted_name(call.func)
-            if not name:
-                return set()
-            targets: set[str] = set()
-            parts = name.split(".")
-            if parts[0] == "self" and len(parts) == 2:
-                # self.m() -> enclosing class method, else any same-name
-                # method in the package (base-class fallback)
-                qn = f"{mod_name}.{enclosing_class}.{parts[1]}"
-                if qn in self.functions:
-                    targets.add(qn)
-                else:
-                    targets |= self._methods.get(parts[1], set())
-                return targets
-            # plain f() -> same module, then from-imports
-            if len(parts) == 1:
-                qn = f"{mod_name}.{parts[0]}"
-                if qn in self.functions:
-                    targets.add(qn)
-                imp = imports.get(parts[0])
-                if imp and imp in self.functions:
-                    targets.add(imp)
-                return targets
-            # alias.f() / alias.sub.f() -> imported module function
-            imp = imports.get(parts[0])
-            if imp:
-                qn = ".".join([imp] + parts[1:])
-                if qn in self.functions:
-                    targets.add(qn)
-            qn = ".".join([mod_name] + parts)  # e.g. Class.method refs
+    def resolve_call(
+        self,
+        module: Module,
+        call: ast.Call,
+        enclosing_class: str,
+        owner: str | None = None,
+    ) -> set[str]:
+        """Package qualnames a call expression may target. Name-based,
+        same three edges the module docstring describes; ``owner`` (the
+        caller's qualname) additionally resolves bare names to nested
+        defs in the caller — ``submit(run)``-style closures."""
+        name = dotted_name(call.func)
+        if not name:
+            return set()
+        mod_name = self._mod_names[module]
+        imports = self.imports_of(module)
+        targets: set[str] = set()
+        parts = name.split(".")
+        if parts[0] == "self" and len(parts) == 2:
+            # self.m() -> enclosing class method, else any same-name
+            # method in the package (base-class fallback)
+            qn = f"{mod_name}.{enclosing_class}.{parts[1]}"
             if qn in self.functions:
                 targets.add(qn)
+            else:
+                targets |= self._methods.get(parts[1], set())
             return targets
+        # plain f() -> nested def in the caller, same module, from-imports
+        if len(parts) == 1:
+            if owner and f"{owner}.{parts[0]}" in self.functions:
+                targets.add(f"{owner}.{parts[0]}")
+            qn = f"{mod_name}.{parts[0]}"
+            if qn in self.functions:
+                targets.add(qn)
+            imp = imports.get(parts[0])
+            if imp and imp in self.functions:
+                targets.add(imp)
+            return targets
+        # alias.f() / alias.sub.f() -> imported module function
+        imp = imports.get(parts[0])
+        if imp:
+            qn = ".".join([imp] + parts[1:])
+            if qn in self.functions:
+                targets.add(qn)
+        qn = ".".join([mod_name] + parts)  # e.g. Class.method refs
+        if qn in self.functions:
+            targets.add(qn)
+        return targets
+
+    def _collect_edges(self, module: Module) -> None:
+        def resolve(call: ast.Call, enclosing_class: str) -> set[str]:
+            return self.resolve_call(module, call, enclosing_class)
 
         def walk(node: ast.AST, owner: str | None, enclosing_class: str) -> None:
             for child in ast.iter_child_nodes(node):

@@ -114,11 +114,13 @@ class Module:
 
 
 class Package:
-    """The analyzed module set + shared lazy facilities (call graph)."""
+    """The analyzed module set + shared lazy facilities (call graph,
+    thread/lock model)."""
 
     def __init__(self, modules: list[Module]) -> None:
         self.modules = modules
         self._callgraph = None
+        self._threads = None
         self.errors: list[str] = []
 
     @property
@@ -128,6 +130,17 @@ class Package:
 
             self._callgraph = CallGraph(self.modules)
         return self._callgraph
+
+    @property
+    def threads(self):
+        """Lazy :class:`analysis.threads.ThreadModel` — the package-wide
+        lock graph + thread-root model the TPL6xx family queries. Built
+        once and shared by every rule (same contract as ``callgraph``)."""
+        if self._threads is None:
+            from triton_client_tpu.analysis.threads import ThreadModel
+
+            self._threads = ThreadModel(self)
+        return self._threads
 
 
 class Rule:
@@ -189,13 +202,19 @@ def _iter_py_files(path: str) -> Iterator[str]:
                 yield os.path.join(root, f)
 
 
-def load_package(paths: Iterable[str], root: str | None = None) -> Package:
+def load_package(
+    paths: Iterable[str], root: str | None = None, jobs: int = 1
+) -> Package:
     """Parse every .py under ``paths`` into a Package. Unparseable files
     are recorded on ``package.errors`` instead of aborting the run —
     the CLI reports them and exits non-zero (a file the analyzer cannot
-    read is a file the rules cannot vouch for)."""
-    modules: list[Module] = []
-    errors: list[str] = []
+    read is a file the rules cannot vouch for).
+
+    ``jobs > 1`` loads files on a thread pool — read + parse of ~40
+    modules overlap instead of running serially (the CI gate passes
+    ``--jobs``). Results keep the deterministic sorted-walk order
+    regardless of completion order."""
+    targets: list[tuple[str, str]] = []  # (abspath, relpath)
     root = os.path.abspath(root) if root else os.getcwd()
     for path in paths:
         for fpath in _iter_py_files(path):
@@ -203,14 +222,30 @@ def load_package(paths: Iterable[str], root: str | None = None) -> Package:
             rel = os.path.relpath(abspath, root)
             if rel.startswith(".."):
                 rel = abspath
-            try:
-                with open(abspath, encoding="utf-8") as f:
-                    source = f.read()
-                modules.append(Module(abspath, rel, source))
-            except (OSError, SyntaxError, ValueError) as e:
-                errors.append(f"{rel}: {e}")
+            targets.append((abspath, rel))
+
+    def load_one(target: tuple[str, str]):
+        abspath, rel = target
+        try:
+            with open(abspath, encoding="utf-8") as f:
+                source = f.read()
+            return Module(abspath, rel, source), None
+        except (OSError, SyntaxError, ValueError) as e:
+            return None, f"{rel}: {e}"
+
+    if jobs > 1 and len(targets) > 1:
+        import concurrent.futures
+
+        with concurrent.futures.ThreadPoolExecutor(
+            max_workers=min(jobs, len(targets))
+        ) as pool:
+            results = list(pool.map(load_one, targets))
+    else:
+        results = [load_one(t) for t in targets]
+
+    modules = [m for m, _ in results if m is not None]
     pkg = Package(modules)
-    pkg.errors = errors
+    pkg.errors = [e for _, e in results if e is not None]
     return pkg
 
 
@@ -285,6 +320,89 @@ def _count_by(findings: list[Finding], attr: str) -> dict:
     return dict(sorted(out.items()))
 
 
+def render_sarif(
+    findings: list[Finding], errors: list[str] | None = None
+) -> str:
+    """SARIF 2.1.0 document for code-scanning UIs (GitHub, VS Code SARIF
+    viewers). ``partialFingerprints`` carries the same line-churn-proof
+    fingerprint the baseline uses, so scanning backends dedupe alerts
+    across commits exactly the way ``tpulint.baseline.json`` does."""
+    rules_meta: dict[str, dict] = {}
+    for code, cls in registry().items():
+        rules_meta[code] = {
+            "id": code,
+            "name": cls.name,
+            "shortDescription": {"text": cls.name},
+            "fullDescription": {"text": " ".join((cls.doc or "").split())},
+            "helpUri": "docs/LINTING.md",
+        }
+    results = []
+    for f in findings:
+        # codes emitted via Rule.finding(code=...) (TPL302, TPL6xx
+        # variants) still resolve to a driver rule entry
+        if f.code not in rules_meta:
+            rules_meta[f.code] = {
+                "id": f.code,
+                "name": f.name,
+                "shortDescription": {"text": f.name},
+                "helpUri": "docs/LINTING.md",
+            }
+        results.append(
+            {
+                "ruleId": f.code,
+                "level": "error",
+                "message": {
+                    "text": f.message
+                    + (f" [{f.context}]" if f.context else "")
+                },
+                "locations": [
+                    {
+                        "physicalLocation": {
+                            "artifactLocation": {
+                                "uri": f.path.replace(os.sep, "/"),
+                            },
+                            "region": {
+                                "startLine": max(f.line, 1),
+                                "startColumn": f.col + 1,
+                            },
+                        }
+                    }
+                ],
+                "partialFingerprints": {"tpulint/v1": f.fingerprint()},
+            }
+        )
+    for msg in errors or ():
+        results.append(
+            {
+                "ruleId": "TPL000",
+                "level": "error",
+                "message": {"text": f"analysis error: {msg}"},
+            }
+        )
+    doc = {
+        "$schema": (
+            "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+            "master/Schemata/sarif-schema-2.1.0.json"
+        ),
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "tpulint",
+                        "informationUri": "docs/LINTING.md",
+                        "rules": [
+                            rules_meta[k] for k in sorted(rules_meta)
+                        ],
+                    }
+                },
+                "results": results,
+            }
+        ],
+    }
+    return json.dumps(doc, indent=2, sort_keys=True)
+
+
 # -- shared AST helpers (used by several rule modules) ----------------------
 
 
@@ -336,3 +454,43 @@ def dotted_name(node: ast.AST) -> str:
         parts.append(node.id)
         return ".".join(reversed(parts))
     return ""
+
+
+def walk_held(
+    fn: ast.AST, lock_of
+) -> Iterator[tuple[ast.AST, frozenset]]:
+    """Flow-sensitive walk of ``fn``'s body: yield ``(node, held)`` for
+    every node lexically inside ``fn`` (nested defs/lambdas excluded —
+    they are separate call-graph functions analyzed under their own
+    qualname, and a closure does NOT inherit its definer's locks: it
+    usually runs later, on another thread, unlocked).
+
+    ``lock_of(expr) -> lock_id | None`` classifies ``with`` context
+    expressions; a recognized lock extends the held set for exactly the
+    ``with`` body. The ``With`` node itself is yielded with the
+    PRE-acquisition held set — that yield IS the acquisition event the
+    thread model turns into lock-order edges."""
+
+    def rec(node: ast.AST, held: frozenset) -> Iterator[tuple[ast.AST, frozenset]]:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            if isinstance(child, ast.With):
+                yield child, held
+                inner = held
+                for item in child.items:
+                    yield item.context_expr, held
+                    yield from rec(item.context_expr, held)
+                    lid = lock_of(item.context_expr)
+                    if lid:
+                        inner = inner | {lid}
+                for stmt in child.body:
+                    yield stmt, inner
+                    yield from rec(stmt, inner)
+                continue
+            yield child, held
+            yield from rec(child, held)
+
+    yield from rec(fn, frozenset())

@@ -6,6 +6,8 @@ engine registry (each module calls ``@register`` at import time).
   TPL3xx  host sync on the hot path (rules.hostsync)
   TPL4xx  lock discipline           (rules.locks)
   TPL5xx  telemetry correctness     (rules.telemetry)
+  TPL6xx  whole-program concurrency (rules.concurrency)
+  TPL7xx  zero-copy / host path     (rules.zerocopy)
 
 Adding a family: create ``rules/<name>.py``, subclass ``engine.Rule``
 with a fresh TPLnxx code, decorate with ``@register``, import it here,
@@ -14,9 +16,11 @@ document it in docs/LINTING.md, and add positive/negative fixtures to
 """
 
 from triton_client_tpu.analysis.rules import (  # noqa: F401
+    concurrency,
     donation,
     hostsync,
     locks,
     recompile,
     telemetry,
+    zerocopy,
 )

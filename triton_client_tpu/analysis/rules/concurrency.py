@@ -1,0 +1,234 @@
+"""TPL6xx — whole-program concurrency hazards.
+
+TPL4xx proves per-class lock *discipline*; this family proves the
+properties that need the package-wide :mod:`analysis.threads` model:
+
+  TPL601  lock-order cycle: two (or more) locks are nested in opposite
+          orders on different call paths — two threads taking the two
+          paths concurrently deadlock. Also flags re-acquiring a
+          non-reentrant ``threading.Lock`` while it is already held
+          (the length-1 cycle: self-deadlock on the calling thread).
+  TPL602  cross-thread-root race: an instance attribute of a
+          lock-carrying class is mutated from two or more distinct
+          thread roots (dispatcher loop, watchdog, executor callbacks,
+          signal handlers, the caller's thread...) and at least one of
+          those mutation sites holds no lock.
+  TPL603  check-then-act atomicity violation: a guarded attribute is
+          tested WITHOUT the lock and then mutated UNDER the lock
+          inside the same ``if`` — the classic broken double-checked
+          init, racing threads both pass the stale check. The fix is
+          re-checking under the lock (which suppresses the finding).
+
+All three lean on over-approximations that only ever SUPPRESS race
+findings and ADD deadlock edges (see threads.py); genuine
+single-writer designs (the watchdog heartbeat) are baselined with a
+justification rather than special-cased here.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from triton_client_tpu.analysis.engine import (
+    Finding,
+    Package,
+    Rule,
+    register,
+    walk_held,
+)
+
+
+def _short(qualname: str) -> str:
+    """Class.method tail of a dotted qualname (module prefix dropped)."""
+    parts = qualname.split(".")
+    for i, p in enumerate(parts):
+        if p[:1].isupper():
+            return ".".join(parts[i:])
+    return ".".join(parts[-2:]) if len(parts) > 1 else qualname
+
+
+@register
+class LockOrderRule(Rule):
+    code = "TPL601"
+    name = "lock-order-cycle"
+    doc = (
+        "Two locks are acquired in opposite orders on different call "
+        "paths (potential deadlock), or a non-reentrant lock is "
+        "re-acquired while already held (self-deadlock). Pick one "
+        "global nesting order, or drop to a single lock."
+    )
+
+    def check(self, package: Package) -> Iterator[Finding]:
+        model = package.threads
+        for cycle, witnesses in model.lock_cycles():
+            order = " -> ".join(cycle + (cycle[0],))
+            for site in witnesses:
+                held = sorted(
+                    h for h in model.held_at(site) if h in cycle
+                )
+                yield self.finding(
+                    site.module,
+                    site.node,
+                    f"lock-order cycle {order}: `{site.lock}` is "
+                    f"acquired here while holding {', '.join(held)} — "
+                    "an opposite-order path exists, so two threads can "
+                    "deadlock",
+                    context=_short(site.function),
+                )
+        for site in model.reacquisitions:
+            yield self.finding(
+                site.module,
+                site.node,
+                f"non-reentrant `{site.lock}` is re-acquired while "
+                "already held on this path (self-deadlock); use RLock, "
+                "or the `*_locked` caller-holds-it convention",
+                context=_short(site.function),
+            )
+
+
+@register
+class ThreadEscapeRule(Rule):
+    code = "TPL602"
+    name = "cross-thread-race"
+    doc = (
+        "An instance attribute of a lock-carrying class is mutated "
+        "from two or more distinct thread roots with at least one "
+        "mutation holding no lock — a data race under load. Guard "
+        "every mutation with the class lock or confine the attribute "
+        "to a single thread."
+    )
+
+    def check(self, package: Package) -> Iterator[Finding]:
+        model = package.threads
+        for (family, attr), sites in sorted(model.mutations.items()):
+            if not model.lock_attrs.get(family):
+                # a class with no locks at all never promised mutual
+                # exclusion; TPL602 polices classes that did
+                continue
+            groups: set[str] = set()
+            for s in sites:
+                groups |= model.roots_reaching(s.function)
+            if len(groups) < 2:
+                continue
+            bare = [s for s in sites if not model.held_at(s)]
+            if not bare:
+                continue
+            for s in bare:
+                yield self.finding(
+                    s.module,
+                    s.node,
+                    f"`self.{attr}` is mutated lock-free here but is "
+                    f"written from {len(groups)} thread roots "
+                    f"({', '.join(_short(g) for g in sorted(groups))})"
+                    " — guard it or confine it to one thread",
+                    context=_short(s.function),
+                )
+
+
+@register
+class CheckThenActRule(Rule):
+    code = "TPL603"
+    name = "check-then-act"
+    doc = (
+        "A lock-guarded attribute is tested without the lock and then "
+        "mutated under the lock in the same `if` — both racing threads "
+        "pass the stale check. Re-check the condition after acquiring "
+        "the lock (double-checked init) or move the test under it."
+    )
+
+    def check(self, package: Package) -> Iterator[Finding]:
+        model = package.threads
+        # attributes that are mutated under a lock SOMEWHERE: only for
+        # those does an unlocked check promise something the lock keeps
+        guarded: dict[str, set[str]] = {}
+        for (family, attr), sites in model.mutations.items():
+            if any(model.held_at(s) for s in sites):
+                guarded.setdefault(family, set()).add(attr)
+        for qn, info in sorted(package.callgraph.functions.items()):
+            cls = model._class_of(qn, info)
+            if not cls:
+                continue
+            family = model.family(cls)
+            attrs = guarded.get(family)
+            if not attrs:
+                continue
+            yield from self._check_function(model, info, qn, cls, attrs)
+
+    def _check_function(
+        self, model, info, qn: str, cls: str, attrs: set[str]
+    ) -> Iterator[Finding]:
+        if info.node.name in ("__init__", "__new__", "__post_init__"):
+            return
+
+        def lock_of(expr: ast.AST) -> str | None:
+            if (
+                isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self"
+            ):
+                return model.lock_id(cls, expr.attr)
+            return None
+
+        entry = model.entry_held.get(qn, frozenset())
+        for node, held in walk_held(info.node, lock_of):
+            if not isinstance(node, ast.If) or held or entry:
+                continue
+            tested = _self_attrs_read(node.test) & attrs
+            if not tested:
+                continue
+            for inner in ast.walk(node):
+                if not isinstance(inner, ast.With):
+                    continue
+                if not any(
+                    lock_of(item.context_expr) for item in inner.items
+                ):
+                    continue
+                acted = _mutated_attrs(inner) & tested
+                rechecked = _rechecked_attrs(inner)
+                for attr in sorted(acted - rechecked):
+                    yield self.finding(
+                        info.module,
+                        inner,
+                        f"check-then-act on `self.{attr}`: tested "
+                        "without the lock, mutated under it — racing "
+                        "threads both pass the stale check; re-check "
+                        "under the lock",
+                        context=_short(qn),
+                    )
+
+
+def _self_attrs_read(expr: ast.AST) -> set[str]:
+    out: set[str] = set()
+    for node in ast.walk(expr):
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+        ):
+            out.add(node.attr)
+    return out
+
+
+def _mutated_attrs(tree: ast.AST) -> set[str]:
+    from triton_client_tpu.analysis.threads import _mutations
+
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        for attr, _site in _mutations(node):
+            out.add(attr)
+    return out
+
+
+def _rechecked_attrs(with_node: ast.With) -> set[str]:
+    """Attributes re-tested by an `if`/`while` INSIDE the lock body —
+    the double-checked pattern that makes check-then-act safe."""
+    out: set[str] = set()
+    for node in ast.walk(with_node):
+        if node is with_node:
+            continue
+        if isinstance(node, (ast.If, ast.While)):
+            out |= _self_attrs_read(node.test)
+        elif isinstance(node, ast.Assert):
+            out |= _self_attrs_read(node.test)
+    return out

@@ -1,0 +1,361 @@
+"""TPL7xx — hidden host-side copies on the serving hot path.
+
+ROADMAP item 1: the remaining gap between served fps and the device
+ceiling is host work, and the biggest silent contributor is memory
+traffic — request-sized arrays copied on their way through the stack.
+The codec deliberately receives with zero-copy ``frombuffer(...)
+.reshape(...)`` views; one careless ``np.array(...)`` or ``.copy()``
+downstream doubles the per-request byte traffic and shows up nowhere
+but the capacity number. Like TPL3xx, the family walks the call graph
+from :data:`rules.hostsync.HOT_PATH_ROOTS` and audits every reachable
+function:
+
+  TPL701  hidden copy: ``np.ascontiguousarray`` / ``np.copy`` /
+          ``.tobytes()`` / ``.copy()`` on an array value in a hot-path
+          function. Some copies are the design (the wire needs owned
+          contiguous bytes) — those are baselined with a justification.
+  TPL702  unguarded ``astype``: dtype conversion without a
+          dtype-identity guard copies even when dtypes already match.
+          ``astype(dt, copy=False)`` or an enclosing ``if ... dtype``
+          check is the guard.
+  TPL703  broken zero-copy view: a ``frombuffer`` chain immediately
+          materialized (``np.array(np.frombuffer(...))``,
+          ``frombuffer(...).reshape(...).copy()``) — the zero-copy
+          receive path pays for an allocation anyway.
+  TPL704  per-element serialization: a loop whose body serializes
+          (``.tobytes()`` / ``struct.pack``) element by element —
+          one vectorized call does the same work without the
+          per-iteration Python and allocator overhead.
+
+Method-call heuristics (``.copy()``) only fire on receivers the local
+dataflow proves array-like (a numpy call chain or a name assigned from
+one) — ``dict.copy()`` on a params map is not a finding.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from triton_client_tpu.analysis.engine import (
+    Finding,
+    Package,
+    Rule,
+    call_name,
+    register,
+)
+from triton_client_tpu.analysis.rules.hostsync import (
+    HOT_PATH_ROOTS,
+    _short_context,
+)
+
+_COPY_CALLS = {
+    "np.ascontiguousarray": "forces an owned contiguous copy",
+    "numpy.ascontiguousarray": "forces an owned contiguous copy",
+    "np.copy": "explicit array copy",
+    "numpy.copy": "explicit array copy",
+}
+_FROMBUFFER = {"np.frombuffer", "numpy.frombuffer", "frombuffer"}
+# chained ndarray methods that keep a value array-like
+_ARRAY_CHAIN_METHODS = {
+    "reshape",
+    "astype",
+    "ravel",
+    "view",
+    "transpose",
+    "squeeze",
+    "flatten",
+    "copy",
+}
+_SERIALIZE_IN_LOOP = {"tobytes", "pack", "to_bytes"}
+
+
+def _is_numpyish(expr: ast.AST, array_names: set[str]) -> bool:
+    """Local-dataflow guess: does ``expr`` evaluate to an ndarray?"""
+    if isinstance(expr, ast.Name):
+        return expr.id in array_names
+    if isinstance(expr, ast.Call):
+        name = call_name(expr)
+        if name.startswith(("np.", "numpy.")) or name in _FROMBUFFER:
+            return True
+        if (
+            isinstance(expr.func, ast.Attribute)
+            and expr.func.attr in _ARRAY_CHAIN_METHODS
+        ):
+            return _is_numpyish(expr.func.value, array_names)
+        return False
+    if isinstance(expr, ast.Attribute):
+        # arr.T / arr.real keep arrays array-like
+        return _is_numpyish(expr.value, array_names)
+    if isinstance(expr, ast.Subscript):
+        return _is_numpyish(expr.value, array_names)
+    return False
+
+
+def _array_locals(fn: ast.AST) -> set[str]:
+    """Names assigned from numpy-ish expressions anywhere in ``fn`` —
+    order-insensitive on purpose (two passes keep chains like
+    ``a = np.frombuffer(...); b = a.reshape(...)`` covered)."""
+    names: set[str] = set()
+    for _ in range(2):
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and _is_numpyish(
+                node.value, names
+            ):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        names.add(tgt.id)
+    return names
+
+
+def _contains_frombuffer(expr: ast.AST) -> bool:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call) and call_name(node) in _FROMBUFFER:
+            return True
+    return False
+
+
+def _dtype_guarded(ancestors: list[ast.AST]) -> bool:
+    """True when some enclosing if/ternary tests a dtype — the
+    conversion only runs when dtypes genuinely differ."""
+    for node in ancestors:
+        test = None
+        if isinstance(node, (ast.If, ast.IfExp)):
+            test = node.test
+        elif isinstance(node, ast.While):
+            test = node.test
+        if test is None:
+            continue
+        for sub in ast.walk(test):
+            if isinstance(sub, ast.Attribute) and sub.attr == "dtype":
+                return True
+            if isinstance(sub, ast.Name) and "dtype" in sub.id:
+                return True
+    return False
+
+
+def _kw(call: ast.Call, name: str) -> ast.AST | None:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+class _HotScan:
+    """One hot function's scan state: findings accumulate with loop
+    deduplication (a TPL704 loop swallows the TPL701s inside it)."""
+
+    def __init__(self, fn: ast.AST) -> None:
+        self.fn = fn
+        self.array_names = _array_locals(fn)
+        self.loop_lines: set[int] = set()
+        self.hits: list[tuple[ast.AST, str, str]] = []
+
+    def scan(self) -> list[tuple[ast.AST, str, str]]:
+        self._walk(self.fn, [], in_flagged_loop=False)
+        return self.hits
+
+    def _walk(
+        self, node: ast.AST, ancestors: list[ast.AST], in_flagged_loop: bool
+    ) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue  # separate call-graph nodes, scanned there
+            flagged_here = False
+            if isinstance(child, (ast.For, ast.While)):
+                if self._loop_serializes(child):
+                    self.hits.append(
+                        (
+                            child,
+                            "TPL704",
+                            "per-element serialization loop on the hot "
+                            "path — vectorize (one `.tobytes()` /"
+                            " `struct.pack` over the whole array)",
+                        )
+                    )
+                    flagged_here = True
+            elif isinstance(child, ast.Call):
+                self._check_call(child, ancestors, in_flagged_loop)
+            self._walk(
+                child,
+                ancestors + [child],
+                in_flagged_loop or flagged_here,
+            )
+
+    def _loop_serializes(self, loop: ast.AST) -> bool:
+        for node in ast.walk(loop):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(node, ast.Call):
+                f = node.func
+                if (
+                    isinstance(f, ast.Attribute)
+                    and f.attr in _SERIALIZE_IN_LOOP
+                ):
+                    return True
+                if call_name(node) == "struct.pack":
+                    return True
+        return False
+
+    def _check_call(
+        self, call: ast.Call, ancestors: list[ast.AST], in_flagged_loop: bool
+    ) -> None:
+        name = call_name(call)
+        # TPL703 first: a materialized frombuffer chain is the sharpest
+        # diagnosis, and it subsumes the generic copy finding
+        if (
+            name in ("np.array", "numpy.array")
+            and call.args
+            and _contains_frombuffer(call.args[0])
+        ):
+            self.hits.append(
+                (
+                    call,
+                    "TPL703",
+                    "`np.array(...)` materializes a `frombuffer` "
+                    "zero-copy view — keep the view (the codec's "
+                    "receive path is zero-copy by design)",
+                )
+            )
+            return
+        f = call.func
+        if isinstance(f, ast.Attribute) and f.attr == "copy":
+            if _contains_frombuffer(f.value):
+                self.hits.append(
+                    (
+                        call,
+                        "TPL703",
+                        "`.copy()` on a `frombuffer` chain defeats the "
+                        "zero-copy receive view",
+                    )
+                )
+                return
+            if _is_numpyish(f.value, self.array_names):
+                self.hits.append(
+                    (
+                        call,
+                        "TPL701",
+                        "`.copy()` of an array on the hot path "
+                        "(request-sized allocation + memcpy)",
+                    )
+                )
+            return
+        if name in _COPY_CALLS:
+            self.hits.append(
+                (
+                    call,
+                    "TPL701",
+                    f"`{name}` on the hot path ({_COPY_CALLS[name]})",
+                )
+            )
+            return
+        if isinstance(f, ast.Attribute) and f.attr == "tobytes":
+            if in_flagged_loop:
+                return  # the TPL704 loop finding already covers it
+            self.hits.append(
+                (
+                    call,
+                    "TPL701",
+                    "`.tobytes()` on the hot path (full array copy "
+                    "into a bytes object)",
+                )
+            )
+            return
+        if isinstance(f, ast.Attribute) and f.attr == "astype":
+            copy_kw = _kw(call, "copy")
+            if (
+                isinstance(copy_kw, ast.Constant)
+                and copy_kw.value is False
+            ):
+                return  # astype(dt, copy=False): identity-safe
+            if _dtype_guarded(ancestors):
+                return
+            self.hits.append(
+                (
+                    call,
+                    "TPL702",
+                    "`.astype(...)` without a dtype-identity guard "
+                    "copies even when dtypes already match — guard "
+                    "with `if arr.dtype != dt:` or pass `copy=False`",
+                )
+            )
+
+
+@register
+class HiddenCopyRule(Rule):
+    code = "TPL701"
+    name = "hot-path-hidden-copy"
+    doc = (
+        "A request-sized array is copied on the serving hot path "
+        "(`np.ascontiguousarray`, `.copy()`, `.tobytes()`); every such "
+        "copy is host memory traffic ROADMAP item 1 is trying to "
+        "eliminate. Designed copies carry a baseline justification."
+    )
+
+    emit = ("TPL701",)
+    roots: tuple[str, ...] = HOT_PATH_ROOTS
+
+    def check(self, package: Package) -> Iterator[Finding]:
+        yield from _check_hot(package, self, self.emit, self.roots)
+
+
+@register
+class UnguardedAstypeRule(HiddenCopyRule):
+    code = "TPL702"
+    name = "unguarded-astype"
+    doc = (
+        "`.astype(...)` on the hot path without a dtype-identity guard "
+        "or `copy=False` — it allocates and copies even when the dtype "
+        "already matches."
+    )
+
+    emit = ("TPL702",)
+
+
+@register
+class BrokenViewRule(HiddenCopyRule):
+    code = "TPL703"
+    name = "broken-zero-copy-view"
+    doc = (
+        "A `frombuffer` zero-copy view is immediately materialized "
+        "(`np.array(...)` / `.copy()`), paying the allocation the view "
+        "existed to avoid."
+    )
+
+    emit = ("TPL703",)
+
+
+@register
+class ElementLoopRule(HiddenCopyRule):
+    code = "TPL704"
+    name = "per-element-serialization"
+    doc = (
+        "A hot-path loop serializes element by element (`.tobytes()`, "
+        "`struct.pack` per iteration) — vectorize into one call over "
+        "the whole array."
+    )
+
+    emit = ("TPL704",)
+
+
+def _check_hot(
+    package: Package, rule: Rule, emit: tuple[str, ...], roots
+) -> Iterator[Finding]:
+    graph = package.callgraph
+    hot = graph.reachable(roots)
+    for qn in sorted(hot):
+        info = graph.functions.get(qn)
+        if info is None:
+            continue
+        for node, code, msg in _HotScan(info.node).scan():
+            if code not in emit:
+                continue
+            yield rule.finding(
+                info.module,
+                node,
+                msg,
+                context=_short_context(qn),
+                code=code,
+            )

@@ -301,8 +301,34 @@ def lint(argv=None) -> None:
     )
     p.add_argument(
         "--write-baseline", default=None, metavar="FILE",
-        help="write every current finding to FILE as a baseline "
-        "(justifications start as TODO and must be edited) and exit 0",
+        help="write every current finding to FILE as a baseline and "
+        "exit 0; entries surviving from the previous baseline keep "
+        "their justifications, stale entries are pruned, new entries "
+        "start as TODO and must be edited",
+    )
+    p.add_argument(
+        "--prune-stale", action="store_true",
+        help="with --baseline: rewrite the baseline file with stale "
+        "entries (fingerprints nothing matches anymore) removed, "
+        "keeping every surviving entry and justification untouched",
+    )
+    p.add_argument(
+        "--sarif", default=None, metavar="FILE",
+        help="also write findings as SARIF 2.1.0 to FILE ('-' for "
+        "stdout) for code-scanning UIs; fingerprints match the "
+        "baseline's",
+    )
+    p.add_argument(
+        "--jobs", type=int, default=1, metavar="N",
+        help="load/parse files on N threads (CI passes this; default "
+        "serial)",
+    )
+    p.add_argument(
+        "--changed", action="store_true",
+        help="treat the given paths as CHANGED FILES: analyze the "
+        "whole package (interprocedural rules need it) but report "
+        "only findings located in those files — the pre-commit fast "
+        "path",
     )
     p.add_argument(
         "--rules", default=None,
@@ -332,16 +358,46 @@ def lint(argv=None) -> None:
                 print(f"       {doc}")
         return
 
-    paths = args.paths or [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+    pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if args.changed:
+        # fast path: the WHOLE package is analyzed (reachability, lock
+        # and thread models are interprocedural — a changed callee can
+        # create a finding in an unchanged caller's scope only via its
+        # own file, but a changed file's findings need global context),
+        # then the report is restricted to the files that changed
+        if not args.paths:
+            print("tpulint: --changed given but no files; nothing to do",
+                  file=sys.stderr)
+            return
+        paths = [pkg_dir]
+    else:
+        paths = args.paths or [pkg_dir]
     codes = args.rules.split(",") if args.rules else None
-    package = analysis.load_package(paths)
+    package = analysis.load_package(paths, jobs=max(1, args.jobs))
     findings = analysis.run_rules(package, codes=codes)
+    if args.changed:
+        changed = {
+            os.path.relpath(os.path.abspath(p)) for p in args.paths
+        }
+        findings = [f for f in findings if f.path in changed]
 
     if args.write_baseline:
-        analysis.Baseline.from_findings(findings).save(args.write_baseline)
+        prior = None
+        for prior_path in (args.write_baseline, args.baseline):
+            if prior_path and os.path.exists(prior_path):
+                prior = analysis.Baseline.load(prior_path)
+                break
+        bl = analysis.Baseline.from_findings(findings, prior=prior)
+        bl.save(args.write_baseline)
+        kept = sum(
+            1 for e in bl.entries.values()
+            if e.get("justification") not in ("", analysis.baseline.UNJUSTIFIED)
+        ) if prior else 0
+        todo = len(bl.entries) - kept
         print(
-            f"wrote {len(findings)} finding(s) -> {args.write_baseline}; "
-            "edit the TODO justifications before committing",
+            f"wrote {len(bl.entries)} entr(ies) -> {args.write_baseline} "
+            f"({kept} justification(s) preserved, {todo} TODO); edit the "
+            "TODOs before committing",
             file=sys.stderr,
         )
         return
@@ -350,6 +406,14 @@ def lint(argv=None) -> None:
     problems: list[str] = list(package.errors)
     if args.baseline:
         bl = analysis.Baseline.load(args.baseline)
+        if args.prune_stale and not args.changed:
+            dropped = bl.prune(findings)
+            bl.save(args.baseline)
+            print(
+                f"tpulint: pruned {len(dropped)} stale entr(ies) from "
+                f"{args.baseline}",
+                file=sys.stderr,
+            )
         findings, suppressed = bl.split(findings)
         for fp in bl.unjustified():
             e = bl.entries[fp]
@@ -357,7 +421,9 @@ def lint(argv=None) -> None:
                 f"baseline entry {fp} ({e.get('code')} {e.get('path')}) "
                 "has no justification"
             )
-        if not args.no_stale_check:
+        # --changed reports a SUBSET of findings, so "nothing matches
+        # this entry" would be meaningless noise there
+        if not args.no_stale_check and not args.changed:
             for fp in bl.stale(findings + suppressed):
                 e = bl.entries[fp]
                 print(
@@ -365,6 +431,14 @@ def lint(argv=None) -> None:
                     f"({e.get('code')} {e.get('path')}: nothing matches it)",
                     file=sys.stderr,
                 )
+    if args.sarif:
+        body = analysis.render_sarif(findings, errors=problems)
+        if args.sarif == "-":
+            print(body)
+        else:
+            with open(args.sarif, "w", encoding="utf-8") as fh:
+                fh.write(body + "\n")
+            print(f"tpulint: SARIF -> {args.sarif}", file=sys.stderr)
 
     if args.json:
         doc = _json.loads(

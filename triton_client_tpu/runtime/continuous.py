@@ -56,6 +56,7 @@ import bisect
 import collections
 import concurrent.futures
 import math
+import threading
 import time
 
 import numpy as np
@@ -160,8 +161,13 @@ class ContinuousBatchingChannel(BatchingChannel):
         self._capacity = max(1, int(capacity))
         # (model, version) -> frozenset of packed-input names, or None
         # when the model has no segment-aware body; filled lazily from
-        # inner.get_metadata so registration order doesn't matter
+        # inner.get_metadata so registration order doesn't matter.
+        # Filled from RPC threads AND the dispatcher/executor threads,
+        # so writes go through _ragged_cache_lock (the metadata RPC
+        # itself runs outside the lock; racing fillers converge via
+        # setdefault)
         self._ragged_inputs_cache: dict = {}
+        self._ragged_cache_lock = threading.Lock()
         self._ragged_stats = {
             "ragged_batches": 0,
             "ragged_segments": 0,
@@ -344,21 +350,31 @@ class ContinuousBatchingChannel(BatchingChannel):
     def _ragged_names(self, model_name: str, model_version: str):
         """Packed-input names for a model with a segment-aware body
         (``spec.extra["ragged_inputs"]``), else None. Cached, including
-        negative answers — this sits on the per-request path."""
+        negative answers — this sits on the per-request path.
+
+        Called from RPC threads (``do_inference``) and from the
+        dispatcher/executor threads (``_run_group``), so the cache fill
+        is double-checked: the lock-free fast path covers the steady
+        state, the metadata RPC runs unlocked (it can block), and the
+        insert goes through ``setdefault`` under ``_ragged_cache_lock``
+        so racing fillers agree on one winner."""
         key = (model_name, model_version)
-        if key not in self._ragged_inputs_cache:
+        try:
+            return self._ragged_inputs_cache[key]
+        except KeyError:
+            pass
+        names = None
+        try:
+            spec = self._inner.get_metadata(model_name, model_version)
+            declared = (getattr(spec, "extra", None) or {}).get(
+                "ragged_inputs"
+            )
+            if declared:
+                names = frozenset(declared)
+        except Exception:
             names = None
-            try:
-                spec = self._inner.get_metadata(model_name, model_version)
-                declared = (getattr(spec, "extra", None) or {}).get(
-                    "ragged_inputs"
-                )
-                if declared:
-                    names = frozenset(declared)
-            except Exception:
-                names = None
-            self._ragged_inputs_cache[key] = names
-        return self._ragged_inputs_cache[key]
+        with self._ragged_cache_lock:
+            return self._ragged_inputs_cache.setdefault(key, names)
 
     # -- ragged execution -----------------------------------------------------
 
