@@ -99,9 +99,10 @@ SERVING_TAIL_S = 120.0      # merge-size precompiles + row-flush slack
 SERVING_MIN_WINDOW_S = 15.0  # floor per transport window (~20 batches)
 SERVING_MAX_WINDOW_S = 60.0
 # cheapest viable stage: the tail plus one minimum window per transport
-# row (3 rows) — below this the window formula would bottom out under
-# its own floor, so don't start at all
-SERVING_FLOOR_S = SERVING_TAIL_S + 3 * SERVING_MIN_WINDOW_S
+# row (5 rows: grpc/shm/uds/stream_b8 + the 3D row) — below this the
+# window formula would bottom out under its own floor, so don't start
+# at all
+SERVING_FLOOR_S = SERVING_TAIL_S + 5 * SERVING_MIN_WINDOW_S
 assert SERVING_FLOOR_S < SERVING_RESERVE_S
 
 # Wall-clock budget (VERDICT r3 #1): BENCH_r03.json shows the driver's
@@ -491,21 +492,29 @@ def measure_serving(
     """Serving-path benchmark (VERDICT r2 #3): N concurrent gRPC
     clients on localhost against the KServe server + micro-batcher —
     the Triton-equivalent surface whose metrics ARE the reference's
-    perf story (README.md:88-95). Two transports, one row each:
+    perf story (README.md:88-95). Four transports, one row each:
 
-      * wire — stock KServe raw tensors (what a remote client pays);
-      * shm  — the system shared-memory extension (what a same-host
-        client pays): request tensors travel as region coordinates and
-        the 786 KB frame payload is one memcpy instead of a protobuf
-        serialize/copy/deserialize in each process.
+      * grpc      — stock KServe raw tensors over loopback TCP (what a
+        remote client pays);
+      * shm       — the system shared-memory extension (the same-host
+        auto-negotiated default): request tensors travel as region
+        coordinates and the 786 KB frame payload is one memcpy instead
+        of a protobuf serialize/copy/deserialize in each process;
+      * uds       — shm tensors with the control plane on a unix
+        socket instead of loopback TCP;
+      * stream_b8 — uds+shm through ModelStreamInfer with 8-frame
+        groups: one message carries 8 packed frames, so the
+        per-message protocol cost is paid once per group.
 
-    The gap between either row and the in-process primary is the
-    serving overhead; the gap BETWEEN the rows is the wire codec's
-    share of it. Each row reports served fps, request p50/p99, and the
-    batcher's merge-size histogram, alongside the two environment
-    probes (upload_mbps, direct_batch_ms) that dominate this rig. A
-    mode that completes zero requests degrades to a value-0 row with
-    the error note — the decomposition fields stay meaningful.
+    The gap between any row and the in-process primary is the serving
+    overhead; the gaps BETWEEN the rows decompose it (codec vs TCP vs
+    per-message cost). Each row reports served fps, ``host_gap_ratio``
+    (served fps / device ceiling — the headline the tentpole moves),
+    request p50/p99, and the batcher's merge-size histogram, alongside
+    the two environment probes (upload_mbps, direct_batch_ms) that
+    dominate this rig. A mode that completes zero requests degrades to
+    a value-0 row with the error note — the decomposition fields stay
+    meaningful.
 
     Round 4 (VERDICT r3 #2): the batcher forms device batches at slot
     time with ``max_merge`` > admission size, power-of-two bucket
@@ -671,15 +680,33 @@ def measure_serving(
         max_merge=max_merge, pad_to_buckets=True,
     )
     server = InferenceServer(
-        repo, batching, address="127.0.0.1:0", max_workers=clients + 8
+        repo, batching, address="127.0.0.1:0", uds_address="auto",
+        max_workers=clients + 8,
     )
     server.start()
     addr = f"127.0.0.1:{server.port}"
     replica_servers: list = []  # BENCH_REPLICAS extra front-door targets
 
-    def run_mode(use_shm: bool) -> dict:
+    # per-transport serving rows (ISSUE 13): the host-gap story needs
+    # one row per transport the host path offers, not just wire-vs-shm
+    #   grpc      — loopback TCP, raw protobuf tensors (remote-client
+    #               cost model)
+    #   shm       — loopback TCP control + shared-memory tensors (the
+    #               same-host default)
+    #   uds       — unix socket control + shared-memory tensors
+    #   stream_b8 — uds+shm with 8-frame stream groups: the per-message
+    #               protocol cost paid once per 8 frames
+    _TRANSPORT_MODES = {
+        "grpc": dict(use_shm=False, uds=False, group=1),
+        "shm": dict(use_shm=True, uds=False, group=1),
+        "uds": dict(use_shm=True, uds=True, group=1),
+        "stream_b8": dict(use_shm=True, uds=True, group=8),
+    }
+
+    def run_mode(transport: str) -> dict:
         from triton_client_tpu.utils.loadgen import run_pool
 
+        mode = _TRANSPORT_MODES[transport]
         stats0 = {}
 
         def window_start():
@@ -691,19 +718,22 @@ def measure_serving(
             stats0.update(batching.stats())
 
         res = run_pool(
-            addr,
+            server.uds_address if mode["uds"] else addr,
             spec.name,
             {"images": frame},
             clients=clients,
             duration_s=duration_s,
             deadline_s=deadline_s,
-            use_shared_memory=use_shm,
+            use_shared_memory=mode["use_shm"],
+            mode="stream" if mode["group"] > 1 else "unary",
+            inflight=mode["group"],
+            stream_group=mode["group"],
             on_window_start=window_start,
         )
         stats = batching.stats()
         if res.errors:
             print(
-                f"serving bench ({'shm' if use_shm else 'wire'}) client "
+                f"serving bench ({transport}) client "
                 f"errors: {res.errors[:3]}",
                 file=sys.stderr,
             )
@@ -724,9 +754,12 @@ def measure_serving(
             "ragged_pad_rows", 0
         )
         mean_batch = (d_frames / d_merges) if d_merges else 0.0
-        suffix = "_shm" if use_shm else ""
+        # the wire row keeps its historical unsuffixed metric name so
+        # bench_diff comparisons line up across rounds
+        suffix = "" if transport == "grpc" else f"_{transport}"
         row = {
             "metric": f"yolov5n_512_served{suffix}_frames_per_sec",
+            "transport": transport,
             "value": round(res.fps, 2),
             "unit": "frames/sec",
             "vs_baseline": round(res.fps / CAMERA_FPS_BASELINE, 2),
@@ -778,6 +811,14 @@ def measure_serving(
             "device_ceiling_fps": round(
                 max_merge / (direct_batch_ms / 1e3), 2
             ),
+            # the host-gap headline: served rate as a fraction of what
+            # the device leg alone supports on this rig — 1.0 means the
+            # host transport costs nothing, the seed's shm row sat at
+            # ~0.01 on BENCH_r05's rig
+            "host_gap_ratio": round(
+                res.fps / max(1e-9, max_merge / (direct_batch_ms / 1e3)),
+                4,
+            ),
             "client_errors": len(res.errors),
             "device_batches": d_merges,
             "mean_batch": round(float(mean_batch), 2),
@@ -826,18 +867,22 @@ def measure_serving(
 
     rows = []
     try:
-        for use_shm in (False, True):
-            if use_shm and _remaining() < 100.0:
-                # the wire row is already captured; a second transport
+        for transport in ("grpc", "shm", "uds", "stream_b8"):
+            if transport != "grpc" and _remaining() < 100.0:
+                # the wire row is already captured; further transports
                 # must not drag the run past the external cap
                 print(
-                    f"serving shm mode skipped: {_remaining():.0f}s "
-                    f"left", file=sys.stderr,
+                    f"serving {transport} mode skipped: "
+                    f"{_remaining():.0f}s left", file=sys.stderr,
                 )
                 break
             try:
-                row = run_mode(use_shm)
-                if not use_shm and row["request_p50_ms"] and _remaining() > 240.0:
+                row = run_mode(transport)
+                if (
+                    transport == "grpc"
+                    and row["request_p50_ms"]
+                    and _remaining() > 240.0
+                ):
                     # open-loop SLO capacity on the wire transport: the
                     # MLPerf server-scenario number (max offered qps at
                     # p99 <= SLO) next to the closed-loop fps. SLO =
@@ -922,8 +967,7 @@ def measure_serving(
                     on_row(row)  # emitted the moment it exists
             except Exception as e:
                 print(
-                    f"serving mode {'shm' if use_shm else 'wire'} "
-                    f"failed: {e}",
+                    f"serving mode {transport} failed: {e}",
                     file=sys.stderr,
                 )
         # 3D served row (VERDICT r4 Weak #2: serving evidence was
@@ -1551,7 +1595,7 @@ def main() -> None:
                     SERVING_MAX_WINDOW_S,
                     max(
                         SERVING_MIN_WINDOW_S,
-                        (_remaining() - SERVING_TAIL_S) / 3,
+                        (_remaining() - SERVING_TAIL_S) / 5,
                     ),
                 ),
                 on_row=lambda row: (_emit_row(row, primary=False),

@@ -112,11 +112,24 @@ TPU_FAULT_SEED=7 python -m pytest tests/test_router.py -q -m '' \
     --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly
 
+echo "== transport shard (shm pool, UDS, stream groups, parity) =="
+# the host-transport contract (channel/transport.py, the shm region
+# pool, UDS listener, multi-frame stream groups, wire encodings):
+# bitwise wire/shm/stream parity on 2D and 3D shapes, the 8-thread
+# no-alias gate over the region pool, shm_detach restart recovery,
+# and transport metrics — named by its shard so a zero-copy-path
+# regression is visible before the tier-1 wall
+python -m pytest tests/test_transport.py tests/test_shared_memory.py \
+    -q -m '' \
+    --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+
 echo "== bench diff (optional shard: fresh bench vs BENCH_LOCAL.json) =="
 # perf-regression gate: compares a freshly produced bench results file
 # (BENCH_FRESH=<results.json>, written by a perf/ script on real
 # hardware) against the committed BENCH_LOCAL.json and fails on a >10%
-# throughput or MFU regression. Skipped — loudly — when no fresh row
+# throughput, MFU, or host_gap_ratio (served fps / device ceiling)
+# regression. Skipped — loudly — when no fresh row
 # exists: CI containers have no accelerator to produce one.
 if [[ -n "${BENCH_FRESH:-}" && -f "${BENCH_FRESH}" ]]; then
     python perf/bench_diff.py "${BENCH_FRESH}" --baseline BENCH_LOCAL.json

@@ -7,7 +7,9 @@ nonzero when either
 
   * throughput (``value``, frames/scans per sec per chip) regressed by
     more than the threshold (default 10%), or
-  * ``mfu`` dropped by more than the threshold
+  * ``mfu`` dropped by more than the threshold, or
+  * ``host_gap_ratio`` (serving rows: served fps / device ceiling)
+    dropped by more than the threshold
 
 — so a perf regression fails CI the same way a test failure does.
 ci.sh runs this as an OPTIONAL shard: only when a fresh row exists
@@ -67,7 +69,15 @@ def diff_rows(
         if b_row is None:
             lines.append(f"  {metric}: NEW (no baseline)")
             continue
-        for key, label in (("value", "throughput"), ("mfu", "mfu")):
+        for key, label in (
+            ("value", "throughput"),
+            ("mfu", "mfu"),
+            # the serving rows' host-gap headline (served fps /
+            # device ceiling): a transport-stack regression can hide
+            # inside a faster device (value improves while the host
+            # share of the ceiling collapses) — gate the ratio itself
+            ("host_gap_ratio", "host_gap_ratio"),
+        ):
             f_v, b_v = f_row.get(key), b_row.get(key)
             if f_v is None or b_v is None or not b_v:
                 continue

@@ -85,13 +85,23 @@ def main(argv=None) -> None:
     server.start()
     addr = f"127.0.0.1:{server.port}"
 
+    # use_shared_memory is pinned per case: loopback channels now
+    # auto-negotiate shm by default, which would silently turn every
+    # "wire" case into an shm case
     cases = [
-        ("unary_wire", dict(mode="unary")),
+        ("unary_wire", dict(mode="unary", use_shared_memory=False)),
         ("unary_shm", dict(mode="unary", use_shared_memory=True)),
-        ("stream_wire_if1", dict(mode="stream", inflight=1)),
-        ("stream_wire_if4", dict(mode="stream", inflight=4)),
-        ("async_wire_if2", dict(mode="async", inflight=2)),
-        ("async_wire_if4", dict(mode="async", inflight=4)),
+        ("stream_wire_if1", dict(
+            mode="stream", inflight=1, use_shared_memory=False)),
+        ("stream_wire_if4", dict(
+            mode="stream", inflight=4, use_shared_memory=False)),
+        ("stream_shm_b4", dict(
+            mode="stream", inflight=4, stream_group=4,
+            use_shared_memory=True)),
+        ("async_wire_if2", dict(
+            mode="async", inflight=2, use_shared_memory=False)),
+        ("async_wire_if4", dict(
+            mode="async", inflight=4, use_shared_memory=False)),
     ]
     try:
         for name, kw in cases:

@@ -161,7 +161,10 @@ def test_channel_infer3d_over_grpc(yolo_server):
                           address="127.0.0.1:0", max_workers=2)
     srv.start()
     try:
-        channel = GRPCChannel(f"127.0.0.1:{srv.port}", timeout_s=10.0)
+        # loopback auto-negotiates shm; force pure wire for the control
+        channel = GRPCChannel(
+            f"127.0.0.1:{srv.port}", timeout_s=10.0, use_shared_memory=False
+        )
         # extra must survive the wire (ModelConfig parameters map)
         assert channel.get_metadata("pp3d").extra["point_buckets"] == [64]
         infer = channel_infer3d(channel, "pp3d")

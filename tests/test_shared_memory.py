@@ -217,16 +217,20 @@ class TestLiveShmServer:
 
     def test_shm_channel_matches_wire_channel(self, server):
         addr = f"127.0.0.1:{server.port}"
-        wire = GRPCChannel(addr, timeout_s=10.0)
+        # loopback auto-negotiates shm; force pure wire for the control
+        wire = GRPCChannel(addr, timeout_s=10.0, use_shared_memory=False)
         shm = GRPCChannel(addr, timeout_s=10.0, use_shared_memory=True)
         x = np.random.default_rng(1).random((3, 4)).astype(np.float32)
         req = InferRequest(model_name="addone", inputs={"x": x})
         try:
+            assert wire.transport == "grpc"
+            assert shm.transport == "shm"
             a = wire.do_inference(req).outputs["y"]
             b = shm.do_inference(req).outputs["y"]
             np.testing.assert_array_equal(a, b)
             np.testing.assert_allclose(b, x + 1.0)
-            # one registered region, one backing segment
+            # one input region from the shm channel's pool slot; the
+            # wire control registered nothing
             assert len(server.shm_registry.status()) == 1
         finally:
             shm.close()
@@ -244,7 +248,9 @@ class TestLiveShmServer:
                     InferRequest(model_name="addone", inputs={"x": x})
                 ).outputs["y"]
                 np.testing.assert_allclose(out, x + 1.0)
-            assert len(server.shm_registry.status()) == 1
+            # generation-tagged growth retires the old segment: one
+            # live input region plus the learned output arena
+            assert len(server.shm_registry.status()) == 2
         finally:
             shm.close()
 
@@ -418,7 +424,9 @@ class TestSecurityAndRecovery:
             np.testing.assert_allclose(
                 chan.do_inference(req).outputs["y"], x + 1.0
             )
-            assert len(server.shm_registry.status()) == 1
+            # recovery re-registered the input region; the second
+            # request also carries the learned output arena
+            assert len(server.shm_registry.status()) == 2
         finally:
             chan.close()
             server.stop()

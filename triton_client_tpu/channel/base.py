@@ -52,6 +52,13 @@ class InferRequest:
     ragged: object | None = dataclasses.field(
         default=None, repr=False, compare=False
     )
+    # per-input-tensor wire parameters (input name -> params dict),
+    # e.g. runtime/wire_encoding's ``content_encoding`` for inputs that
+    # travel compressed (JPEG bytes, quantized pointclouds) and decode
+    # server-side. Only remote channels read it; None on the hot path.
+    input_params: Mapping[str, dict] | None = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
 
 
 @dataclasses.dataclass

@@ -20,14 +20,20 @@ TPUChannel implements. Departures from the reference:
 
 from __future__ import annotations
 
+import collections
 import itertools
+import json
 import logging
 import os
 import random
+import threading
 import time
+import weakref
 
 import grpc
+import numpy as np
 
+from triton_client_tpu.channel import transport as transports
 from triton_client_tpu.channel.base import (
     BaseChannel,
     InferFuture,
@@ -37,6 +43,7 @@ from triton_client_tpu.channel.base import (
 from triton_client_tpu.channel.kserve import codec, pb, service
 from triton_client_tpu.config import FRAMING_BYTES, ModelSpec, TensorSpec
 from triton_client_tpu.obs.trace import SUMMARY_PARAM_KEY, TraceContext
+from triton_client_tpu.runtime.shared_memory import ShmRegionPool
 
 log = logging.getLogger(__name__)
 
@@ -109,6 +116,21 @@ class DeadlineExceededRpcError(grpc.RpcError):
 # channel instances (live or dead) ever share a name prefix
 _SHM_CHANNEL_SEQ = itertools.count()
 
+# A server that answers the shm extension with one of these codes does
+# not serve it at all (stock gRPC UNIMPLEMENTED, the server's same-host
+# PERMISSION_DENIED gate for tunneled "loopback" dials, fake test
+# servicers' UNKNOWN): an auto-negotiated channel falls back to the
+# wire permanently instead of failing every request. INVALID_ARGUMENT
+# is deliberately absent — that is the restart-recovery signal.
+_SHM_UNSUPPORTED = (
+    grpc.StatusCode.UNIMPLEMENTED,
+    grpc.StatusCode.PERMISSION_DENIED,
+    grpc.StatusCode.UNKNOWN,
+)
+
+# per-output alignment inside a slot's output arena (cache-line)
+_SHM_OUT_ALIGN = 64
+
 
 class GRPCChannel(BaseChannel):
     def __init__(
@@ -118,21 +140,30 @@ class GRPCChannel(BaseChannel):
         timeout_s: float = 30.0,
         retries: int = 3,
         backoff_s: float = 0.1,
-        use_shared_memory: bool = False,
+        use_shared_memory: bool | None = None,
+        pipeline_depth: int = 4,
     ) -> None:
         """``use_shared_memory``: same-host transport — inputs are
         written into client-owned POSIX shm segments and requests carry
         only region coordinates (Triton system-shared-memory
         extension), skipping the protobuf serialize/copy/deserialize of
-        the tensor payload in both processes. Regions are created and
-        registered lazily per input name and sized to the largest array
-        seen. The shm path serializes do_inference calls on this
-        channel (a region must stay untouched until its response
-        arrives); use one channel per concurrent client. Only the
-        synchronous do_inference path uses shm — do_inference_async and
-        infer_stream fall back to the wire (a region may not be reused
-        while a request is in flight, which is exactly what pipelined
-        calls do; a warning is logged once)."""
+        the tensor payload in both processes. ``None`` (the default)
+        auto-detects: loopback and ``unix:`` endpoints with a usable
+        /dev/shm ride shm, everything else rides the wire
+        (channel/transport.py's eligibility matrix); a same-host-
+        looking endpoint whose server rejects the extension (a
+        tunnel, a stock server without it) degrades to the wire once
+        and permanently. ``True``/``False`` force the decision.
+
+        Regions live in a pool of ``pipeline_depth`` slots, each
+        generation-tagged per input and sized to the largest array
+        seen: ``do_inference``, ``do_inference_async`` and
+        ``infer_stream`` all ride shm concurrently — the
+        ``pipeline_depth+1``-th in-flight request blocks until a slot
+        frees (natural backpressure mirroring the server's staging
+        pipeline). Responses ride shm too once the channel has seen a
+        model's output sizes (requested-output windows in a per-slot
+        arena the server writes readback bytes into directly)."""
         self._endpoint = endpoint
         self._max_message_bytes = max_message_bytes
         self._timeout_s = timeout_s
@@ -141,27 +172,39 @@ class GRPCChannel(BaseChannel):
         self._channel: grpc.Channel | None = None
         self._stub: service.GRPCInferenceServiceStub | None = None
         self._retired: list[grpc.Channel] = []
-        self._use_shm = use_shared_memory
-        self._shm_regions: dict = {}  # input name -> SharedMemoryRegion
-        self._shm_gen: dict = {}      # input name -> segment generation
+        self._shm_auto = use_shared_memory is None
+        self._use_shm = (
+            transports.shm_eligible(endpoint)
+            if use_shared_memory is None
+            else bool(use_shared_memory)
+        )
+        self._pipeline_depth = max(1, int(pipeline_depth))
         # region names were keyed on id(self), which CPython reuses
         # after GC: a dead channel whose close() failed to unregister
         # server-side left a stale registry entry that a NEW channel
         # reusing the id would collide with forever. A process-wide
         # monotonic tag can never recur within the process.
         self._shm_tag = next(_SHM_CHANNEL_SEQ)
-        self._shm_lock = None
-        self._shm_async_warned = False
+        self._pool: ShmRegionPool | None = None
+        self._pool_lock = threading.Lock()
+        # learned response contract: model -> output name -> max bytes
+        # seen. The first request for a model gets its response over
+        # the wire; every later one carries requested-output windows
+        # sized from this map, so responses bypass the wire too.
+        self._learned_out: dict[str, dict[str, int]] = {}
         # client-side overload ledger: sheds the server sent back
         # (RESOURCE_EXHAUSTED on ModelInfer — never retried) vs
         # transient retries the ladder absorbed
         self._infer_rejections = 0
         self._retries_total = 0
-        if use_shared_memory:
-            import threading
-
-            self._shm_lock = threading.Lock()
         self.register_channel()
+
+    @property
+    def transport(self) -> str:
+        """Negotiated transport label: ``grpc`` / ``uds`` / ``shm`` /
+        ``uds+shm`` (channel/transport.py). Reported by stats(), the
+        route CLI, and bench rows."""
+        return transports.negotiated(self._endpoint, self._use_shm)
 
     # -- BaseChannel protocol -------------------------------------------------
 
@@ -214,14 +257,31 @@ class GRPCChannel(BaseChannel):
         return spec
 
     def do_inference(self, request: InferRequest) -> InferResponse:
+        # fail-fast BEFORE any transport work: the shm path's region
+        # registration is itself a wire RPC, and an already-expired
+        # deadline must surface as DEADLINE_EXCEEDED, not whatever
+        # that RPC happens to return
+        if (
+            request.deadline_s is not None
+            and request.deadline_s - time.perf_counter() <= 0
+        ):
+            raise DeadlineExceededRpcError(
+                "deadline expired before ModelInfer was issued"
+            )
         if self._use_shm:
-            return self._do_inference_shm(request)
+            try:
+                return self._do_inference_shm(request)
+            except grpc.RpcError as e:
+                if not self._maybe_disable_shm(e):
+                    raise
+                # degraded to the wire (server lacks the extension)
         wire = codec.build_infer_request(
             model_name=request.model_name,
             inputs=request.inputs,
             model_version=request.model_version,
             request_id=request.request_id,
             parameters=_wire_params(request),
+            input_parameters=request.input_params,
         )
         t0 = time.perf_counter()
         try:
@@ -245,153 +305,224 @@ class GRPCChannel(BaseChannel):
 
     # -- shared-memory transport ----------------------------------------------
 
-    def _warn_shm_wire_fallback(self) -> None:
-        if self._use_shm and not self._shm_async_warned:
-            self._shm_async_warned = True
-            log.warning(
-                "use_shared_memory only covers synchronous do_inference; "
-                "async/streamed requests travel over the wire (pipelined "
-                "calls would reuse a region while it is still in flight)"
-            )
+    def _shm_pool(self) -> ShmRegionPool:
+        pool = self._pool
+        if pool is not None:
+            return pool
+        with self._pool_lock:
+            if self._pool is None:
+                # the pool's RPC callbacks must not hold a strong ref
+                # back to the channel: channel->pool->bound-method->
+                # channel is a cycle, and a CLI that simply drops its
+                # channel then relies on refcount-immediate __del__ to
+                # unregister + unlink /dev/shm segments — gc-deferred
+                # teardown leaves regions registered on the server
+                def _weak(method):
+                    ref = weakref.WeakMethod(method)
 
-    def _shm_region_for(self, name: str, nbytes: int):
-        """Client-owned region for one input, grown when outsized.
-        Region/segment names are unique per channel instance so many
-        clients can share a server. Growth generation-tags the segment
-        name (the registry rejects duplicate names) and replaces the
-        old registration only AFTER the new one succeeds, so a failed
-        register RPC leaks nothing and leaves the old region usable."""
-        from triton_client_tpu.runtime.shared_memory import SharedMemoryRegion
+                    def call(*a):
+                        fn = ref()
+                        if fn is not None:
+                            fn(*a)
 
-        region = self._shm_regions.get(name)
-        if region is not None and region.size >= nbytes:
-            return region
-        # every attempt burns a generation so a failed register (which
-        # may have executed server-side) never reuses its segment name
-        gen = self._shm_gen.get(name, 0)
-        self._shm_gen[name] = gen + 1
-        rname = f"tct_{os.getpid()}_{self._shm_tag}_{name}_{gen}"
-        new = SharedMemoryRegion.create(f"/{rname}", max(nbytes, 1))
+                    return call
+
+                self._pool = ShmRegionPool(
+                    tag=f"tct_{os.getpid()}_{self._shm_tag}",
+                    depth=self._pipeline_depth,
+                    register_fn=_weak(self._shm_register),
+                    unregister_fn=_weak(self._shm_unregister_quiet),
+                )
+            return self._pool
+
+    def _shm_register(self, name: str, key: str, byte_size: int) -> None:
+        # no retry: register is not idempotent (duplicate names are
+        # rejected), and it is a fast metadata RPC — a transient
+        # failure surfaces to the caller, who may simply call again
+        self._call(
+            self._stub.SystemSharedMemoryRegister,
+            pb.SystemSharedMemoryRegisterRequest(
+                name=name, key=key, offset=0, byte_size=byte_size
+            ),
+            retryable=(),
+        )
+
+    def _shm_unregister_quiet(self, name: str) -> None:
+        """Best-effort unregister (growth path, recovery's duplicate-
+        name guard, teardown): failure must never mask the operation
+        that needed it."""
         try:
-            # no retry: register is not idempotent (duplicate names are
-            # rejected), and it is a fast metadata RPC — a transient
-            # failure surfaces to the caller, who may simply call again
-            self._call(
-                self._stub.SystemSharedMemoryRegister,
-                pb.SystemSharedMemoryRegisterRequest(
-                    name=rname, key=new.key, offset=0, byte_size=new.size
-                ),
-                retryable=(),
+            self._stub.SystemSharedMemoryUnregister(
+                pb.SystemSharedMemoryUnregisterRequest(name=name),
+                timeout=min(self._timeout_s, 2.0),
             )
-        except Exception:
-            new.close()  # unlinks; the server maps the file by its own
-            # fd if it did register, so unlinking is safe either way
-            raise
-        if region is not None:
-            old_name = region.key.lstrip("/")
-            try:
-                self._call(
-                    self._stub.SystemSharedMemoryUnregister,
-                    pb.SystemSharedMemoryUnregisterRequest(name=old_name),
-                    retryable=(),
-                )
-            except grpc.RpcError:
-                log.warning(
-                    "could not unregister outgrown region %s", old_name
-                )
-            region.close()
-        self._shm_regions[name] = new
-        return new
+        except grpc.RpcError as e:
+            log.debug("unregister of shm region %s failed (%s)", name, e)
 
-    def _do_inference_shm(self, request: InferRequest) -> InferResponse:
-        import numpy as np
+    def _maybe_disable_shm(self, e: grpc.RpcError) -> bool:
+        """Auto-negotiation escape hatch: a server that answers the shm
+        extension with UNIMPLEMENTED / PERMISSION_DENIED / UNKNOWN does
+        not serve it (stock server, tunneled dial that only LOOKS
+        loopback, fake test servicer) — flip this channel to the wire
+        permanently and tell the caller to re-issue there. Forced
+        ``use_shared_memory=True`` never degrades."""
+        code = e.code() if hasattr(e, "code") else None
+        if self._shm_auto and code in _SHM_UNSUPPORTED:
+            log.info(
+                "endpoint %s does not serve the shared-memory extension "
+                "(%s); negotiated transport falls back to the wire",
+                self._endpoint, code,
+            )
+            self._use_shm = False
+            return True
+        return False
 
-        with self._shm_lock:
+    def _stage_shm(
+        self,
+        request: InferRequest,
+        extra_params: dict | None = None,
+        acquire_timeout_s: float | None = None,
+    ):
+        """Acquire a pool slot, write the request's inputs into its
+        regions, and build the coordinate-carrying wire message.
+        Returns ``(wire, slot)`` with the slot owned by the caller (it
+        must be released when the response is parsed or the request
+        abandoned). Known output sizes additionally attach requested-
+        output windows in the slot's arena so the response bypasses
+        the wire too. ``acquire_timeout_s`` overrides how long to wait
+        for a free slot (the async path passes 0: a caller that
+        pipelines PAST the pool depth overflows onto the wire rather
+        than deadlocking its own issuing thread, since slots only free
+        when that thread resolves futures)."""
+        pool = self._shm_pool()
+        slot = pool.acquire(
+            timeout_s=self._timeout_s
+            if acquire_timeout_s is None
+            else acquire_timeout_s
+        )
+        try:
             shm_inputs = {}
             arrays = {}
             for name, value in request.inputs.items():
-                arr = np.ascontiguousarray(np.asarray(value))
-                arrays[name] = arr
-                region = self._shm_region_for(name, arr.nbytes)
+                arr = np.asarray(value)
+                region = slot.region_for(f"i_{name}", arr.nbytes)
                 region.write(arr)
-                rname = region.key.lstrip("/")
-                shm_inputs[name] = (rname, 0, arr.nbytes)
+                shm_inputs[name] = (region.key.lstrip("/"), 0, arr.nbytes)
+                arrays[name] = arr
+            params = _wire_params(request)
+            if extra_params:
+                params = {**(params or {}), **extra_params}
             wire = codec.build_infer_request_shm(
                 model_name=request.model_name,
                 inputs=arrays,
                 shm_inputs=shm_inputs,
                 model_version=request.model_version,
                 request_id=request.request_id,
-                parameters=_wire_params(request),
+                parameters=params,
+                input_parameters=request.input_params,
             )
+            self._request_shm_outputs(wire, slot, request.model_name)
+            return wire, slot
+        except BaseException:
+            pool.release(slot)
+            raise
+
+    def _request_shm_outputs(self, wire, slot, model_name: str) -> None:
+        """Attach requested-output windows (learned sizes, cache-line
+        aligned) in the slot's output arena. No-op until a first
+        response has taught the channel this model's output sizes."""
+        sizes = self._learned_out.get(model_name)
+        if not sizes:
+            return
+        offsets = {}
+        total = 0
+        for name in sorted(sizes):
+            offsets[name] = total
+            total += -(-sizes[name] // _SHM_OUT_ALIGN) * _SHM_OUT_ALIGN
+        arena = slot.region_for("o", total)
+        rname = arena.key.lstrip("/")
+        for name, off in offsets.items():
+            codec.add_requested_output(wire, name, rname, off, sizes[name])
+
+    def _parse_shm_response(
+        self, resp, slot, model_name: str, t0: float
+    ) -> InferResponse:
+        regions = {}
+        arena = slot.regions.get("o")
+        if arena is not None:
+            regions[arena.key.lstrip("/")] = arena
+        outputs = codec.parse_infer_response(resp, regions=regions)
+        if arena is not None:
+            # arena views die with the slot (the next request on this
+            # slot overwrites them): materialize arena-backed outputs
+            # into owned arrays — the single designed host copy on the
+            # response path, replacing protobuf serialize + framing +
+            # parse. Wire-backed views keep their protobuf buffer.
+            arena_outs = {
+                t.name for t in resp.outputs
+                if codec.shm_params(t) is not None
+            }
+            for name in arena_outs:
+                outputs[name] = np.copy(outputs[name])
+        sizes = self._learned_out.setdefault(model_name, {})
+        for name, arr in outputs.items():
+            if sizes.get(name, 0) < arr.nbytes:
+                sizes[name] = arr.nbytes
+        return InferResponse(
+            model_name=resp.model_name,
+            model_version=resp.model_version,
+            outputs=outputs,
+            request_id=resp.id,
+            latency_s=time.perf_counter() - t0,
+            parameters=_response_params(resp),
+        )
+
+    def _recover_shm(self, e: grpc.RpcError, wire, request: InferRequest):
+        """A restarted server has an empty registry: its
+        INVALID_ARGUMENT 'not registered' is recoverable by
+        re-registering the pool's segments and re-issuing once — the
+        wire path recovers from restarts via the UNAVAILABLE ladder,
+        the shm path must not be worse."""
+        if not (
+            e.code() == grpc.StatusCode.INVALID_ARGUMENT
+            and "not registered" in (e.details() or "")
+        ):
+            self._record_infer_error(e)
+            raise e
+        pool = self._shm_pool()
+        log.warning(
+            "server lost shared-memory registrations (%s); "
+            "re-registering %d region(s)",
+            e.details(), len(pool.regions()),
+        )
+        pool.reregister_all()
+        return self._call(
+            self._stub.ModelInfer,
+            wire,
+            retryable=_INFER_RETRYABLE,
+            deadline_s=request.deadline_s,
+        )
+
+    def _do_inference_shm(self, request: InferRequest) -> InferResponse:
+        wire, slot = self._stage_shm(request)
+        pool = self._pool
+        try:
             t0 = time.perf_counter()
             try:
                 # UNAVAILABLE-only retry, same contract as the wire path
                 resp = self._call(
-                    self._stub.ModelInfer, wire, retryable=_INFER_RETRYABLE
+                    self._stub.ModelInfer,
+                    wire,
+                    retryable=_INFER_RETRYABLE,
+                    deadline_s=request.deadline_s,
                 )
             except grpc.RpcError as e:
-                # a restarted server has an empty registry: its
-                # INVALID_ARGUMENT 'not registered' is recoverable by
-                # re-registering our cached segments and re-issuing
-                # once — the wire path recovers from restarts via the
-                # UNAVAILABLE ladder, the shm path must not be worse
-                if not (
-                    e.code() == grpc.StatusCode.INVALID_ARGUMENT
-                    and "not registered" in (e.details() or "")
-                ):
-                    raise
-                log.warning(
-                    "server lost shared-memory registrations (%s); "
-                    "re-registering %d region(s)",
-                    e.details(), len(self._shm_regions),
-                )
-                for region in self._shm_regions.values():
-                    rname = region.key.lstrip("/")
-                    try:
-                        # unregister first: if only SOME regions were
-                        # lost, a blind re-register would hit the
-                        # duplicate-name rejection (unknown-name
-                        # unregister is a no-op). It is ONLY that
-                        # guard — a transient failure here must not
-                        # abort the recovery mid-loop and mask the
-                        # original 'not registered' with an unrelated
-                        # error while _shm_regions sits half-recovered
-                        self._stub.SystemSharedMemoryUnregister(
-                            pb.SystemSharedMemoryUnregisterRequest(
-                                name=rname
-                            ),
-                            timeout=self._timeout_s,
-                        )
-                    except grpc.RpcError as ue:
-                        log.warning(
-                            "duplicate-name guard unregister of %s "
-                            "failed (%s); attempting register anyway",
-                            rname, ue,
-                        )
-                    # a failed register surfaces here with the
-                    # recovery context still in the log above
-                    self._call(
-                        self._stub.SystemSharedMemoryRegister,
-                        pb.SystemSharedMemoryRegisterRequest(
-                            name=rname,
-                            key=region.key,
-                            offset=0,
-                            byte_size=region.size,
-                        ),
-                        retryable=(),
-                    )
-                resp = self._call(
-                    self._stub.ModelInfer, wire, retryable=_INFER_RETRYABLE
-                )
-            return InferResponse(
-                model_name=resp.model_name,
-                model_version=resp.model_version,
-                outputs=codec.parse_infer_response(resp),
-                request_id=resp.id,
-                latency_s=time.perf_counter() - t0,
-                parameters=_response_params(resp),
+                resp = self._recover_shm(e, wire, request)
+            return self._parse_shm_response(
+                resp, slot, request.model_name, t0
             )
+        finally:
+            pool.release(slot)
 
     def do_inference_async(self, request: InferRequest) -> InferFuture:
         """Non-blocking ModelInfer via a gRPC call future (the --async
@@ -400,6 +531,12 @@ class GRPCChannel(BaseChannel):
         code safe to re-issue, see _call) falls back to the sync retry
         ladder at resolution time; all other errors surface at result().
 
+        On a shm-negotiated channel the async path rides shm too: each
+        in-flight request owns a pool slot (released at resolution), so
+        up to ``pipeline_depth`` async calls overlap without ever
+        aliasing a live region — the pre-round-13 wire fallback and its
+        one-time warning are gone.
+
         The returned future is cancellable and subscribable (see
         InferFuture): cancel() abandons the wire call, and
         add_done_callback fires on the gRPC completion thread — the
@@ -407,7 +544,29 @@ class GRPCChannel(BaseChannel):
         release the loser's replica slot. The resolution-time retry
         fallback honors request.deadline_s, so a failover retry never
         sleeps past the caller's budget."""
-        self._warn_shm_wire_fallback()
+        # same pre-transport fail-fast as do_inference: async contract
+        # says errors surface at result(), so wrap it in a future
+        if (
+            request.deadline_s is not None
+            and request.deadline_s - time.perf_counter() <= 0
+        ):
+            return InferFuture.failed(
+                DeadlineExceededRpcError(
+                    "deadline expired before async ModelInfer was issued"
+                )
+            )
+        if self._use_shm:
+            try:
+                return self._do_inference_async_shm(request)
+            except TimeoutError:
+                # pool exhausted: the overflow request rides the wire
+                # (see _stage_shm — blocking here could deadlock a
+                # single-threaded pipelining driver)
+                pass
+            except grpc.RpcError as e:
+                if not self._maybe_disable_shm(e):
+                    # async contract: errors surface at result()
+                    return InferFuture.failed(e)
         try:
             wire = codec.build_infer_request(
                 model_name=request.model_name,
@@ -415,17 +574,10 @@ class GRPCChannel(BaseChannel):
                 model_version=request.model_version,
                 request_id=request.request_id,
                 parameters=_wire_params(request),
+                input_parameters=request.input_params,
             )
             t0 = time.perf_counter()
-            timeout = self._timeout_s
-            if request.deadline_s is not None:
-                remaining = request.deadline_s - t0
-                if remaining <= 0:
-                    raise DeadlineExceededRpcError(
-                        "deadline expired before async ModelInfer was issued"
-                    )
-                timeout = min(timeout, remaining)
-            call = self._stub.ModelInfer.future(wire, timeout=timeout)
+            call = self._issue_async(wire, request.deadline_s)
         except Exception as e:  # async contract: errors surface at result()
             return InferFuture.failed(e)
 
@@ -433,28 +585,7 @@ class GRPCChannel(BaseChannel):
             try:
                 resp = call.result()
             except grpc.RpcError as e:
-                self._record_infer_error(e)
-                code = e.code() if hasattr(e, "code") else None
-                # Only connection-level failures (UNAVAILABLE) are
-                # re-issued automatically — the code least likely to mean
-                # the request executed server-side (no such gRPC code
-                # guarantees it). DEADLINE_EXCEEDED/RESOURCE_EXHAUSTED
-                # requests frequently HAVE executed, so re-running those
-                # is unsafe for non-idempotent models and doubles load
-                # exactly when the server is saturated. CANCELLED means
-                # our own cancel() won the race — never re-issue it.
-                if code not in _INFER_RETRYABLE:
-                    raise
-                log.warning(
-                    "async ModelInfer failed (%s); re-issuing on the "
-                    "sync retry path", code,
-                )
-                resp = self._call(
-                    self._stub.ModelInfer,
-                    wire,
-                    retryable=_INFER_RETRYABLE,
-                    deadline_s=request.deadline_s,
-                )
+                resp = self._async_retry(e, wire, request)
             return InferResponse(
                 model_name=resp.model_name,
                 model_version=resp.model_version,
@@ -467,6 +598,88 @@ class GRPCChannel(BaseChannel):
         return InferFuture(
             resolve,
             cancel=call.cancel,
+            subscribe=lambda fn: call.add_done_callback(lambda _c: fn()),
+        )
+
+    def _issue_async(self, wire, deadline_s: float | None):
+        t0 = time.perf_counter()
+        timeout = self._timeout_s
+        if deadline_s is not None:
+            remaining = deadline_s - t0
+            if remaining <= 0:
+                raise DeadlineExceededRpcError(
+                    "deadline expired before async ModelInfer was issued"
+                )
+            timeout = min(timeout, remaining)
+        return self._stub.ModelInfer.future(wire, timeout=timeout)
+
+    def _async_retry(self, e: grpc.RpcError, wire, request: InferRequest):
+        """Resolution-time fallback shared by the wire and shm async
+        paths. Only connection-level failures (UNAVAILABLE) are
+        re-issued automatically — the code least likely to mean the
+        request executed server-side (no such gRPC code guarantees
+        it). DEADLINE_EXCEEDED/RESOURCE_EXHAUSTED requests frequently
+        HAVE executed, so re-running those is unsafe for
+        non-idempotent models and doubles load exactly when the server
+        is saturated. CANCELLED means our own cancel() won the race —
+        never re-issue it."""
+        self._record_infer_error(e)
+        code = e.code() if hasattr(e, "code") else None
+        if code not in _INFER_RETRYABLE:
+            raise e
+        log.warning(
+            "async ModelInfer failed (%s); re-issuing on the "
+            "sync retry path", code,
+        )
+        return self._call(
+            self._stub.ModelInfer,
+            wire,
+            retryable=_INFER_RETRYABLE,
+            deadline_s=request.deadline_s,
+        )
+
+    def _do_inference_async_shm(self, request: InferRequest) -> InferFuture:
+        wire, slot = self._stage_shm(request, acquire_timeout_s=0.0)
+        pool = self._pool
+        try:
+            t0 = time.perf_counter()
+            call = self._issue_async(wire, request.deadline_s)
+        except BaseException:
+            pool.release(slot)
+            raise
+
+        def resolve() -> InferResponse:
+            try:
+                try:
+                    resp = call.result()
+                except grpc.RpcError as e:
+                    if (
+                        e.code() == grpc.StatusCode.INVALID_ARGUMENT
+                        and "not registered" in (e.details() or "")
+                    ):
+                        resp = self._recover_shm(e, wire, request)
+                    else:
+                        resp = self._async_retry(e, wire, request)
+                return self._parse_shm_response(
+                    resp, slot, request.model_name, t0
+                )
+            finally:
+                pool.release(slot)
+
+        def cancel() -> bool:
+            ok = call.cancel()
+            if ok:
+                # the server may still write this request's outputs
+                # into the arena arbitrarily late: retire it (next use
+                # re-creates a fresh generation) so the slot's next
+                # owner can never be corrupted by a ghost write
+                slot.retire("o")
+                pool.release(slot)
+            return ok
+
+        return InferFuture(
+            resolve,
+            cancel=cancel,
             subscribe=lambda fn: call.add_done_callback(lambda _c: fn()),
         )
 
@@ -524,10 +737,140 @@ class GRPCChannel(BaseChannel):
         )
         return [(m.name, m.version, m.state) for m in resp.models]
 
-    def infer_stream(self, requests, stream_timeout_s: float | None = 3600.0):
+    def _stream_groups(self, requests, group_size: int):
+        """Batch consecutive compatible requests into frame groups of
+        up to ``group_size`` for the multi-frame stream protocol. A
+        request joins a group only when it matches the group head on
+        model/version/priority and every input's shape+dtype, carries
+        no trace or per-input params, and all inputs have a leading
+        axis to pack along; anything else flushes the group and streams
+        as a singleton. Grouping buffers up to group_size requests, so
+        it suits open-loop producers (a camera, a replayed log) — a
+        closed-loop caller that waits on responses must keep
+        ``group_size=1``."""
+
+        def groupable(r: InferRequest) -> bool:
+            if r.trace is not None or r.input_params:
+                return False
+            return all(np.asarray(v).ndim >= 1 for v in r.inputs.values())
+
+        def compatible(a: InferRequest, b: InferRequest) -> bool:
+            if (
+                a.model_name != b.model_name
+                or a.model_version != b.model_version
+                or a.priority != b.priority
+                or set(a.inputs) != set(b.inputs)
+            ):
+                return False
+            return all(
+                np.asarray(v).shape == np.asarray(b.inputs[k]).shape
+                and np.asarray(v).dtype == np.asarray(b.inputs[k]).dtype
+                for k, v in a.inputs.items()
+            )
+
+        group: list[InferRequest] = []
+        for r in requests:
+            if group_size > 1 and groupable(r):
+                if group and not compatible(group[0], r):
+                    yield group
+                    group = []
+                group.append(r)
+                if len(group) >= group_size:
+                    yield group
+                    group = []
+            else:
+                if group:
+                    yield group
+                    group = []
+                yield [r]
+        if group:
+            yield group
+
+    def _stage_stream_group(self, members: list[InferRequest]):
+        """One wire message for a group of G compatible requests:
+        members' inputs are packed back-to-back along the leading axis
+        — into a pooled shm region per input on a shm channel (no
+        intermediate concatenation; the region write IS the pack), or
+        into joined raw content on the wire. Returns ``(wire, slot)``;
+        slot is None on the wire path. Responses always ride the wire:
+        a stream multiplexes many in-flight requests per slot, so
+        there is no per-request output arena to target."""
+        first = members[0]
+        g = len(members)
+        slot = (
+            self._shm_pool().acquire(timeout_s=self._timeout_s)
+            if self._use_shm
+            else None
+        )
+        try:
+            req = pb.ModelInferRequest(
+                model_name=first.model_name,
+                model_version=first.model_version,
+                id=first.request_id,
+            )
+            params = dict(_wire_params(first) or {})
+            if g > 1:
+                params[codec.STREAM_GROUP_PARAM] = g
+                ids = [m.request_id for m in members]
+                if any(ids):
+                    params[codec.STREAM_GROUP_IDS_PARAM] = json.dumps(ids)
+            codec.set_request_params(req, params)
+            for name in sorted(first.inputs):
+                arrs = [np.asarray(m.inputs[name]) for m in members]
+                a0 = arrs[0]
+                shape = (
+                    (g * a0.shape[0],) + tuple(a0.shape[1:])
+                    if g > 1
+                    else a0.shape
+                )
+                t = req.inputs.add(
+                    name=name, datatype=codec.datatype_of(a0), shape=shape
+                )
+                if g == 1 and first.input_params:
+                    codec.set_request_params(
+                        t, first.input_params.get(name)
+                    )
+                if slot is not None:
+                    region = slot.region_for(f"i_{name}", g * a0.nbytes)
+                    for i, a in enumerate(arrs):
+                        region.write(a, offset=i * a0.nbytes)
+                    codec.set_shm_params(
+                        t, region.key.lstrip("/"), 0, g * a0.nbytes
+                    )
+                else:
+                    req.raw_input_contents.append(
+                        b"".join(codec.serialize_tensor(a) for a in arrs)
+                    )
+            return req, slot
+        except BaseException:
+            if slot is not None:
+                self._pool.release(slot)
+            raise
+
+    def infer_stream(
+        self,
+        requests,
+        stream_timeout_s: float | None = 3600.0,
+        group_size: int = 1,
+    ):
         """Bidirectional streaming inference (the reference's unused
         --streaming flag, main.py:66-70, made real). ``requests`` is an
-        iterable of InferRequest; yields InferResponse.
+        iterable of InferRequest; yields InferResponse in request order.
+
+        On a shm-negotiated channel every stream entry stages its
+        inputs through the region pool (one slot per in-flight group,
+        released when the group's last response lands), so the stream
+        path skips the tensor serialize/copy/deserialize exactly like
+        unary shm — the pre-round-13 wire fallback is gone.
+
+        ``group_size > 1`` enables the multi-frame protocol: up to that
+        many consecutive compatible requests pack into ONE stream
+        message (frames concatenated on the leading axis) that the
+        server fans back into individual batcher requests, so a long
+        tunnel RTT is paid once per group instead of once per frame.
+        The server streams one response per member as each resolves; a
+        whole-group failure is prefixed ``stream group failed:`` so it
+        consumes all member responses at once.
 
         ``stream_timeout_s`` bounds the WHOLE stream (gRPC deadlines are
         per-call): a stalled server or a silent network partition
@@ -535,54 +878,84 @@ class GRPCChannel(BaseChannel):
         forever — the unary path gets the same protection from
         ``timeout_s`` per request. Pass None for an unbounded session
         (long-lived live streams)."""
-        self._warn_shm_wire_fallback()
+        # appended by wire_iter on gRPC's request-consumer thread,
+        # consumed in order here: the server answers each message only
+        # after receiving it, so an entry is always enqueued before its
+        # first response arrives (deque ops are atomic under the GIL)
+        entries: collections.deque = collections.deque()
 
         def wire_iter():
-            for r in requests:
-                yield codec.build_infer_request(
-                    model_name=r.model_name,
-                    inputs=r.inputs,
-                    model_version=r.model_version,
-                    request_id=r.request_id,
-                    parameters=_wire_params(r),
+            for members in self._stream_groups(requests, group_size):
+                wire, slot = self._stage_stream_group(members)
+                entries.append(
+                    {"members": members, "slot": slot,
+                     "remaining": len(members)}
                 )
+                yield wire
 
-        for resp in self._stub.ModelStreamInfer(
+        call = self._stub.ModelStreamInfer(
             wire_iter(), timeout=stream_timeout_s
-        ):
-            if resp.error_message:
-                raise RuntimeError(resp.error_message)
-            inner = resp.infer_response
-            yield InferResponse(
-                model_name=inner.model_name,
-                model_version=inner.model_version,
-                outputs=codec.parse_infer_response(inner),
-                request_id=inner.id,
-                parameters=_response_params(inner),
-            )
+        )
+        try:
+            for resp in call:
+                entry = entries[0]
+                if resp.error_message:
+                    msg = resp.error_message
+                    whole_entry = (
+                        len(entry["members"]) == 1
+                        or msg.startswith("stream group failed: ")
+                    )
+                    if whole_entry:
+                        entries.popleft()
+                        if entry["slot"] is not None:
+                            self._pool.release(entry["slot"])
+                        if (
+                            entry["slot"] is not None
+                            and "not registered" in msg
+                        ):
+                            # server lost its registry mid-stream (see
+                            # _recover_shm): re-register the pool and
+                            # re-issue this entry's members unary so
+                            # the stream keeps its one-response-per-
+                            # request contract
+                            log.warning(
+                                "stream entry hit an empty server shm "
+                                "registry (%s); re-registering and "
+                                "re-issuing %d member(s)",
+                                msg, len(entry["members"]),
+                            )
+                            self._shm_pool().reregister_all()
+                            for m in entry["members"]:
+                                yield self.do_inference(m)
+                            continue
+                    raise RuntimeError(msg)
+                entry["remaining"] -= 1
+                if entry["remaining"] <= 0:
+                    entries.popleft()
+                    if entry["slot"] is not None:
+                        self._pool.release(entry["slot"])
+                inner = resp.infer_response
+                yield InferResponse(
+                    model_name=inner.model_name,
+                    model_version=inner.model_version,
+                    outputs=codec.parse_infer_response(inner),
+                    request_id=inner.id,
+                    parameters=_response_params(inner),
+                )
+        finally:
+            call.cancel()
+            while entries:
+                entry = entries.popleft()
+                if entry["slot"] is not None:
+                    self._pool.release(entry["slot"])
 
     def close(self) -> None:
-        # client owns the shm segments: unregister server-side (best
-        # effort — the server may already be gone), then unlink. Taken
-        # under the shm lock so an in-flight do_inference finishes
-        # before its regions are torn down.
-        import contextlib
-
-        with self._shm_lock or contextlib.nullcontext():
-            for name, region in self._shm_regions.items():
-                try:
-                    # no retry ladder: cleanup against a dead server
-                    # must not stall shutdown for the backoff budget
-                    self._stub.SystemSharedMemoryUnregister(
-                        pb.SystemSharedMemoryUnregisterRequest(
-                            name=region.key.lstrip("/")
-                        ),
-                        timeout=min(self._timeout_s, 2.0),
-                    )
-                except grpc.RpcError:
-                    pass
-                region.close()
-            self._shm_regions.clear()
+        # client owns the shm segments: the pool unregisters server-
+        # side (best effort — the server may already be gone) and
+        # unlinks every slot's regions
+        pool = self._pool
+        if pool is not None:
+            pool.close()
         if self._channel is not None:
             self._channel.close()
         for ch in self._retired:
@@ -613,12 +986,17 @@ class GRPCChannel(BaseChannel):
     def stats(self) -> dict:
         """Client-side counters: ``infer_rejections`` (ModelInfer
         requests the server shed with RESOURCE_EXHAUSTED — never
-        retried) and ``retries`` (transient failures the backoff ladder
-        re-issued)."""
-        return {
+        retried), ``retries`` (transient failures the backoff ladder
+        re-issued), the negotiated ``transport`` label, and the shm
+        ``pool``'s occupancy/alias counters once it exists."""
+        out = {
             "infer_rejections": self._infer_rejections,
             "retries": self._retries_total,
+            "transport": self.transport,
         }
+        if self._pool is not None:
+            out["shm_pool"] = self._pool.stats()
+        return out
 
     def _call(
         self,

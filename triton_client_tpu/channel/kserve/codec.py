@@ -100,13 +100,34 @@ def get_string_param(msg, key: str) -> str | None:
     return p[key].string_param or None
 
 
+def get_int_param(msg, key: str, default: int = 0) -> int:
+    """Presence-checked read of an int64 parameter."""
+    p = msg.parameters
+    if key not in p:
+        return default
+    return int(p[key].int64_param)
+
+
+# multi-frame streaming protocol (round 13): one ModelStreamInfer
+# message carries a packed group of G equal-shape frames concatenated
+# along the leading axis; the server fans them into the batcher as
+# individual requests and streams one response per frame, so a tunnel
+# RTT is paid once per group instead of once per frame.
+STREAM_GROUP_PARAM = "stream_group"
+STREAM_GROUP_IDS_PARAM = "stream_group_ids"
+
+
 def build_infer_request(
     model_name: str,
     inputs: dict[str, np.ndarray],
     model_version: str = "",
     request_id: str = "",
     parameters: dict | None = None,
+    input_parameters: dict[str, dict] | None = None,
 ) -> pb.ModelInferRequest:
+    """``input_parameters`` maps input name -> per-tensor parameters
+    (e.g. ``content_encoding`` for wire-compressed payloads,
+    runtime/wire_encoding.py)."""
     req = pb.ModelInferRequest(
         model_name=model_name, model_version=model_version, id=request_id
     )
@@ -115,7 +136,11 @@ def build_infer_request(
     # (the wire pairs them by position).
     for name in sorted(inputs):
         arr = np.asarray(inputs[name])
-        req.inputs.add(name=name, datatype=datatype_of(arr), shape=arr.shape)
+        t = req.inputs.add(
+            name=name, datatype=datatype_of(arr), shape=arr.shape
+        )
+        if input_parameters and name in input_parameters:
+            set_request_params(t, input_parameters[name])
         req.raw_input_contents.append(serialize_tensor(arr))
     return req
 
@@ -127,6 +152,7 @@ def build_infer_request_shm(
     model_version: str = "",
     request_id: str = "",
     parameters: dict | None = None,
+    input_parameters: dict[str, dict] | None = None,
 ) -> pb.ModelInferRequest:
     """Like build_infer_request, but inputs named in ``shm_inputs``
     (name -> (region, offset, byte_size)) travel as metadata + shared-
@@ -141,12 +167,29 @@ def build_infer_request_shm(
         t = req.inputs.add(
             name=name, datatype=datatype_of(arr), shape=arr.shape
         )
+        if input_parameters and name in input_parameters:
+            set_request_params(t, input_parameters[name])
         target = shm_inputs.get(name)
         if target is None:
             req.raw_input_contents.append(serialize_tensor(arr))
         else:
             set_shm_params(t, *target)
     return req
+
+
+def add_requested_output(
+    req: pb.ModelInferRequest,
+    name: str,
+    region: str,
+    offset: int,
+    byte_size: int,
+) -> None:
+    """Request that the server place one response tensor into a
+    client-owned shm window (Triton requested-output semantics): the
+    server writes readback bytes straight into the client's mapped
+    segment and the response carries only coordinates."""
+    t = req.outputs.add(name=name)
+    set_shm_params(t, region, offset, byte_size)
 
 
 def shm_params(tensor) -> tuple[str, int, int] | None:
@@ -228,11 +271,18 @@ def build_infer_response(
     shm_outputs: dict[str, tuple[str, int, int]] | None = None,
     shm=None,
     parameters: dict | None = None,
+    fallback_to_wire: bool = False,
 ) -> pb.ModelInferResponse:
     """``shm_outputs`` maps output name -> (region, offset, byte_size):
     those tensors are written into the registry's region and travel as
     metadata + shared-memory parameters with no raw content (Triton
-    system-shared-memory extension, response side)."""
+    system-shared-memory extension, response side).
+
+    ``fallback_to_wire``: an output that exceeds its requested window
+    ships as raw content instead of raising — the serving path passes
+    True so a client whose learned output sizes lag a growing batch
+    still gets its response (and learns the larger size from it);
+    the strict default stays for direct codec users."""
     resp = pb.ModelInferResponse(
         model_name=model_name, model_version=model_version, id=request_id
     )
@@ -248,11 +298,16 @@ def build_infer_response(
             continue
         region, offset, byte_size = target
         if arr.nbytes > byte_size:
-            raise ValueError(
-                f"output {name!r} is {arr.nbytes} bytes but the requested "
-                f"shared-memory window is {byte_size}"
-            )
-        shm.write(region, offset, np.ascontiguousarray(arr))
+            if not fallback_to_wire:
+                raise ValueError(
+                    f"output {name!r} is {arr.nbytes} bytes but the "
+                    f"requested shared-memory window is {byte_size}"
+                )
+            resp.raw_output_contents.append(serialize_tensor(arr))
+            continue
+        # single designed copy: readback view -> client's mapped page
+        # (write() handles contiguity; no intermediate materialization)
+        shm.write(region, offset, arr)
         set_shm_params(t, region, offset, arr.nbytes)
     return resp
 

@@ -22,15 +22,23 @@ def add_common_flags(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("-x", "--model-version", default="", help="model version")
     parser.add_argument(
         "-u", "--channel", default="tpu", dest="channel",
-        help="inference channel: 'tpu' (in-process jit, default) or "
+        help="inference channel: 'tpu' (in-process jit, default), "
         "'grpc:<host:port>' (remote KServe v2 server — the reference's "
-        "-u server URL, main.py:51-113)",
+        "-u server URL, main.py:51-113), or 'grpc:unix:/path.sock' "
+        "(the server's same-host unix socket, printed by serve)",
     )
     parser.add_argument(
-        "--shm", action="store_true", dest="use_shared_memory",
-        help="with a grpc: channel on the same host as the server, pass "
-        "input tensors through POSIX shared memory instead of the wire "
-        "(Triton system-shared-memory extension)",
+        "--shm", action="store_const", const=True, default=None,
+        dest="use_shared_memory",
+        help="force the POSIX shared-memory tensor transport (Triton "
+        "system-shared-memory extension). Default is AUTO: same-host "
+        "grpc:/unix: channels negotiate shm on their own and remote "
+        "ones stay on the wire; --no-shm pins the wire everywhere",
+    )
+    parser.add_argument(
+        "--no-shm", action="store_const", const=False,
+        dest="use_shared_memory",
+        help="force the gRPC wire transport even on a same-host channel",
     )
     parser.add_argument(
         "--profile", action="store_true",

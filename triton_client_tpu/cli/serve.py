@@ -27,6 +27,15 @@ def main(argv=None) -> None:
         help="model repository root (examples/ layout)",
     )
     p.add_argument("-a", "--address", default="0.0.0.0:8001")
+    p.add_argument(
+        "--uds", default="auto",
+        help="unix-domain-socket listener alongside TCP: 'auto' "
+        "(default) picks a per-process socket under $TMPDIR, "
+        "'unix:/path.sock' or '/path.sock' pins it, 'off' disables. "
+        "Same-host clients dialing the unix: target skip the loopback "
+        "TCP stack and auto-negotiate shared-memory tensor transport "
+        "(docs/OPERATIONS.md 'Host transport')",
+    )
     p.add_argument("--max-workers", type=int, default=8)
     p.add_argument(
         "--mesh", default="",
@@ -190,6 +199,8 @@ def main(argv=None) -> None:
     # flush=True: supervisors/drives parse this line through a pipe,
     # where block buffering would hold it until exit.
     print(f"KServe v2 gRPC server listening on port {server.port}", flush=True)
+    if getattr(server, "uds_address", None):
+        print(f"unix socket: {server.uds_address}", flush=True)
     if server.metrics_enabled:
         print(
             f"telemetry on :{server.metrics_port} "
@@ -375,10 +386,12 @@ def build_server(args):
             f"pad_buckets={batcher == 'continuous' or getattr(args, 'pad_buckets', False)}",
             flush=True,
         )
+    uds = getattr(args, "uds", "auto") or "off"
     return InferenceServer(
         repo,
         channel,
         address=args.address,
+        uds_address=None if uds == "off" else uds,
         max_workers=args.max_workers,
         metrics_port=args.metrics_port,
         trace_capacity=getattr(args, "trace_capacity", 256),

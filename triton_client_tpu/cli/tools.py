@@ -577,8 +577,10 @@ def route(argv=None) -> None:
                 else "DEAD"
             )
             detail = "" if models_ok or not ready else " (model not ready)"
+            transport = getattr(chan, "transport", "grpc")
             print(
-                f"{ep:<28} {state:<12} replica_of={label_of(chan)}{detail}",
+                f"{ep:<28} {state:<12} transport={transport:<8} "
+                f"replica_of={label_of(chan)}{detail}",
                 flush=True,
             )
         print(

@@ -129,6 +129,15 @@ METRIC_TYPES: dict[str, str] = {
     "tpu_serving_device_seconds_total": "counter",
     "tpu_serving_device_utilization_ratio": "gauge",
     "tpu_serving_mfu": "gauge",
+    # host-transport plane (round 13): which transport carried each
+    # request's tensors (grpc / uds / shm / uds+shm), payload bytes by
+    # path (the wire-vs-shm mix a host-gap regression shows up in
+    # first), and the multi-frame stream group-size distribution
+    "tpu_serving_transport_info": "gauge",
+    "tpu_serving_transport_requests_total": "counter",
+    "tpu_serving_wire_bytes_total": "counter",
+    "tpu_serving_shm_bytes_total": "counter",
+    "tpu_serving_stream_group_size": "histogram",
 }
 
 _HBM_KINDS = ("bytes_in_use", "bytes_limit", "peak_bytes_in_use")
@@ -243,6 +252,13 @@ class RuntimeCollector:
         # admission-door sheds ("model|priority|stage"); the channel
         # and batcher keep their own stage sheds, merged at snapshot
         self._shed: dict[str, int] = {}
+        # host-transport mix: requests per negotiated transport label,
+        # input payload bytes split wire vs shm, and the multi-frame
+        # stream group-size occupancy
+        self._transport_requests: dict[str, int] = {}
+        self._wire_bytes = 0
+        self._shm_bytes = 0
+        self._stream_groups: dict[int, int] = {}
         self._draining = False
         self._registry = None
         if registry is not None:
@@ -272,6 +288,26 @@ class RuntimeCollector:
             key = f"{model}|{int(priority)}|{stage}"
             self._shed[key] = self._shed.get(key, 0) + 1
 
+    def record_transport(
+        self, transport: str, wire_bytes: int, shm_bytes: int
+    ) -> None:
+        """One inference request's transport mix: the negotiated label
+        (grpc/uds/shm/uds+shm) and how many input-payload bytes each
+        path moved."""
+        with self._lock:
+            self._transport_requests[transport] = (
+                self._transport_requests.get(transport, 0) + 1
+            )
+            self._wire_bytes += int(wire_bytes)
+            self._shm_bytes += int(shm_bytes)
+
+    def record_stream_group(self, size: int) -> None:
+        """One packed multi-frame stream message of ``size`` frames."""
+        with self._lock:
+            self._stream_groups[int(size)] = (
+                self._stream_groups.get(int(size), 0) + 1
+            )
+
     def set_draining(self, draining: bool) -> None:
         with self._lock:
             self._draining = bool(draining)
@@ -284,6 +320,12 @@ class RuntimeCollector:
             errors = {f"{m}|{c}": n for (m, c), n in self._errors.items()}
             shed = dict(self._shed)
             draining = self._draining
+            transport = {
+                "requests": dict(self._transport_requests),
+                "wire_bytes": self._wire_bytes,
+                "shm_bytes": self._shm_bytes,
+                "stream_groups": dict(self._stream_groups),
+            }
         snap = {
             "channel": self._tpu.stats() if self._tpu is not None else None,
             "batching": (
@@ -302,6 +344,7 @@ class RuntimeCollector:
                 shed[key] = shed.get(key, 0) + n
         snap["shed"] = shed
         snap["draining"] = int(draining)
+        snap["transport"] = transport
         if self._admission is not None:
             snap["admission"] = self._admission.stats()
         if self._lifecycle is not None:
@@ -883,6 +926,54 @@ class RuntimeCollector:
                 ([m], v) for m, v in (dt_window.get("mfu") or {}).items()
             ],
         )
+
+        # host-transport plane: negotiated transport per request, the
+        # wire-vs-shm payload byte split, and the multi-frame stream
+        # group-size distribution
+        tp = snap.get("transport") or {}
+        tp_requests = tp.get("requests") or {}
+        yield gauge(
+            f"{ns}_transport_info",
+            "transports observed carrying inference requests "
+            "(grpc/uds/shm/uds+shm; info gauge, 1 per observed label)",
+            0,
+            labels=["transport"],
+            samples=[([t], 1) for t in sorted(tp_requests)],
+        )
+        yield counter(
+            f"{ns}_transport_requests_total",
+            "inference requests per negotiated transport",
+            0,
+            labels=["transport"],
+            samples=[([t], n) for t, n in sorted(tp_requests.items())],
+        )
+        yield counter(
+            f"{ns}_wire_bytes_total",
+            "input payload bytes that travelled as gRPC raw content",
+            tp.get("wire_bytes", 0),
+        )
+        yield counter(
+            f"{ns}_shm_bytes_total",
+            "input payload bytes that travelled through shared memory",
+            tp.get("shm_bytes", 0),
+        )
+        groups = {
+            int(k): v for k, v in (tp.get("stream_groups") or {}).items()
+        }
+        group_hist = HistogramMetricFamily(
+            f"{ns}_stream_group_size",
+            "frames per packed multi-frame stream message",
+            labels=[],
+        )
+        cum, cum_buckets = 0, []
+        for bound in (1, 2, 4, 8, 16, 32, 64):
+            cum += sum(v for k, v in groups.items() if bound / 2 < k <= bound)
+            cum_buckets.append((repr(float(bound)), cum))
+        cum_buckets.append(("+Inf", sum(groups.values())))
+        group_hist.add_metric(
+            [], cum_buckets, float(sum(k * v for k, v in groups.items()))
+        )
+        yield group_hist
 
         # device HBM (absent on backends without memory_stats)
         if snap["memory"]:

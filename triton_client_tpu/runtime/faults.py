@@ -17,6 +17,7 @@ probe named injection points:
   codec_decode    codec.parse_infer_request                  raise
   batcher_stall   BatchingChannel dispatcher, slot time      sleep
   replica_down    _Servicer ServerReady/ModelReady/_issue    flag
+  shm_detach      _Servicer before shm request parse         flag
   ==============  ========================================== =========
 
 The ``replica_down`` point is flag-class (:func:`probe_flag`): the
@@ -24,6 +25,12 @@ server consults it with its ``--replica-of`` label as the model key and
 simulates process death while the transport stays up — ServerReady
 answers not-ready and inference answers UNAVAILABLE (no drain marker) —
 so the router chaos shard can kill a replica deterministically.
+
+``shm_detach`` is flag-class too, keyed by model name: the servicer
+drops its whole shared-memory registry before parsing the faulted
+request, simulating a server restart under a client that still holds
+mapped segments — the client must re-register its pool and re-issue
+(unary) or fall back per-member (stream), never serve stale bytes.
 
 Determinism: rules fire by COUNT windows (requests ``after`` .. ``after
 + count`` at that point/model), and probabilistic rules draw from a

@@ -350,6 +350,10 @@ class ReplicaSet:
             return [
                 {
                     "endpoint": r.endpoint,
+                    # negotiated per-endpoint transport (uds+shm / shm /
+                    # uds / grpc); custom channel factories may not
+                    # expose one
+                    "transport": getattr(r.channel, "transport", "grpc"),
                     "inflight": r.inflight,
                     "probe_ready": r.probe_ready,
                     "draining": r.draining,

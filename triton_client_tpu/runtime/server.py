@@ -21,7 +21,9 @@ Differences from the reference's serving story, by design:
 from __future__ import annotations
 
 import concurrent.futures
+import json
 import logging
+import os
 import threading
 import time
 
@@ -47,6 +49,7 @@ from triton_client_tpu.obs.trace import (
     TraceContext,
     encode_span_summary,
 )
+from triton_client_tpu.runtime import wire_encoding
 from triton_client_tpu.runtime.repository import ModelRepository
 
 log = logging.getLogger(__name__)
@@ -307,8 +310,17 @@ class _Servicer(service.GRPCInferenceServiceServicer):
 
     # -- inference ------------------------------------------------------------
 
-    def _issue(self, request):
+    def _issue(self, request, inputs_override=None, id_override=None):
         """Parse + dispatch one request; returns a finisher callable.
+
+        ``inputs_override``/``id_override``: set by _issue_group when
+        this "request" is one member of a packed multi-frame stream
+        message — the member's input views (already split off the
+        group parse) and its per-member id replace the wire message's;
+        parse, content decoding, and response shm placement are then
+        skipped (the group was parsed once, encoded groups are not
+        packed client-side, and a shared output region cannot serve G
+        members).
 
         The dispatch goes through ``do_inference_async`` so the device
         (or inner batcher) starts while THIS thread still prepares the
@@ -329,6 +341,7 @@ class _Servicer(service.GRPCInferenceServiceServicer):
         the min of its members') to the staged launchers; _account
         scores met/missed on every exit path."""
         t0 = time.perf_counter()
+        request_id = id_override if id_override is not None else request.id
         trace = None
         if self._tracer is not None:
             # adopt the inbound distributed context (router- or client-
@@ -339,7 +352,7 @@ class _Servicer(service.GRPCInferenceServiceServicer):
                 codec.get_string_param(request, TraceContext.PARAM_KEY) or ""
             )
             trace = self._tracer.start(
-                model=request.model_name, request_id=request.id,
+                model=request.model_name, request_id=request_id,
                 context=context,
             )
         deadline_s, priority = None, 0
@@ -404,11 +417,39 @@ class _Servicer(service.GRPCInferenceServiceServicer):
                             request.model_name, priority, "lifecycle"
                         )
                     raise
-            if trace is not None:
-                with trace.span("parse"):
-                    inputs = codec.parse_infer_request(request, shm=self._shm)
+            if inputs_override is not None:
+                inputs = inputs_override
             else:
-                inputs = codec.parse_infer_request(request, shm=self._shm)
+                # chaos point: drop every attached segment right before
+                # parse, so the parse fails exactly like a freshly
+                # restarted server ('not registered' -> INVALID_ARGUMENT)
+                # and clients must exercise their re-registration path
+                if self._shm is not None and faults.probe_flag(
+                    "shm_detach", request.model_name
+                ):
+                    self._shm.unregister_all()
+                if trace is not None:
+                    with trace.span("parse"):
+                        inputs = codec.parse_infer_request(
+                            request, shm=self._shm
+                        )
+                else:
+                    inputs = codec.parse_infer_request(request, shm=self._shm)
+                encodings = wire_encoding.encodings_of(request)
+                if encodings:
+                    # compressed wire payloads (JPEG frames, quantized
+                    # pointclouds) decode on the host pool / device here;
+                    # in a pipelined stream this runs on the reader
+                    # thread while the previous request owns the device
+                    if trace is not None:
+                        with trace.span("decode"):
+                            inputs = wire_encoding.decode_inputs(
+                                inputs, encodings
+                            )
+                    else:
+                        inputs = wire_encoding.decode_inputs(
+                            inputs, encodings
+                        )
             if trace is not None:
                 # closed in finish() once the future resolves: the whole
                 # channel-stack residence (queue/stage/device/readback
@@ -420,7 +461,7 @@ class _Servicer(service.GRPCInferenceServiceServicer):
                     model_name=request.model_name,
                     model_version=request.model_version,
                     inputs=inputs,
-                    request_id=request.id,
+                    request_id=request_id,
                     trace=trace,
                     deadline_s=deadline_s,
                     priority=priority,
@@ -428,11 +469,15 @@ class _Servicer(service.GRPCInferenceServiceServicer):
             )
             # overlapped with device execution: shm placement parsing
             # needs only the request, not the result
-            shm_outputs = {
-                t.name: params
-                for t in request.outputs
-                if (params := codec.shm_params(t)) is not None
-            }
+            shm_outputs = (
+                {}
+                if inputs_override is not None
+                else {
+                    t.name: params
+                    for t in request.outputs
+                    if (params := codec.shm_params(t)) is not None
+                }
+            )
         except BaseException as e:
             # parse/dispatch failed before a finisher existed: close out
             # the request's accounting here (finish() will never run)
@@ -460,6 +505,7 @@ class _Servicer(service.GRPCInferenceServiceServicer):
                         request_id=result.request_id,
                         shm_outputs=shm_outputs,
                         shm=self._shm,
+                        fallback_to_wire=True,
                     )
                     trace.add("encode", t_e0, time.perf_counter())
                     # compact span summary in the response parameters
@@ -477,6 +523,7 @@ class _Servicer(service.GRPCInferenceServiceServicer):
                     request_id=result.request_id,
                     shm_outputs=shm_outputs,
                     shm=self._shm,
+                    fallback_to_wire=True,
                 )
             except BaseException as e:
                 error = e
@@ -561,9 +608,105 @@ class _Servicer(service.GRPCInferenceServiceServicer):
             for t in list(request.inputs) + list(request.outputs)
         )
 
+    @staticmethod
+    def _stream_group_size(request) -> int:
+        return max(1, codec.get_int_param(request, codec.STREAM_GROUP_PARAM, 1))
+
+    def _record_transport(self, request, context) -> None:
+        """Feed the transport-mix counters: which transport carried
+        this request's tensors and how many payload bytes each moved.
+        (Input side only — it dominates for perception serving, and
+        response bytes are not knowable until resolution.)"""
+        if self._collector is None:
+            return
+        wire_bytes = sum(len(b) for b in request.raw_input_contents)
+        shm_bytes = 0
+        for t in request.inputs:
+            p = t.parameters
+            if "shared_memory_region" in p and "shared_memory_byte_size" in p:
+                shm_bytes += int(p["shared_memory_byte_size"].int64_param)
+        uds = context.peer().startswith("unix:")
+        if shm_bytes:
+            transport = "uds+shm" if uds else "shm"
+        else:
+            transport = "uds" if uds else "grpc"
+        self._collector.record_transport(transport, wire_bytes, shm_bytes)
+
+    def _issue_group(self, request):
+        """Fan one multi-frame stream message into per-member batcher
+        requests; returns one finisher per member, in member order.
+
+        The packed message concatenates G equal-shape frames along the
+        leading axis (client: GRPCChannel._stage_stream_group); each
+        member is issued through the full admission/lifecycle/batcher
+        path as its own request with its own id, so the continuous
+        batcher schedules members individually and responses stream
+        back as each resolves. Member inputs are zero-copy views into
+        the group parse — no unpack copy. A member whose ISSUE fails
+        (shed, cold model) becomes a finisher that raises its error,
+        so the other members still serve and the client sees a
+        per-member error_message."""
+        g = self._stream_group_size(request)
+        if g == 1:
+            return [self._issue(request)]
+        if self._shm is not None and faults.probe_flag(
+            "shm_detach", request.model_name
+        ):
+            self._shm.unregister_all()
+        inputs = codec.parse_infer_request(request, shm=self._shm)
+        members: list[dict] = [{} for _ in range(g)]
+        for name, arr in inputs.items():
+            if arr.ndim < 1 or arr.shape[0] % g:
+                raise ValueError(
+                    f"stream group of {g} needs every input's leading "
+                    f"axis divisible by {g}; input {name!r} has shape "
+                    f"{tuple(arr.shape)}"
+                )
+            b = arr.shape[0] // g
+            for i in range(g):
+                members[i][name] = arr[i * b : (i + 1) * b]
+        raw_ids = codec.get_string_param(
+            request, codec.STREAM_GROUP_IDS_PARAM
+        )
+        try:
+            ids = json.loads(raw_ids) if raw_ids else []
+        except ValueError:
+            ids = []
+        if len(ids) != g:
+            ids = [f"{request.id}#{i}" if request.id else "" for i in range(g)]
+        def deferred_error(err):
+            def fin():
+                raise err
+            return fin
+
+        finishers = []
+        for i in range(g):
+            try:
+                fin = self._issue(
+                    request, inputs_override=members[i], id_override=ids[i]
+                )
+            except Exception as e:
+                # already accounted by _issue's except path; defer the
+                # error to this member's response slot
+                fin = deferred_error(e)
+            finishers.append(fin)
+        return finishers
+
+    @staticmethod
+    def _group_error(request, e: BaseException) -> str:
+        """error_message for a failure that consumed a WHOLE stream
+        entry before any member was issued (group parse/validation):
+        the prefix tells the client to retire all G member slots at
+        once instead of waiting for per-member responses."""
+        g = _Servicer._stream_group_size(request)
+        if g > 1:
+            return f"stream group failed: {e}"
+        return str(e)
+
     def ModelInfer(self, request, context):
         if self._uses_shm(request):
             self._require_local(context)
+        self._record_transport(request, context)
         try:
             return self._infer(request)
         except OverloadError as e:
@@ -593,12 +736,28 @@ class _Servicer(service.GRPCInferenceServiceServicer):
             for request in request_iterator:
                 if self._uses_shm(request):
                     self._require_local(context)
+                self._record_transport(request, context)
+                if (
+                    self._collector is not None
+                    and (g := self._stream_group_size(request)) > 1
+                ):
+                    self._collector.record_stream_group(g)
                 try:
-                    yield pb.ModelStreamInferResponse(
-                        infer_response=self._infer(request)
-                    )
+                    finishers = self._issue_group(request)
                 except (KeyError, ValueError, OverloadError) as e:
-                    yield pb.ModelStreamInferResponse(error_message=str(e))
+                    yield pb.ModelStreamInferResponse(
+                        error_message=self._group_error(request, e)
+                    )
+                    continue
+                for fin in finishers:
+                    try:
+                        yield pb.ModelStreamInferResponse(
+                            infer_response=fin()
+                        )
+                    except (KeyError, ValueError, OverloadError) as e:
+                        yield pb.ModelStreamInferResponse(
+                            error_message=str(e)
+                        )
             return
 
         import queue
@@ -618,12 +777,23 @@ class _Servicer(service.GRPCInferenceServiceServicer):
                         # the abort must run on the handler thread
                         q.put(("non_local", None))
                         return
+                    self._record_transport(request, context)
+                    if (
+                        self._collector is not None
+                        and (g := self._stream_group_size(request)) > 1
+                    ):
+                        self._collector.record_stream_group(g)
                     try:
-                        finish = self._issue(request)
+                        finishers = self._issue_group(request)
                     except (KeyError, ValueError, OverloadError) as e:
-                        q.put(("error", str(e)))
+                        q.put(("error", self._group_error(request, e)))
                         continue
-                    q.put(("finish", finish))
+                    # members are already issued (the batcher owns
+                    # them); the bounded puts pace the READER so the
+                    # next group is not parsed until this one's
+                    # finishers are draining
+                    for finish in finishers:
+                        q.put(("finish", finish))
             except Exception as e:  # surface reader crashes to the RPC
                 q.put(("crash", e))
             finally:
@@ -665,6 +835,7 @@ class InferenceServer:
         repository: ModelRepository,
         channel: BaseChannel,
         address: str = "0.0.0.0:8001",
+        uds_address: str | None = None,
         max_workers: int = 8,
         max_message_bytes: int | None = None,
         profiler=None,
@@ -718,7 +889,14 @@ class InferenceServer:
         ``attach_tenants``).
         ``replica_of``: replica-set label (``serve --replica-of``) —
         keys the ``replica_down`` fault point and is advertised via
-        ServerMetadata.extensions for the route tool."""
+        ServerMetadata.extensions for the route tool.
+        ``uds_address``: additionally listen on a unix socket
+        (``unix:/path`` / bare path / ``"auto"`` for a generated
+        per-process path) alongside TCP — same-host clients then skip
+        the loopback TCP stack entirely and their ``unix:`` peer
+        passes the shared-memory locality gate by construction. Read
+        the bound target back from ``.uds_address``; the socket file
+        is unlinked on stop()."""
         self.lifecycle = lifecycle
         self.tenants = tenants
         self.replica_of = replica_of
@@ -867,6 +1045,32 @@ class InferenceServer:
         if self._port == 0:
             raise RuntimeError(f"could not bind {address}")
         self._address = address
+        self.uds_address: str | None = None
+        self._uds_path: str | None = None
+        if uds_address:
+            from triton_client_tpu.channel import transport as transports
+
+            path = uds_address
+            if path == "auto":
+                import tempfile
+
+                path = os.path.join(
+                    tempfile.gettempdir(),
+                    f"tct_serve_{os.getpid()}_{self._port}.sock",
+                )
+            elif transports.is_uds(path):
+                path = transports.uds_path(path)
+            try:
+                # a stale socket from a crashed run blocks the bind;
+                # a LIVE server's socket would too — last binder wins,
+                # same as SO_REUSEADDR semantics on the TCP side
+                os.unlink(path)
+            except FileNotFoundError:
+                pass
+            if self._server.add_insecure_port(f"unix:{path}") == 0:
+                raise RuntimeError(f"could not bind unix:{path}")
+            self.uds_address = f"unix:{path}"
+            self._uds_path = path
         # the channel stack is part of the server's public surface:
         # embedders read stats()/batch_multiple off it, and start()
         # logs the mesh-serving shape it implies
@@ -894,13 +1098,16 @@ class InferenceServer:
     def start(self) -> None:
         self._server.start()
         multiple = self._channel_multiple()
+        listening = self._address
+        if self.uds_address:
+            listening = f"{listening} + {self.uds_address}"
         if multiple > 1:
             log.info(
                 "KServe v2 server listening on %s (mesh serving: batches "
-                "shard over a data axis of %d)", self._address, multiple,
+                "shard over a data axis of %d)", listening, multiple,
             )
         else:
-            log.info("KServe v2 server listening on %s", self._address)
+            log.info("KServe v2 server listening on %s", listening)
 
     def wait(self) -> None:
         self._server.wait_for_termination()
@@ -945,3 +1152,10 @@ class InferenceServer:
             self.collector.close()
         # detach (never unlink — the segments are client-owned)
         self.shm_registry.unregister_all()
+        if self._uds_path is not None:
+            # the SOCKET file is server-owned (unlike the shm segments)
+            try:
+                os.unlink(self._uds_path)
+            except FileNotFoundError:
+                pass
+            self._uds_path = None

@@ -29,6 +29,7 @@ Lifecycle contract (same as Triton's):
 
 from __future__ import annotations
 
+import collections
 import mmap
 import os
 import threading
@@ -219,10 +220,185 @@ class SystemSharedMemoryRegistry:
                     f"shared-memory region {name!r} is not registered"
                 )
             reg = self._regions[name]
-        arr = np.ascontiguousarray(arr)
         if offset < 0 or arr.nbytes > reg.byte_size - offset:
             raise ValueError(
                 f"output of {arr.nbytes} bytes at offset {offset} exceeds "
                 f"registered window of {name!r} ({reg.byte_size} bytes)"
             )
+        # region.write is the single designed host copy on the response
+        # path: readback view -> client's mapped segment (it handles
+        # non-contiguous inputs itself; no pre-copy here)
         return reg.region.write(arr, reg.offset + offset)
+
+
+class PoolSlot:
+    """One pipeline slot of a :class:`ShmRegionPool`: a set of
+    client-owned regions keyed by logical tensor name, each generation-
+    tagged so a grown (re-created) segment never reuses a registered
+    name. A slot is exclusively owned by one in-flight request between
+    ``acquire`` and ``release``; its regions persist across requests so
+    registration is amortized to once per (slot, input, size class)."""
+
+    __slots__ = ("index", "busy", "regions", "_gen", "_pool")
+
+    def __init__(self, pool: "ShmRegionPool", index: int) -> None:
+        self._pool = pool
+        self.index = index
+        self.busy = False
+        self.regions: dict[str, SharedMemoryRegion] = {}
+        self._gen: dict[str, int] = {}
+
+    def region_for(self, name: str, nbytes: int) -> SharedMemoryRegion:
+        """The slot's region for one logical tensor, created or grown
+        on demand. Growth burns a generation (segment names are
+        register-once server-side) and replaces the old registration
+        only AFTER the new register succeeds, so a failed register RPC
+        leaks nothing and leaves the old region usable."""
+        region = self.regions.get(name)
+        if region is not None and region.size >= nbytes:
+            return region
+        gen = self._gen.get(name, 0)
+        self._gen[name] = gen + 1
+        rname = f"{self._pool.tag}_s{self.index}_{name}_g{gen}"
+        new = SharedMemoryRegion.create(f"/{rname}", max(nbytes, 1))
+        try:
+            self._pool.register_fn(rname, new.key, new.size)
+        except Exception:
+            new.close()  # unlinks; server maps by its own fd if it
+            raise        # did register, so unlinking is safe either way
+        if region is not None:
+            self._pool.unregister_fn(region.key.lstrip("/"))
+            region.close()
+        self.regions[name] = new
+        return new
+
+    def retire(self, name: str) -> None:
+        """Drop one logical region (unregister + unlink). The cancel
+        path retires the output arena: a cancelled server may write
+        into it arbitrarily late, so the segment must never be handed
+        to the slot's next owner — the next use re-creates it under a
+        fresh generation name."""
+        region = self.regions.pop(name, None)
+        if region is not None:
+            self._pool.unregister_fn(region.key.lstrip("/"))
+            region.close()
+
+
+class ShmRegionPool:
+    """Client-side pool of shm slots sized to the pipeline depth.
+
+    The pre-round-13 channel kept ONE region per input behind a coarse
+    lock, which serialized do_inference and forced async/stream calls
+    onto the wire (a region must stay untouched until its response
+    arrives). Pooling per ``(slot, input, generation)`` gives every
+    in-flight request exclusive segments: ``depth`` concurrent requests
+    ride shm, the ``depth+1``-th blocks in ``acquire`` — backpressure
+    that mirrors the server's staging-slot pipeline depth.
+
+    ``register_fn(name, key, byte_size)`` / ``unregister_fn(name)`` are
+    the owner channel's RPC hooks; unregister must be best-effort (it
+    is called on the growth path against possibly-gone registrations).
+    """
+
+    def __init__(
+        self,
+        tag: str,
+        depth: int,
+        register_fn,
+        unregister_fn,
+    ) -> None:
+        self.tag = tag
+        self.depth = max(1, int(depth))
+        self.register_fn = register_fn
+        self.unregister_fn = unregister_fn
+        self._slots = [PoolSlot(self, i) for i in range(self.depth)]
+        self._free: collections.deque[PoolSlot] = collections.deque(
+            self._slots
+        )
+        self._cv = threading.Condition()
+        self._closed = False
+        # gate-test observability: acquires, high-water in-flight, and
+        # the alias counter a correct pool keeps at zero forever
+        self._acquires = 0
+        self._max_in_flight = 0
+        self._aliased = 0
+
+    def acquire(self, timeout_s: float | None = None) -> PoolSlot:
+        with self._cv:
+            if not self._cv.wait_for(
+                lambda: self._free or self._closed, timeout=timeout_s
+            ):
+                raise TimeoutError(
+                    f"no free shm slot within {timeout_s}s "
+                    f"({self.depth} in flight)"
+                )
+            if self._closed:
+                raise RuntimeError("shm region pool is closed")
+            slot = self._free.popleft()
+            if slot.busy:  # invariant violation — must never happen
+                self._aliased += 1
+                raise RuntimeError(
+                    f"shm slot {slot.index} handed out while busy"
+                )
+            slot.busy = True
+            self._acquires += 1
+            in_flight = self.depth - len(self._free)
+            if in_flight > self._max_in_flight:
+                self._max_in_flight = in_flight
+            return slot
+
+    def release(self, slot: PoolSlot) -> None:
+        """Idempotent: resolve-path ``finally`` and cancel hooks may
+        both fire for one request."""
+        with self._cv:
+            if self._closed or not slot.busy:
+                return
+            slot.busy = False
+            # LIFO: the just-released slot goes to the front so low
+            # concurrency reuses warm slots (regions already sized and
+            # registered) instead of rotating cold ones into play
+            self._free.appendleft(slot)
+            self._cv.notify()
+
+    def regions(self) -> list[SharedMemoryRegion]:
+        return [r for s in self._slots for r in s.regions.values()]
+
+    def reregister_all(self) -> None:
+        """Restart recovery: push every slot's segments back into a
+        server whose registry came up empty. The guarded unregister
+        first is ONLY the duplicate-name guard (if merely SOME regions
+        were lost, a blind register hits the rejection; unknown-name
+        unregister is a no-op)."""
+        for region in self.regions():
+            rname = region.key.lstrip("/")
+            self.unregister_fn(rname)
+            self.register_fn(rname, region.key, region.size)
+
+    def stats(self) -> dict:
+        with self._cv:
+            return {
+                "depth": self.depth,
+                "in_flight": self.depth - len(self._free),
+                "max_in_flight": self._max_in_flight,
+                "acquires": self._acquires,
+                "aliased": self._aliased,
+                "regions": sum(len(s.regions) for s in self._slots),
+                "region_bytes": sum(
+                    r.size for s in self._slots
+                    for r in s.regions.values()
+                ),
+            }
+
+    def close(self) -> None:
+        """Unregister (best effort, via the owner's hook) and unlink
+        every segment; wake blocked acquirers with an error."""
+        with self._cv:
+            if self._closed:
+                return
+            self._closed = True
+            self._cv.notify_all()
+        for slot in self._slots:
+            for region in slot.regions.values():
+                self.unregister_fn(region.key.lstrip("/"))
+                region.close()
+            slot.regions.clear()

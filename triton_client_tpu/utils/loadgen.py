@@ -63,11 +63,12 @@ def run_pool(
     clients: int,
     duration_s: float,
     deadline_s: float = 300.0,
-    use_shared_memory: bool = False,
+    use_shared_memory: bool | None = None,
     stagger_s: float = 0.25,
     on_window_start=None,
     mode: str = "unary",
     inflight: int = 1,
+    stream_group: int = 1,
 ) -> PoolResult:
     """Drive ``clients`` closed-loop threads for ``duration_s`` and
     return counts/latencies. ``on_window_start`` fires after the warm
@@ -82,6 +83,15 @@ def run_pool(
         matching response; responses preserve order on a stream);
       * 'async'  — ModelInfer call-futures with ``inflight`` in the
         air per client (the --async --inflight N path).
+
+    ``use_shared_memory=None`` (default) lets each channel
+    auto-negotiate its transport from the endpoint — shm on loopback /
+    unix: targets, plain wire otherwise; pass True/False to pin it.
+
+    ``stream_group`` (stream mode only) packs that many frames into one
+    ModelStreamInfer message (the multi-frame group protocol); it is
+    clamped to ``inflight`` because a closed-loop client can never have
+    more than ``inflight`` frames buffered toward a group.
     """
     from triton_client_tpu.channel.base import InferRequest
     from triton_client_tpu.channel.grpc_channel import GRPCChannel
@@ -89,6 +99,8 @@ def run_pool(
     if mode not in ("unary", "stream", "async"):
         raise ValueError(f"unknown pool mode {mode!r}")
     inflight = max(1, int(inflight))
+    # a group can only fill from frames the closed loop has in flight
+    stream_group = max(1, min(int(stream_group), inflight))
 
     served: list = []
     latencies: list = []
@@ -157,7 +169,11 @@ def run_pool(
                         cell[0] = time.perf_counter()
                         yield req
 
-                for _resp in chan.infer_stream(gen(), stream_timeout_s=deadline_s):
+                for _resp in chan.infer_stream(
+                    gen(),
+                    stream_timeout_s=deadline_s,
+                    group_size=stream_group,
+                ):
                     t0 = sent.get()[0]
                     mine.append((time.perf_counter() - t0) * 1e3)
                     if not stop.is_set():
