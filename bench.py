@@ -132,8 +132,23 @@ def _load_flops_sidecar() -> dict:
         return {}
 
 
-# metric -> flops/call, persisted across runs (see Config.warmup)
+# metric -> {"flops", "bytes"} per call, persisted across runs (see
+# Config.warmup). Entries were plain flops floats before the roofline
+# round; _sidecar_cost loads both forms.
 _FLOPS_SIDEBAR = _load_flops_sidecar()
+
+
+def _sidecar_cost(key: str) -> tuple[float, float]:
+    """(flops, bytes) per call from a sidecar entry (0.0 = unknown)."""
+    entry = _FLOPS_SIDEBAR.get(key)
+    if isinstance(entry, dict):
+        return (
+            float(entry.get("flops", 0.0) or 0.0),
+            float(entry.get("bytes", 0.0) or 0.0),
+        )
+    if entry:
+        return float(entry), 0.0
+    return 0.0, 0.0
 
 
 def _save_flops_sidecar() -> None:
@@ -144,18 +159,15 @@ def _save_flops_sidecar() -> None:
         print(f"could not write BENCH_FLOPS.json: {e}", file=sys.stderr)
 CAMERA_FPS_BASELINE = 30.0
 LIDAR_HZ_BASELINE = 10.0  # KITTI/nuScenes lidar scan rate
-V5E_PEAK_FLOPS = 197e12   # bf16 MXU peak; fp32 runs the MXU at the same
-                          # rate under jax's default (bf16xN) precision
-# Per-policy MXU peak for the MFU denominator (round 10): MFU was
-# computed as-if-f32 for every row. f32/bf16/int8w all execute the
-# matmuls at the bf16 peak (int8w dequantizes to f32 compute inside
-# the trace); full int8 runs the v5e int8 MAC path at 2x.
-POLICY_PEAK_FLOPS = {
-    "f32": V5E_PEAK_FLOPS,
-    "bf16": V5E_PEAK_FLOPS,
-    "int8w": V5E_PEAK_FLOPS,
-    "int8": 2 * V5E_PEAK_FLOPS,
-}
+# Per-chip peaks live in obs/roofline.py — ONE table for bench MFU,
+# served MFU, and the roofline ceiling (it keeps the per-policy MXU
+# rationale: f32/bf16/int8w execute matmuls at the bf16 peak under
+# jax's default precision, full int8 runs the int8 MAC path at 2x).
+from triton_client_tpu.obs.roofline import (  # noqa: E402
+    POLICY_PEAK_FLOPS,
+    V5E_PEAK_FLOPS,
+    classify as roofline_classify,
+)
 
 
 def _tunnel_rtt_ms() -> float:
@@ -200,6 +212,7 @@ class Config:
         self.baseline_hz = baseline_hz
         self.trial_ms = []                # per-call ms, one entry per trial
         self.flops_per_call = None
+        self.bytes_per_call = None
 
     def warmup(self):
         tok = jnp.float32(0.0)
@@ -210,21 +223,34 @@ class Config:
         # pure warmup bill) on every run after the first; a config
         # whose flops change (model edit) just needs the sidecar entry
         # deleted — or delete the file to re-derive everything
-        cached = _FLOPS_SIDEBAR.get(self.metric)
-        if cached:
-            self.flops_per_call = float(cached)
+        cached_flops, cached_bytes = _sidecar_cost(self.metric)
+        if cached_flops and cached_bytes:
+            self.flops_per_call = cached_flops
+            self.bytes_per_call = cached_bytes
             return
         try:
             cost = self.step.lower(jnp.float32(0.0)).compile().cost_analysis()
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0] if cost else {}
             if cost and cost.get("flops"):
                 self.flops_per_call = float(cost["flops"])
-                _FLOPS_SIDEBAR[self.metric] = self.flops_per_call
+                self.bytes_per_call = float(
+                    cost.get("bytes accessed", 0.0) or 0.0
+                )
+                _FLOPS_SIDEBAR[self.metric] = {
+                    "flops": self.flops_per_call,
+                    "bytes": self.bytes_per_call,
+                }
                 # persist per-config: a timeout mid-warmup (the exact
                 # failure this cache targets) must not lose the
                 # entries already derived
                 _save_flops_sidecar()
         except Exception:
             pass  # cost analysis is best-effort over the tunnel
+        if self.flops_per_call is None and cached_flops:
+            # legacy flops-only sidecar entry and no fresh measurement:
+            # MFU still computes, the roofline columns wait for bytes
+            self.flops_per_call = cached_flops
 
     def run_trial(self):
         tok = jnp.float32(0.0)
@@ -286,6 +312,22 @@ class Config:
                 / POLICY_PEAK_FLOPS.get(self.precision, V5E_PEAK_FLOPS),
                 4,
             )
+            if self.bytes_per_call:
+                # roofline placement: measured intensity vs the machine
+                # knee, the binding ceiling, and the attainable rate if
+                # only that ceiling bound (obs/roofline.py)
+                roof = roofline_classify(
+                    self.flops_per_call, self.bytes_per_call,
+                    self.precision, batch=int(self.unit_per_call),
+                )
+                out["bytes_per_call"] = self.bytes_per_call
+                out["arithmetic_intensity"] = round(roof.intensity, 2)
+                out["roofline_bound"] = roof.bound
+                out["attainable_fps"] = round(roof.attainable_fps, 2)
+                if roof.attainable_fps > 0:
+                    out["roofline_attained_ratio"] = round(
+                        rate / roof.attainable_fps, 6
+                    )
         return out
 
 
@@ -635,22 +677,28 @@ def measure_serving(
     # cached, same methodology as the e2e configs) so served mfu stops
     # being as-if-f32
     flops_key = f"served_yolov5n_{input_hw[0]}_{precision}_b{max_merge}"
-    flops_per_frame = _FLOPS_SIDEBAR.get(flops_key)
-    if flops_per_frame:
-        flops_per_frame = float(flops_per_frame)
-    else:
+    flops_per_frame, bytes_per_frame = _sidecar_cost(flops_key)
+    if not (flops_per_frame and bytes_per_frame):
         try:
             cost = (
                 pipe._jit.lower(jnp.asarray(direct), tuple(input_hw))
                 .compile()
                 .cost_analysis()
             )
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0] if cost else {}
             if cost and cost.get("flops"):
                 flops_per_frame = float(cost["flops"]) / max_merge
-                _FLOPS_SIDEBAR[flops_key] = flops_per_frame
+                bytes_per_frame = (
+                    float(cost.get("bytes accessed", 0.0) or 0.0) / max_merge
+                )
+                _FLOPS_SIDEBAR[flops_key] = {
+                    "flops": flops_per_frame,
+                    "bytes": bytes_per_frame,
+                }
                 _save_flops_sidecar()
         except Exception:
-            flops_per_frame = None  # best-effort over the tunnel
+            pass  # best-effort over the tunnel
 
     # host->device upload bandwidth probe: the per-request transfer the
     # in-process configs never pay (device-resident inputs); over this
@@ -858,6 +906,20 @@ def measure_serving(
                 / POLICY_PEAK_FLOPS.get(precision, V5E_PEAK_FLOPS),
                 4,
             )
+            if bytes_per_frame:
+                roof = roofline_classify(
+                    flops_per_frame * max_merge,
+                    bytes_per_frame * max_merge,
+                    precision, batch=max_merge,
+                )
+                row["bytes_per_frame"] = bytes_per_frame
+                row["arithmetic_intensity"] = round(roof.intensity, 2)
+                row["roofline_bound"] = roof.bound
+                row["attainable_fps"] = round(roof.attainable_fps, 2)
+                if roof.attainable_fps > 0:
+                    row["roofline_attained_ratio"] = round(
+                        res.fps / roof.attainable_fps, 6
+                    )
         if total == 0:
             row["degraded"] = (
                 f"no request completed in the {duration_s:.0f}s window; "

@@ -124,6 +124,17 @@ python -m pytest tests/test_transport.py tests/test_shared_memory.py \
     --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly
 
+echo "== kernel-attribution shard (op stats, roofline, history) =="
+# the device-attribution contract (obs/opstats.py, obs/roofline.py,
+# obs/sampler.py, obs/history.py): trace-parse fixtures, roofline
+# classification + measured-cost capture, sampler duty-cycle/guard
+# contention, history ring + drain-persist — includes the slow-marked
+# live /profile capture tier-1 deselects
+python -m pytest tests/test_opstats.py tests/test_roofline.py \
+    tests/test_history.py -q -m '' \
+    --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+
 echo "== bench diff (optional shard: fresh bench vs BENCH_LOCAL.json) =="
 # perf-regression gate: compares a freshly produced bench results file
 # (BENCH_FRESH=<results.json>, written by a perf/ script on real

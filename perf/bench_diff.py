@@ -9,7 +9,10 @@ nonzero when either
     more than the threshold (default 10%), or
   * ``mfu`` dropped by more than the threshold, or
   * ``host_gap_ratio`` (serving rows: served fps / device ceiling)
-    dropped by more than the threshold
+    dropped by more than the threshold, or
+  * ``roofline_attained_ratio`` (measured fps / roofline attainable
+    fps from XLA-measured flops+bytes) dropped by more than the
+    threshold
 
 — so a perf regression fails CI the same way a test failure does.
 ci.sh runs this as an OPTIONAL shard: only when a fresh row exists
@@ -77,6 +80,11 @@ def diff_rows(
             # inside a faster device (value improves while the host
             # share of the ceiling collapses) — gate the ratio itself
             ("host_gap_ratio", "host_gap_ratio"),
+            # fraction of the roofline ceiling actually attained
+            # (measured fps / attainable fps from flops+bytes): a drop
+            # means the kernel moved away from its own hardware bound
+            # even if absolute throughput held up
+            ("roofline_attained_ratio", "roofline_attained_ratio"),
         ):
             f_v, b_v = f_row.get(key), b_row.get(key)
             if f_v is None or b_v is None or not b_v:

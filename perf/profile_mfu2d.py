@@ -27,6 +27,7 @@ import jax.numpy as jnp
 from _harness import compile_looped, run_trials
 
 from triton_client_tpu.models.yolov5 import YoloV5
+from triton_client_tpu.obs.roofline import V5E_PEAK_FLOPS, classify
 from triton_client_tpu.ops.detect_postprocess import extract_boxes
 from triton_client_tpu.ops.preprocess import normalize_image
 
@@ -91,6 +92,7 @@ def main():
     cases = []
     units = {}
     flops = {}
+    nbytes = {}
     for name in wanted:
         step, batch = factories[name]()
         print(f"compiling {name} ...", flush=True)
@@ -99,21 +101,36 @@ def main():
         units[name] = batch
         try:
             cost = looped.lower(jnp.float32(0.0)).compile().cost_analysis()
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0] if cost else {}
             # XLA's cost model counts the fori_loop BODY once (verified
             # against the bench's single-step flops for the base
             # config), so no division by the trip count
             flops[name] = float(cost.get("flops", 0.0))
+            nbytes[name] = float(cost.get("bytes accessed", 0.0))
         except Exception:
             flops[name] = 0.0
+            nbytes[name] = 0.0
     out = run_trials(cases, inner=inner, trials=8)
-    peak = 197e12  # v5e bf16 MXU peak (fp32 runs the MXU at bf16 rate
-    # under jax's default precision)
+    # v5e bf16 MXU peak (fp32 runs the MXU at bf16 rate under jax's
+    # default precision) — single source of truth in obs.roofline
+    peak = V5E_PEAK_FLOPS
     print("\n== results ==")
     for name, ms in out.items():
         fps = units[name] / (ms / 1e3)
         mfu = flops[name] / (ms / 1e3) / peak if flops.get(name) else 0.0
+        roof = classify(
+            flops.get(name, 0.0), nbytes.get(name, 0.0),
+            precision="bf16", batch=units[name],
+        )
+        ceiling = (
+            f"  {roof.bound:9s} ceil={roof.attainable_fps:9.1f} fps"
+            f"  I={roof.intensity:6.1f} flop/B"
+            if roof.bound != "unknown" else ""
+        )
         print(
-            f"{name:10s} {ms:7.3f} ms/call  {fps:8.1f} fps  mfu={mfu:.4f}",
+            f"{name:10s} {ms:7.3f} ms/call  {fps:8.1f} fps  mfu={mfu:.4f}"
+            f"{ceiling}",
             flush=True,
         )
 

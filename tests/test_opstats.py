@@ -1,0 +1,368 @@
+"""Op-level device attribution (obs/opstats) + the continuous sampler.
+
+Covers ISSUE 14's parser contract against a checked-in fixture trace
+(tests/data/opstats — the jax.profiler Chrome-trace shape frozen so the
+parser can't drift with the profiler plugin), both attribution paths
+(HLO module name, launch-annotation windows), the /profile endpoint's
+parsed summary + capture-guard release on parse failure, and the
+ContinuousSampler's structural <1% overhead budget and 409-style
+contention behavior.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from triton_client_tpu.obs import opstats
+from triton_client_tpu.obs.sampler import MAX_DUTY_CYCLE, ContinuousSampler
+
+FIXTURE_DIR = os.path.join(os.path.dirname(__file__), "data", "opstats")
+
+
+def _fixture_doc():
+    path = opstats.find_trace_file(FIXTURE_DIR)
+    assert path is not None and path.endswith("fixture.trace.json")
+    return opstats.load_trace(path)
+
+
+# -- parser: fixture trace ----------------------------------------------------
+
+
+def test_fixture_totals_models_and_unattributed():
+    s = opstats.summarize(_fixture_doc())
+    assert s["total_op_time_us"] == pytest.approx(300.0)
+    assert s["op_count"] == 6
+    # self-describing module name: jit_mdl_det2d_1 (+ the .2 recompile
+    # suffix) attributes without any mapping
+    assert s["models"]["det2d"] == pytest.approx(170.0)
+    # the anonymous module lands via its launch:pillars:1 window
+    assert s["models"]["pillars"] == pytest.approx(100.0)
+    assert s["unattributed_us"] == pytest.approx(30.0)
+    assert s["annotation_windows"] == {"det2d": 1, "pillars": 1}
+    # rows are device ops only: the 5000us python event never counted
+    assert all(r["time_us"] <= 100.0 for r in s["ops"])
+
+
+def test_fixture_rows_ranked_with_kind_and_share():
+    s = opstats.summarize(_fixture_doc())
+    rows = s["ops"]
+    assert [r["time_us"] for r in rows] == sorted(
+        (r["time_us"] for r in rows), reverse=True
+    )
+    top = rows[0]
+    assert top["op"] == "fusion.1" and top["kind"] == "fusion"
+    assert top["occurrences"] == 2
+    assert top["share"] == pytest.approx(100.0 / 300.0)
+    kinds = {r["op"]: r["kind"] for r in rows}
+    assert kinds["convolution.2"] == "convolution"
+    assert kinds["copy.3"] == "data-movement"
+    assert kinds["custom-call.7"] == "custom-call"
+    assert kinds["dot.9"] == "dot"
+
+
+def test_module_mapping_beats_annotation_windows():
+    s = opstats.summarize(
+        _fixture_doc(), hlo_modules={"jit_ragged_bucket": "second"}
+    )
+    # the explicit {module: model} mapping wins over the launch window
+    assert s["models"]["second"] == pytest.approx(100.0)
+    assert "pillars" not in s["models"]
+
+
+def test_top_k_truncates_rows_but_not_totals():
+    s = opstats.summarize(_fixture_doc(), top_k=2)
+    assert len(s["ops"]) == 2
+    assert s["op_count"] == 6
+    assert s["total_op_time_us"] == pytest.approx(300.0)
+
+
+def test_op_kind_rules():
+    assert opstats.op_kind("fusion.123") == "fusion"
+    assert opstats.op_kind("all-reduce.1") == "collective"
+    assert opstats.op_kind("transpose.4") == "data-movement"
+    assert opstats.op_kind("weird-thing.9") == "other"
+
+
+def test_gz_round_trip_and_dir_discovery(tmp_path):
+    import gzip
+
+    run = tmp_path / "plugins" / "profile" / "r1"
+    run.mkdir(parents=True)
+    doc = {"traceEvents": [
+        {"ph": "X", "name": "dot.1", "ts": 0, "dur": 7,
+         "args": {"hlo_module": "jit_mdl_m_1", "hlo_op": "dot.1"}},
+    ]}
+    with gzip.open(run / "host.trace.json.gz", "wt") as fh:
+        json.dump(doc, fh)
+    s = opstats.summarize_profile_dir(str(tmp_path))
+    assert s["total_op_time_us"] == 7.0
+    assert s["models"] == {"m": 7.0}
+    assert s["trace_file"].endswith(".trace.json.gz")
+
+
+def test_summarize_profile_dir_without_trace_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        opstats.summarize_profile_dir(str(tmp_path))
+
+
+# -- /profile endpoint --------------------------------------------------------
+
+
+class _StubCollector:
+    """Just enough collector surface for TelemetryServer._profile."""
+
+    def hlo_modules(self):
+        return {"jit_mdl_fix_1": "fix"}
+
+
+def _get(url, timeout=30.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+@pytest.mark.slow
+def test_profile_endpoint_returns_parsed_op_summary():
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+
+    from triton_client_tpu.obs.http import TelemetryServer
+
+    def compute(x):
+        return x @ x
+
+    compute.__name__ = compute.__qualname__ = "mdl_fix_1"
+    f = jax.jit(compute)
+    # a few-ms matmul: enough calls land in the window to be captured,
+    # few enough that stop_trace's event serialization stays fast
+    x = jnp.ones((256, 256), jnp.float32)
+    f(x).block_until_ready()  # compile outside the window
+
+    srv = TelemetryServer(port=0, collector=_StubCollector())
+    stop = threading.Event()
+
+    def busy():
+        while not stop.is_set():
+            f(x).block_until_ready()
+            time.sleep(0.001)
+
+    t = threading.Thread(target=busy, daemon=True)
+    t.start()
+    try:
+        doc = _get(
+            f"http://127.0.0.1:{srv.port}/profile?seconds=0.3&top_k=5",
+            timeout=120.0,
+        )
+    finally:
+        stop.set()
+        t.join(timeout=10.0)
+        srv.close()
+    assert doc["seconds"] == pytest.approx(0.3)
+    summary = doc.get("op_summary")
+    assert summary, doc.get("op_summary_error")
+    assert summary["op_count"] > 0
+    assert len(summary["ops"]) <= 5
+    # the named launcher module attributed its device time to the model
+    assert summary["models"].get("fix", 0.0) > 0.0
+
+
+def test_profile_parse_failure_degrades_and_releases_guard(monkeypatch):
+    pytest.importorskip("jax")
+    from triton_client_tpu.obs.http import TelemetryServer
+
+    srv = TelemetryServer(port=0)
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        monkeypatch.setattr(
+            opstats, "summarize_profile_dir",
+            lambda *a, **k: (_ for _ in ()).throw(RuntimeError("boom")),
+        )
+        doc = _get(base + "/profile?seconds=0.05")
+        # still 200 with the capture path; the failure is named
+        assert doc["log_dir"]
+        assert "op_summary" not in doc
+        assert "boom" in doc["op_summary_error"]
+        monkeypatch.undo()
+        # the guard was released before the parse: a second capture runs
+        doc2 = _get(base + "/profile?seconds=0.05")
+        assert "op_summary" in doc2
+    finally:
+        srv.close()
+
+
+def test_profile_concurrent_capture_gets_409():
+    pytest.importorskip("jax")
+    from triton_client_tpu.obs.http import TelemetryServer
+
+    srv = TelemetryServer(port=0)
+    try:
+        assert srv.profile_lock.acquire(blocking=False)
+        try:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/profile?seconds=0.05",
+                    timeout=10,
+                )
+            assert err.value.code == 409
+        finally:
+            srv.profile_lock.release()
+    finally:
+        srv.close()
+
+
+# -- continuous sampler -------------------------------------------------------
+
+
+def test_sampler_duty_cycle_is_structurally_capped():
+    # whatever knobs the operator passes, capture share stays <1%
+    for interval, window in ((30.0, 5.0), (1.0, 1.0), (0.2, 0.2), (60, 10)):
+        s = ContinuousSampler(interval_s=interval, window_s=window)
+        assert s.duty_cycle <= MAX_DUTY_CYCLE + 1e-12, (interval, window)
+        assert s.interval_s >= 1.0
+    # a compliant config is not clamped further
+    s = ContinuousSampler(interval_s=60.0, window_s=0.2)
+    assert s.window_s == pytest.approx(0.2)
+    assert s.stats()["duty_cycle"] == pytest.approx(0.2 / 60.0)
+
+
+def test_sampler_skips_when_capture_guard_busy():
+    pytest.importorskip("jax")
+    lock = threading.Lock()
+    sink_calls = []
+
+    class Sink:
+        def record_op_sample(self, rows, window_s):
+            sink_calls.append((rows, window_s))
+
+    s = ContinuousSampler(sink=Sink(), interval_s=30.0, lock=lock)
+    assert lock.acquire(blocking=False)  # an operator /profile holds it
+    try:
+        assert s.sample_once() is None
+    finally:
+        lock.release()
+    st = s.stats()
+    assert st["skipped_busy"] == 1
+    assert st["captures"] == 0
+    assert sink_calls == []
+
+
+def test_sampler_feeds_sink_and_cleans_up(monkeypatch, tmp_path):
+    pytest.importorskip("jax")
+    sink_calls = []
+
+    class Sink:
+        def record_op_sample(self, rows, window_s):
+            sink_calls.append((rows, window_s))
+
+    canned = {
+        "total_op_time_us": 10.0,
+        "op_count": 1,
+        "ops": [{"op": "dot.1", "kind": "dot", "model": "m",
+                 "occurrences": 1, "time_us": 10.0, "share": 1.0}],
+        "models": {"m": 10.0},
+        "unattributed_us": 0.0,
+        "annotation_windows": {},
+    }
+    seen_dirs = []
+
+    def fake_summarize(log_dir, hlo_modules=None, top_k=0):
+        seen_dirs.append(log_dir)
+        assert hlo_modules == {"jit_mdl_m_1": "m"}
+        return canned
+
+    monkeypatch.setattr(opstats, "summarize_profile_dir", fake_summarize)
+    s = ContinuousSampler(
+        sink=Sink(), interval_s=30.0, window_s=0.2,
+        hlo_modules=lambda: {"jit_mdl_m_1": "m"},
+    )
+    summary = s.sample_once()
+    assert summary is canned
+    assert sink_calls == [(canned["ops"], s.window_s)]
+    st = s.stats()
+    assert st["captures"] == 1 and st["failures"] == 0
+    assert st["capture_seconds"] >= s.window_s
+    # the capture directory is deleted after parsing (no trace leak)
+    assert seen_dirs and not os.path.exists(seen_dirs[0])
+
+
+def test_sampler_counts_failures_without_wedging_the_lock(monkeypatch):
+    pytest.importorskip("jax")
+    monkeypatch.setattr(
+        opstats, "summarize_profile_dir",
+        lambda *a, **k: (_ for _ in ()).throw(RuntimeError("bad trace")),
+    )
+    lock = threading.Lock()
+    s = ContinuousSampler(interval_s=30.0, lock=lock)
+    assert s.sample_once() is None
+    assert s.stats()["failures"] == 1
+    # the shared guard is free again for the next tick / operator capture
+    assert lock.acquire(blocking=False)
+    lock.release()
+
+
+def test_collector_op_sample_plane(monkeypatch):
+    pytest.importorskip("jax")
+    prometheus_client = pytest.importorskip("prometheus_client")
+    from triton_client_tpu.obs.collector import RuntimeCollector
+
+    registry = prometheus_client.CollectorRegistry()
+    collector = RuntimeCollector(registry=registry)
+    try:
+        rows = [
+            {"op": "fusion.1", "kind": "fusion", "model": "det2d",
+             "occurrences": 3, "time_us": 120.0, "share": 0.8},
+            {"op": "copy.2", "kind": "data-movement", "model": None,
+             "occurrences": 1, "time_us": 30.0, "share": 0.2},
+        ]
+        collector.record_op_sample(rows, 0.2)
+        snap = collector.snapshot()
+        assert snap["op_sample"]["samples"] == 1
+        fams = {f.name: f for f in collector.collect()}
+        od = {
+            (s.labels["model"], s.labels["op"]): s.value
+            for s in fams["tpu_serving_op_device_seconds"].samples
+        }
+        assert od[("det2d", "fusion.1")] == pytest.approx(120e-6)
+        assert od[("unattributed", "copy.2")] == pytest.approx(30e-6)
+        (win,) = fams["tpu_serving_op_sample_window_seconds"].samples
+        assert win.value == pytest.approx(0.2)
+        # CounterMetricFamily strips the _total suffix on family.name
+        samples_total = fams["tpu_serving_op_samples"].samples
+        assert sum(s.value for s in samples_total) >= 1
+    finally:
+        collector.close()
+
+
+def test_collector_hlo_modules_maps_registered_models():
+    from triton_client_tpu.config import ModelSpec, TensorSpec
+    from triton_client_tpu.obs.collector import RuntimeCollector
+    from triton_client_tpu.runtime.repository import ModelRepository
+
+    spec = ModelSpec(
+        name="det2d", version="1",
+        inputs=(TensorSpec("x", (-1, 4), "FP32"),),
+        outputs=(TensorSpec("y", (-1, 4), "FP32"),),
+    )
+    spec.extra["hlo_module"] = "jit_mdl_det2d_1"
+    repo = ModelRepository()
+    repo.register(spec, lambda inputs: inputs)
+    collector = RuntimeCollector(repository=repo)
+    try:
+        assert collector.hlo_modules() == {"jit_mdl_det2d_1": "det2d"}
+    finally:
+        collector.close()
+
+
+def test_trace_dump_ops_offline(capsys, tmp_path):
+    from triton_client_tpu.cli.tools import trace_dump
+
+    out = tmp_path / "ops.json"
+    trace_dump(["--ops", FIXTURE_DIR, "-o", str(out)])
+    printed = capsys.readouterr().out
+    assert "det2d" in printed and "fusion.1" in printed
+    doc = json.loads(out.read_text())
+    assert doc["total_op_time_us"] == pytest.approx(300.0)

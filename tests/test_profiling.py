@@ -5,7 +5,7 @@ import urllib.request
 import numpy as np
 import pytest
 
-from triton_client_tpu.utils.profiling import StageProfiler
+from triton_client_tpu.obs.profiling import StageProfiler
 
 
 def test_summary_quantiles_and_counts():
@@ -75,7 +75,7 @@ def test_prometheus_exporter_serves_histograms():
     prometheus_client = pytest.importorskip("prometheus_client")
     import socket
 
-    from triton_client_tpu.utils.profiling import PrometheusStageExporter
+    from triton_client_tpu.obs.profiling import PrometheusStageExporter
 
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
@@ -143,7 +143,7 @@ def test_device_trace_writes_profile(tmp_path):
     jax = pytest.importorskip("jax")
     import jax.numpy as jnp
 
-    from triton_client_tpu.utils.profiling import device_trace
+    from triton_client_tpu.obs.profiling import device_trace
 
     with device_trace(str(tmp_path)):
         jnp.ones(8).sum().block_until_ready()
@@ -153,7 +153,7 @@ def test_device_trace_writes_profile(tmp_path):
 
 def test_exporter_collision_degrades_not_raises():
     pytest.importorskip("prometheus_client")
-    from triton_client_tpu.utils.profiling import PrometheusStageExporter
+    from triton_client_tpu.obs.profiling import PrometheusStageExporter
 
     ex = PrometheusStageExporter(0, namespace="collide_ns")
     ex.observe("yolo-v5", 0.01)
@@ -167,7 +167,7 @@ def test_exporter_shares_family_on_same_registry():
     hitting the duplicate-registration ValueError and silently
     recording nothing."""
     prometheus_client = pytest.importorskip("prometheus_client")
-    from triton_client_tpu.utils.profiling import PrometheusStageExporter
+    from triton_client_tpu.obs.profiling import PrometheusStageExporter
 
     registry = prometheus_client.CollectorRegistry()
     a = PrometheusStageExporter(0, registry=registry)
@@ -183,7 +183,7 @@ def test_exporter_shares_family_on_same_registry():
 
 def test_exporter_registries_are_independent():
     prometheus_client = pytest.importorskip("prometheus_client")
-    from triton_client_tpu.utils.profiling import PrometheusStageExporter
+    from triton_client_tpu.obs.profiling import PrometheusStageExporter
 
     r1 = prometheus_client.CollectorRegistry()
     r2 = prometheus_client.CollectorRegistry()

@@ -174,7 +174,7 @@ def test_tracer_ring_buffer_is_bounded():
 
 
 def test_tracer_feeds_profiler_span_histograms():
-    from triton_client_tpu.utils.profiling import StageProfiler
+    from triton_client_tpu.obs.profiling import StageProfiler
 
     p = StageProfiler()
     tr = Tracer(profiler=p)

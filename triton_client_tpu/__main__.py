@@ -13,9 +13,13 @@ Commands map 1:1 onto the reference's entry scripts:
   repo-index — list a model repository (local dir or grpc:<addr>)
   bag-info   — rosbag info equivalent
   trace-dump — Chrome-trace JSON of recent requests from a serving
-               process's telemetry port (serve --metrics-port)
+               process's telemetry port (serve --metrics-port);
+               --ops ranks XLA ops by device time instead
   trace-join — merge client/router/replica trace dumps onto one
                timeline (per-source pid rows + clock offsets)
+  roofline   — per-model compute-/bandwidth-bound classification with
+               the attainable-fps ceiling (live /snapshot or bench
+               JSON; measured flops/bytes from XLA's cost model)
   lint       — tpulint AST hazard analysis (recompilation / donation /
                host-sync / lock / telemetry rules; docs/LINTING.md)
   route      — probe a replica set (health/readiness/labels per
@@ -40,6 +44,7 @@ COMMANDS = (
     "repo-index",
     "trace-dump",
     "trace-join",
+    "roofline",
     "lint",
     "route",
 )
@@ -77,6 +82,8 @@ def main() -> None:
         from triton_client_tpu.cli.tools import trace_dump as run
     elif cmd == "trace-join":
         from triton_client_tpu.cli.tools import trace_join as run
+    elif cmd == "roofline":
+        from triton_client_tpu.cli.tools import roofline as run
     elif cmd == "lint":
         from triton_client_tpu.cli.tools import lint as run
     elif cmd == "route":

@@ -122,6 +122,17 @@ HOT_PATH_ROOTS = (
     "DeviceTimeLedger.record",
     "FrontDoorRouter._route",
     "FrontDoorRouter._attempt_span",
+    # ISSUE 14 kernel attribution: the sampler's capture tick and the
+    # collector's sink run on the telemetry cadence but inside the
+    # process serving traffic (and the tick holds the /profile guard);
+    # the history tick runs on a timer diffing ledger snapshots under
+    # the collector lock — a host sync in any of them turns background
+    # observability into a serving stall. The launch-cost capture runs
+    # once per model on the first-launch path itself.
+    "ContinuousSampler.sample_once",
+    "MetricHistory.tick",
+    "RuntimeCollector.record_op_sample",
+    "StagedChannel._ensure_launch_cost",
 )
 
 # module-level call targets that force a host sync

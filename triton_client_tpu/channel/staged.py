@@ -32,6 +32,7 @@ the readback (and any dispatch-time error) to ``result()``.
 from __future__ import annotations
 
 import collections
+import logging
 import threading
 import time
 
@@ -58,9 +59,21 @@ from triton_client_tpu.parallel.ragged_kernels import (
 )
 from triton_client_tpu.runtime.repository import ModelRepository
 
+log = logging.getLogger(__name__)
+
 #: Reserved device-input key carrying the packed batch's row->segment
 #: table (parallel/ragged_kernels.py). Never a wire tensor name.
 SEGMENT_IDS_KEY = "__segment_ids__"
+
+
+def _batch_rows(device_inputs: dict) -> int:
+    """Frames in one dense launch: the largest leading dim among the
+    staged arrays (pure shape metadata — no host sync)."""
+    rows = 1
+    for v in device_inputs.values():
+        if getattr(v, "ndim", 0) >= 1:
+            rows = max(rows, int(v.shape[0]))
+    return rows
 
 
 def cast_wire_input(model, name: str, arr: np.ndarray) -> np.ndarray:
@@ -219,6 +232,11 @@ class StagedChannel(BaseChannel):
         # output wire dtypes); rebuilt when the repository reloads the
         # model (identity mismatch)
         self._launch_cache: dict = {}
+        # models whose measured flops/bytes (obs/roofline.py) were
+        # already recorded into spec.extra — one attempt per model
+        # identity, success or not, so a cost-model failure cannot
+        # re-trace the launcher on every launch
+        self._cost_measured: set = set()
         # optional ModelLifecycleManager (runtime/lifecycle.py): when
         # attached, stage() blocks until the model is WARM and holds an
         # in-flight reference through resolve
@@ -310,11 +328,17 @@ class StagedChannel(BaseChannel):
         # named distinctly from the dense `launcher`: this jit does NOT
         # donate, and tpulint's donor index pools jit-bound names
         # module-wide
-        @jax.jit
         def ragged_launcher(device_inputs):
             inputs = dict(device_inputs)
             ids = inputs.pop(SEGMENT_IDS_KEY)
             return ragged_fn(inputs, ids, num_segments)
+
+        # stamped with the model's launcher name (runtime only — the
+        # local binding above keeps lint's donor index unambiguous) so
+        # profiler op events attribute by HLO module (obs/opstats.py)
+        from triton_client_tpu.obs.roofline import name_launcher
+
+        ragged_launcher = jax.jit(name_launcher(ragged_launcher, model))
 
         out_dtype = {
             t.name: config_dtypes().get(t.dtype) for t in model.spec.outputs
@@ -574,7 +598,14 @@ class StagedChannel(BaseChannel):
                     model, request.ragged.launch_segments
                 )
                 donate_names = frozenset()
-                outputs = ragged_launcher(staged.device_inputs)
+                self._ensure_launch_cost(
+                    model, ragged_launcher, (staged.device_inputs,),
+                    batch_rows=request.ragged.n_segments,
+                )
+                with jax.profiler.TraceAnnotation(
+                    f"launch:{name}:{model.spec.version}"
+                ):
+                    outputs = ragged_launcher(staged.device_inputs)
             else:
                 launcher, donate_names, out_dtype = self._launcher(model)
                 if launcher is not None:
@@ -588,9 +619,23 @@ class StagedChannel(BaseChannel):
                         for k, v in staged.device_inputs.items()
                         if k not in donate_names
                     }
-                    outputs = launcher(donated, kept)
+                    self._ensure_launch_cost(
+                        model, launcher, (donated, kept),
+                        batch_rows=_batch_rows(staged.device_inputs),
+                    )
+                    # named region around the dispatch: a profiler
+                    # capture (/profile, the continuous sampler) then
+                    # maps device ops back to this model even when the
+                    # HLO module name is unavailable (obs/opstats.py)
+                    with jax.profiler.TraceAnnotation(
+                        f"launch:{name}:{model.spec.version}"
+                    ):
+                        outputs = launcher(donated, kept)
                 else:
-                    outputs = model.infer_fn(staged.device_inputs)
+                    with jax.profiler.TraceAnnotation(
+                        f"launch:{name}:{model.spec.version}"
+                    ):
+                        outputs = model.infer_fn(staged.device_inputs)
         except Exception as e:
             # fan the error to THIS request's future only; the slot
             # frees, the channel and its caches stay serviceable for
@@ -674,6 +719,31 @@ class StagedChannel(BaseChannel):
         with self._slot_cv:
             self._launch_cache[key] = (model, launcher, donate_names, out_dtype)
         return launcher, donate_names, out_dtype
+
+    def _ensure_launch_cost(
+        self, model, launcher, args, batch_rows: int = 1
+    ) -> None:
+        """Record XLA's measured flops/bytes for one launcher call into
+        ``model.spec.extra`` (obs/roofline.py) — once per model
+        identity, on the first launch, where the example args finally
+        exist. Tracing-only (no backend compile) and immediately before
+        the first call's full compile, so the marginal cost is
+        milliseconds on a path about to pay seconds. Never fails the
+        launch: the roofline is observability, not serving."""
+        key = (model.spec.name, model.spec.version)
+        with self._slot_cv:
+            if key in self._cost_measured:
+                return
+            self._cost_measured.add(key)
+        try:
+            from triton_client_tpu.obs.roofline import record_launch_cost
+
+            record_launch_cost(model, launcher, *args, batch_rows=batch_rows)
+        except Exception:  # cost model unavailable on this backend
+            log.debug(
+                "measured-cost capture failed for %s:%s",
+                *key, exc_info=True,
+            )
 
     # -- model lifecycle (runtime/lifecycle.py) -------------------------------
 

@@ -40,6 +40,7 @@ from triton_client_tpu.channel.staged import (  # noqa: F401 — re-exported
     cast_wire_input,
 )
 from triton_client_tpu.config import config_dtypes
+from triton_client_tpu.obs.roofline import name_launcher
 from triton_client_tpu.parallel.mesh import batch_sharding
 
 
@@ -72,8 +73,13 @@ class TPUChannel(StagedChannel):
             frozenset(model.spec.donatable_inputs()) if self._donate else frozenset()
         )
         device_fn = self._device_body(model)
+        # the launcher carries the model's name so its HLO module is
+        # jit_mdl_<name>_<version> — profiler op events then attribute
+        # back to the model by module name (obs/opstats.py)
         launcher = jax.jit(
-            lambda donated, kept: device_fn({**donated, **kept}),
+            name_launcher(
+                lambda donated, kept: device_fn({**donated, **kept}), model
+            ),
             donate_argnums=(0,),
         )
         out_dtype = {

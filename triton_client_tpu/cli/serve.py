@@ -104,6 +104,34 @@ def main(argv=None) -> None:
         "state on /snapshot (0 disables)",
     )
     p.add_argument(
+        "--op-sample-interval", type=float, default=0.0,
+        help="continuous op-level sampling: take a short jax.profiler "
+        "window every this many seconds and export top-K per-op device "
+        "time at tpu_serving_op_device_seconds{model,op,kind} "
+        "(obs/sampler.py; capture share of wall time is structurally "
+        "capped at 1%%). 0 disables. Requires --metrics-port",
+    )
+    p.add_argument(
+        "--op-sample-window", type=float, default=0.2,
+        help="length of one sampler capture window in seconds (clamped "
+        "so window/interval never exceeds the 1%% duty-cycle budget)",
+    )
+    p.add_argument(
+        "--history-interval", type=float, default=10.0,
+        help="metric-history ring spacing in seconds: per-model×tenant "
+        "launch/device-time rates, utilization and MFU snapshots "
+        "served at /history (0 disables)",
+    )
+    p.add_argument(
+        "--history-capacity", type=int, default=360,
+        help="metric-history ring depth (default 360 x 10s = 1h)",
+    )
+    p.add_argument(
+        "--history-path", default="",
+        help="persist the metric-history ring to this JSON file on "
+        "drain and restore from it on startup (empty disables)",
+    )
+    p.add_argument(
         "--trace-capacity", type=int, default=256,
         help="recent request traces kept for /traces export "
         "(`trace-dump`); 0 disables request-scoped spans",
@@ -204,7 +232,7 @@ def main(argv=None) -> None:
     if server.metrics_enabled:
         print(
             f"telemetry on :{server.metrics_port} "
-            "(/metrics /traces /snapshot)", flush=True,
+            "(/metrics /traces /snapshot /profile /history)", flush=True,
         )
 
     import signal
@@ -402,6 +430,11 @@ def build_server(args):
         lifecycle=lifecycle,
         tenants=tenants,
         replica_of=getattr(args, "replica_of", "") or None,
+        op_sample_interval_s=getattr(args, "op_sample_interval", 0.0),
+        op_sample_window_s=getattr(args, "op_sample_window", 0.2),
+        history_interval_s=getattr(args, "history_interval", 10.0),
+        history_capacity=getattr(args, "history_capacity", 360),
+        history_path=getattr(args, "history_path", "") or None,
     )
 
 

@@ -10,7 +10,18 @@
                 handy since TPU hosts have no ROS tooling).
   trace-dump  — pull the request-trace ring buffer off a serving
                 process's telemetry port as Chrome-trace JSON
-                (open in Perfetto / chrome://tracing).
+                (open in Perfetto / chrome://tracing). ``--ops`` turns
+                it into a per-op device-time report instead: summarize
+                an offline jax.profiler capture (``--ops PATH``) or
+                take a live capture through ``/profile`` (bare
+                ``--ops``) and rank XLA ops by device time with their
+                owning model (obs/opstats.py).
+  roofline    — per-model roofline report: measured flops/bytes from
+                XLA's cost model (spec.extra, recorded at first
+                launch), arithmetic intensity vs the machine knee,
+                compute-/bandwidth-bound class, attainable-fps ceiling
+                next to the measured rate. Reads a live /snapshot URL
+                or a bench.py results JSON.
   trace-join  — merge several Chrome-trace exports (client / router /
                 replica trace-dump outputs) onto ONE timeline: each
                 source becomes its own pid row, shifted by an explicit
@@ -125,11 +136,76 @@ def trace_dump(argv=None) -> None:
         "chrome://tracing",
     )
     p.add_argument("--timeout", type=float, default=10.0)
+    p.add_argument(
+        "--ops", nargs="?", const="", default=None, metavar="TRACE",
+        help="per-op device-time report instead of a raw trace dump: "
+        "with a PATH, summarize that jax.profiler capture (a profile "
+        "dir or .trace.json[.gz] file) offline; bare --ops takes a "
+        "live capture through <url>/profile first",
+    )
+    p.add_argument(
+        "--seconds", type=float, default=1.0,
+        help="live capture window for bare --ops (the /profile knob)",
+    )
+    p.add_argument(
+        "--top-k", type=int, default=20,
+        help="op rows to keep in the --ops report",
+    )
     args = p.parse_args(argv)
 
     import json
     import sys
     import urllib.request
+
+    if args.ops is not None:
+        from triton_client_tpu.obs import opstats
+
+        if args.ops:
+            summary = opstats.summarize_profile_dir(
+                args.ops, top_k=args.top_k
+            )
+        else:
+            url = (
+                args.url.rstrip("/")
+                + f"/profile?seconds={args.seconds}&top_k={args.top_k}"
+            )
+            with urllib.request.urlopen(url, timeout=args.timeout + args.seconds) as resp:
+                doc = json.load(resp)
+            if "op_summary" not in doc:
+                raise SystemExit(
+                    f"{url} returned no op summary "
+                    f"({doc.get('op_summary_error', 'unknown failure')})"
+                )
+            summary = doc["op_summary"]
+        total_us = summary.get("total_op_time_us", 0.0) or 0.0
+        print(
+            f"{summary.get('op_count', 0)} distinct ops, "
+            f"{total_us / 1e3:.3f} ms total device op time"
+        )
+        for model, us in sorted(
+            (summary.get("models") or {}).items(), key=lambda kv: -kv[1]
+        ):
+            print(f"  {model}: {us / 1e3:.3f} ms")
+        unattr = summary.get("unattributed_us", 0.0)
+        if unattr:
+            print(f"  (unattributed: {unattr / 1e3:.3f} ms)")
+        hdr = f"{'model':<16} {'kind':<14} {'occ':>5} {'ms':>10} {'share':>7}  op"
+        print(hdr)
+        print("-" * len(hdr))
+        for row in summary.get("ops") or []:
+            print(
+                f"{(row.get('model') or '-'):<16} "
+                f"{row.get('kind', '?'):<14} "
+                f"{row.get('occurrences', 0):>5} "
+                f"{row.get('time_us', 0.0) / 1e3:>10.3f} "
+                f"{row.get('share', 0.0):>6.1%}  "
+                f"{row.get('op', '?')}"
+            )
+        if args.output != "-":
+            with open(args.output, "w") as f:
+                json.dump(summary, f, indent=2)
+            print(f"wrote op summary -> {args.output}", file=sys.stderr)
+        return
 
     url = args.url.rstrip("/") + "/traces"
     if args.count:
@@ -271,6 +347,104 @@ def trace_join(argv=None) -> None:
         print(
             f"wrote {len(events)} joined events -> {args.output}",
             file=sys.stderr,
+        )
+
+
+def roofline(argv=None) -> None:
+    """Per-model roofline report: measured flops/bytes (XLA cost model,
+    recorded into spec.extra at first launch), arithmetic intensity vs
+    the machine knee, the binding ceiling, and the attainable-fps
+    ceiling next to the measured rate. Reads a live server's /snapshot
+    or a bench.py results JSON."""
+    p = argparse.ArgumentParser(
+        description="per-model roofline classification "
+        "(compute- vs bandwidth-bound, attainable-fps ceiling)"
+    )
+    p.add_argument(
+        "source", nargs="?", default="http://127.0.0.1:8002",
+        help="telemetry URL of a serving process (reads /snapshot) or "
+        "a bench.py results JSON file",
+    )
+    p.add_argument("--timeout", type=float, default=10.0)
+    p.add_argument(
+        "--json", action="store_true", help="machine-readable JSON output"
+    )
+    args = p.parse_args(argv)
+
+    import json
+    import urllib.request
+
+    rows = []
+    if os.path.exists(args.source):
+        with open(args.source) as f:
+            doc = json.load(f)
+        # bench.py results: rows carry the roofline columns directly
+        for r in doc.get("rows") or doc.get("results") or []:
+            if not r.get("roofline_bound"):
+                continue
+            per_call = r.get("flops_per_call") or (
+                (r.get("flops_per_frame") or 0.0) * 1
+            )
+            rows.append(
+                {
+                    "model": r.get("metric", "?"),
+                    "precision": r.get("precision", "f32"),
+                    "flops": per_call,
+                    "bytes": r.get("bytes_per_call")
+                    or r.get("bytes_per_frame") or 0.0,
+                    "intensity": r.get("arithmetic_intensity", 0.0),
+                    "bound": r.get("roofline_bound", "unknown"),
+                    "attainable_fps": r.get("attainable_fps", 0.0),
+                    "measured_fps": r.get("value"),
+                    "attained_fraction": r.get("roofline_attained_ratio"),
+                }
+            )
+    else:
+        url = args.source.rstrip("/") + "/snapshot"
+        with urllib.request.urlopen(url, timeout=args.timeout) as resp:
+            snap = json.load(resp)
+        for m in snap.get("models") or []:
+            roof = m.get("roofline")
+            if not roof:
+                continue
+            rows.append(
+                {
+                    "model": f"{m['model']}:{m['version']}",
+                    "precision": roof.get("precision", "f32"),
+                    "flops": roof.get("flops", 0.0),
+                    "bytes": roof.get("bytes", 0.0),
+                    "intensity": roof.get("intensity", 0.0),
+                    "bound": roof.get("bound", "unknown"),
+                    "attainable_fps": roof.get("attainable_fps", 0.0),
+                    "measured_fps": roof.get("measured_fps"),
+                    "attained_fraction": roof.get("attained_fraction"),
+                }
+            )
+    if args.json:
+        print(json.dumps({"rows": rows}, indent=2))
+        return
+    if not rows:
+        raise SystemExit(
+            "no roofline rows: models record measured flops/bytes at "
+            "their first launch (serve a request, then retry), and "
+            "bench JSON needs the roofline columns (rerun bench.py)"
+        )
+    hdr = (
+        f"{'model':<40} {'prec':<6} {'GF/call':>9} {'MB/call':>9} "
+        f"{'flop/B':>8} {'bound':<10} {'ceiling fps':>12} {'attained':>9}"
+    )
+    print(hdr)
+    print("-" * len(hdr))
+    for r in rows:
+        attained = (
+            f"{r['attained_fraction']:.1%}"
+            if r.get("attained_fraction") is not None else "-"
+        )
+        print(
+            f"{r['model']:<40} {r['precision']:<6} "
+            f"{r['flops'] / 1e9:>9.2f} {r['bytes'] / 1e6:>9.2f} "
+            f"{r['intensity']:>8.1f} {r['bound']:<10} "
+            f"{r['attainable_fps']:>12.1f} {attained:>9}"
         )
 
 

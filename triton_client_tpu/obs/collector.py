@@ -138,6 +138,20 @@ METRIC_TYPES: dict[str, str] = {
     "tpu_serving_wire_bytes_total": "counter",
     "tpu_serving_shm_bytes_total": "counter",
     "tpu_serving_stream_group_size": "histogram",
+    # kernel-attribution plane (ISSUE 14): per-XLA-op device time over
+    # the continuous sampler's last capture window (top-K by model, op,
+    # fusion kind), the window length and capture/skip counters, the
+    # per-model roofline placement from cost_analysis()-measured
+    # flops/bytes (arithmetic intensity, binding ceiling class,
+    # attainable-fps ceiling), and the metric-history ring depth
+    "tpu_serving_op_device_seconds": "gauge",
+    "tpu_serving_op_sample_window_seconds": "gauge",
+    "tpu_serving_op_samples_total": "counter",
+    "tpu_serving_op_sample_skips_total": "counter",
+    "tpu_serving_model_roofline_info": "gauge",
+    "tpu_serving_model_arithmetic_intensity": "gauge",
+    "tpu_serving_model_attainable_fps": "gauge",
+    "tpu_serving_history_buffered": "gauge",
 }
 
 _HBM_KINDS = ("bytes_in_use", "bytes_limit", "peak_bytes_in_use")
@@ -259,6 +273,14 @@ class RuntimeCollector:
         self._wire_bytes = 0
         self._shm_bytes = 0
         self._stream_groups: dict[int, int] = {}
+        # kernel-attribution plane: the sampler's last per-op window
+        # (gauges show the latest capture; the counter accumulates) and
+        # the optional sampler/history components attached post-build
+        self._op_rows: list = []
+        self._op_window_s = 0.0
+        self._op_samples = 0
+        self._sampler = None
+        self._history = None
         self._draining = False
         self._registry = None
         if registry is not None:
@@ -308,6 +330,48 @@ class RuntimeCollector:
                 self._stream_groups.get(int(size), 0) + 1
             )
 
+    def record_op_sample(self, rows, window_s: float) -> None:
+        """The continuous sampler's sink: the top-K per-op rows of one
+        capture window (obs.opstats.summarize row shape). Gauges export
+        the LAST window; the samples counter accumulates."""
+        with self._lock:
+            self._op_rows = list(rows or [])
+            self._op_window_s = float(window_s or 0.0)
+            self._op_samples += 1
+
+    def attach_sampler(self, sampler) -> None:
+        """Wire the ContinuousSampler whose stats() (skips, duty cycle)
+        this collector exports — attached after construction because the
+        sampler itself takes the collector as its sink."""
+        self._sampler = sampler
+
+    def attach_history(self, history) -> None:
+        """Wire the MetricHistory whose ring depth this collector
+        exports."""
+        self._history = history
+
+    def hlo_modules(self) -> dict[str, str]:
+        """``{hlo_module: model_name}`` over every registered model —
+        the op->model attribution map the sampler and /profile hand to
+        obs.opstats (each spec.extra's ``hlo_module`` is recorded at
+        launcher build by obs.roofline.record_launch_cost)."""
+        out: dict[str, str] = {}
+        if self._repository is None:
+            return out
+        try:
+            listing = self._repository.list_models()
+        except Exception:
+            return out
+        for name, version in listing:
+            try:
+                extra = self._repository.get(name, version).spec.extra
+            except Exception:
+                continue
+            module = extra.get("hlo_module")
+            if module:
+                out[str(module)] = name
+        return out
+
     def set_draining(self, draining: bool) -> None:
         with self._lock:
             self._draining = bool(draining)
@@ -325,6 +389,11 @@ class RuntimeCollector:
                 "wire_bytes": self._wire_bytes,
                 "shm_bytes": self._shm_bytes,
                 "stream_groups": dict(self._stream_groups),
+            }
+            op_sample = {
+                "rows": list(self._op_rows),
+                "window_s": self._op_window_s,
+                "samples": self._op_samples,
             }
         snap = {
             "channel": self._tpu.stats() if self._tpu is not None else None,
@@ -353,6 +422,11 @@ class RuntimeCollector:
             snap["tracer"] = self._tracer.stats()
         if self._device_time is not None:
             snap["device_time"] = self._device_time.snapshot()
+        snap["op_sample"] = op_sample
+        if self._sampler is not None:
+            snap["sampler"] = self._sampler.stats()
+        if self._history is not None:
+            snap["history"] = self._history.stats()
         if self._histograms is not None:
             # numeric-leaved per-(model|stage) bucket counts + sum:
             # delta() of two snapshots is the WINDOW's histogram, and
@@ -383,14 +457,23 @@ class RuntimeCollector:
                 extra = self._repository.get(name, version).spec.extra
             except Exception:
                 continue
-            rows.append(
-                {
-                    "model": name,
-                    "version": version,
-                    "precision": str(extra.get("precision", "f32")),
-                    "param_bytes": int(extra.get("param_bytes", 0) or 0),
-                }
-            )
+            row = {
+                "model": name,
+                "version": version,
+                "precision": str(extra.get("precision", "f32")),
+                "param_bytes": int(extra.get("param_bytes", 0) or 0),
+            }
+            # roofline placement once the channel has recorded the
+            # XLA-measured launch cost (obs.roofline.record_launch_cost
+            # at first launch; absent until then / without a cost model)
+            if extra.get("measured_flops_per_call") is not None:
+                try:
+                    from triton_client_tpu.obs.roofline import model_row
+
+                    row["roofline"] = model_row(extra)
+                except Exception:
+                    pass
+            rows.append(row)
         return rows
 
     @staticmethod
@@ -925,6 +1008,92 @@ class RuntimeCollector:
             samples=[
                 ([m], v) for m, v in (dt_window.get("mfu") or {}).items()
             ],
+        )
+
+        # kernel-attribution plane (ISSUE 14): per-op device time over
+        # the sampler's last capture window, sampler counters, and each
+        # model's roofline placement from the measured launch cost
+        op = snap.get("op_sample") or {}
+        samp = snap.get("sampler") or {}
+        yield gauge(
+            f"{ns}_op_device_seconds",
+            "device time per XLA op over the sampler's last capture "
+            "window (top-K by time; model attributed via HLO module / "
+            "launch annotations)",
+            0,
+            labels=["model", "op", "kind"],
+            samples=[
+                (
+                    [
+                        str(r.get("model") or "unattributed"),
+                        str(r.get("op", "?")),
+                        str(r.get("kind", "other")),
+                    ],
+                    float(r.get("time_us", 0.0)) / 1e6,
+                )
+                for r in (op.get("rows") or [])
+            ],
+        )
+        yield gauge(
+            f"{ns}_op_sample_window_seconds",
+            "length of the sampler's last profiler capture window",
+            op.get("window_s", 0.0),
+        )
+        yield counter(
+            f"{ns}_op_samples_total",
+            "profiler capture windows delivered by the continuous "
+            "sampler",
+            op.get("samples", 0),
+        )
+        yield counter(
+            f"{ns}_op_sample_skips_total",
+            "sampler windows skipped because /profile held the capture "
+            "guard",
+            samp.get("skipped_busy", 0),
+        )
+        roofline_rows = [
+            (m, m["roofline"]) for m in models if m.get("roofline")
+        ]
+        yield gauge(
+            f"{ns}_model_roofline_info",
+            "roofline bound class per model from XLA-measured "
+            "flops/bytes (info gauge: compute/bandwidth)",
+            0,
+            labels=["model", "version", "bound"],
+            samples=[
+                ([m["model"], m["version"], r["bound"]], 1)
+                for m, r in roofline_rows
+            ],
+        )
+        yield gauge(
+            f"{ns}_model_arithmetic_intensity",
+            "measured flops per HBM byte of one launch "
+            "(XLA cost model at the serving batch)",
+            0,
+            labels=["model", "version"],
+            samples=[
+                ([m["model"], m["version"]], r["intensity"])
+                for m, r in roofline_rows
+                if r["intensity"] == r["intensity"]
+                and r["intensity"] not in (float("inf"),)
+            ],
+        )
+        yield gauge(
+            f"{ns}_model_attainable_fps",
+            "roofline-ceiling frames/s at the measured batch (the "
+            "honest headroom next to the served rate)",
+            0,
+            labels=["model", "version"],
+            samples=[
+                ([m["model"], m["version"]], r["attainable_fps"])
+                for m, r in roofline_rows
+            ],
+        )
+        hist_stats = snap.get("history") or {}
+        yield gauge(
+            f"{ns}_history_buffered",
+            "metric-history snapshots buffered in the ring",
+            hist_stats.get("buffered", 0),
         )
 
         # host-transport plane: negotiated transport per request, the

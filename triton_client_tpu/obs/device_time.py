@@ -30,15 +30,13 @@ import collections
 import threading
 import time
 
-# v5e peak MAC rates per chip, mirroring perf/bench.py's accounting so
-# served MFU and bench MFU are the same unit. int8 MACs run at 2x.
-V5E_PEAK_FLOPS = 197e12
-POLICY_PEAK_FLOPS = {
-    "f32": V5E_PEAK_FLOPS,
-    "bf16": V5E_PEAK_FLOPS,
-    "int8w": V5E_PEAK_FLOPS,
-    "int8": 2 * V5E_PEAK_FLOPS,
-}
+# Re-exported from the roofline module — the single home of the
+# per-chip peaks (bench.py imports the same table), so served MFU,
+# bench MFU, and the roofline ceiling all divide by one denominator.
+from triton_client_tpu.obs.roofline import (  # noqa: F401
+    POLICY_PEAK_FLOPS,
+    V5E_PEAK_FLOPS,
+)
 
 
 class DeviceTimeLedger:

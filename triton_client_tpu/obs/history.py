@@ -1,0 +1,187 @@
+"""Metric history ring: per-model×tenant rate/util/MFU over time.
+
+ROADMAP item 4's predictive autoscaling needs a traffic HISTORY to
+forecast from — promote models ahead of the ramp the last N mornings
+showed — and PR 9 declared the collector's per-tenant history as its
+feed. This module is that feed: a fixed-interval ring of snapshots,
+each one the DELTA of the DeviceTimeLedger's cumulative account over
+the interval,
+
+  {"t": unix_seconds, "interval_s": ...,
+   "utilization": window busy ratio,
+   "models": {"model|tenant": {"launches_per_s": ...,
+                               "device_s_per_s": ...,
+                               "mfu": ...}}}
+
+exported live at ``GET /history`` (?n=K most recent) and persisted to
+JSON on drain, so a restart — or the autoscaler's offline trainer —
+reads the same shape the live endpoint serves.
+
+The ring is bounded (``capacity`` intervals, default 360 × 10 s = 1 h)
+and ``tick()`` is plain dict arithmetic off two ledger snapshots: no
+host syncs, no device work — safe on the telemetry timer thread.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from collections import deque
+
+log = logging.getLogger(__name__)
+
+
+class MetricHistory:
+    """Fixed-interval ring of serving-rate snapshots.
+
+    ``ledger``: an obs.device_time.DeviceTimeLedger (the source of
+    device-seconds / launches / MFU). ``interval_s``: snapshot spacing;
+    ``capacity``: ring depth. The background thread starts only on
+    :meth:`start`; tests call :meth:`tick` directly.
+    """
+
+    def __init__(
+        self,
+        ledger=None,
+        interval_s: float = 10.0,
+        capacity: int = 360,
+    ) -> None:
+        self._ledger = ledger
+        self.interval_s = max(0.5, float(interval_s))
+        self.capacity = max(2, int(capacity))
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=self.capacity)
+        self._last: dict | None = None
+        self._last_t = time.perf_counter()
+        self._ticks = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- recording ------------------------------------------------------------
+
+    def tick(self, now: float | None = None) -> dict | None:
+        """Take one snapshot: the ledger's cumulative account diffed
+        against the previous tick, normalized to rates. Returns the
+        appended entry (None when no ledger is wired)."""
+        if self._ledger is None:
+            return None
+        try:
+            snap = self._ledger.snapshot()
+        except Exception:
+            log.exception("history tick: ledger snapshot failed")
+            return None
+        t = time.perf_counter() if now is None else float(now)
+        with self._lock:
+            prev, prev_t = self._last, self._last_t
+            self._last, self._last_t = snap, t
+            dt = max(t - prev_t, 1e-9) if prev is not None else None
+            entry = self._entry(snap, prev, dt)
+            self._ring.append(entry)
+            self._ticks += 1
+        return entry
+
+    @staticmethod
+    def _entry(snap: dict, prev: dict | None, dt: float | None) -> dict:
+        """One ring entry from consecutive ledger snapshots. The first
+        tick has no delta baseline: rates are 0, util/MFU still export
+        (they are window gauges, not counters)."""
+        window = snap.get("window") or {}
+        mfu = window.get("mfu") or {}
+        device_s = snap.get("device_seconds") or {}
+        launches = snap.get("launches") or {}
+        prev_device = (prev or {}).get("device_seconds") or {}
+        prev_launches = (prev or {}).get("launches") or {}
+        models: dict[str, dict] = {}
+        for key, total in device_s.items():
+            model = key.split("|", 1)[0]
+            d_dev = total - prev_device.get(key, 0.0) if dt else 0.0
+            d_launch = (
+                launches.get(model, 0) - prev_launches.get(model, 0)
+                if dt
+                else 0
+            )
+            models[key] = {
+                "launches_per_s": (d_launch / dt) if dt else 0.0,
+                "device_s_per_s": (d_dev / dt) if dt else 0.0,
+                "mfu": float(mfu.get(model, 0.0)),
+            }
+        return {
+            "t": time.time(),
+            "interval_s": dt or 0.0,
+            "utilization": float(window.get("utilization", 0.0)),
+            "models": models,
+        }
+
+    # -- reading --------------------------------------------------------------
+
+    def snapshots(self, n: int = 0) -> list[dict]:
+        """Most recent ``n`` entries (0 = everything buffered),
+        oldest first."""
+        with self._lock:
+            entries = list(self._ring)
+        if n and n > 0:
+            entries = entries[-n:]
+        return entries
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "interval_s": self.interval_s,
+                "capacity": self.capacity,
+                "buffered": len(self._ring),
+                "ticks": self._ticks,
+            }
+
+    # -- persistence (the drain path) -----------------------------------------
+
+    def persist(self, path: str) -> int:
+        """Write the ring to ``path`` as JSON; returns the entry count.
+        Called from InferenceServer.drain() so the history survives the
+        restart it is most needed across."""
+        doc = {
+            "interval_s": self.interval_s,
+            "persisted_at": time.time(),
+            "snapshots": self.snapshots(),
+        }
+        with open(path, "w") as fh:
+            json.dump(doc, fh)
+        return len(doc["snapshots"])
+
+    @staticmethod
+    def load(path: str) -> dict:
+        """Read a persisted history document (the autoscaler's offline
+        side of the round-trip)."""
+        with open(path) as fh:
+            return json.load(fh)
+
+    def restore(self, doc: dict) -> int:
+        """Seed the ring from a persisted document (newest entries kept
+        when the document exceeds capacity)."""
+        entries = list(doc.get("snapshots") or [])
+        with self._lock:
+            for e in entries[-self.capacity:]:
+                self._ring.append(e)
+        return min(len(entries), self.capacity)
+
+    # -- background loop ------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="metric-history", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.tick()
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

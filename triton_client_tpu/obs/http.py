@@ -13,15 +13,24 @@ traces and the raw collector snapshot:
                  landed at/above the live per-model p99)
   GET /snapshot  RuntimeCollector.snapshot() as JSON (debug/automation)
   GET /profile   on-demand jax.profiler capture (?seconds=N, default 1,
-                 capped at 60): blocks for the window, writes the XLA +
-                 device timeline into a server-local directory, and
-                 returns its path as JSON. One capture at a time — a
-                 concurrent request gets 409 (jax.profiler is a
-                 process-global singleton; overlapping captures abort).
+                 capped at 60; ?top_k=K bounds the op rows): blocks for
+                 the window, writes the XLA + device timeline into a
+                 server-local directory, and returns its path PLUS the
+                 parsed per-op summary (obs.opstats: op, kind, model,
+                 occurrences, device time) as JSON. One capture at a
+                 time — a concurrent request gets 409 (jax.profiler is
+                 a process-global singleton; overlapping captures
+                 abort). A trace that fails to parse still returns the
+                 capture path (op_summary_error names the failure) and
+                 NEVER wedges the capture guard.
+  GET /history   the MetricHistory ring (?n=K most recent snapshots):
+                 per-model×tenant launch/device-time rates, utilization
+                 and MFU at a fixed interval (obs/history.py).
 
 Paths degrade independently: without prometheus_client /metrics is 503
 but traces still export; without a tracer /traces is 404 (and without
-an SLO tracker, ?slo_violations=1 is 404); without jax /profile is 503.
+an SLO tracker, ?slo_violations=1 is 404); without jax /profile is 503;
+without a history ring /history is 404.
 """
 
 from __future__ import annotations
@@ -52,11 +61,13 @@ class TelemetryServer:
         collector=None,
         host: str = "0.0.0.0",
         slo=None,
+        history=None,
     ) -> None:
         self._registry = registry
         self._tracer = tracer
         self._collector = collector
         self._slo = slo
+        self._history = history
         # /profile concurrency guard: jax.profiler keeps ONE process-
         # global capture; a second start_trace raises mid-capture and
         # would kill the first requester's window too
@@ -131,23 +142,55 @@ class TelemetryServer:
             self._send(req, 200, body, "application/json")
         elif path == "/profile":
             self._profile(req, parsed)
+        elif path == "/history":
+            if self._history is None:
+                self._send(req, 404, b"metric history disabled\n")
+                return
+            q = parse_qs(parsed.query)
+            try:
+                n = int(q.get("n", ["0"])[0])
+            except ValueError:
+                n = 0
+            body = json.dumps(
+                {
+                    "stats": self._history.stats(),
+                    "snapshots": self._history.snapshots(n),
+                }
+            ).encode()
+            self._send(req, 200, body, "application/json")
         elif path == "/":
             self._send(
                 req, 200,
                 b"tpu_serving telemetry: /metrics /traces /snapshot "
-                b"/profile\n",
+                b"/profile /history\n",
             )
         else:
             self._send(req, 404, b"not found\n")
 
+    @property
+    def profile_lock(self) -> threading.Lock:
+        """The process-global capture guard. The ContinuousSampler
+        shares this lock so background windows and on-demand /profile
+        captures can never overlap (jax.profiler is a singleton)."""
+        return self._profile_lock
+
     def _profile(self, req, parsed) -> None:
-        """Blocking jax.profiler capture window; refuses overlap."""
+        """Blocking jax.profiler capture window; refuses overlap. The
+        response carries the capture path AND the parsed per-op summary
+        (obs.opstats). The guard covers ONLY the profiler singleton:
+        it is released in a finally before the (pure-file) parse, so a
+        malformed trace degrades to an ``op_summary_error`` field and
+        can never wedge future captures."""
         q = parse_qs(parsed.query)
         try:
             seconds = float(q.get("seconds", ["1"])[0])
         except ValueError:
             self._send(req, 400, b"seconds must be a number\n")
             return
+        try:
+            top_k = int(q.get("top_k", ["20"])[0])
+        except ValueError:
+            top_k = 20
         seconds = min(max(seconds, 0.05), _PROFILE_MAX_S)
         try:
             import jax
@@ -166,15 +209,28 @@ class TelemetryServer:
                 time.sleep(seconds)
             finally:
                 jax.profiler.stop_trace()
-            body = json.dumps(
-                {"log_dir": log_dir, "seconds": seconds}
-            ).encode()
-            self._send(req, 200, body, "application/json")
         except Exception as e:
             log.exception("profile capture failed")
             self._send(req, 500, f"profile capture failed: {e}\n".encode())
+            return
         finally:
             self._profile_lock.release()
+        doc = {"log_dir": log_dir, "seconds": seconds}
+        try:
+            from triton_client_tpu.obs import opstats
+
+            modules = None
+            if self._collector is not None:
+                hlo_modules = getattr(self._collector, "hlo_modules", None)
+                if callable(hlo_modules):
+                    modules = hlo_modules()
+            doc["op_summary"] = opstats.summarize_profile_dir(
+                log_dir, hlo_modules=modules, top_k=top_k
+            )
+        except Exception as e:
+            log.exception("profile trace parse failed")
+            doc["op_summary_error"] = str(e)
+        self._send(req, 200, json.dumps(doc).encode(), "application/json")
 
     @staticmethod
     def _send(req, code: int, body: bytes, ctype: str = "text/plain") -> None:

@@ -1,0 +1,255 @@
+"""Op-level device attribution from jax.profiler captures.
+
+The ``/profile`` endpoint and ``--profile-trace`` both write a
+TensorBoard profile directory whose useful artifact — for a machine —
+is the Chrome-trace JSON under ``plugins/profile/<run>/*.trace.json.gz``.
+Until this module a human eyeballed it in Perfetto; now it parses into
+per-op rows the rest of the observability plane can rank, export, and
+diff:
+
+  op name, fusion kind, occurrences, device-time, share of the
+  window's total op time, owning model.
+
+Op -> model attribution uses two keys, in order:
+
+  1. ``hlo_module`` — XLA stamps every op event with its module name,
+     and the staged channels name each model's launcher so the module
+     is ``jit_mdl_<name>_<version>`` (obs/roofline.py
+     ``name_launcher``). Exact and unambiguous, survives async
+     dispatch and pipelining.
+  2. ``TraceAnnotation`` windows — ``StagedChannel.launch`` brackets
+     every dispatch in a ``launch:<model>:<version>`` annotation; an op
+     event whose midpoint falls inside exactly one model's windows is
+     attributed to it. The fallback for launchers that predate naming
+     (ragged buckets, host-side custom calls).
+
+Everything here is stdlib (json + gzip): the parser must run inside
+the serving process's telemetry thread and in offline CLI use on
+machines without TensorBoard.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+
+#: annotation prefix StagedChannel.launch emits around every dispatch
+LAUNCH_ANNOTATION_PREFIX = "launch:"
+
+#: op-name substring -> fusion/kind bucket, first match wins. Coarse on
+#: purpose: the question is "what KIND of work dominates", not XLA's
+#: full taxonomy.
+_KIND_RULES = (
+    ("fusion", "fusion"),
+    ("custom-call", "custom-call"),
+    ("custom_call", "custom-call"),
+    ("convolution", "convolution"),
+    ("conv", "convolution"),
+    ("dot", "dot"),
+    ("all-reduce", "collective"),
+    ("all-gather", "collective"),
+    ("reduce-scatter", "collective"),
+    ("collective", "collective"),
+    ("scatter", "scatter"),
+    ("gather", "gather"),
+    ("reduce", "reduce"),
+    ("sort", "sort"),
+    ("copy", "data-movement"),
+    ("transpose", "data-movement"),
+    ("reshape", "data-movement"),
+    ("broadcast", "data-movement"),
+    ("slice", "data-movement"),
+    ("concatenate", "data-movement"),
+    ("pad", "data-movement"),
+    ("infeed", "host-transfer"),
+    ("outfeed", "host-transfer"),
+)
+
+
+def op_kind(name: str) -> str:
+    low = name.lower()
+    for needle, kind in _KIND_RULES:
+        if needle in low:
+            return kind
+    return "other"
+
+
+def find_trace_file(log_dir: str) -> str | None:
+    """Newest ``*.trace.json(.gz)`` under a jax.profiler log dir (the
+    ``plugins/profile/<timestamp>/`` layout), or the path itself when
+    it already points at a trace file."""
+    if os.path.isfile(log_dir):
+        return log_dir
+    candidates = glob.glob(
+        os.path.join(log_dir, "**", "*.trace.json.gz"), recursive=True
+    ) + glob.glob(os.path.join(log_dir, "**", "*.trace.json"), recursive=True)
+    if not candidates:
+        return None
+    return max(candidates, key=os.path.getmtime)
+
+
+def load_trace(path: str) -> dict:
+    """Chrome-trace JSON document from a .trace.json(.gz) file."""
+    if path.endswith(".gz"):
+        with gzip.open(path, "rt") as fh:
+            return json.load(fh)
+    with open(path) as fh:
+        return json.load(fh)
+
+
+def _annotation_windows(events, prefix: str) -> dict[str, list]:
+    """``model -> [(ts, ts_end), ...]`` from launch annotations. The
+    annotation name is ``<prefix><model>:<version>``; version is folded
+    out — device time is accounted per model name everywhere else."""
+    windows: dict[str, list] = {}
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        name = e.get("name", "")
+        if not name.startswith(prefix):
+            continue
+        model = name[len(prefix):].rsplit(":", 1)[0]
+        ts = float(e.get("ts", 0.0))
+        windows.setdefault(model, []).append((ts, ts + float(e.get("dur", 0.0))))
+    return windows
+
+
+def _module_models(hlo_modules: dict | None) -> dict[str, str]:
+    """Normalize an ``{hlo_module: model}`` mapping (the collector
+    builds one from each spec.extra's recorded ``hlo_module``)."""
+    return {str(k): str(v) for k, v in (hlo_modules or {}).items()}
+
+
+def summarize(
+    doc: dict,
+    hlo_modules: dict | None = None,
+    annotation_prefix: str = LAUNCH_ANNOTATION_PREFIX,
+    top_k: int = 0,
+) -> dict:
+    """Per-op rows from one Chrome-trace document.
+
+    An event is a DEVICE OP when it carries ``args.hlo_op`` or
+    ``args.hlo_module`` (XLA stamps both on CPU and TPU op events;
+    python/runtime events carry neither). Rows aggregate over
+    ``(module, op name)``; ``top_k`` > 0 truncates to the K largest by
+    device time (the full totals stay in the summary header either
+    way)."""
+    events = doc.get("traceEvents", []) or []
+    module_of = _module_models(hlo_modules)
+    windows = _annotation_windows(events, annotation_prefix)
+
+    rows: dict[tuple, dict] = {}
+    total_us = 0.0
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        args = e.get("args") or {}
+        module = args.get("hlo_module")
+        hlo_op = args.get("hlo_op")
+        if not module and not hlo_op:
+            continue
+        name = str(hlo_op or e.get("name", "?"))
+        module = str(module or "?")
+        dur = float(e.get("dur", 0.0))
+        ts = float(e.get("ts", 0.0))
+        key = (module, name)
+        row = rows.get(key)
+        if row is None:
+            row = rows[key] = {
+                "op": name,
+                "module": module,
+                "kind": op_kind(name),
+                "model": None,
+                "occurrences": 0,
+                "time_us": 0.0,
+                "_mid": [],
+            }
+        row["occurrences"] += 1
+        row["time_us"] += dur
+        row["_mid"].append(ts + dur / 2.0)
+        total_us += dur
+
+    # attribution pass: module name first, annotation midpoint second
+    model_us: dict[str, float] = {}
+    unattributed_us = 0.0
+    for row in rows.values():
+        model = _attribute_module(row["module"], module_of)
+        if model is None:
+            model = _attribute_windows(row["_mid"], windows)
+        row["model"] = model
+        del row["_mid"]
+        if model is None:
+            unattributed_us += row["time_us"]
+        else:
+            model_us[model] = model_us.get(model, 0.0) + row["time_us"]
+
+    ordered = sorted(rows.values(), key=lambda r: -r["time_us"])
+    for row in ordered:
+        row["share"] = row["time_us"] / total_us if total_us > 0 else 0.0
+    if top_k and top_k > 0:
+        ordered = ordered[:top_k]
+    return {
+        "total_op_time_us": total_us,
+        "op_count": len(rows),
+        "ops": ordered,
+        "models": model_us,
+        "unattributed_us": unattributed_us,
+        "annotation_windows": {
+            m: len(ws) for m, ws in windows.items()
+        },
+    }
+
+
+def _attribute_module(module: str, module_of: dict[str, str]) -> str | None:
+    """Exact match first; then prefix match — XLA may suffix a module
+    name per recompile (``jit_mdl_x_1.2``)."""
+    model = module_of.get(module)
+    if model is not None:
+        return model
+    for known, m in module_of.items():
+        if module.startswith(known):
+            return m
+    # the channel's naming convention is self-describing even without
+    # a mapping: jit_mdl_<name>_<version>
+    if module.startswith("jit_mdl_"):
+        stem = module[len("jit_mdl_"):].split(".", 1)[0]
+        # strip the trailing _<version> segment
+        if "_" in stem:
+            return stem.rsplit("_", 1)[0]
+    return None
+
+
+def _attribute_windows(
+    midpoints: list, windows: dict[str, list]
+) -> str | None:
+    """Majority vote of op-occurrence midpoints over the models' launch
+    annotation windows; None when no midpoint lands in any window."""
+    votes: dict[str, int] = {}
+    for mid in midpoints:
+        for model, spans in windows.items():
+            if any(lo <= mid <= hi for lo, hi in spans):
+                votes[model] = votes.get(model, 0) + 1
+                break
+    if not votes:
+        return None
+    return max(votes.items(), key=lambda kv: kv[1])[0]
+
+
+def summarize_profile_dir(
+    log_dir: str,
+    hlo_modules: dict | None = None,
+    top_k: int = 0,
+) -> dict:
+    """End-to-end: find the capture's trace file, parse, summarize.
+    Raises ``FileNotFoundError`` when the directory holds no trace —
+    callers on the serving path catch and degrade."""
+    path = find_trace_file(log_dir)
+    if path is None:
+        raise FileNotFoundError(f"no .trace.json(.gz) under {log_dir}")
+    summary = summarize(
+        load_trace(path), hlo_modules=hlo_modules, top_k=top_k
+    )
+    summary["trace_file"] = path
+    return summary

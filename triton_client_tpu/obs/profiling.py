@@ -3,8 +3,8 @@
 Lives in ``obs/`` since ISSUE 11 so the repo has ONE timing substrate:
 the request-scoped tracer (obs/trace.py) feeds its finished spans into
 a StageProfiler from this module, and the drivers/CLIs record their
-pipeline stages into the same reservoir. ``utils/profiling.py`` remains
-as a deprecation shim for external imports.
+pipeline stages into the same reservoir. (The ``utils/profiling.py``
+deprecation shim has been removed — import from here.)
 
 The reference has NO tracer — only commented-out ``time.time()`` pairs
 around the 3D callback (ros_inference3d.py:122,209-210) and print-based

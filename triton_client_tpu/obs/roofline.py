@@ -1,0 +1,237 @@
+"""Roofline attribution: measured flops/bytes -> bound class + ceiling.
+
+ROADMAP item 2 says the chips are almost idle (MFU 1.4-7%) but nothing
+in the stack can say *why*: is a model compute-bound (fuse harder, use
+the MXU at int8) or bandwidth-bound (keep intermediates in VMEM, shrink
+the working set)? The roofline model answers with two numbers per
+model:
+
+  arithmetic intensity  I = flops / bytes            (flop per HBM byte)
+  machine knee          K = peak_flops / peak_bw     (flop per byte)
+
+I >= K means the MXU ceiling binds (compute-bound: the attainable rate
+is ``peak_flops / flops`` calls/s); I < K means the HBM ceiling binds
+(bandwidth-bound: ``peak_bw / bytes`` calls/s). The attainable-fps
+ceiling next to the measured fps is the honest headroom statement —
+"yolov5n serves 1,685 fps against an 8,900 fps roofline" names the gap
+a kernel PR must close.
+
+flops/bytes come MEASURED from XLA's own cost model at launcher-build
+time (``jax.stages.Lowered.cost_analysis()`` — no backend compile, a
+few ms of tracing the launcher already paid) and are recorded into
+``model.spec.extra``:
+
+  measured_flops_per_call / measured_bytes_per_call   XLA cost model
+  measured_batch                                      rows they were
+                                                      measured at
+  flops_per_call                                      overwritten with
+                                                      the measured
+                                                      value (the ledger
+                                                      and MFU gauges
+                                                      then use it)
+  analytic_flops_per_call                             the previous
+                                                      hand-maintained
+                                                      seed, kept as a
+                                                      labeled
+                                                      comparison only
+  hlo_module                                          the jit module
+                                                      name opstats maps
+                                                      device ops back
+                                                      to this model by
+
+This module is also the single home of the per-chip peaks: bench.py
+and obs/device_time.py used to carry duplicate POLICY_PEAK_FLOPS
+tables; both now import from here so served MFU, bench MFU, and the
+roofline all divide by the same denominator.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+
+#: v5e per-chip peaks. The MXU runs f32 inputs at the bf16 MAC rate
+#: under jax's default precision, so f32/bf16/int8-weight policies all
+#: see the same flops ceiling; int8 activations double the MAC rate.
+V5E_PEAK_FLOPS = 197e12
+#: v5e HBM2 bandwidth per chip (bytes/s) — the roofline's memory slope.
+V5E_PEAK_HBM_BPS = 819e9
+
+POLICY_PEAK_FLOPS = {
+    "f32": V5E_PEAK_FLOPS,
+    "bf16": V5E_PEAK_FLOPS,
+    "int8w": V5E_PEAK_FLOPS,
+    "int8": 2 * V5E_PEAK_FLOPS,
+}
+#: HBM bandwidth is precision-independent (the bytes themselves shrink
+#: with narrower dtypes — that is already in the measured byte count).
+POLICY_PEAK_BYTES = {
+    "f32": V5E_PEAK_HBM_BPS,
+    "bf16": V5E_PEAK_HBM_BPS,
+    "int8w": V5E_PEAK_HBM_BPS,
+    "int8": V5E_PEAK_HBM_BPS,
+}
+
+
+def peak_flops(precision: str | None) -> float:
+    return POLICY_PEAK_FLOPS.get(str(precision or "f32"), V5E_PEAK_FLOPS)
+
+
+def peak_bytes_per_s(precision: str | None) -> float:
+    return POLICY_PEAK_BYTES.get(str(precision or "f32"), V5E_PEAK_HBM_BPS)
+
+
+@dataclass
+class RooflineRow:
+    """One model's (or op's) position against the machine roofline."""
+
+    flops: float
+    bytes: float
+    precision: str = "f32"
+    batch: int = 1
+    #: derived
+    intensity: float = 0.0
+    knee: float = 0.0
+    bound: str = "unknown"
+    attainable_calls_per_s: float = 0.0
+    attainable_fps: float = 0.0
+
+    def as_dict(self) -> dict:
+        return {
+            "flops": self.flops,
+            "bytes": self.bytes,
+            "precision": self.precision,
+            "batch": self.batch,
+            "intensity": self.intensity,
+            "knee": self.knee,
+            "bound": self.bound,
+            "attainable_calls_per_s": self.attainable_calls_per_s,
+            "attainable_fps": self.attainable_fps,
+        }
+
+
+def classify(
+    flops: float,
+    bytes_accessed: float,
+    precision: str = "f32",
+    batch: int = 1,
+) -> RooflineRow:
+    """Roofline position of one launch: arithmetic intensity against
+    the machine knee, the binding ceiling, and the attainable call/fps
+    rate if ONLY that ceiling bound (the ideal-overlap upper bound an
+    actual serving rate is compared to)."""
+    flops = max(0.0, float(flops or 0.0))
+    bytes_accessed = max(0.0, float(bytes_accessed or 0.0))
+    batch = max(1, int(batch or 1))
+    pf, pb = peak_flops(precision), peak_bytes_per_s(precision)
+    row = RooflineRow(
+        flops=flops, bytes=bytes_accessed, precision=str(precision or "f32"),
+        batch=batch, knee=pf / pb,
+    )
+    if flops <= 0 and bytes_accessed <= 0:
+        return row
+    row.intensity = flops / bytes_accessed if bytes_accessed > 0 else float(
+        "inf"
+    )
+    compute_rate = pf / flops if flops > 0 else float("inf")
+    memory_rate = pb / bytes_accessed if bytes_accessed > 0 else float("inf")
+    row.bound = "compute" if compute_rate <= memory_rate else "bandwidth"
+    row.attainable_calls_per_s = min(compute_rate, memory_rate)
+    row.attainable_fps = row.attainable_calls_per_s * batch
+    return row
+
+
+# -- measured cost capture (launcher-build / first-launch time) ---------------
+
+
+def _cost_dict(cost) -> dict:
+    """Normalize jax's cost_analysis return (dict, or list-of-dict on
+    some backends) to one flat dict."""
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    return dict(cost or {})
+
+
+def launcher_name(model) -> str:
+    """The python-identifier name the channel gives a model's jitted
+    launcher, so the HLO module (``jit_<this>``) names the model in
+    profiler traces — opstats' primary op->model attribution key."""
+    raw = f"mdl_{model.spec.name}_{model.spec.version}"
+    return re.sub(r"[^0-9a-zA-Z_]", "_", raw)
+
+
+def hlo_module_for(model) -> str:
+    """The HLO module name xla emits for the named launcher."""
+    return "jit_" + launcher_name(model)
+
+
+def name_launcher(fn, model):
+    """Stamp a launcher callable with the model's launcher name BEFORE
+    ``jax.jit`` wraps it — jit takes the module name from the wrapped
+    function's ``__name__``."""
+    name = launcher_name(model)
+    try:
+        fn.__name__ = name
+        fn.__qualname__ = name
+    except (AttributeError, TypeError):
+        pass
+    return fn
+
+
+def measure_launch_cost(launcher, *args, batch_rows: int = 1) -> dict:
+    """Measured flops/bytes of one launcher call at the given args'
+    shapes, via XLA's cost model on the LOWERED module — tracing only,
+    no backend compile, so calling this next to the first launch adds
+    milliseconds to a path that is about to pay a full compile anyway.
+
+    Returns ``{"flops", "bytes", "batch"}`` (zeros when the cost model
+    reports nothing)."""
+    lowered = launcher.lower(*args)
+    cost = _cost_dict(lowered.cost_analysis())
+    return {
+        "flops": float(cost.get("flops", 0.0) or 0.0),
+        "bytes": float(cost.get("bytes accessed", 0.0) or 0.0),
+        "batch": max(1, int(batch_rows or 1)),
+    }
+
+
+def record_launch_cost(model, launcher, *args, batch_rows: int = 1) -> dict:
+    """Measure one launcher call and record the result into
+    ``model.spec.extra`` (see the module docstring for the keys).
+    The previous hand-maintained ``flops_per_call`` seed — if any — is
+    preserved as ``analytic_flops_per_call`` and then OVERWRITTEN with
+    the measured value, so every downstream flops consumer (the
+    DeviceTimeLedger's MFU, the collector's model rows, bench) divides
+    by what XLA actually scheduled rather than what a human last
+    derived."""
+    measured = measure_launch_cost(launcher, *args, batch_rows=batch_rows)
+    extra = model.spec.extra
+    seed = extra.get("flops_per_call")
+    if seed is not None and "analytic_flops_per_call" not in extra:
+        extra["analytic_flops_per_call"] = seed
+    if measured["flops"] > 0:
+        extra["flops_per_call"] = measured["flops"]
+    extra["measured_flops_per_call"] = measured["flops"]
+    extra["measured_bytes_per_call"] = measured["bytes"]
+    extra["measured_batch"] = measured["batch"]
+    extra.setdefault("hlo_module", hlo_module_for(model))
+    return measured
+
+
+def model_row(extra: dict, measured_fps: float | None = None) -> dict:
+    """Roofline report row from a model's ``spec.extra`` (the shape the
+    collector's ``models`` snapshot section and the ``roofline`` CLI
+    share). ``measured_fps`` — when known — is reported next to the
+    attainable ceiling as ``attained_fraction``."""
+    flops = float(extra.get("measured_flops_per_call") or 0.0)
+    bytes_ = float(extra.get("measured_bytes_per_call") or 0.0)
+    batch = int(extra.get("measured_batch") or 1)
+    precision = str(extra.get("precision") or "f32")
+    row = classify(flops, bytes_, precision, batch).as_dict()
+    analytic = extra.get("analytic_flops_per_call")
+    if analytic is not None:
+        row["analytic_flops_per_call"] = float(analytic)
+    if measured_fps is not None and row["attainable_fps"] > 0:
+        row["measured_fps"] = float(measured_fps)
+        row["attained_fraction"] = float(measured_fps) / row["attainable_fps"]
+    return row

@@ -850,6 +850,11 @@ class InferenceServer:
         lifecycle=None,
         tenants=None,
         replica_of: str | None = None,
+        op_sample_interval_s: float = 0.0,
+        op_sample_window_s: float = 0.2,
+        history_interval_s: float = 10.0,
+        history_capacity: int = 360,
+        history_path: str | None = None,
     ) -> None:
         """``metrics_port``: serve the telemetry endpoint — Prometheus
         exposition on ``/metrics`` (Triton's :8002 role), Chrome-trace
@@ -896,7 +901,17 @@ class InferenceServer:
         the loopback TCP stack entirely and their ``unix:`` peer
         passes the shared-memory locality gate by construction. Read
         the bound target back from ``.uds_address``; the socket file
-        is unlinked on stop()."""
+        is unlinked on stop().
+        ``op_sample_interval_s``: > 0 starts the continuous op sampler
+        (obs/sampler.py): a short jax.profiler window every interval,
+        parsed into top-K per-op device time on the collector
+        (structurally capped at a 1% capture duty cycle;
+        ``op_sample_window_s`` bounds one window). Shares the
+        /profile capture guard — on-demand captures always win.
+        ``history_interval_s``/``history_capacity``: the metric-history
+        ring (obs/history.py) of per-model×tenant rate/util/MFU
+        snapshots served at ``/history``; ``history_path`` persists the
+        ring there on drain (and restores from it on startup)."""
         self.lifecycle = lifecycle
         self.tenants = tenants
         self.replica_of = replica_of
@@ -920,6 +935,9 @@ class InferenceServer:
         self.histograms = None
         self.slo = None
         self.device_time = None
+        self.sampler = None
+        self.history = None
+        self._history_path = history_path
         self.metrics_enabled = False
         self._telemetry = None
         if metrics_port:
@@ -991,12 +1009,34 @@ class InferenceServer:
                     tenants=tenant_table, devices=devices
                 )
                 target.attach_device_time(self.device_time)
+            # metric history: a fixed-interval ring of ledger deltas
+            # (per-model×tenant rates, utilization, MFU) served at
+            # /history and persisted across the drain/restart boundary
+            if self.device_time is not None and history_interval_s > 0:
+                from triton_client_tpu.obs.history import MetricHistory
+
+                self.history = MetricHistory(
+                    ledger=self.device_time,
+                    interval_s=history_interval_s,
+                    capacity=history_capacity,
+                )
+                if history_path and os.path.exists(history_path):
+                    try:
+                        self.history.restore(MetricHistory.load(history_path))
+                    except (OSError, ValueError):
+                        log.warning(
+                            "could not restore metric history from %s",
+                            history_path, exc_info=True,
+                        )
+                self.history.start()
             self.collector = RuntimeCollector(
                 channel=channel, tracer=self.tracer, registry=registry,
                 repository=repository, histograms=self.histograms,
                 slo=self.slo, admission=self.admission,
                 lifecycle=lifecycle, device_time=self.device_time,
             )
+            if self.history is not None:
+                self.collector.attach_history(self.history)
             try:
                 from triton_client_tpu.obs.http import TelemetryServer
 
@@ -1006,8 +1046,26 @@ class InferenceServer:
                     tracer=self.tracer,
                     collector=self.collector,
                     slo=self.slo,
+                    history=self.history,
                 )
                 self.metrics_enabled = registry is not None
+                if op_sample_interval_s > 0:
+                    from triton_client_tpu.obs.sampler import (
+                        ContinuousSampler,
+                    )
+
+                    # shares the /profile capture guard: a background
+                    # window never collides with an on-demand capture
+                    # (jax.profiler is a process-global singleton)
+                    self.sampler = ContinuousSampler(
+                        sink=self.collector,
+                        interval_s=op_sample_interval_s,
+                        window_s=op_sample_window_s,
+                        lock=self._telemetry.profile_lock,
+                        hlo_modules=self.collector.hlo_modules,
+                    )
+                    self.collector.attach_sampler(self.sampler)
+                    self.sampler.start()
             except OSError as e:
                 log.warning(
                     "could not bind metrics port %s (%s); telemetry "
@@ -1135,6 +1193,18 @@ class InferenceServer:
                 drained = True
                 break
             time.sleep(poll_s)
+        # final history tick + persist: the restart this ring is most
+        # needed across is the one about to happen
+        if self.history is not None:
+            self.history.tick()
+            if self._history_path:
+                try:
+                    self.history.persist(self._history_path)
+                except OSError:
+                    log.warning(
+                        "could not persist metric history to %s",
+                        self._history_path, exc_info=True,
+                    )
         # stop(grace) rejects anything new at the transport and waits
         # out stragglers up to the remaining budget before cancelling
         self.stop(grace=max(0.0, deadline - time.monotonic()) + 0.1)
@@ -1145,6 +1215,11 @@ class InferenceServer:
 
     def stop(self, grace: float = 1.0) -> None:
         self._server.stop(grace).wait()
+        if self.sampler is not None:
+            self.sampler.close()
+            self.sampler = None
+        if self.history is not None:
+            self.history.close()
         if self._telemetry is not None:
             self._telemetry.close()
             self._telemetry = None
