@@ -1141,6 +1141,99 @@ def _serve_3d_row(repo, batching, server, rtt_ms, duration_s: float) -> dict:
     return row
 
 
+def _serve_streaming_sessions_row(duration_s: float) -> dict:
+    """ISSUE 15 streaming sessions at replay pace: 8 concurrent
+    synthetic streams, each a scripted multi-object scene replayed at
+    recorded fps through its own ``sequence_id`` against one in-process
+    server with device-resident tracking. The row's ``value`` is the
+    total sustained frames/sec across streams — gated by
+    perf/bench_diff.py like every throughput row; the tracking-quality
+    counters (id switches, fragmentation, aliases) ride along so a
+    regression in EITHER pace or identity stability shows up in the
+    diff. Echo detector on purpose: the row measures the session layer
+    (slot pool + on-device tracker step + sequence plumbing), not
+    detector math."""
+    from triton_client_tpu.channel.tpu_channel import TPUChannel
+    from triton_client_tpu.config import ModelSpec, TensorSpec
+    from triton_client_tpu.ops.tracking import TrackerConfig
+    from triton_client_tpu.runtime.repository import ModelRepository
+    from triton_client_tpu.runtime.server import InferenceServer
+    from triton_client_tpu.runtime.sessions import SessionManager
+    from triton_client_tpu.utils.loadgen import run_streams, synthetic_stream
+
+    n_streams, fps = 8, 10.0
+    det_dim = 11
+    spec = ModelSpec(
+        name="stream_echo",
+        version="1",
+        platform="jax",
+        inputs=(
+            TensorSpec("detections", (-1, det_dim), "FP32"),
+            TensorSpec("valid", (-1,), "BOOL"),
+        ),
+        outputs=(
+            TensorSpec("detections", (-1, det_dim), "FP32"),
+            TensorSpec("valid", (-1,), "BOOL"),
+        ),
+    )
+    repo = ModelRepository()
+    repo.register(
+        spec,
+        lambda inputs: {
+            "detections": inputs["detections"],
+            "valid": inputs["valid"],
+        },
+    )
+    chan = TPUChannel(repo)
+    manager = SessionManager(
+        max_sessions=n_streams * 2, ttl_s=300.0,
+        tracker=TrackerConfig(max_tracks=32),
+    )
+    chan.attach_sessions(manager)
+    server = InferenceServer(
+        repo, chan, address="127.0.0.1:0", uds_address="auto",
+        max_workers=n_streams + 2,
+    )
+    server.start()
+    try:
+        # warm: compile the tracker step before the paced window
+        run_streams(
+            server.uds_address, spec.name, n_streams=1,
+            source=lambda i: synthetic_stream(n_frames=3, fps=100.0),
+            deadline_s=60.0, stream_id_prefix="warm",
+        )
+        n_frames = max(10, int(duration_s * fps))
+        res = run_streams(
+            server.uds_address, spec.name, n_streams=n_streams,
+            source=lambda i: synthetic_stream(
+                n_frames=n_frames, fps=fps, n_objects=4, seed=i
+            ),
+            deadline_s=duration_s + 120.0,
+        )
+        summary = res.summary()
+        total_fps = sum(s.sustained_fps for s in res.streams)
+        row = {
+            "metric": "streaming_sessions",
+            "value": round(total_fps, 2),
+            "unit": "frames/sec",
+            "streams": n_streams,
+            "requested_fps_per_stream": fps,
+            "min_sustained_fps": summary["min_sustained_fps"],
+            "worst_inter_frame_p99_ms": summary["worst_inter_frame_p99_ms"],
+            "goodput": summary["goodput"],
+            "id_switches": summary["id_switches"],
+            "fragmentation": summary["fragmentation"],
+            "track_id_aliases": summary["track_id_aliases"],
+            "session_frames": manager.stats()["frames_total"],
+            "precision": "f32",
+        }
+        if res.frames_ok == 0:
+            row["degraded"] = "no stream frame completed"
+        return row
+    finally:
+        server.stop()
+
+
 def _serve_multitenant_row(duration_s: float) -> dict:
     """ISSUE 9 multi-tenant lifecycle under pressure: five synthetic
     models (distinct multipliers, synthetic 100-byte HBM costs) over a
@@ -1682,6 +1775,24 @@ def main() -> None:
             print(
                 f"multitenant row skipped: {_remaining():.0f}s left",
                 file=sys.stderr,
+            )
+        # streaming-session replay row (ISSUE 15): synthetic and cheap
+        # like the multitenant row — paced replay, so the window IS the
+        # duration; last in the serving stage's value order
+        if _remaining() > 40.0:
+            try:
+                row = _serve_streaming_sessions_row(
+                    duration_s=min(8.0, max(4.0, _remaining() - 30.0))
+                )
+                _emit_row(row, primary=False)
+                _write_local()
+            except Exception as e:
+                print(f"streaming sessions bench failed: {e}",
+                      file=sys.stderr)
+        else:
+            print(
+                f"streaming sessions row skipped: {_remaining():.0f}s "
+                "left", file=sys.stderr,
             )
     else:
         print(

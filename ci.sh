@@ -135,6 +135,18 @@ python -m pytest tests/test_opstats.py tests/test_roofline.py \
     --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly
 
+echo "== streaming-session shard (sessions, tracking, affinity) =="
+# the streaming-session contract (runtime/sessions.py, ops/tracking.py,
+# the router's rendezvous affinity): slot pool reclaim ladder + the
+# refcount bracket, device/NumPy association parity (bitwise) and the
+# transfer-guard residency proof, sequence-param round trips, and the
+# slow-marked drives tier-1 deselects — the multi-stream replay and the
+# kill-one-replica affinity chaos drive (>=90% goodput, no id aliases)
+python -m pytest tests/test_sessions.py tests/test_tracking.py \
+    -q -m '' \
+    --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+
 echo "== bench diff (optional shard: fresh bench vs BENCH_LOCAL.json) =="
 # perf-regression gate: compares a freshly produced bench results file
 # (BENCH_FRESH=<results.json>, written by a perf/ script on real

@@ -248,3 +248,79 @@ def test_yolov5_mxu_pipeline_golden(rng):
             "top5_rows": dets[valid][:5],
         },
     )
+
+
+def test_centerpoint_velocity_golden(rng):
+    """Seeded CenterPoint with ``with_velocity`` on a fixed cloud: the
+    NAMED ``velocities`` output (ISSUE 15 satellite) is pinned — it
+    must stay a bitwise view of detection columns 7:9 AND keep
+    producing the same numbers (the session tracker's motion seed
+    regresses silently if the head drifts)."""
+    from triton_client_tpu.models.centerpoint import CenterPointConfig
+    from triton_client_tpu.ops.voxelize import VoxelConfig
+    from triton_client_tpu.pipelines.detect3d import (
+        Detect3DConfig,
+        build_centerpoint_pipeline,
+    )
+
+    model_cfg = CenterPointConfig(
+        voxel=VoxelConfig(
+            point_cloud_range=(-8.0, -8.0, -5.0, 8.0, 8.0, 3.0),
+            voxel_size=(0.5, 0.5, 8.0),
+            max_voxels=256,
+            max_points_per_voxel=8,
+        ),
+        vfe_filters=16,
+        backbone_layers=(1, 1),
+        backbone_strides=(1, 2),
+        backbone_filters=(16, 32),
+        upsample_strides=(1, 2),
+        upsample_filters=(16, 16),
+        head_width=16,
+        max_objects=16,
+    )
+    pipe, spec, _ = build_centerpoint_pipeline(
+        jax.random.PRNGKey(0),
+        model_cfg=model_cfg,
+        config=Detect3DConfig(
+            model_name="centerpoint",
+            class_names=model_cfg.class_names,
+            point_buckets=(2048,),
+            max_det=16,
+            pre_max=32,
+            score_thresh=0.05,
+            iou_thresh=0.2,
+        ),
+    )
+    assert spec.extra["with_velocity"] is True
+    assert [t.name for t in spec.outputs] == [
+        "detections", "valid", "velocities",
+    ]
+    pts = np.column_stack(
+        [
+            rng.uniform(-8, 8, 600),
+            rng.uniform(-8, 8, 600),
+            rng.uniform(-4, 2, 600),
+            rng.uniform(0, 1, 600),
+        ]
+    ).astype(np.float32)
+    out = pipe.infer_fn()(
+        {
+            "points": jnp.asarray(pts),
+            "num_points": jnp.asarray(600, jnp.int32),
+        }
+    )
+    dets = np.asarray(out["detections"])
+    valid = np.asarray(out["valid"]).astype(bool)
+    vel = np.asarray(out["velocities"])
+    # the named output IS the packed-row slice, bitwise
+    assert vel.shape == (16, 2)
+    np.testing.assert_array_equal(vel, dets[:, 7:9])
+    _check(
+        "centerpoint_velocity_tiny",
+        {
+            "n_det": [float(valid.sum())],
+            "velocities_live": vel[valid][:6],
+            "boxes_head": dets[valid][:6, :4],
+        },
+    )

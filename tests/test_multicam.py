@@ -112,3 +112,70 @@ def test_parse_mesh_errors_are_usage_errors():
     cfg = parse_mesh("data=4,model=2")
     assert (cfg.data, cfg.model) == (4, 2)
     assert parse_mesh("") is None
+
+
+class TestOnStreamEnd:
+    """ISSUE 15 satellite: camera dropout policy for the lockstep group."""
+
+    def _infer(self, inputs):
+        return {"mean": inputs["images"].mean(axis=(1, 2, 3))}
+
+    def test_stop_is_default_and_ends_group_together(self):
+        sinked = []
+        driver = MultiCameraDriver(
+            self._infer,
+            [_Frames([1, 2, 3, 4]), _Frames([10, 20])],
+            sink=lambda ci, f, r: sinked.append((ci, float(r["mean"]))),
+            warmup=0,
+        )
+        assert driver.on_stream_end == "stop"
+        stats = driver.run()
+        # run ends at the first exhausted camera: no ragged tail
+        assert stats.ticks == 2
+        assert stats.frames == 4
+        assert sinked == [(0, 1.0), (1, 10.0), (0, 2.0), (1, 20.0)]
+
+    def test_drop_lets_survivors_continue(self):
+        sinked = []
+        driver = MultiCameraDriver(
+            self._infer,
+            [_Frames([1, 2, 3, 4]), _Frames([10, 20])],
+            sink=lambda ci, f, r: sinked.append((ci, float(r["mean"]))),
+            warmup=0,
+            on_stream_end="drop",
+        )
+        stats = driver.run()
+        # camera 1 leaves after its 2 frames; camera 0 plays out all 4
+        assert stats.ticks == 4
+        assert stats.frames == 6
+        # sink keeps the ORIGINAL camera index for the survivor
+        assert sinked == [
+            (0, 1.0), (1, 10.0),
+            (0, 2.0), (1, 20.0),
+            (0, 3.0),
+            (0, 4.0),
+        ]
+
+    def test_drop_middle_camera_preserves_indices(self):
+        # the SHORT camera sits in the middle slot: demux after the
+        # drop must still bind results to original indices 0 and 2
+        sinked = []
+        driver = MultiCameraDriver(
+            self._infer,
+            [_Frames([1, 2]), _Frames([10]), _Frames([100, 200])],
+            sink=lambda ci, f, r: sinked.append((ci, float(r["mean"]))),
+            warmup=0,
+            on_stream_end="drop",
+        )
+        stats = driver.run()
+        assert stats.ticks == 2
+        assert stats.frames == 5
+        assert sinked == [
+            (0, 1.0), (1, 10.0), (2, 100.0),
+            (0, 2.0), (2, 200.0),
+        ]
+
+    def test_invalid_policy_rejected(self):
+        with pytest.raises(ValueError, match="on_stream_end"):
+            MultiCameraDriver(self._infer, [_Frames([1])],
+                              on_stream_end="pause")

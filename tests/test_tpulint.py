@@ -369,6 +369,118 @@ class TestHostSyncRules:
         assert "shard_pack_rows" in names
 
 
+class TestStreamingSessionLint:
+    """ISSUE 15: the session frame bracket (advance/_step/release) and
+    the association core are hot roots — a host sync anywhere in them
+    would serialize every live stream at once."""
+
+    def test_session_advance_root_is_hot(self):
+        src = (
+            "import numpy as np\n"
+            "class SessionManager:\n"
+            "    def advance(self, request, outputs):\n"
+            "        return _snap(outputs)\n"
+            "def _snap(outputs):\n"
+            "    return np.asarray(outputs['detections'])\n"
+        )
+        found = lint_source(src, codes=["TPL3"])
+        assert len(found) == 1 and found[0].context.endswith("_snap")
+
+    def test_session_release_root_is_hot(self):
+        # release runs inside the resolve closure: a scalar readback
+        # there stalls the deferred-readback pipeline
+        src = (
+            "class SessionManager:\n"
+            "    def release(self, stream_id):\n"
+            "        return self._refs[stream_id].item()\n"
+        )
+        assert codes(lint_source(src, codes=["TPL3"])) == ["TPL301"]
+
+    def test_affinity_pick_root_is_hot(self):
+        src = (
+            "import jax\n"
+            "class ReplicaSet:\n"
+            "    def pick_affinity(self, stream_id, exclude=()):\n"
+            "        jax.block_until_ready(stream_id)\n"
+            "        return None\n"
+        )
+        assert codes(lint_source(src, codes=["TPL3"])) == ["TPL302"]
+
+    def test_association_core_is_hot(self):
+        # tracking.greedy_assign is rooted DIRECTLY: a readback inside
+        # the device association can't hide behind the jit boundary
+        src = (
+            "import numpy as np\n"
+            "def greedy_assign(xp, cost, trips):\n"
+            "    return float(cost[0, 0])\n"
+        )
+        pkg = load_source(src, path="triton_client_tpu/ops/tracking.py")
+        found = list(check_reachable(pkg, ["tracking.greedy_assign"]))
+        assert len(found) == 1 and found[0].code == "TPL301"
+
+    def test_scrape_time_fold_negative(self):
+        # stats()/_drain_folds is the DESIGNED device-read seam and is
+        # not a hot root: a readback there is clean
+        src = (
+            "import numpy as np\n"
+            "class SessionManager:\n"
+            "    def stats(self):\n"
+            "        return int(np.asarray(self._births))\n"
+        )
+        assert lint_source(src, codes=["TPL3"]) == []
+
+    def test_real_session_path_reachable_from_roots(self):
+        # the actual package: the whole frame bracket sits in the
+        # reachable-from-hot-roots set
+        from triton_client_tpu.analysis.rules.hostsync import (
+            HOT_PATH_ROOTS,
+        )
+
+        package = analysis.load_package([PKG], root=REPO)
+        hot = package.callgraph.reachable(list(HOT_PATH_ROOTS))
+        names = {q.rsplit(".", 1)[-1] for q in hot}
+        assert "advance" in names
+        assert "greedy_assign" in names
+        assert "pick_affinity" in names
+
+    def test_session_pool_race_positive(self):
+        # the frame bracket spans threads (advance on the request
+        # thread, release on the readback executor — both DECLARED
+        # roots): an unguarded slot-table mutation on either side is a
+        # race
+        src = (
+            "import threading\n"
+            "class SessionManager:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._slots = {}\n"
+            "    def advance(self, request, outputs):\n"
+            "        self._slots[request] = outputs\n"
+            "    def release(self, stream_id):\n"
+            "        with self._lock:\n"
+            "            self._slots[stream_id] = None\n"
+        )
+        found = lint_source(src, codes=["TPL602"])
+        assert len(found) == 1
+        assert found[0].context == "SessionManager.advance"
+
+    def test_session_pool_guarded_negative(self):
+        src = (
+            "import threading\n"
+            "class SessionManager:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._slots = {}\n"
+            "    def advance(self, request, outputs):\n"
+            "        with self._lock:\n"
+            "            self._slots[request] = outputs\n"
+            "    def release(self, stream_id):\n"
+            "        with self._lock:\n"
+            "            self._slots[stream_id] = None\n"
+        )
+        assert lint_source(src, codes=["TPL602"]) == []
+
+
 # -- TPL4xx lock discipline -------------------------------------------------
 
 

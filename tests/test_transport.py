@@ -504,3 +504,129 @@ class TestLoadgenTransport:
             assert snap["transport"] == "shm"
         finally:
             rs.close()
+
+
+class TestSequenceParams:
+    """Streaming-session sequence parameters (ISSUE 15) ride the same
+    request-parameter plumbing as ``priority``/``traceparent`` — and
+    must survive every transport: wire, shm, uds, and grouped streams.
+    The observation point is end-to-end: a SessionManager attached to
+    the serving channel only opens/advances/closes a session when the
+    decoded parameters say so."""
+
+    DET_DIM = 11
+
+    @pytest.fixture()
+    def session_server(self):
+        from triton_client_tpu.ops.tracking import TrackerConfig
+        from triton_client_tpu.runtime.sessions import SessionManager
+
+        repo = ModelRepository()
+        repo.register(
+            ModelSpec(
+                name="echo",
+                version="1",
+                inputs=(
+                    TensorSpec("detections", (-1, self.DET_DIM), "FP32"),
+                    TensorSpec("valid", (-1,), "BOOL"),
+                ),
+                outputs=(
+                    TensorSpec("detections", (-1, self.DET_DIM), "FP32"),
+                    TensorSpec("valid", (-1,), "BOOL"),
+                ),
+            ),
+            lambda inputs: {
+                "detections": inputs["detections"],
+                "valid": inputs["valid"],
+            },
+        )
+        chan = TPUChannel(repo)
+        manager = SessionManager(
+            max_sessions=8, tracker=TrackerConfig(max_tracks=8)
+        )
+        chan.attach_sessions(manager)
+        server = InferenceServer(
+            repo, chan, address="127.0.0.1:0", uds_address="auto"
+        )
+        server.start()
+        yield server, manager
+        server.stop()
+
+    def _frame(self):
+        det = np.zeros((4, self.DET_DIM), np.float32)
+        det[0, :2] = (1.0, 2.0)
+        det[0, -2] = 0.9
+        valid = np.zeros((4,), bool)
+        valid[0] = True
+        return {"detections": det, "valid": valid}
+
+    def _reqs(self, sid, n=3):
+        return [
+            InferRequest(
+                model_name="echo",
+                inputs=self._frame(),
+                sequence_id=sid,
+                sequence_start=(k == 0),
+                sequence_end=(k == n - 1),
+                priority=1,  # parameter plane shared with sequences
+            )
+            for k in range(n)
+        ]
+
+    @pytest.mark.parametrize("transport", ["wire", "shm", "uds", "stream"])
+    def test_sequence_round_trip_matrix(self, session_server, transport):
+        server, manager = session_server
+        addr = f"127.0.0.1:{server.port}"
+        if transport == "wire":
+            chan = GRPCChannel(addr, timeout_s=10.0,
+                               use_shared_memory=False)
+        elif transport == "shm":
+            chan = GRPCChannel(addr, timeout_s=10.0, use_shared_memory=True)
+        elif transport == "uds":
+            chan = GRPCChannel(server.uds_address, timeout_s=10.0)
+        else:
+            chan = GRPCChannel(addr, timeout_s=10.0)
+        sid = f"seq-{transport}"
+        before = manager.stats()
+        try:
+            reqs = self._reqs(sid)
+            if transport == "stream":
+                resps = list(
+                    chan.infer_stream(iter(reqs), stream_timeout_s=10.0)
+                )
+            else:
+                resps = [chan.do_inference(r) for r in reqs]
+        finally:
+            chan.close()
+        # sequence_id decoded on every frame: the tracker ran, and the
+        # same session advanced each time (one stable track id)
+        tids = [int(r.outputs["det_track_ids"][0]) for r in resps]
+        assert len(resps) == 3
+        assert tids[0] > 0 and len(set(tids)) == 1
+        after = manager.stats()
+        assert after["created_total"] == before["created_total"] + 1
+        assert after["frames_total"] == before["frames_total"] + 3
+        # sequence_end decoded: the slot closed with the stream
+        assert after["ended_total"] == before["ended_total"] + 1
+        assert after["active_sessions"] == 0
+
+    def test_stateless_alongside_traced_request(self, session_server):
+        # a request with NO sequence params but a trace + priority must
+        # stay stateless: parameter planes do not bleed into each other
+        from triton_client_tpu.obs.trace import RequestTrace
+
+        server, manager = session_server
+        chan = GRPCChannel(f"127.0.0.1:{server.port}", timeout_s=10.0)
+        try:
+            resp = chan.do_inference(
+                InferRequest(
+                    model_name="echo",
+                    inputs=self._frame(),
+                    priority=2,
+                    trace=RequestTrace(7, model="echo"),
+                )
+            )
+            assert "det_track_ids" not in resp.outputs
+            assert manager.stats()["active_sessions"] == 0
+        finally:
+            chan.close()

@@ -133,6 +133,20 @@ HOT_PATH_ROOTS = (
     "MetricHistory.tick",
     "RuntimeCollector.record_op_sample",
     "StagedChannel._ensure_launch_cost",
+    # ISSUE 15 streaming sessions: advance/_step run per session frame
+    # between stage and launch (the tracker's jit dispatch must stay
+    # async — a host read there serializes every stream), release runs
+    # inside the resolve closure, end on the RPC thread, and the
+    # router's rendezvous pick on every stateful request. The
+    # association core is rooted directly so a host sync inside the
+    # device variant of greedy_assign can never hide behind the jit
+    # boundary.
+    "SessionManager.advance",
+    "SessionManager._step",
+    "SessionManager.release",
+    "SessionManager.end",
+    "ReplicaSet.pick_affinity",
+    "tracking.greedy_assign",
 )
 
 # module-level call targets that force a host sync

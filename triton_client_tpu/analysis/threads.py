@@ -87,6 +87,21 @@ DECLARED_THREAD_ROOTS: tuple[tuple[str, str, str], ...] = (
         "caller",
         "async issue side of the public entry point",
     ),
+    # ISSUE 15 streaming sessions: the frame bracket spans threads —
+    # advance runs on the issuing request thread (inside launch),
+    # release on the readback executor inside the resolve closure — so
+    # the lock-carrying SessionManager races across these two groups
+    # unless every mutation holds the pool lock
+    (
+        "SessionManager.advance",
+        "caller",
+        "session frame bracket: runs on the issuing request thread",
+    ),
+    (
+        "SessionManager.release",
+        "executor",
+        "resolve side of the frame bracket: readback executor threads",
+    ),
 )
 
 # spawn shapes: call-name -> (kind, how to find the target expression)

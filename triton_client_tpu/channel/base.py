@@ -59,6 +59,17 @@ class InferRequest:
     input_params: Mapping[str, dict] | None = dataclasses.field(
         default=None, repr=False, compare=False
     )
+    # streaming-session identity (runtime/sessions.py): frames of one
+    # stream carry the same sequence_id; start/end bracket the stream's
+    # life. Empty = stateless request — every existing path. Stateful
+    # requests are solo-batched, affinity-routed, and never hedged.
+    sequence_id: str = dataclasses.field(default="", repr=False, compare=False)
+    sequence_start: bool = dataclasses.field(
+        default=False, repr=False, compare=False
+    )
+    sequence_end: bool = dataclasses.field(
+        default=False, repr=False, compare=False
+    )
 
 
 @dataclasses.dataclass

@@ -62,6 +62,14 @@ def _wire_params(request: InferRequest) -> dict | None:
         if params is None:
             params = {}
         params["priority"] = int(request.priority)
+    if request.sequence_id:
+        if params is None:
+            params = {}
+        params[codec.SEQUENCE_ID_PARAM] = str(request.sequence_id)
+        if request.sequence_start:
+            params[codec.SEQUENCE_START_PARAM] = True
+        if request.sequence_end:
+            params[codec.SEQUENCE_END_PARAM] = True
     return params
 
 
@@ -751,6 +759,11 @@ class GRPCChannel(BaseChannel):
 
         def groupable(r: InferRequest) -> bool:
             if r.trace is not None or r.input_params:
+                return False
+            if r.sequence_id:
+                # a packed group travels under the HEAD's parameters —
+                # session frames must each carry their own sequence
+                # params (and two streams must never share a message)
                 return False
             return all(np.asarray(v).ndim >= 1 for v in r.inputs.values())
 

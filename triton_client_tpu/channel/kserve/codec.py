@@ -108,6 +108,24 @@ def get_int_param(msg, key: str, default: int = 0) -> int:
     return int(p[key].int64_param)
 
 
+def get_bool_param(msg, key: str, default: bool = False) -> bool:
+    """Presence-checked read of a bool parameter."""
+    p = msg.parameters
+    if key not in p:
+        return default
+    return bool(p[key].bool_param)
+
+
+# streaming-session sequence parameters (runtime/sessions.py): frames
+# of one stream share a sequence_id; sequence_start/sequence_end
+# bracket the stream's life. Triton's sequence-batcher extension uses
+# the same three names, so sequence-aware Triton clients speak this
+# without translation.
+SEQUENCE_ID_PARAM = "sequence_id"
+SEQUENCE_START_PARAM = "sequence_start"
+SEQUENCE_END_PARAM = "sequence_end"
+
+
 # multi-frame streaming protocol (round 13): one ModelStreamInfer
 # message carries a packed group of G equal-shape frames concatenated
 # along the leading axis; the server fans them into the batcher as

@@ -245,6 +245,10 @@ class StagedChannel(BaseChannel):
         # every launch's device-execute window accrues into per-
         # model×tenant device-seconds + live MFU
         self._device_time = None
+        # optional SessionManager (runtime/sessions.py): when attached,
+        # launches carrying a sequence_id run the device-resident
+        # tracking step on their outputs before the response forms
+        self._sessions = None
         # unregister must drop the cached launcher too — the cached
         # closure pins replicated params in HBM and would otherwise
         # leak until a same-named model happens to fail the identity
@@ -645,6 +649,21 @@ class StagedChannel(BaseChannel):
             self._release_lifecycle(staged)
             self._record_launch_failure(name)
             return InferFuture.failed(e)
+        sessions = self._sessions
+        session_id = request.sequence_id if sessions is not None else ""
+        if session_id:
+            # append the stream's device-resident tracking step to this
+            # launch: async jit dispatch over arrays already in HBM —
+            # the track tensors join the outputs, the state pytree
+            # stays on device inside the session slot. The slot ref
+            # advance() takes is dropped in resolve's finally.
+            try:
+                outputs = sessions.advance(request, outputs)
+            except Exception as e:
+                self._release_slot()
+                self._release_lifecycle(staged)
+                self._count_shed(name, request.priority, "session")
+                return InferFuture.failed(e)
         rec = _Inflight(outputs)
         t_launched = time.perf_counter()
         if tr is not None:
@@ -675,8 +694,14 @@ class StagedChannel(BaseChannel):
                     if tr is not None:
                         tr.add("device_execute", t_launched, t_ready)
                     if ledger is not None:
+                        # session frames accrue under a per-stream
+                        # tenant, so the ledger's tenant axis answers
+                        # "device seconds per live stream" directly
                         ledger.record(
-                            name, t_ready - t_launched, model.spec.extra
+                            name, t_ready - t_launched, model.spec.extra,
+                            tenant=f"stream:{session_id}"
+                            if session_id
+                            else None,
                         )
                 faults.probe("readback", name)
                 host = self._host_outputs(outputs, out_dtype, staged.meta)
@@ -691,6 +716,8 @@ class StagedChannel(BaseChannel):
             finally:
                 self._retire(rec)
                 self._release_lifecycle(staged)
+                if session_id:
+                    sessions.release(session_id)
             if self._breaker is not None:
                 self._breaker.record_success(name)
             return InferResponse(
@@ -772,6 +799,19 @@ class StagedChannel(BaseChannel):
     @property
     def device_time(self):
         return self._device_time
+
+    # -- streaming sessions (runtime/sessions.py) -----------------------------
+
+    def attach_sessions(self, manager) -> None:
+        """Attach a SessionManager: launches whose request carries a
+        ``sequence_id`` advance that stream's device-resident tracker
+        on the launch outputs (state never leaves HBM between frames)
+        and hold the session slot's refcount until resolve."""
+        self._sessions = manager
+
+    @property
+    def sessions(self):
+        return self._sessions
 
     def _warm_model(self, name: str, version: str) -> None:
         """Lifecycle page-in hook: build + cache the jitted launcher (the

@@ -209,6 +209,26 @@ def main(argv=None) -> None:
         "docs/OPERATIONS.md 'Multi-tenant serving')",
     )
     p.add_argument(
+        "--max-sessions", type=int, default=64,
+        help="streaming-session slot pool size (runtime/sessions.py): "
+        "requests carrying a sequence_id parameter get device-resident "
+        "per-stream tracker state in one of this many slots; ended and "
+        "TTL-expired slots are reclaimed, a full unreclaimable pool "
+        "sheds with RESOURCE_EXHAUSTED. 0 disables sessions (sequence "
+        "params pass through untracked)",
+    )
+    p.add_argument(
+        "--session-ttl-s", type=float, default=60.0,
+        help="idle seconds before a streaming session's slot is "
+        "reclaimable (streams that vanish without sequence_end)",
+    )
+    p.add_argument(
+        "--session-id-namespace", type=int, default=0,
+        help="track-id namespace (0-15) stamped into this replica's "
+        "track ids — give each replica of a fleet a distinct value so "
+        "ids stay globally unique across session re-homing",
+    )
+    p.add_argument(
         "--replica-of", default="",
         help="replica-set label: this server is one replica of the named "
         "fleet. Advertised via ServerMetadata extensions (the `route` "
@@ -349,6 +369,25 @@ def build_server(args):
             f"{f'{budget_mb:g}MB' if budget_mb > 0 else 'unlimited'} "
             f"tenants={len(tenants.tenants()) if tenants else 0} "
             "(models page in on demand, evict LRU-within-priority)",
+            flush=True,
+        )
+    # streaming sessions: device-resident per-stream tracker state keyed
+    # by the KServe sequence_id parameter (runtime/sessions.py)
+    max_sessions = int(getattr(args, "max_sessions", 64) or 0)
+    if max_sessions > 0 and hasattr(base_channel, "attach_sessions"):
+        from triton_client_tpu.runtime.sessions import SessionManager
+
+        sessions = SessionManager(
+            max_sessions=max_sessions,
+            ttl_s=float(getattr(args, "session_ttl_s", 60.0)),
+            id_namespace=int(getattr(args, "session_id_namespace", 0)),
+        )
+        base_channel.attach_sessions(sessions)
+        print(
+            f"streaming sessions: max_sessions={max_sessions} "
+            f"ttl={float(getattr(args, 'session_ttl_s', 60.0)):g}s "
+            f"id_namespace={int(getattr(args, 'session_id_namespace', 0))} "
+            "(device-resident tracking keyed by sequence_id)",
             flush=True,
         )
     if args.batching:

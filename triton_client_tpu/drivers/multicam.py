@@ -25,9 +25,21 @@ class MultiCameraDriver:
 
     ``infer`` receives {"images": (C, H, W, 3)} -> outputs whose leading
     axis is the camera axis (the repository infer_fn contract). A sink
-    receives (camera_index, frame, per_camera_result). Streams advance
-    in lockstep; the run ends when ANY camera is exhausted (ragged tails
-    would silently skew a camera's latency statistics)."""
+    receives (camera_index, frame, per_camera_result) — the index is the
+    ORIGINAL camera slot, stable even after other cameras drop out.
+
+    ``on_stream_end`` decides what happens when a camera source
+    exhausts mid-run:
+      * ``"stop"`` (default) — the whole run ends at the first
+        exhausted camera. Ragged tails would silently skew a camera's
+        latency statistics, and a session-grouped tracker (detections
+        stacked on the camera axis feed ONE server-side session group)
+        rejects a group-size change mid-stream, so the safe default is
+        to end the group together.
+      * ``"drop"`` — the exhausted camera leaves the lockstep group and
+        the survivors keep ticking until every source is dry. The batch
+        (and any downstream session group) SHRINKS at that tick; only
+        use this when the consumer tolerates a camera-axis resize."""
 
     def __init__(
         self,
@@ -35,29 +47,45 @@ class MultiCameraDriver:
         sources: Sequence[Any],
         sink: Callable[[int, Any, Mapping[str, Any]], None] | None = None,
         warmup: int = 1,
+        on_stream_end: str = "stop",
     ) -> None:
         if not sources:
             raise ValueError("need at least one camera source")
+        if on_stream_end not in ("stop", "drop"):
+            raise ValueError(
+                f"on_stream_end must be 'stop' or 'drop', "
+                f"not {on_stream_end!r}"
+            )
         self.infer = infer
         self.sources = list(sources)
         self.sink = sink
         self.warmup = warmup
+        self.on_stream_end = on_stream_end
 
     def run(self, max_ticks: int = 0) -> DriverStats:
         iters = [iter(s) for s in self.sources]
+        live = list(range(len(self.sources)))
         latencies: list[float] = []
         ticks = 0
+        frames_served = 0
         t_start = None
         while not max_ticks or ticks < max_ticks:
-            frames = []
-            for it in iters:
-                frame = next(it, None)
+            frames = []  # (original camera index, frame)
+            still = []
+            stopped = False
+            for ci in live:
+                frame = next(iters[ci], None)
                 if frame is None:
-                    break
-                frames.append(frame)
-            if len(frames) < len(iters):
+                    if self.on_stream_end == "stop":
+                        stopped = True
+                        break
+                    continue  # drop: camera leaves the lockstep group
+                still.append(ci)
+                frames.append((ci, frame))
+            if stopped or not frames:
                 break
-            batch = np.stack([np.asarray(f.data) for f in frames])
+            live = still
+            batch = np.stack([np.asarray(f.data) for _, f in frames])
             if ticks == 0:
                 for _ in range(self.warmup):
                     self.infer({"images": batch})
@@ -66,16 +94,17 @@ class MultiCameraDriver:
             result = self.infer({"images": batch})
             latencies.append(time.perf_counter() - t0)
             if self.sink is not None:
-                for ci, frame in enumerate(frames):
+                for bi, (ci, frame) in enumerate(frames):
                     per_cam = {
-                        k: np.asarray(v)[ci]
+                        k: np.asarray(v)[bi]
                         for k, v in result.items()
                         if np.ndim(v) > 0 and np.shape(v)[0] == len(frames)
                     }
                     self.sink(ci, frame, per_cam)
             ticks += 1
+            frames_served += len(frames)
 
         wall = (time.perf_counter() - t_start) if t_start is not None else 0.0
         return latency_stats(
-            latencies, frames=ticks * len(self.sources), wall_s=wall, ticks=ticks
+            latencies, frames=frames_served, wall_s=wall, ticks=ticks
         )

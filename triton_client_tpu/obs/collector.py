@@ -144,6 +144,21 @@ METRIC_TYPES: dict[str, str] = {
     # per-model roofline placement from cost_analysis()-measured
     # flops/bytes (arithmetic intensity, binding ceiling class,
     # attainable-fps ceiling), and the metric-history ring depth
+    # streaming-session plane (ISSUE 15): device-resident per-stream
+    # tracker slots — live occupancy of the bounded pool, in-flight
+    # session frames, slot churn (created/restarted/ended/expired/
+    # LRU-reclaimed/rejected), frames advanced through session state,
+    # and track births/deaths folded from device counters at scrape
+    # time (per-stream device-seconds ride the device_seconds_total
+    # tenant axis as stream:<id>)
+    "tpu_serving_sessions_active": "gauge",
+    "tpu_serving_session_slot_occupancy": "gauge",
+    "tpu_serving_session_inflight_frames": "gauge",
+    "tpu_serving_sessions_total": "counter",
+    "tpu_serving_sessions_rejected_total": "counter",
+    "tpu_serving_session_frames_total": "counter",
+    "tpu_serving_track_births_total": "counter",
+    "tpu_serving_track_deaths_total": "counter",
     "tpu_serving_op_device_seconds": "gauge",
     "tpu_serving_op_sample_window_seconds": "gauge",
     "tpu_serving_op_samples_total": "counter",
@@ -422,6 +437,16 @@ class RuntimeCollector:
             snap["tracer"] = self._tracer.stats()
         if self._device_time is not None:
             snap["device_time"] = self._device_time.snapshot()
+        sessions = (
+            getattr(self._tpu, "sessions", None)
+            if self._tpu is not None
+            else None
+        )
+        if sessions is not None:
+            # stats() drains the deferred device-counter folds — the
+            # only host read of tracker state, at scrape time, never on
+            # the frame path
+            snap["sessions"] = sessions.stats()
         snap["op_sample"] = op_sample
         if self._sampler is not None:
             snap["sampler"] = self._sampler.stats()
@@ -1008,6 +1033,62 @@ class RuntimeCollector:
             samples=[
                 ([m], v) for m, v in (dt_window.get("mfu") or {}).items()
             ],
+        )
+
+        # streaming-session plane: the bounded slot pool's live state
+        # plus churn/track counters (per-stream device-seconds already
+        # ride device_seconds_total's tenant axis as stream:<id>)
+        ses = snap.get("sessions") or {}
+        yield gauge(
+            f"{ns}_sessions_active",
+            "streaming sessions currently holding a device-resident "
+            "tracker slot",
+            ses.get("active_sessions", 0),
+        )
+        yield gauge(
+            f"{ns}_session_slot_occupancy",
+            "active sessions over the slot pool size",
+            ses.get("slot_occupancy", 0.0),
+        )
+        yield gauge(
+            f"{ns}_session_inflight_frames",
+            "session frames between launch and resolve (slot refcounts)",
+            ses.get("inflight_frames", 0),
+        )
+        yield counter(
+            f"{ns}_sessions_total",
+            "session slot transitions by event (created / restarted / "
+            "ended / expired / reclaimed)",
+            0,
+            labels=["event"],
+            samples=[
+                ([ev], ses.get(f"{ev}_total", 0))
+                for ev in (
+                    "created", "restarted", "ended", "expired", "reclaimed"
+                )
+            ],
+        )
+        yield counter(
+            f"{ns}_sessions_rejected_total",
+            "session frames shed because the slot pool was full and "
+            "unreclaimable",
+            ses.get("rejected_total", 0),
+        )
+        yield counter(
+            f"{ns}_session_frames_total",
+            "frames advanced through device-resident session state",
+            ses.get("frames_total", 0),
+        )
+        yield counter(
+            f"{ns}_track_births_total",
+            "tracks born across all sessions (device counters folded at "
+            "scrape/end, never on the frame path)",
+            ses.get("track_births_total", 0),
+        )
+        yield counter(
+            f"{ns}_track_deaths_total",
+            "tracks retired across all sessions",
+            ses.get("track_deaths_total", 0),
         )
 
         # kernel-attribution plane (ISSUE 14): per-op device time over

@@ -98,15 +98,21 @@ class DeviceTimeLedger:
         return tenant
 
     def record(
-        self, model: str, duration_s: float, spec_extra=None
+        self, model: str, duration_s: float, spec_extra=None, tenant=None
     ) -> None:
         """Account one launch's device-execute window. Called from the
         channel's resolve() with the SAME (t_launched, t_ready)
         interval the trace's device_execute span gets — the two
-        measurements cannot drift."""
+        measurements cannot drift.
+
+        ``tenant`` overrides the table lookup — streaming-session
+        launches pass ``stream:<sequence_id>`` so the tenant axis
+        answers "device seconds per live stream" directly
+        (runtime/sessions.py)."""
         if duration_s < 0:
             duration_s = 0.0
-        tenant = self.tenant_of(model)
+        if tenant is None:
+            tenant = self.tenant_of(model)
         flops = self._flops_per_call.get(model)
         if flops is None and spec_extra:
             try:

@@ -1,0 +1,434 @@
+"""On-device multi-object tracking head: Kalman + greedy association.
+
+ROADMAP item 5's tracking head, built to compose with the detectors'
+decoded outputs *without leaving HBM*: the whole per-frame step —
+constant-velocity Kalman predict, two-stage ByteTrack-style greedy
+association, update, birth/death bookkeeping — is one jit-compiled
+function over fixed-shape arrays (``max_tracks`` slots, the detector's
+``max_det`` rows), so the session layer (runtime/sessions.py) can chain
+it after a detector launch and keep track state device-resident between
+frames. ``jax.vmap`` over the step gives synchronized multi-camera
+session groups for free (drivers/multicam.py stacks C cameras on the
+leading axis).
+
+Design choices, each motivated by the serving context:
+
+  * **Hungarian-free greedy matching** — greedy closest-match
+    association is within a hair of Hungarian on detection-quality
+    tracks, and greedy is a fixed-trip ``lax.fori_loop`` of masked
+    argmaxes — shape-static, jit-friendly, and bitwise-reproducible
+    against the NumPy mirror below (``reference_step``), which the
+    parity gate in tests/ compares association-for-association.
+  * **Two-stage matching (ByteTrack)** — high-score detections
+    associate first at a wide gate; still-unmatched tracks then get a
+    second chance against LOW-score detections at a tighter gate,
+    recovering occluded objects the score threshold would have dropped.
+  * **Decoupled scalar Kalman** — per-axis (pos, vel) 2x2 blocks with
+    diagonal noise reduce predict/update to elementwise arithmetic: no
+    matrix inverses, nothing the VPU can't chew through in one pass,
+    and the NumPy reference stays operation-for-operation identical.
+  * **Measured velocity seeding** — when the detector carries a
+    velocity head (CenterPoint, ``velocity_cols``), matched tracks fuse
+    the measured (vx, vy) as a second scalar update and new tracks are
+    born with it, so the motion prior is right from frame one.
+
+Detection rows follow ops/detect3d_postprocess.py's packed convention
+``[x, y, ..., score, label]``: centers are columns 0:2, score column
+-2. Track ids are int32, strictly positive, offset by the session
+layer's ``id_base`` so ids never alias across session restarts or
+replica failovers (the handoff contract in runtime/router.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: Gated / impossible affinity. Large-negative finite (not -inf) so an
+#: argmax over an all-gated matrix still returns index 0 and the
+#: validity check ``best > GATED / 2`` stays well-defined in f32.
+GATED = np.float32(-1e18)
+
+
+@dataclasses.dataclass(frozen=True)
+class TrackerConfig:
+    """Static tracker shape/policy — hashable, so one jit per config."""
+
+    max_tracks: int = 64
+    #: ByteTrack score split: >= high associates in stage 1 (and may
+    #: found new tracks); [low, high) only rescues existing tracks
+    score_high: float = 0.5
+    score_low: float = 0.1
+    #: stage-1 association gate, center distance (world units)
+    gate_dist: float = 5.0
+    #: stage-2 (low-score rescue) gate — tighter: a weak detection must
+    #: be right where the track predicted it
+    gate_dist_low: float = 2.5
+    #: Mahalanobis gate on the position innovation (chi-square, 2 dof,
+    #: p=0.01 -> 9.21); <= 0 disables the statistical gate
+    gate_maha2: float = 9.21
+    #: consecutive missed frames before a track slot frees
+    max_age: int = 3
+    dt: float = 1.0
+    #: process noise added per predict (position / velocity variance)
+    q_pos: float = 0.1
+    q_vel: float = 0.1
+    #: measurement noise (position; velocity when measured)
+    r_pos: float = 0.5
+    r_vel: float = 1.0
+    #: initial covariance of a newborn track
+    p0_pos: float = 1.0
+    p0_vel: float = 10.0
+    #: detection columns holding measured (vx, vy) — CenterPoint's
+    #: velocity head rides columns 7:9 of the packed row; None = no
+    #: measured velocity (2D trackers, velocity-less 3D heads)
+    velocity_cols: tuple | None = (7, 9)
+
+    def __post_init__(self):
+        if self.velocity_cols is not None:
+            a, b = self.velocity_cols
+            if b - a != 2:
+                raise ValueError("velocity_cols must span exactly 2 columns")
+
+
+#: state-dict leaves, all fixed-shape: the session layer stores exactly
+#: this pytree on device between frames
+STATE_KEYS = (
+    "mean", "cov", "box", "tid", "age", "hits",
+    "next_id", "frame", "births", "deaths",
+)
+
+#: output tensor names the session hook adds to a response
+OUTPUT_KEYS = (
+    "tracks", "track_ids", "tracks_valid", "track_assign", "det_track_ids",
+)
+
+
+def init_state(cfg: TrackerConfig, det_dim: int, id_base: int = 0):
+    """Fresh (host) tracker state for one stream. ``id_base`` offsets
+    every id this state will ever mint — the session layer derives it
+    from (manager namespace, session epoch) so a restarted session's
+    ids can never collide with its previous life's."""
+    t = int(cfg.max_tracks)
+    return {
+        # [x, y, vx, vy] per slot
+        "mean": np.zeros((t, 4), np.float32),
+        # per-axis 2x2 covariance packed [p00, p01, p11] (x/y share it)
+        "cov": np.zeros((t, 3), np.float32),
+        # last matched detection row, center/velocity refreshed from
+        # the fused mean
+        "box": np.zeros((t, int(det_dim)), np.float32),
+        "tid": np.zeros((t,), np.int32),  # 0 = free slot
+        "age": np.zeros((t,), np.int32),
+        "hits": np.zeros((t,), np.int32),
+        "next_id": np.asarray(int(id_base) + 1, np.int32),
+        "frame": np.asarray(0, np.int32),
+        "births": np.asarray(0, np.int32),
+        "deaths": np.asarray(0, np.int32),
+    }
+
+
+# -- association ---------------------------------------------------------------
+
+
+def greedy_assign(xp, cost, trips: int):
+    """Greedy one-to-one matching over an affinity matrix.
+
+    ``trips`` masked global argmaxes: take the best remaining
+    (track, det) pair, bind it, blank its row and column. ``xp`` is
+    ``jnp`` or ``np`` — the loop body is the same expression sequence
+    for both (both argmaxes pick the FIRST maximum on ties, row-major),
+    which is what makes the device/host parity gate bitwise. Returns
+    ``(track_det, det_track)``: per-track matched detection index and
+    per-detection matched track slot, -1 where unmatched."""
+    t, n = cost.shape
+    track_det = xp.full((t,), -1, xp.int32)
+    det_track = xp.full((n,), -1, xp.int32)
+    if xp is np:
+        cost = cost.copy()
+        for _ in range(trips):
+            flat = int(np.argmax(cost))
+            ti, di = flat // n, flat % n
+            if cost[ti, di] > GATED / 2:
+                track_det[ti] = di
+                det_track[di] = ti
+                cost[ti, :] = GATED
+                cost[:, di] = GATED
+        return track_det, det_track
+
+    def body(_, carry):
+        cost, track_det, det_track = carry
+        flat = xp.argmax(cost)
+        ti, di = flat // n, flat % n
+        ok = cost[ti, di] > GATED / 2
+        track_det = xp.where(
+            ok, track_det.at[ti].set(di.astype(xp.int32)), track_det
+        )
+        det_track = xp.where(
+            ok, det_track.at[di].set(ti.astype(xp.int32)), det_track
+        )
+        cost = xp.where(ok, cost.at[ti, :].set(GATED), cost)
+        cost = xp.where(ok, cost.at[:, di].set(GATED), cost)
+        return cost, track_det, det_track
+
+    _, track_det, det_track = jax.lax.fori_loop(
+        0, trips, body, (cost, track_det, det_track)
+    )
+    return track_det, det_track
+
+
+def _affinity(xp, cfg, mean, cov, tid, centers, det_mask, gate_dist):
+    """Negative squared center distance, gated on distance and (when
+    enabled) the Mahalanobis position innovation. Rows: track slots;
+    cols: detections. Inactive slots / masked detections are GATED."""
+    dx = mean[:, 0:1] - centers[:, 0][None, :]
+    dy = mean[:, 1:2] - centers[:, 1][None, :]
+    d2 = dx * dx + dy * dy
+    gated = d2 > np.float32(float(gate_dist) ** 2)
+    if cfg.gate_maha2 > 0:
+        # per-axis innovation variance post-predict: S = p00 + r
+        s = cov[:, 0:1] + np.float32(cfg.r_pos)
+        gated = gated | (d2 / s > np.float32(cfg.gate_maha2))
+    keep = (tid > 0)[:, None] & det_mask[None, :] & ~gated
+    return xp.where(keep, (-d2).astype(xp.float32), GATED)
+
+
+# -- Kalman (decoupled per-axis scalar blocks) ---------------------------------
+
+
+def _predict(xp, cfg, mean, cov):
+    dt = np.float32(cfg.dt)
+    x, y, vx, vy = mean[:, 0], mean[:, 1], mean[:, 2], mean[:, 3]
+    p00, p01, p11 = cov[:, 0], cov[:, 1], cov[:, 2]
+    x = x + vx * dt
+    y = y + vy * dt
+    n00 = p00 + dt * (p01 + p01) + dt * dt * p11 + np.float32(cfg.q_pos)
+    n01 = p01 + dt * p11
+    n11 = p11 + np.float32(cfg.q_vel)
+    return xp.stack([x, y, vx, vy], axis=1), xp.stack([n00, n01, n11], axis=1)
+
+
+def _update(xp, cfg, mean, cov, z_pos, z_vel, matched):
+    """Scalar-gain update per axis for matched slots; unmatched slots
+    pass through untouched. ``z_vel`` is None without a velocity head."""
+    x, y, vx, vy = mean[:, 0], mean[:, 1], mean[:, 2], mean[:, 3]
+    p00, p01, p11 = cov[:, 0], cov[:, 1], cov[:, 2]
+    s = p00 + np.float32(cfg.r_pos)
+    k0 = p00 / s
+    k1 = p01 / s
+    ix = z_pos[:, 0] - x
+    iy = z_pos[:, 1] - y
+    ux, uy = x + k0 * ix, y + k0 * iy
+    uvx, uvy = vx + k1 * ix, vy + k1 * iy
+    one = np.float32(1.0)
+    u00 = (one - k0) * p00
+    u01 = (one - k0) * p01
+    u11 = p11 - k1 * p01
+    if z_vel is not None:
+        sv = u11 + np.float32(cfg.r_vel)
+        kv = u11 / sv
+        uvx = uvx + kv * (z_vel[:, 0] - uvx)
+        uvy = uvy + kv * (z_vel[:, 1] - uvy)
+        u11 = (one - kv) * u11
+    m = matched
+    mean = xp.stack(
+        [
+            xp.where(m, ux, x),
+            xp.where(m, uy, y),
+            xp.where(m, uvx, vx),
+            xp.where(m, uvy, vy),
+        ],
+        axis=1,
+    )
+    cov = xp.stack(
+        [
+            xp.where(m, u00, p00),
+            xp.where(m, u01, p01),
+            xp.where(m, u11, p11),
+        ],
+        axis=1,
+    )
+    return mean, cov
+
+
+# -- birth bookkeeping ---------------------------------------------------------
+
+
+def _scatter_births(xp, t, n, takes, free_rank, placed, born_rank):
+    """Order-preserving one-to-one map between taking slots and placed
+    detections: the rank-i free slot receives the rank-i newborn.
+    Returns ``(slot_det, det_slot)``: per-slot detection index (0 on
+    non-taking slots) and per-detection slot index (0 where not
+    placed). Both backends route through the same rank pairing, and
+    every rank below the birth count has exactly one writer —
+    deterministic, hence bitwise-comparable."""
+    if xp is np:
+        det_ids = np.nonzero(placed)[0].astype(np.int32)
+        slot_ids = np.nonzero(takes)[0].astype(np.int32)
+        slot_det = np.zeros((t,), np.int32)
+        det_slot = np.zeros((n,), np.int32)
+        slot_det[slot_ids] = det_ids
+        det_slot[det_ids] = slot_ids
+        return slot_det, det_slot
+    # rank tables carry one junk row (index t) so non-placed /
+    # non-taking writes land off the read range
+    rank_det = xp.zeros((t + 1,), xp.int32)
+    rank_det = rank_det.at[xp.where(placed, born_rank, t)].set(
+        xp.where(placed, xp.arange(n, dtype=xp.int32), 0)
+    )
+    rank_slot = xp.zeros((t + 1,), xp.int32)
+    rank_slot = rank_slot.at[xp.where(takes, free_rank, t)].set(
+        xp.where(takes, xp.arange(t, dtype=xp.int32), 0)
+    )
+    slot_det = xp.where(takes, rank_det[xp.where(takes, free_rank, 0)], 0)
+    det_slot = xp.where(placed, rank_slot[xp.where(placed, born_rank, 0)], 0)
+    return slot_det, det_slot
+
+
+# -- the per-frame step --------------------------------------------------------
+
+
+def _step(xp, cfg: TrackerConfig, state, detections, valid):
+    """One tracking frame. ``detections``: (N, D) packed rows,
+    ``valid``: (N,) bool. Returns (new_state, outputs); outputs carry
+    the full track table plus the per-detection association
+    (``track_assign``) the parity gate checks bitwise."""
+    t = int(cfg.max_tracks)
+    detections = detections.astype(xp.float32)
+    n = int(detections.shape[0])
+    valid = valid.astype(np.bool_ if xp is np else jnp.bool_)
+    score = detections[:, -2]
+    centers = detections[:, 0:2]
+    high = valid & (score >= np.float32(cfg.score_high))
+    low = valid & ~high & (score >= np.float32(cfg.score_low))
+
+    mean, cov = _predict(xp, cfg, state["mean"], state["cov"])
+
+    trips = min(t, n)
+    # stage 1: confident detections, wide gate
+    cost1 = _affinity(xp, cfg, mean, cov, state["tid"], centers, high,
+                      cfg.gate_dist)
+    td1, dt1 = greedy_assign(xp, cost1, trips)
+    # stage 2: still-unmatched tracks rescue low-score detections,
+    # tighter gate
+    tid2 = xp.where(td1 >= 0, xp.int32(0), state["tid"])
+    cost2 = _affinity(xp, cfg, mean, cov, tid2, centers, low,
+                      cfg.gate_dist_low)
+    td2, dt2 = greedy_assign(xp, cost2, trips)
+
+    track_det = xp.where(td1 >= 0, td1, td2)
+    det_track = xp.where(dt1 >= 0, dt1, dt2)
+    matched = track_det >= 0
+    gather = xp.where(matched, track_det, 0)
+
+    z_pos = centers[gather]
+    z_vel = None
+    if cfg.velocity_cols is not None:
+        a, b = cfg.velocity_cols
+        z_vel = detections[:, a:b][gather]
+    mean, cov = _update(xp, cfg, mean, cov, z_pos, z_vel, matched)
+
+    # misses age; past max_age an active track's slot frees (and is
+    # immediately reusable by this frame's births)
+    active = state["tid"] > 0
+    age = xp.where(matched, xp.int32(0), state["age"] + 1)
+    dead = active & ~matched & (age > np.int32(cfg.max_age))
+    tid = xp.where(dead, xp.int32(0), state["tid"])
+    hits = xp.where(matched, state["hits"] + 1, state["hits"])
+    box = xp.where(matched[:, None], detections[gather], state["box"])
+
+    # births: unmatched HIGH detections claim free slots, rank-i slot
+    # to rank-i detection (both ascending) — deterministic, replayable
+    free = tid == 0
+    newborn = high & (det_track < 0)
+    free_rank = xp.cumsum(free.astype(xp.int32)) - 1
+    born_rank = xp.cumsum(newborn.astype(xp.int32)) - 1
+    n_born = xp.minimum(
+        xp.sum(free.astype(xp.int32)), xp.sum(newborn.astype(xp.int32))
+    )
+    takes = free & (free_rank < n_born)
+    placed = newborn & (born_rank < n_born)
+    slot_det, det_slot = _scatter_births(
+        xp, t, n, takes, free_rank, placed, born_rank
+    )
+
+    det_new = detections[slot_det]
+    if cfg.velocity_cols is not None:
+        a = cfg.velocity_cols[0]
+        bvx, bvy = det_new[:, a], det_new[:, a + 1]
+    else:
+        bvx = bvy = xp.zeros((t,), xp.float32)
+    b_mean = xp.stack([det_new[:, 0], det_new[:, 1], bvx, bvy], axis=1)
+    b_cov = xp.broadcast_to(
+        xp.asarray([cfg.p0_pos, 0.0, cfg.p0_vel], dtype=xp.float32), (t, 3)
+    )
+    new_ids = state["next_id"].astype(xp.int32) + free_rank
+    mean = xp.where(takes[:, None], b_mean, mean)
+    cov = xp.where(takes[:, None], b_cov, cov)
+    box = xp.where(takes[:, None], det_new, box)
+    tid = xp.where(takes, new_ids, tid)
+    age = xp.where(takes, xp.int32(0), age)
+    hits = xp.where(takes, xp.int32(1), hits)
+
+    # refresh the reported row's center (and velocity columns, when
+    # present) from the fused mean
+    box = xp.concatenate([mean[:, 0:2], box[:, 2:]], axis=1)
+    if cfg.velocity_cols is not None and box.shape[1] >= cfg.velocity_cols[1]:
+        a = cfg.velocity_cols[0]
+        box = xp.concatenate([box[:, :a], mean[:, 2:4], box[:, a + 2:]],
+                             axis=1)
+
+    new_state = {
+        "mean": mean,
+        "cov": cov,
+        "box": box,
+        "tid": tid,
+        "age": age,
+        "hits": hits,
+        "next_id": state["next_id"] + n_born,
+        "frame": state["frame"] + xp.int32(1),
+        "births": state["births"] + n_born,
+        "deaths": state["deaths"] + xp.sum(dead.astype(xp.int32)),
+    }
+    # per-detection association: matched track slot, else newborn slot,
+    # else -1 — the tensor the parity gate compares bitwise
+    assign_slot = xp.where(placed, det_slot, det_track).astype(xp.int32)
+    det_track_ids = xp.where(
+        assign_slot >= 0, tid[xp.where(assign_slot >= 0, assign_slot, 0)],
+        xp.int32(-1),
+    )
+    outputs = {
+        "tracks": box,
+        "track_ids": tid,
+        "tracks_valid": tid > 0,
+        "track_assign": assign_slot,
+        "det_track_ids": det_track_ids.astype(xp.int32),
+    }
+    return new_state, outputs
+
+
+@functools.lru_cache(maxsize=32)
+def make_step(cfg: TrackerConfig):
+    """The jit-compiled device step for one stream:
+    (state, detections, valid) -> (state, outputs). Cached per config —
+    one trace per (config, shape)."""
+    return jax.jit(functools.partial(_step, jnp, cfg))
+
+
+@functools.lru_cache(maxsize=32)
+def make_group_step(cfg: TrackerConfig):
+    """vmap of the step over a leading session-group axis: C
+    synchronized cameras advance as one launch (drivers/multicam.py)."""
+    return jax.jit(jax.vmap(functools.partial(_step, jnp, cfg)))
+
+
+def reference_step(cfg: TrackerConfig, state, detections, valid):
+    """NumPy mirror of the device step — same expression sequence, so
+    associations are bitwise-comparable. The tests' ground truth."""
+    state = {k: np.asarray(v) for k, v in state.items()}
+    det = np.asarray(detections, np.float32)
+    return _step(np, cfg, state, det, np.asarray(valid, bool))

@@ -240,11 +240,19 @@ class Detect3DPipeline:
         return InferFuture(resolve)
 
     def infer_fn(self):
-        """Repository-facing adapter over the padded static contract."""
+        """Repository-facing adapter over the padded static contract.
+        CenterPoint's velocity head additionally surfaces as a NAMED
+        ``velocities`` output — the packed-row slice stays a device
+        view, so remote clients (and the session tracker's motion seed)
+        address it without knowing the row layout."""
+        wv = getattr(self.model.cfg, "with_velocity", False)
 
         def fn(inputs):
             dets, valid = self._jit(inputs["points"], inputs["num_points"])
-            return {"detections": dets, "valid": valid}
+            out = {"detections": dets, "valid": valid}
+            if wv:
+                out["velocities"] = dets[:, 7:9]
+            return out
 
         return fn
 
@@ -255,11 +263,16 @@ class Detect3DPipeline:
         e.g. an aggregation/compensation step chained into a 3D
         detector keeps the padded cloud in HBM between members."""
 
+        wv = getattr(self.model.cfg, "with_velocity", False)
+
         def fn(inputs):
             dets, valid = self._pipeline(
                 inputs["points"], inputs["num_points"]
             )
-            return {"detections": dets, "valid": valid}
+            out = {"detections": dets, "valid": valid}
+            if wv:
+                out["velocities"] = dets[:, 7:9]
+            return out
 
         return fn
 
@@ -287,6 +300,14 @@ def _detect3d_spec(
         outputs=(
             TensorSpec("detections", (cfg.max_det, 9 + n_extra), "FP32"),
             TensorSpec("valid", (cfg.max_det,), "BOOL"),
+        )
+        + (
+            # the velocity head's named surface (a view of detection
+            # columns 7:9) — present exactly when with_velocity, so the
+            # spec and the infer_fn output set never disagree
+            (TensorSpec("velocities", (cfg.max_det, 2), "FP32"),)
+            if n_extra
+            else ()
         ),
         extra={
             "score_thresh": cfg.score_thresh,

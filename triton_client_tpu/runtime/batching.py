@@ -59,6 +59,14 @@ _bucket = bucket
 
 
 def _merge_key(request: InferRequest):
+    if request.sequence_id:
+        # streaming-session frames NEVER merge: the device-resident
+        # tracking step (runtime/sessions.py) consumes the launch's
+        # outputs per stream and per frame — batching two streams (or
+        # two frames of one) into a single launch would interleave
+        # their state advances. A unique key makes every session frame
+        # a group of one, dispatched through the solo path.
+        return ("__session__", id(request))
     return (
         request.model_name,
         request.model_version,
@@ -590,7 +598,13 @@ class BatchingChannel(BaseChannel):
             group = self._shed_expired_members(group)
             if not group:
                 return  # every member expired; caller's finally frees
-        if len(group) == 1 and not self._pad_to_buckets:
+        if len(group) == 1 and (
+            not self._pad_to_buckets or group[0][1].sequence_id
+        ):
+            # session frames take the solo path even under bucket
+            # padding: pad rows would read as extra cameras to the
+            # session layer, and the solo path is the one that carries
+            # the original request (sequence fields intact) downstream
             t_staged, request, future = group[0]
             self._run_solo(request, future, free_slot, t_staged=t_staged)
             return

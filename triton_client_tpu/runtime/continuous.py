@@ -280,6 +280,11 @@ class ContinuousBatchingChannel(BatchingChannel):
         ragged_names = self._ragged_names(
             request.model_name, request.model_version
         )
+        if request.sequence_id:
+            # session frames bypass BOTH merge paths (ragged packing
+            # included): _merge_key solos them, so the tracking step
+            # sees exactly one stream's frame per launch in order
+            ragged_names = None
         if ragged_names:
             # one segment per request: same-model ragged requests merge
             # regardless of their (wildly varying) row counts — that

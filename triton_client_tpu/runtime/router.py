@@ -47,6 +47,7 @@ become fleet numbers.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import logging
 import queue
 import random
@@ -333,6 +334,42 @@ class ReplicaSet:
             pick.inflight += 1
             return pick
 
+    def pick_affinity(
+        self, stream_id: str, exclude: Sequence[Replica] = ()
+    ) -> Replica | None:
+        """Rendezvous (highest-random-weight) pick for a stateful
+        stream: every router instance hashing the same ``stream_id``
+        over the same endpoint set lands on the same replica — no
+        shared table, no coordination — and when that replica dies only
+        ITS streams move (each to its second-highest score), which is
+        the minimal-disruption property plain mod-N hashing lacks.
+        Same availability ladder and in-flight accounting as
+        :meth:`pick`; ``exclude`` is the failover path (the dead
+        owner)."""
+        now = time.perf_counter()
+        with self._lock:
+            pool = [
+                r for r in self.replicas
+                if r.available(now) and r not in exclude
+            ]
+            if not pool:
+                pool = [
+                    r for r in self.replicas
+                    if not r.draining and r not in exclude
+                ]
+            if not pool:
+                pool = [r for r in self.replicas if r not in exclude]
+            if not pool:
+                return None
+            pick = max(
+                pool,
+                key=lambda r: (
+                    _rendezvous_score(stream_id, r.endpoint), r.endpoint
+                ),
+            )
+            pick.inflight += 1
+            return pick
+
     def release(self, rep: Replica) -> None:
         with self._lock:
             rep.inflight -= 1
@@ -380,6 +417,15 @@ class ReplicaSet:
                 rep.channel.close()
             except Exception:
                 pass
+
+
+def _rendezvous_score(stream_id: str, endpoint: str) -> int:
+    """Stable 64-bit weight for (stream, endpoint) — hashlib, not
+    hash(), so every process (and every restart) agrees."""
+    digest = hashlib.blake2b(
+        f"{stream_id}|{endpoint}".encode(), digest_size=8
+    ).digest()
+    return int.from_bytes(digest, "big")
 
 
 class _AttemptCarrier:
@@ -499,6 +545,8 @@ class FrontDoorRouter:
         self._hedges_denied = 0
         self._failovers = 0
         self._drain_failovers = 0
+        self._affinity_routed = 0
+        self._affinity_handoffs = 0
         self._errors = 0
 
     # -- BaseChannel quack ----------------------------------------------------
@@ -639,9 +687,19 @@ class FrontDoorRouter:
             self._budget.deposit()
         deadline = request.deadline_s
         done: queue.SimpleQueue = queue.SimpleQueue()
-        hedge_delay = self._hedge_delay_s()
-
-        rep = self.replica_set.pick()
+        stream_id = request.sequence_id
+        if stream_id:
+            # stateful request: the stream's device-resident session
+            # lives on exactly one replica. Rendezvous hashing pins the
+            # stream there, and hedging is OFF — a hedge would run the
+            # tracking step twice and corrupt the session's frame order.
+            hedge_delay = None
+            rep = self.replica_set.pick_affinity(stream_id)
+            with self._lock:
+                self._affinity_routed += 1
+        else:
+            hedge_delay = self._hedge_delay_s()
+            rep = self.replica_set.pick()
         if rep is None:
             raise RuntimeError("replica set is empty")
         outstanding = [self._launch(rep, request, done, "primary", 0, ctx)]
@@ -731,10 +789,27 @@ class FrontDoorRouter:
                 retry_rep = self._try_retry(
                     att, e, attempts_made, deadline,
                     tag=log_tag(trace, request.request_id),
+                    stream_id=stream_id,
                 )
                 if retry_rep is None:
                     self._count_error()
                     raise
+                if stream_id:
+                    # explicit failover handoff: the session re-homes
+                    # to the rendezvous runner-up and RESTARTS there —
+                    # sequence_start forces a fresh epoch (disjoint
+                    # track ids), never a resume of state the old owner
+                    # still holds
+                    request = dataclasses.replace(
+                        request, sequence_start=True
+                    )
+                    with self._lock:
+                        self._affinity_handoffs += 1
+                    log.warning(
+                        "stream %s re-homed %s -> %s (session restarts)%s",
+                        stream_id, att.replica.endpoint, retry_rep.endpoint,
+                        log_tag(trace, request.request_id),
+                    )
                 attempts_made += 1
                 attempt_idx += 1
                 outstanding.append(
@@ -811,10 +886,14 @@ class FrontDoorRouter:
         attempts_made: int,
         deadline: float | None,
         tag: str = "",
+        stream_id: str = "",
     ) -> Replica | None:
         """Gate + pick for a failover retry. Drain failovers skip the
         budget (orchestrated, not a fault); everything else spends a
-        token. Returns the replica to retry on, or None to surface."""
+        token. Stateful streams re-pick by rendezvous (minus the dead
+        owner), so every frame of a re-homed stream lands on the SAME
+        survivor. Returns the replica to retry on, or None to
+        surface."""
         if attempts_made >= self._max_attempts:
             return None
         if deadline is not None and time.perf_counter() >= deadline:
@@ -829,7 +908,12 @@ class FrontDoorRouter:
                         self._budget.floor_hits, att.replica.endpoint, tag,
                     )
                     return None
-        rep = self.replica_set.pick(exclude=[att.replica])
+        if stream_id:
+            rep = self.replica_set.pick_affinity(
+                stream_id, exclude=[att.replica]
+            )
+        else:
+            rep = self.replica_set.pick(exclude=[att.replica])
         if rep is None:
             return None
         with self._lock:
@@ -858,6 +942,8 @@ class FrontDoorRouter:
                 "hedges_denied": self._hedges_denied,
                 "failovers": self._failovers,
                 "drain_failovers": self._drain_failovers,
+                "affinity_routed": self._affinity_routed,
+                "affinity_handoffs": self._affinity_handoffs,
                 "retry_budget_tokens": self._budget.tokens,
                 "retry_budget_floor_hits": self._budget.floor_hits,
                 "retries_spent": self._budget.spent,
@@ -913,6 +999,12 @@ class RouterCollector:
             "tpu_router_hedges_total": ("hedges_launched", "hedges launched"),
             "tpu_router_hedges_won_total": ("hedges_won", "hedges that won"),
             "tpu_router_failovers_total": ("failovers", "failover retries"),
+            "tpu_router_affinity_routed_total": (
+                "affinity_routed", "stream requests routed by rendezvous"
+            ),
+            "tpu_router_affinity_handoffs_total": (
+                "affinity_handoffs", "stream sessions re-homed on failover"
+            ),
             "tpu_router_ejections_total": ("ejections_total", "ejections"),
             "tpu_router_retry_budget_floor_total": (
                 "retry_budget_floor_hits", "retries denied at budget floor"

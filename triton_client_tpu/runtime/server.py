@@ -364,6 +364,17 @@ class _Servicer(service.GRPCInferenceServiceServicer):
                     priority = int(params["priority"].int64_param)
             except (AttributeError, TypeError, ValueError):
                 priority = 0  # malformed parameter: never fail the request
+        # streaming-session identity (runtime/sessions.py) — decoded
+        # independent of the SLO plane; absent on stateless requests
+        sequence_id = codec.get_string_param(request, codec.SEQUENCE_ID_PARAM)
+        sequence_start = sequence_end = False
+        if sequence_id:
+            sequence_start = codec.get_bool_param(
+                request, codec.SEQUENCE_START_PARAM
+            )
+            sequence_end = codec.get_bool_param(
+                request, codec.SEQUENCE_END_PARAM
+            )
         if self._collector is not None:
             self._collector.request_started()
         with self._active_lock:
@@ -465,6 +476,9 @@ class _Servicer(service.GRPCInferenceServiceServicer):
                     trace=trace,
                     deadline_s=deadline_s,
                     priority=priority,
+                    sequence_id=sequence_id or "",
+                    sequence_start=sequence_start,
+                    sequence_end=sequence_end,
                 )
             )
             # overlapped with device execution: shm placement parsing

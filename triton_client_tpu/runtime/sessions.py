@@ -1,0 +1,360 @@
+"""Streaming-session state: device-resident per-stream tracking slots.
+
+ROADMAP item 5's session layer. A :class:`SessionManager` owns a
+bounded pool of per-stream slots, each holding the on-device tracker
+state pytree from ops/tracking.py between frames — the KV-cache
+pattern from PAPERS.md's ragged-paged-attention exemplar transplanted
+to track state: per-sequence state lives in HBM for the stream's
+lifetime and the per-frame step is appended to the detector's launch,
+so on the steady-state path NOTHING crosses the host boundary (the
+parity/residency gate in tests/test_sessions.py runs a whole stream
+under ``jax.transfer_guard_device_to_host("disallow")``).
+
+Wiring (the ``sequence_id`` thread): kserve clients set
+``sequence_id`` / ``sequence_start`` / ``sequence_end`` request
+parameters (channel/kserve/codec.py), ``_Servicer._issue`` decodes
+them onto the InferRequest, the batchers solo-dispatch session frames
+(state depends on frame order — merging two streams' frames into one
+launch would interleave their steps), and StagedChannel.launch calls
+:meth:`SessionManager.advance` on the launch outputs before the
+response futures form. ``advance`` bumps the slot's refcount;
+``release`` (called from the launch's resolve, success or failure)
+drops it — exactly the lifecycle manager's acquire/release bracket, so
+TTL/LRU reclaim can never free a slot with an in-flight launch.
+
+Slot reclaim mirrors runtime/lifecycle.py's eviction ladder: ended
+slots first, then TTL-expired, then LRU — always refs==0 only; a full
+pool with every slot in flight rejects the new stream with
+:class:`SessionLimitError` (RESOURCE_EXHAUSTED on the wire, same
+non-retryable overload contract as admission).
+
+Track-id namespace: ids are int32 ``namespace(4b) | epoch(11b) |
+local(16b)`` — ``namespace`` distinguishes replicas (serve
+``--session-id-namespace``), ``epoch`` increments on every session
+(re)start, so a stream re-homed to a new replica after failover — or
+restarted on the same one — mints ids PROVABLY disjoint from its
+previous life's. 16 local bits bound one session life at 65k track
+births; 11 epoch bits wrap at 2048 session lives per process
+(documented in OPERATIONS.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+
+import numpy as np
+
+from triton_client_tpu.ops import tracking
+from triton_client_tpu.runtime.admission import AdmissionRejectedError
+
+log = logging.getLogger(__name__)
+
+
+class SessionLimitError(AdmissionRejectedError):
+    """Session pool full and nothing reclaimable — maps to
+    RESOURCE_EXHAUSTED (non-retryable overload) like every admission
+    reject."""
+
+
+#: output tensors ``advance`` consumes from the detector launch
+DET_KEY = "detections"
+VALID_KEY = "valid"
+
+_NAMESPACE_BITS = 4
+_EPOCH_BITS = 11
+_LOCAL_BITS = 16
+
+
+def id_base_for(namespace: int, epoch: int) -> int:
+    """int32-positive id floor for one session life — see module doc."""
+    ns = int(namespace) & ((1 << _NAMESPACE_BITS) - 1)
+    ep = int(epoch) & ((1 << _EPOCH_BITS) - 1)
+    return (ns << (_EPOCH_BITS + _LOCAL_BITS)) | (ep << _LOCAL_BITS)
+
+
+@dataclasses.dataclass
+class _Slot:
+    stream_id: str
+    epoch: int
+    id_base: int
+    state: dict | None = None  # device pytree, lazily built on frame 1
+    group: int = 0  # 0 single-frame; >0 synchronized-camera group size
+    refs: int = 0
+    frames: int = 0
+    ended: bool = False
+    created: float = 0.0
+    last_used: float = 0.0
+    # serializes the per-frame step: frames of one stream must advance
+    # in order even if a client pipelines requests
+    step_lock: threading.Lock = dataclasses.field(
+        default_factory=threading.Lock, repr=False
+    )
+
+
+class SessionManager:
+    """Bounded pool of device-resident streaming-session slots.
+
+    ``tracker``: the ops/tracking.py config every session runs.
+    ``id_namespace``: replica-distinguishing 4-bit id prefix.
+    ``time_fn``: injectable clock (tests drive TTL deterministically).
+    """
+
+    def __init__(
+        self,
+        max_sessions: int = 64,
+        ttl_s: float = 60.0,
+        tracker: tracking.TrackerConfig | None = None,
+        id_namespace: int = 0,
+        time_fn=time.monotonic,
+    ) -> None:
+        self.tracker = tracker or tracking.TrackerConfig()
+        self._max = max(1, int(max_sessions))
+        self._ttl_s = float(ttl_s)
+        self._namespace = int(id_namespace)
+        self._time = time_fn
+        self._lock = threading.Lock()
+        self._slots: dict[str, _Slot] = {}
+        # dead sessions' state pytrees awaiting a counter fold (device
+        # reads deferred to scrape time — see _drain_folds)
+        self._dead_states: list = []
+        self._epochs = 0
+        # host-side counters; device birth/death totals fold in when a
+        # session ends or restarts (one read per session LIFE, never on
+        # the steady-state frame path)
+        self._created = 0
+        self._restarted = 0
+        self._expired = 0
+        self._reclaimed = 0
+        self._rejected = 0
+        self._ended = 0
+        self._frames = 0
+        self._births_total = 0
+        self._deaths_total = 0
+
+    # -- pool bookkeeping (locked) --------------------------------------------
+
+    def _next_epoch_locked(self) -> int:
+        self._epochs += 1
+        return self._epochs
+
+    def _make_room_locked(self, now: float) -> None:
+        """Free one refs==0 slot: ended > TTL-expired > LRU. Raises
+        SessionLimitError when every slot has in-flight work."""
+        if len(self._slots) < self._max:
+            return
+        idle = [s for s in self._slots.values() if s.refs == 0]
+        victim = None
+        for s in idle:
+            if s.ended:
+                victim = s
+                break
+        if victim is None and self._ttl_s > 0:
+            for s in idle:
+                if now - s.last_used > self._ttl_s:
+                    victim = s
+                    self._expired += 1
+                    break
+        if victim is None and idle:
+            victim = min(idle, key=lambda s: s.last_used)
+            self._reclaimed += 1
+        if victim is None:
+            self._rejected += 1
+            raise SessionLimitError(
+                f"session pool full ({self._max} slots, all in flight)"
+            )
+        del self._slots[victim.stream_id]
+        self._fold_async_locked(victim)
+
+    def _fold_async_locked(self, slot: _Slot) -> None:
+        """Queue a dead slot's device counters for the next stats()
+        fold (caller holds the pool lock) — the device READ happens
+        later, outside the lock and off the frame path."""
+        if slot.state is not None:
+            self._dead_states.append(slot.state)
+
+    def _drain_folds(self) -> None:
+        """Fold queued dead sessions' device birth/death counters into
+        the host totals. Device reads, so: never called from advance /
+        release (the hot bracket) — only from stats() scrapes and
+        end-of-stream folds."""
+        while True:
+            with self._lock:
+                if not self._dead_states:
+                    return
+                state = self._dead_states.pop()
+            births = int(np.asarray(state["births"]))
+            deaths = int(np.asarray(state["deaths"]))
+            with self._lock:
+                self._births_total += births
+                self._deaths_total += deaths
+
+    # -- the frame bracket ----------------------------------------------------
+
+    def advance(self, request, outputs):
+        """Run one tracking step on a detector launch's device outputs.
+
+        Called from StagedChannel.launch with the raw (device) output
+        dict; returns the dict extended with the track tensors. Bumps
+        the slot refcount — the caller MUST pair with :meth:`release`
+        (the launch's resolve does, on success and failure alike).
+        Pure device work: the step is an async jit dispatch on arrays
+        already in HBM; no host transfer happens here.
+        """
+        sid = request.sequence_id
+        now = self._time()
+        with self._lock:
+            slot = self._slots.get(sid)
+            fresh = None
+            if slot is None:
+                self._make_room_locked(now)
+                slot = _Slot(
+                    stream_id=sid,
+                    epoch=self._next_epoch_locked(),
+                    id_base=0,
+                    created=now,
+                    last_used=now,
+                )
+                slot.id_base = id_base_for(self._namespace, slot.epoch)
+                self._slots[sid] = slot
+                self._created += 1
+            elif request.sequence_start or slot.ended:
+                # clean in-place restart: fresh epoch, disjoint ids —
+                # the failover contract (router re-homes with
+                # sequence_start=True on the new owner)
+                fresh = slot.state
+                slot.epoch = self._next_epoch_locked()
+                slot.id_base = id_base_for(self._namespace, slot.epoch)
+                slot.state = None
+                slot.group = 0
+                slot.frames = 0
+                slot.ended = False
+                slot.created = now
+                self._restarted += 1
+            slot.refs += 1
+            slot.last_used = now
+            if fresh is not None:
+                self._dead_states.append(fresh)
+        try:
+            out = self._step(slot, outputs)
+        except Exception:
+            with self._lock:
+                slot.refs -= 1
+            raise
+        if request.sequence_end:
+            with self._lock:
+                slot.ended = True
+                self._ended += 1
+        return out
+
+    def _step(self, slot: _Slot, outputs):
+        det = outputs.get(DET_KEY)
+        valid = outputs.get(VALID_KEY)
+        if det is None or valid is None:
+            return outputs  # model has no tracking-compatible head
+        ndim = getattr(det, "ndim", 2)
+        cfg = self.tracker
+        with slot.step_lock:
+            if ndim == 3:
+                # leading dim = synchronized camera group (B==1 is a
+                # group of one): vmapped step, stacked state
+                group = int(det.shape[0])
+                if slot.state is None:
+                    base = tracking.init_state(
+                        cfg, int(det.shape[-1]), slot.id_base
+                    )
+                    # disjoint per-camera id ranges: split the session's
+                    # 16-bit local id space evenly across the group
+                    span = (1 << _LOCAL_BITS) // group
+                    stacked = {
+                        k: np.stack([v] * group) for k, v in base.items()
+                    }
+                    stacked["next_id"] = np.asarray(
+                        [slot.id_base + 1 + c * span for c in range(group)],
+                        np.int32,
+                    )
+                    slot.state = stacked
+                    slot.group = group
+                elif slot.group != group:
+                    raise ValueError(
+                        f"stream '{slot.stream_id}': camera-group size "
+                        f"changed mid-stream ({slot.group} -> {group})"
+                    )
+                step = tracking.make_group_step(cfg)
+            else:
+                if slot.state is None:
+                    slot.state = tracking.init_state(
+                        cfg, int(det.shape[-1]), slot.id_base
+                    )
+                    slot.group = 0
+                step = tracking.make_step(cfg)
+            new_state, track_out = step(slot.state, det, valid)
+            slot.state = new_state
+            slot.frames += 1
+        with self._lock:
+            self._frames += 1
+        out = dict(outputs)
+        out.update(track_out)
+        return out
+
+    def release(self, stream_id: str) -> None:
+        """Drop the in-flight ref taken by :meth:`advance`. Ended slots
+        free (and queue their counters for the next stats fold) once
+        the last ref drops."""
+        with self._lock:
+            slot = self._slots.get(stream_id)
+            if slot is None:
+                return
+            slot.refs = max(0, slot.refs - 1)
+            if slot.ended and slot.refs == 0:
+                del self._slots[stream_id]
+                self._fold_async_locked(slot)
+
+    def end(self, stream_id: str) -> None:
+        """Explicitly end a session (server drain, client abort)."""
+        with self._lock:
+            slot = self._slots.get(stream_id)
+            if slot is None:
+                return
+            slot.ended = True
+            if slot.refs == 0:
+                del self._slots[stream_id]
+                self._fold_async_locked(slot)
+        self._drain_folds()
+
+    def reset(self) -> None:
+        """Drop every session (drain/shutdown). In-flight launches keep
+        their state pytrees alive via closure; new frames restart."""
+        with self._lock:
+            slots = list(self._slots.values())
+            self._slots.clear()
+            for s in slots:
+                self._fold_async_locked(s)
+
+    # -- observability --------------------------------------------------------
+
+    def stats(self) -> dict:
+        """Pool counters for the collector. Folds queued dead-session
+        device counters first (scrape-time device reads only — the
+        frame path stays transfer-free)."""
+        self._drain_folds()
+        with self._lock:
+            active = len(self._slots)
+            inflight = sum(s.refs for s in self._slots.values())
+            return {
+                "active_sessions": active,
+                "max_sessions": self._max,
+                "slot_occupancy": active / self._max,
+                "inflight_frames": inflight,
+                "created_total": self._created,
+                "restarted_total": self._restarted,
+                "ended_total": self._ended,
+                "expired_total": self._expired,
+                "reclaimed_total": self._reclaimed,
+                "rejected_total": self._rejected,
+                "frames_total": self._frames,
+                "track_births_total": self._births_total,
+                "track_deaths_total": self._deaths_total,
+            }
