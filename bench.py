@@ -198,11 +198,16 @@ class Config:
     order of magnitude."""
 
     def __init__(self, name, metric, one, unit_per_call, baseline_hz,
-                 reps=REPS, precision="f32"):
+                 reps=REPS, precision="f32", fused_stages=()):
         self.name = name
         self.metric = metric
         self.one = one
         self.precision = precision  # serving policy the row ran under
+        # which Pallas fusions the row's pipeline routed (ops/fused
+        # resolution at build time; [] = pure XLA reference path) —
+        # bench_diff readers need the column to know WHICH route a
+        # round's number measured
+        self.fused_stages = tuple(fused_stages)
         self.reps = reps
         self.step = jax.jit(one)          # single-dispatch form (latency)
         self.looped = jax.jit(
@@ -301,6 +306,7 @@ class Config:
             "trial_spread": round(spread, 3),
             "trials": len(self.trial_ms),
             "precision": self.precision,
+            "fused_stages": list(self.fused_stages),
         }
         if self.flops_per_call:
             # MFU against the peak of the dtype the row actually ran
@@ -334,6 +340,7 @@ class Config:
 def make_yolov5(dtype=None, batch=BATCH, mxu=False) -> Config:
     from triton_client_tpu.models.yolov5 import init_yolov5
     from triton_client_tpu.ops.detect_postprocess import extract_boxes
+    from triton_client_tpu.ops.fused import fused_interpret, resolve_fused_stages
     from triton_client_tpu.ops.preprocess import normalize_image
 
     input_hw = (512, 512)
@@ -346,11 +353,19 @@ def make_yolov5(dtype=None, batch=BATCH, mxu=False) -> Config:
     frames = jnp.asarray(
         rng.integers(0, 255, (batch, *input_hw, 3)).astype(np.float32)
     )
+    # same trace-time routing the served pipeline uses: fused decode+NMS
+    # tail on a real TPU (ISSUE 16), reference chain elsewhere — the
+    # row's fused_stages column records which route the number measured
+    fused_stages = resolve_fused_stages("auto", ("decode_nms",))
 
     def step(tok):
         x = normalize_image(frames + tok * 0.0, "yolo")
         pred = model.decode(model.apply(variables, x, train=False))
-        dets, valid = extract_boxes(pred, conf_thresh=0.3, iou_thresh=0.45)
+        dets, valid = extract_boxes(
+            pred, conf_thresh=0.3, iou_thresh=0.45,
+            fused="decode_nms" in fused_stages,
+            interpret=fused_interpret(),
+        )
         # token depends on every output row -> readback fences the call
         return (jnp.sum(valid) + jnp.sum(dets) * 1e-12).astype(jnp.float32)
 
@@ -368,6 +383,7 @@ def make_yolov5(dtype=None, batch=BATCH, mxu=False) -> Config:
         # regime
         reps=120 if batch == BATCH else 50,
         precision="bf16" if dtype == jnp.bfloat16 else "f32",
+        fused_stages=fused_stages,
     )
 
 
@@ -409,7 +425,7 @@ def _structured_cloud(pc_range, n_target=120_000) -> np.ndarray:
 
 
 def _make_3d(pipeline, point_budget, name, metric, cloud=None,
-             structured=True, reps=REPS) -> Config:
+             structured=True, reps=REPS, fused_stages=()) -> Config:
     """Shared 3D config builder; ``cloud`` overrides the default
     synthetic KITTI-sized scan (CenterPoint passes its aggregated
     multi-sweep cloud) so the fencing-token step exists in ONE place."""
@@ -435,7 +451,8 @@ def _make_3d(pipeline, point_budget, name, metric, cloud=None,
         dets, valid = inner(pj + tok * 0.0, mj)
         return (jnp.sum(valid) + jnp.sum(dets) * 1e-12).astype(jnp.float32)
 
-    return Config(name, metric, step, 1, LIDAR_HZ_BASELINE, reps=reps)
+    return Config(name, metric, step, 1, LIDAR_HZ_BASELINE, reps=reps,
+                  fused_stages=fused_stages)
 
 
 def make_pointpillars(structured=True) -> Config:
@@ -443,7 +460,7 @@ def make_pointpillars(structured=True) -> Config:
     from triton_client_tpu.pipelines.detect3d import build_pointpillars_pipeline
 
     _, model_cfg, pipe_cfg = detect3d_from_yaml("data/kitti_pointpillars.yaml")
-    pipeline, _, _ = build_pointpillars_pipeline(
+    pipeline, spec, _ = build_pointpillars_pipeline(
         jax.random.PRNGKey(0), model_cfg=model_cfg, config=pipe_cfg
     )
     suffix = "" if structured else "_uniform"
@@ -452,6 +469,7 @@ def make_pointpillars(structured=True) -> Config:
         f"pointpillars_kitti{suffix}_e2e_scans_per_sec_per_chip",
         structured=structured,
         reps=75,  # ~11 ms/scan -> ~0.8 s per dispatch
+        fused_stages=spec.extra.get("fused_stages", []),
     )
 
 
@@ -468,7 +486,7 @@ def make_centerpoint() -> Config:
 
     _, model_cfg, pipe_cfg = detect3d_from_yaml("data/nusc_centerpoint.yaml")
     pipe_cfg = dataclasses.replace(pipe_cfg, point_buckets=(131072,))
-    pipeline, _, _ = build_centerpoint_pipeline(
+    pipeline, spec, _ = build_centerpoint_pipeline(
         jax.random.PRNGKey(0), model_cfg=model_cfg, config=pipe_cfg
     )
     r = model_cfg.voxel.point_cloud_range
@@ -484,6 +502,7 @@ def make_centerpoint() -> Config:
         "centerpoint_nusc_10sweep_e2e_scans_per_sec_per_chip",
         cloud=cloud,
         reps=75,  # ~11 ms/scan -> ~0.8 s per dispatch
+        fused_stages=spec.extra.get("fused_stages", []),
     )
 
 
@@ -494,11 +513,12 @@ def make_second() -> Config:
     )
 
     cfg = Detect3DConfig(model_name="second_iou")
-    pipeline, _, _ = build_second_pipeline(jax.random.PRNGKey(0), config=cfg)
+    pipeline, spec, _ = build_second_pipeline(jax.random.PRNGKey(0), config=cfg)
     return _make_3d(
         pipeline, max(cfg.point_buckets), "second_iou",
         "second_iou_kitti_e2e_scans_per_sec_per_chip",
         reps=50,  # ~16 ms/scan -> ~0.8 s per dispatch
+        fused_stages=spec.extra.get("fused_stages", []),
     )
 
 
@@ -512,12 +532,13 @@ def make_second_sparse() -> Config:
     _, model_cfg, pipe_cfg = detect3d_from_yaml(
         "data/kitti_second_sparse005.yaml"
     )
-    pipeline, _, _ = build_second_pipeline(
+    pipeline, spec, _ = build_second_pipeline(
         jax.random.PRNGKey(0), model_cfg=model_cfg, config=pipe_cfg
     )
     return _make_3d(
         pipeline, max(pipe_cfg.point_buckets), "second_sparse005",
         "second_iou_sparse005_e2e_scans_per_sec_per_chip",
+        fused_stages=spec.extra.get("fused_stages", []),
     )
 
 
@@ -898,6 +919,7 @@ def measure_serving(
                 if device_call_s else None
             ),
             "precision": precision,
+            "fused_stages": spec.extra.get("fused_stages", []),
         }
         if flops_per_frame:
             row["flops_per_frame"] = flops_per_frame
@@ -1135,6 +1157,7 @@ def _serve_3d_row(repo, batching, server, rtt_ms, duration_s: float) -> dict:
         "device_ceiling_fps": round(1e3 / direct_ms, 2) if direct_ms else None,
         "client_errors": len(res.errors),
         "precision": "f32",
+        "fused_stages": spec3.extra.get("fused_stages", []),
     }
     if res.served_frames == 0:
         row["degraded"] = f"no request completed; first error: {res.errors[:1]}"

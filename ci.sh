@@ -147,6 +147,20 @@ python -m pytest tests/test_sessions.py tests/test_tracking.py \
     --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly
 
+echo "== fused-kernels shard (Pallas parity matrix + profiler smoke) =="
+# the fused hot-path contract (ops/pallas_voxel, ops/pallas_decode,
+# ops/fused routing): {yolov5n, centerpoint, second_iou} x {fused,
+# reference} x batch {1,3,8} bitwise, incl. downstream track
+# associations — interpret-mode Pallas on CPU, the same kernels a TPU
+# runs compiled. The profile_fused smoke then proves the before/after
+# harness and the opstats per-stage split end-to-end on tiny shapes
+# (timings under interpret are correctness-true, performance-false).
+python -m pytest tests/test_fused_parity.py -q \
+    --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+python perf/profile_fused.py --stages decode_nms_2d \
+    --repeats 2 --cands 128
+
 echo "== bench diff (optional shard: fresh bench vs BENCH_LOCAL.json) =="
 # perf-regression gate: compares a freshly produced bench results file
 # (BENCH_FRESH=<results.json>, written by a perf/ script on real

@@ -12,9 +12,16 @@ nonzero when either
     dropped by more than the threshold, or
   * ``roofline_attained_ratio`` (measured fps / roofline attainable
     fps from XLA-measured flops+bytes) dropped by more than the
-    threshold
+    threshold, or
+  * a fused-kernel row's ``speedup`` (reference ms / fused ms from
+    perf/profile_fused.py, whose per-stage rows load under synthetic
+    ``fused_<stage>`` metric names) dropped by more than the threshold
 
 — so a perf regression fails CI the same way a test failure does.
+Two comparisons are reported but never gated: rows measured under the
+Pallas INTERPRETER (``interpret: true`` — correctness-true,
+performance-false) and rows whose ``fused_stages`` route changed
+between fresh and baseline (a different code path, not a regression).
 ci.sh runs this as an OPTIONAL shard: only when a fresh row exists
 (``BENCH_FRESH=<results.json>``), because producing one needs the
 actual accelerator; the committed baseline alone proves nothing.
@@ -43,13 +50,25 @@ def load_rows(path: str) -> dict[str, dict]:
     wrapped ``{"results": [...]}`` shape and a bare row list)."""
     with open(path) as f:
         doc = json.load(f)
-    rows = doc.get("results", doc) if isinstance(doc, dict) else doc
+    if isinstance(doc, dict) and "results" in doc:
+        rows = doc["results"]
+    elif isinstance(doc, dict) and "stages" in doc:
+        # perf/profile_fused.py --json output: per-stage fused rows
+        rows = doc["stages"]
+    else:
+        rows = doc
     if not isinstance(rows, list):
         raise SystemExit(f"{path}: expected a results list")
     out = {}
     for row in rows:
-        if isinstance(row, dict) and "metric" in row:
+        if not isinstance(row, dict):
+            continue
+        if "metric" in row:
             out[row["metric"]] = row
+        elif "stage" in row:
+            # profile_fused rows carry no metric name; synthesize one
+            # so fused before/after numbers diff round-over-round
+            out[f"fused_{row['stage']}"] = row
     return out
 
 
@@ -72,6 +91,22 @@ def diff_rows(
         if b_row is None:
             lines.append(f"  {metric}: NEW (no baseline)")
             continue
+        if f_row.get("interpret") or b_row.get("interpret"):
+            lines.append(
+                f"  {metric}: interpret-mode timing (not gated; "
+                "performance numbers need a real chip)"
+            )
+            continue
+        f_route = f_row.get("fused_stages")
+        b_route = b_row.get("fused_stages")
+        if f_route is not None and b_route is not None \
+                and list(f_route) != list(b_route):
+            lines.append(
+                f"  {metric}: fused route changed "
+                f"{b_route} -> {f_route} (not gated; different code "
+                "path — reset the baseline row to re-arm the gate)"
+            )
+            continue
         for key, label in (
             ("value", "throughput"),
             ("mfu", "mfu"),
@@ -85,6 +120,9 @@ def diff_rows(
             # means the kernel moved away from its own hardware bound
             # even if absolute throughput held up
             ("roofline_attained_ratio", "roofline_attained_ratio"),
+            # fused rows (profile_fused): reference ms / fused ms —
+            # the per-stage device-time reduction the fusion claims
+            ("speedup", "fused_speedup"),
         ):
             f_v, b_v = f_row.get(key), b_row.get(key)
             if f_v is None or b_v is None or not b_v:

@@ -81,6 +81,62 @@ def test_top_k_truncates_rows_but_not_totals():
     assert s["total_op_time_us"] == pytest.approx(300.0)
 
 
+def test_fused_stage_split_from_metadata_and_windows():
+    # TPU path: the jax.named_scope rides in op metadata (long_name);
+    # CPU path: a fused:<stage> TraceAnnotation window catches ops whose
+    # metadata dropped the scope. Both SPLIT device time additively —
+    # models/unattributed totals are untouched, so the >=90%
+    # attribution bar still holds with fused kernels on.
+    doc = {"traceEvents": [
+        # metadata-carried scope (TPU-style)
+        {"ph": "X", "name": "fusion.9", "ts": 0, "dur": 40.0,
+         "args": {"hlo_module": "jit_mdl_second_1", "hlo_op": "fusion.9",
+                  "long_name": "jit_p/fused:decode_nms/while/body"}},
+        # annotation window (CPU/interpret-style): no hlo args on the
+        # window event itself
+        {"ph": "X", "name": "fused:voxelize_scatter", "ts": 100.0,
+         "dur": 50.0, "args": {}},
+        {"ph": "X", "name": "dot.3", "ts": 110.0, "dur": 30.0,
+         "args": {"hlo_module": "jit_mdl_second_1", "hlo_op": "dot.3"}},
+        # unscoped op outside any window
+        {"ph": "X", "name": "copy.1", "ts": 300.0, "dur": 10.0,
+         "args": {"hlo_module": "jit_mdl_second_1", "hlo_op": "copy.1"}},
+    ]}
+    s = opstats.summarize(doc)
+    assert s["total_op_time_us"] == pytest.approx(80.0)
+    assert s["models"] == {"second": pytest.approx(80.0)}
+    assert s["unattributed_us"] == 0.0
+    assert s["stages"] == {
+        "decode_nms": pytest.approx(40.0),
+        "voxelize_scatter": pytest.approx(30.0),
+    }
+    stage_of = {r["op"]: r["stage"] for r in s["ops"]}
+    assert stage_of == {
+        "fusion.9": "decode_nms",
+        "dot.3": "voxelize_scatter",
+        "copy.1": None,
+    }
+    # stage time is a subdivision of model time, never additional
+    assert sum(s["stages"].values()) <= s["models"]["second"] + 1e-9
+
+
+def test_fused_stage_helper():
+    assert opstats.fused_stage("fused:decode_nms") == "decode_nms"
+    assert opstats.fused_stage(
+        "while.1", {"long_name": "jit_p/fused:voxelize_scatter/scan"}
+    ) == "voxelize_scatter"
+    assert opstats.fused_stage("dot.1", {"hlo_op": "dot.1"}) is None
+    assert "stages" in opstats.summarize({"traceEvents": []})
+
+
+def test_fixture_has_no_stage_rows():
+    # the frozen fixture predates fused kernels: stage split must stay
+    # empty rather than misfiring on ordinary op names
+    s = opstats.summarize(_fixture_doc())
+    assert s["stages"] == {}
+    assert all(r["stage"] is None for r in s["ops"])
+
+
 def test_op_kind_rules():
     assert opstats.op_kind("fusion.123") == "fusion"
     assert opstats.op_kind("all-reduce.1") == "collective"

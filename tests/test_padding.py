@@ -1,10 +1,24 @@
 """Boundary cases for the shared batch padding/bucketing helpers
 (runtime/padding.py) — the one bucket table both the micro-batcher and
-the mesh-sharded channel pad against."""
+the mesh-sharded channel pad against — plus the ragged-plane tables
+(parallel/ragged_kernels.py) that must stay compatible with the fused
+Pallas kernel block sizes."""
 
 import numpy as np
 import pytest
 
+from triton_client_tpu.parallel.ragged_kernels import (
+    RaggedLayout,
+    assert_block_divides_buckets,
+    kernel_block_rows,
+    pack_rows,
+    ragged_row_bucket,
+    shard_layout,
+    shard_pack_rows,
+    shard_segment_ids,
+    shard_stack_segments,
+    unshard_segments,
+)
 from triton_client_tpu.runtime.padding import (
     bucket,
     bucket_for,
@@ -173,3 +187,145 @@ def test_unpad_rows_device_array_lazy():
     arr = jnp.zeros((8, 4))
     out = unpad_rows(arr, 3)
     assert out.shape == (3, 4)
+
+
+# -- fused-kernel block size vs the learned ragged bucket table ----------------
+
+
+def test_assert_block_divides_buckets_fused_blocks():
+    # every block size a fused Pallas kernel launches at (pallas_voxel's
+    # POINT_BLOCK=1024 and the smaller tiles) must divide the learned
+    # buckets in its regime, or the channel would re-pad between the
+    # segment kernels and a fused launch
+    for block in (8, 16, 64, 128, 256, 512, 1024):
+        assert_block_divides_buckets(block)
+
+
+@pytest.mark.parametrize("bad", [4, 12, 100, 1023])
+def test_kernel_block_rows_rejects_bad_blocks(bad):
+    with pytest.raises(ValueError):
+        kernel_block_rows(64, bad)
+    with pytest.raises(ValueError):
+        assert_block_divides_buckets(bad)
+
+
+@pytest.mark.parametrize(
+    "n,block,expected",
+    [
+        # below 8*block: bucket rounds up to the block multiple
+        (1, 1024, 1024),      # ragged_row_bucket(1) = 8 -> 1024
+        (1000, 1024, 1024),   # bucket already coincides (1024)
+        (1025, 1024, 2048),   # ragged_row_bucket = 1280 -> 2048
+        (7, 8, 8),
+        (100, 128, 128),      # ragged_row_bucket(100) = 112 -> 128
+    ],
+)
+def test_kernel_block_rows_small_regime(n, block, expected):
+    assert kernel_block_rows(n, block) == expected
+
+
+def test_kernel_block_rows_coincides_above_floor():
+    # bucket >= 8*block: the ragged step is already a block multiple,
+    # so the two tables agree exactly — no extra pad, no extra shapes
+    for block in (128, 1024):
+        for n in (8 * block, 8 * block + 1, 9 * block, 16 * block + 7):
+            b = ragged_row_bucket(n)
+            assert b >= 8 * block
+            assert kernel_block_rows(n, block) == b
+
+
+# -- ragged layout across a shed (segment count > launch_segments) ------------
+
+
+def _rows(sizes, width=3, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        rng.standard_normal((s, width)).astype(np.float32) for s in sizes
+    ]
+
+
+def test_sharded_layout_segment_count_exceeds_launch_segments():
+    # launch_segments on the sharded plane is PER-SHARD capacity
+    # (seg_pad), so a wide group's total segment count legitimately
+    # exceeds it — ids must stay shard-local and in range
+    sizes = (40, 8, 96, 16, 24, 56, 12, 4, 64)
+    sl = shard_layout(RaggedLayout(sizes), 4)
+    assert sl.n_segments == 9
+    assert sl.n_segments > sl.launch_segments
+    ids = shard_segment_ids(sl).reshape(sl.n_shards, sl.rows_pad)
+    for w, g in enumerate(sl.groups):
+        real = ids[w][ids[w] < sl.seg_pad]
+        # shard-local ids are a dense [0, len(g)) range covering every
+        # real row; dead rows carry exactly seg_pad
+        assert real.size == sum(sizes[i] for i in g)
+        assert set(np.unique(real)) == set(range(len(g)))
+        assert np.all(ids[w][real.size:] == sl.seg_pad)
+
+
+def test_shed_rebuild_shrinks_layout_and_stale_pack_raises():
+    # the continuous batcher's post-pack shed recheck re-runs the
+    # SURVIVORS through a fresh RaggedLayout (runtime/continuous.py
+    # _run_ragged_group); the stale pre-shed layout must be unusable by
+    # construction, and the rebuilt one must shrink its buckets
+    sizes = (40, 8, 96, 16, 24)          # 5 segments -> seg_bucket 8
+    parts = _rows(sizes)
+    old = RaggedLayout(sizes)
+    assert old.launch_segments == 8
+
+    survivors = [0, 2, 3]                # shed #1 and #4
+    live_sizes = tuple(sizes[i] for i in survivors)
+    live_parts = [parts[i] for i in survivors]
+
+    with pytest.raises(ValueError):
+        pack_rows(live_parts, old)       # stale layout: sizes mismatch
+    with pytest.raises(ValueError):
+        shard_pack_rows(live_parts, shard_layout(old, 2))
+
+    new = RaggedLayout(live_sizes)
+    assert new.n_segments == 3
+    assert new.launch_segments == bucket(3) == 4   # crossed the boundary
+    assert new.padded_rows == ragged_row_bucket(sum(live_sizes))
+    # pad rows belong to the dead segment one past the last real one
+    ids = new.segment_ids
+    assert ids.shape == (new.padded_rows,)
+    assert np.all(ids[: new.total] < new.n_segments)
+    assert np.all(ids[new.total:] == new.n_segments)
+    # repacking never changes a surviving row's values
+    packed = pack_rows(live_parts, new)
+    for seg, p in enumerate(live_parts):
+        lo, hi = new.offsets[seg], new.offsets[seg + 1]
+        assert np.array_equal(packed[lo:hi], p)
+    # per-segment inputs stack to the (smaller) rebuilt segment bucket
+    scalars = [np.full((2,), float(i), np.float32) for i in survivors]
+    stacked = pad_batch(np.stack(scalars), new.seg_bucket)
+    assert stacked.shape == (new.seg_bucket, 2)
+    assert np.array_equal(stacked[: len(survivors)], np.stack(scalars))
+
+
+def test_shed_rebuild_sharded_roundtrip():
+    # sharded flavor of the shed re-run: survivors re-partition, every
+    # row lands under a shard-local id, and per-segment outputs
+    # reassemble in request order through unshard_segments
+    sizes = (40, 8, 96, 16, 24, 56, 12, 4, 64)
+    parts = _rows(sizes)
+    survivors = [0, 2, 3, 5, 6, 8]
+    live_sizes = tuple(sizes[i] for i in survivors)
+    live_parts = [parts[i] for i in survivors]
+    sl = shard_layout(RaggedLayout(live_sizes), 4)
+
+    packed = shard_pack_rows(live_parts, sl).reshape(
+        sl.n_shards, sl.rows_pad, -1
+    )
+    for w, g in enumerate(sl.groups):
+        o = 0
+        for i in g:
+            assert np.array_equal(
+                packed[w, o : o + live_sizes[i]], live_parts[i]
+            )
+            o += live_sizes[i]
+
+    seg_vals = [np.full((2,), float(i), np.float32) for i in survivors]
+    stacked = shard_stack_segments(seg_vals, sl)
+    back = unshard_segments(stacked, sl)
+    order = [i for g in sl.groups for i in g]
+    assert np.array_equal(back, np.stack([seg_vals[i] for i in order]))

@@ -1185,7 +1185,9 @@ class TestThreadModel:
         }
         assert {"_Servicer.*", "do_inference", "do_inference_async"} <= declared
         groups = {r.group for r in model.roots if r.kind == "declared"}
-        assert groups == {"rpc", "caller"}
+        # "executor" joined in PR 15: SessionManager.release is declared
+        # on the readback-executor side of the frame bracket
+        assert groups == {"rpc", "caller", "executor"}
 
     def test_held_lock_propagates_into_locked_helper(self):
         src = (

@@ -720,3 +720,52 @@ class TestBenchDiff:
         )
         assert bad.returncode == 1
         assert b"REGRESSED" in bad.stdout or b"FAIL" in bad.stderr
+
+    def test_fused_stage_rows_load_and_gate(self, tmp_path):
+        """profile_fused --json rows (keyed by ``stage``) load under
+        synthetic fused_<stage> metrics and gate on speedup and
+        roofline_attained_ratio like any other row."""
+        bd = self._load()
+        doc = tmp_path / "fused.json"
+        doc.write_text(json.dumps({
+            "backend": "tpu",
+            "stages": [{
+                "stage": "voxelize_scatter", "ref_ms": 5.0,
+                "fused_ms": 2.0, "speedup": 2.5, "interpret": False,
+                "roofline_attained_ratio": 0.6,
+            }],
+        }))
+        rows = bd.load_rows(str(doc))
+        assert "fused_voxelize_scatter" in rows
+        base = dict(rows)
+        worse = {"fused_voxelize_scatter": dict(
+            rows["fused_voxelize_scatter"], speedup=1.2,
+            roofline_attained_ratio=0.3,
+        )}
+        _lines, failures = bd.diff_rows(worse, base, threshold=0.10)
+        assert len(failures) == 2
+        assert any("fused_speedup" in f for f in failures)
+        assert any("roofline_attained_ratio" in f for f in failures)
+
+    def test_interpret_and_route_change_report_but_never_gate(self):
+        """Interpreter timings are performance-false and a changed
+        fused_stages route is a different code path — both report
+        without failing the gate."""
+        bd = self._load()
+        base = {
+            "fused_decode_nms": {
+                "stage": "decode_nms", "speedup": 3.0, "interpret": True,
+            },
+            "m": {"metric": "m", "value": 100.0,
+                  "fused_stages": ["decode_nms"]},
+        }
+        fresh = {
+            "fused_decode_nms": {
+                "stage": "decode_nms", "speedup": 0.5, "interpret": True,
+            },
+            "m": {"metric": "m", "value": 40.0, "fused_stages": []},
+        }
+        lines, failures = bd.diff_rows(fresh, base, threshold=0.10)
+        assert failures == []
+        assert any("interpret" in ln for ln in lines)
+        assert any("fused route changed" in ln for ln in lines)

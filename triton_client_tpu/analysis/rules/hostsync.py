@@ -147,6 +147,17 @@ HOT_PATH_ROOTS = (
     "SessionManager.end",
     "ReplicaSet.pick_affinity",
     "tracking.greedy_assign",
+    # ISSUE 16 fused Pallas kernels: the fused launch seams run inside
+    # jit traces on the request path (pipelines route into them at
+    # trace time), but rooting them directly means a host sync added to
+    # a kernel wrapper — a debug `np.asarray` on a ref, a stray
+    # `.item()` on a shape probe — is a finding even before any
+    # pipeline test exercises the fused route
+    "pallas_decode.fused_decode_nms_2d",
+    "pallas_decode.fused_residual_decode",
+    "pallas_decode.fused_suppress_pack_3d",
+    "pallas_voxel.fused_mean_volume",
+    "pallas_voxel.sorted_segment_mean_pallas",
 )
 
 # module-level call targets that force a host sync

@@ -154,6 +154,20 @@ def rectify_direction(
     return out + period * dir_bin.astype(jnp.float32)
 
 
+def decode_candidates(
+    cand: dict[str, jnp.ndarray], num_dir_bins: int, dir_offset: float
+) -> dict[str, jnp.ndarray]:
+    """The XLA residual-decode tail over a ``topk_candidates`` set —
+    the reference twin of ops/pallas_decode.fused_residual_decode.
+    Shared by every anchor-head model (PointPillars, SECOND)."""
+    decoded = decode_boxes(cand["deltas"], cand["anchors"])
+    rot = rectify_direction(
+        decoded[..., 6], cand["dir_bin"], num_dir_bins, dir_offset
+    )
+    decoded = jnp.concatenate([decoded[..., :6], rot[..., None]], axis=-1)
+    return {"boxes": decoded, "scores": cand["scores"], "labels": cand["labels"]}
+
+
 def encode_boxes(boxes: jnp.ndarray, anchors: jnp.ndarray) -> jnp.ndarray:
     """Inverse of decode_boxes, for the training target assignment."""
     diag = jnp.sqrt(anchors[..., 3] ** 2 + anchors[..., 4] ** 2)
@@ -477,21 +491,21 @@ class PointPillars(nn.Module):
             "dir": direction.reshape(b, h, w, a, cfg.num_dir_bins),
         }
 
-    def decode_topk(
+    def topk_candidates(
         self,
         heads: dict[str, jnp.ndarray],
         pre_max: int = 512,
         score_thresh: float = 0.1,
     ) -> dict[str, jnp.ndarray]:
-        """Gate + top-k on RAW class logits, then decode only the
-        survivors: boxes (B, K, 7), scores (B, K) with -inf on gated-out
-        slots, labels (B, K) 1-indexed.
+        """Gate + top-k on RAW class logits, BEFORE any box decode:
+        deltas/anchors (B, K, 7), dir_bin (B, K), scores (B, K) with
+        -inf on gated-out slots, labels (B, K) 1-indexed.
 
-        Equivalent to decode() + extract_boxes_3d's prefilter (sigmoid
-        is monotonic, so top-k on max logits = top-k on max sigmoid
-        scores), but the full anchor grid (321k anchors for the KITTI
-        head) never goes through box decode — only K do. On a v5e chip
-        this removes the dominant decode cost from the fused pipeline."""
+        The pre-decode half of decode_topk, split out so pipelines can
+        route the residual decode either through XLA
+        (:func:`decode_candidates`) or the fused Pallas kernel
+        (ops/pallas_decode.fused_residual_decode) — both consume
+        exactly this candidate set."""
         cfg = self.cfg
         b, h, w, a, nc = heads["cls"].shape
         n = h * w * a
@@ -510,15 +524,34 @@ class PointPillars(nn.Module):
         labels_k = jnp.take_along_axis(labels, top_idx, axis=1)
         anchors_k = anchors[top_idx]  # (B, K, 7)
 
-        decoded = decode_boxes(box_k, anchors_k)
-        dir_bin = jnp.argmax(dir_k, axis=-1)
-        rot = rectify_direction(
-            decoded[..., 6], dir_bin, cfg.num_dir_bins, cfg.dir_offset
-        )
-        decoded = jnp.concatenate([decoded[..., :6], rot[..., None]], axis=-1)
         scores = jax.nn.sigmoid(top_logits)
         scores = jnp.where(scores > score_thresh, scores, -jnp.inf)
-        return {"boxes": decoded, "scores": scores, "labels": labels_k}
+        return {
+            "deltas": box_k,
+            "anchors": anchors_k,
+            "dir_bin": jnp.argmax(dir_k, axis=-1),
+            "scores": scores,
+            "labels": labels_k,
+        }
+
+    def decode_topk(
+        self,
+        heads: dict[str, jnp.ndarray],
+        pre_max: int = 512,
+        score_thresh: float = 0.1,
+    ) -> dict[str, jnp.ndarray]:
+        """Gate + top-k on RAW class logits, then decode only the
+        survivors: boxes (B, K, 7), scores (B, K) with -inf on gated-out
+        slots, labels (B, K) 1-indexed.
+
+        Equivalent to decode() + extract_boxes_3d's prefilter (sigmoid
+        is monotonic, so top-k on max logits = top-k on max sigmoid
+        scores), but the full anchor grid (321k anchors for the KITTI
+        head) never goes through box decode — only K do. On a v5e chip
+        this removes the dominant decode cost from the fused pipeline."""
+        cfg = self.cfg
+        cand = self.topk_candidates(heads, pre_max, score_thresh)
+        return decode_candidates(cand, cfg.num_dir_bins, cfg.dir_offset)
 
     def decode(self, heads: dict[str, jnp.ndarray]) -> dict[str, jnp.ndarray]:
         """Raw head maps -> flat per-anchor predictions:

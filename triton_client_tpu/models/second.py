@@ -36,6 +36,7 @@ from triton_client_tpu.models.pointpillars import (
     AnchorClassConfig,
     BEVBackbone,
     decode_boxes,
+    decode_candidates,
     generate_anchors,
     rectify_direction,
     validate_bev_divisible,
@@ -373,6 +374,17 @@ class SECONDIoU(nn.Module):
         volume = _scatter_mean_volume(points, count, self.cfg.voxel)
         return self._heads(volume[None], train)
 
+    def from_volume(
+        self, volume: jnp.ndarray, train: bool = False
+    ) -> dict[str, jnp.ndarray]:
+        """Dense-middle entry for an externally-built (nz, ny, nx, F)
+        mean volume — how the fused voxelize->scatter kernel
+        (ops/pallas_voxel.fused_mean_volume) feeds the model without
+        re-threading the point cloud through _scatter_mean_volume."""
+        if self.cfg.middle == "sparse":
+            raise ValueError("from_volume requires the dense middle encoder")
+        return self._heads(volume[None], train)
+
     def from_points_batch(
         self,
         points: jnp.ndarray,  # (B, P, F>=4) padded clouds
@@ -421,14 +433,14 @@ class SECONDIoU(nn.Module):
             "iou": iou.reshape(b, h, w, a),
         }
 
-    def decode_topk(
+    def topk_candidates(
         self,
         heads: dict[str, jnp.ndarray],
         pre_max: int = 512,
         score_thresh: float = 0.1,
     ) -> dict[str, jnp.ndarray]:
-        """Gate + top-k on the IoU-RECTIFIED score, then decode only the
-        survivors (the PointPillars.decode_topk counterpart).
+        """Gate + top-k on the IoU-RECTIFIED score, BEFORE box decode
+        (the PointPillars.topk_candidates counterpart).
 
         Unlike the plain anchor head, the ranking metric here is
         cls^(1-a) * q^a — not monotonic in the class logit alone — so
@@ -460,14 +472,27 @@ class SECONDIoU(nn.Module):
         labels_k = jnp.take_along_axis(labels, top_idx, axis=1)
         anchors_k = anchors[top_idx]
 
-        decoded = decode_boxes(box_k, anchors_k)
-        dir_bin = jnp.argmax(dir_k, axis=-1)
-        rot = rectify_direction(
-            decoded[..., 6], dir_bin, cfg.num_dir_bins, cfg.dir_offset
-        )
-        decoded = jnp.concatenate([decoded[..., :6], rot[..., None]], axis=-1)
         scores = jnp.where(top_scores > score_thresh, top_scores, -jnp.inf)
-        return {"boxes": decoded, "scores": scores, "labels": labels_k}
+        return {
+            "deltas": box_k,
+            "anchors": anchors_k,
+            "dir_bin": jnp.argmax(dir_k, axis=-1),
+            "scores": scores,
+            "labels": labels_k,
+        }
+
+    def decode_topk(
+        self,
+        heads: dict[str, jnp.ndarray],
+        pre_max: int = 512,
+        score_thresh: float = 0.1,
+    ) -> dict[str, jnp.ndarray]:
+        """topk_candidates + the XLA residual-decode tail: boxes
+        (B, K, 7), scores (B, K) with -inf on gated-out slots, labels
+        (B, K) 1-indexed."""
+        cfg = self.cfg
+        cand = self.topk_candidates(heads, pre_max, score_thresh)
+        return decode_candidates(cand, cfg.num_dir_bins, cfg.dir_offset)
 
     def decode(self, heads: dict[str, jnp.ndarray]) -> dict[str, jnp.ndarray]:
         """Heads -> flat boxes (B, N, 7) + IoU-rectified scores

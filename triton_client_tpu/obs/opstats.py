@@ -34,9 +34,35 @@ import glob
 import gzip
 import json
 import os
+import re
 
 #: annotation prefix StagedChannel.launch emits around every dispatch
 LAUNCH_ANNOTATION_PREFIX = "launch:"
+
+#: scope prefix the fused Pallas kernels stamp on their launches
+#: (ops/pallas_voxel, ops/pallas_decode use jax.named_scope
+#: ``fused:<stage>`` with stage from ops/fused.FUSED_STAGES)
+FUSED_SCOPE_PREFIX = "fused:"
+
+_FUSED_SCOPE_RE = re.compile(r"fused:([A-Za-z0-9_]+)")
+
+
+def fused_stage(name: str, args: dict | None = None) -> str | None:
+    """Stage name from a ``fused:<stage>`` scope marker, searched in the
+    event name and every string-valued arg. On TPU the jax.named_scope
+    rides in the op metadata XLA copies into the trace args (long_name /
+    tf_op carry the full scope path); on CPU the metadata is dropped and
+    per-stage split falls back to annotation windows (see
+    :func:`summarize`)."""
+    m = _FUSED_SCOPE_RE.search(name)
+    if m:
+        return m.group(1)
+    for v in (args or {}).values():
+        if isinstance(v, str):
+            m = _FUSED_SCOPE_RE.search(v)
+            if m:
+                return m.group(1)
+    return None
 
 #: op-name substring -> fusion/kind bucket, first match wins. Coarse on
 #: purpose: the question is "what KIND of work dominates", not XLA's
@@ -139,6 +165,7 @@ def summarize(
     events = doc.get("traceEvents", []) or []
     module_of = _module_models(hlo_modules)
     windows = _annotation_windows(events, annotation_prefix)
+    stage_windows = _stage_windows(events)
 
     rows: dict[tuple, dict] = {}
     total_us = 0.0
@@ -152,9 +179,10 @@ def summarize(
             continue
         name = str(hlo_op or e.get("name", "?"))
         module = str(module or "?")
+        stage = fused_stage(str(e.get("name", "")), args) or fused_stage(name)
         dur = float(e.get("dur", 0.0))
         ts = float(e.get("ts", 0.0))
-        key = (module, name)
+        key = (module, name, stage)
         row = rows.get(key)
         if row is None:
             row = rows[key] = {
@@ -162,6 +190,7 @@ def summarize(
                 "module": module,
                 "kind": op_kind(name),
                 "model": None,
+                "stage": stage,
                 "occurrences": 0,
                 "time_us": 0.0,
                 "_mid": [],
@@ -171,19 +200,28 @@ def summarize(
         row["_mid"].append(ts + dur / 2.0)
         total_us += dur
 
-    # attribution pass: module name first, annotation midpoint second
+    # attribution pass: module name first, annotation midpoint second;
+    # fused-stage split rides the same midpoints when the op metadata
+    # carried no scope marker (CPU traces drop it)
     model_us: dict[str, float] = {}
+    stage_us: dict[str, float] = {}
     unattributed_us = 0.0
     for row in rows.values():
         model = _attribute_module(row["module"], module_of)
         if model is None:
             model = _attribute_windows(row["_mid"], windows)
+        if row["stage"] is None and stage_windows:
+            row["stage"] = _attribute_windows(row["_mid"], stage_windows)
         row["model"] = model
         del row["_mid"]
         if model is None:
             unattributed_us += row["time_us"]
         else:
             model_us[model] = model_us.get(model, 0.0) + row["time_us"]
+        if row["stage"] is not None:
+            stage_us[row["stage"]] = (
+                stage_us.get(row["stage"], 0.0) + row["time_us"]
+            )
 
     ordered = sorted(rows.values(), key=lambda r: -r["time_us"])
     for row in ordered:
@@ -196,10 +234,41 @@ def summarize(
         "ops": ordered,
         "models": model_us,
         "unattributed_us": unattributed_us,
+        # additive sub-attribution: stage time is a SPLIT of the same
+        # device time already counted under its model, never extra —
+        # the >=90% model-attribution bar (perf/profile_roofline.py)
+        # is unaffected by fused-kernel accounting
+        "stages": stage_us,
         "annotation_windows": {
             m: len(ws) for m, ws in windows.items()
         },
     }
+
+
+def _stage_windows(events) -> dict[str, list]:
+    """``stage -> [(ts, ts_end), ...]`` from ``fused:<stage>`` trace
+    annotations (jax.profiler.TraceAnnotation around an eager fused
+    launch — perf/profile_fused.py emits them so CPU/interpret traces
+    still split per stage). Device-op events are excluded: their own
+    scope marker is read directly by :func:`fused_stage`."""
+    windows: dict[str, list] = {}
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        args = e.get("args") or {}
+        if args.get("hlo_op") or args.get("hlo_module"):
+            continue
+        # TraceMe splits "fused:<stage>" at the colon and keeps the full
+        # string only in args.long_name — prefer it over the event name
+        name = str(args.get("long_name") or e.get("name", ""))
+        if not name.startswith(FUSED_SCOPE_PREFIX):
+            continue
+        stage = name[len(FUSED_SCOPE_PREFIX):]
+        ts = float(e.get("ts", 0.0))
+        windows.setdefault(stage, []).append(
+            (ts, ts + float(e.get("dur", 0.0)))
+        )
+    return windows
 
 
 def _attribute_module(module: str, module_of: dict[str, str]) -> str | None:

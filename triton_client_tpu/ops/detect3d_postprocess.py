@@ -6,6 +6,13 @@ invokes inside TritonPythonModel.execute (examples/pointpillar_kitti/
 (clients/postprocess/detector_3d_postprocess.py: pred_boxes (N, 7),
 pred_scores, pred_labels with 1-indexed labels). Fixed shapes
 throughout: score gate + top-k prefilter + rotated-BEV NMS.
+
+``fused=True`` routes the suppression + packing tail through ONE
+Pallas launch (ops/pallas_decode.fused_suppress_pack_3d) instead of
+the nms_bev while_loop + gather/concat chain — bitwise-identical keep
+sequences and packed rows (greedy == fixpoint, the equivalence
+ops/nms pins by test). Pipelines pick the route at trace time from
+ops/fused.fused_stage_enabled.
 """
 
 from __future__ import annotations
@@ -18,7 +25,9 @@ import jax.numpy as jnp
 from triton_client_tpu.ops.boxes3d import nms_bev
 
 
-@functools.partial(jax.jit, static_argnames=("max_det", "pre_max"))
+@functools.partial(
+    jax.jit, static_argnames=("max_det", "pre_max", "fused", "interpret")
+)
 def extract_boxes_3d(
     boxes: jnp.ndarray,
     scores: jnp.ndarray,
@@ -26,6 +35,8 @@ def extract_boxes_3d(
     iou_thresh: float = 0.01,
     max_det: int = 128,
     pre_max: int = 512,
+    fused: bool = False,
+    interpret: bool = False,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """boxes (B, N, 7+e), scores (B, N, nc) -> packed per-image
     detections. Columns past the canonical 7 ride along untouched
@@ -47,16 +58,27 @@ def extract_boxes_3d(
         k = min(pre_max, gated.shape[0])
         top_scores, top_idx = jax.lax.top_k(gated, k)
         return _nms_pack_one(
-            b[top_idx], top_scores, label[top_idx], iou_thresh, max_det
+            b[top_idx], top_scores, label[top_idx], iou_thresh, max_det,
+            fused=fused, interpret=interpret,
         )
 
     return jax.vmap(one_image)(boxes, scores)
 
 
-def _nms_pack_one(cand_boxes, cand_scores, cand_labels, iou_thresh, max_det):
+def _nms_pack_one(
+    cand_boxes, cand_scores, cand_labels, iou_thresh, max_det,
+    fused: bool = False, interpret: bool = False,
+):
     """(K, 7+e) candidates (+ scores with -inf padding, 1-indexed
     labels) -> packed (max_det, 9+e) rows [box7, extras..., score,
     label] + valid mask. BEV NMS reads only the canonical 7 columns."""
+    if fused:
+        from triton_client_tpu.ops.pallas_decode import fused_suppress_pack_3d
+
+        return fused_suppress_pack_3d(
+            cand_boxes, cand_scores, cand_labels,
+            iou_thresh=iou_thresh, max_det=max_det, interpret=interpret,
+        )
     idx, keep = nms_bev(
         cand_boxes[:, :7], cand_scores, iou_thresh=iou_thresh, max_det=max_det
     )
@@ -71,13 +93,15 @@ def _nms_pack_one(cand_boxes, cand_scores, cand_labels, iou_thresh, max_det):
     return jnp.where(keep[:, None], out, 0.0), keep
 
 
-@functools.partial(jax.jit, static_argnames=("max_det",))
+@functools.partial(jax.jit, static_argnames=("max_det", "fused", "interpret"))
 def nms_pack_3d(
     boxes: jnp.ndarray,
     scores: jnp.ndarray,
     labels: jnp.ndarray,
     iou_thresh: float = 0.01,
     max_det: int = 128,
+    fused: bool = False,
+    interpret: bool = False,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Packed NMS over PRE-GATED candidates: boxes (B, K, 7+e), scores
     (B, K) with -inf padding, labels (B, K) 1-indexed. The fast path for
@@ -86,5 +110,7 @@ def nms_pack_3d(
     grid — the OpenPCDet post_processing order, but with the gate moved
     in front of the decode where XLA can't fuse it away itself)."""
     return jax.vmap(
-        lambda b, s, l: _nms_pack_one(b, s, l, iou_thresh, max_det)
+        lambda b, s, l: _nms_pack_one(
+            b, s, l, iou_thresh, max_det, fused=fused, interpret=interpret
+        )
     )(boxes, scores, labels)

@@ -11,6 +11,12 @@ jits and vmaps over the batch:
 The reference's variable-length outputs and its 10 s NMS watchdog
 (yolov5_postprocess.py:51,120-122) are unnecessary here: runtime is
 deterministic by construction.
+
+``fused=True`` collapses the post-top-k tail — xywh->xyxy decode,
+class offset, suppression loop and packing — into ONE Pallas launch
+(ops/pallas_decode.fused_decode_nms_2d) instead of the nms_padded op
+chain. Bitwise-identical rows (pinned by tests/test_fused_parity.py);
+pipelines pick the route at trace time from ops/fused.
 """
 
 from __future__ import annotations
@@ -24,6 +30,31 @@ from triton_client_tpu.ops.boxes import xywh2xyxy
 from triton_client_tpu.ops.nms import nms_padded
 
 
+def _packed_nms(
+    boxes, scores, classes, valid, iou_thresh, max_det, class_agnostic,
+    box_format: str, fused: bool, interpret: bool,
+):
+    """nms_padded vs the fused single-launch tail. ``box_format`` tells
+    the fused kernel whether decode is still pending ("xywh" — the
+    conversion the XLA path already did before top-k happens in-kernel
+    instead)."""
+    if fused:
+        from triton_client_tpu.ops.pallas_decode import fused_decode_nms_2d
+
+        return fused_decode_nms_2d(
+            boxes, scores, classes, valid,
+            iou_thresh=iou_thresh, max_det=max_det, box_format=box_format,
+            class_agnostic=class_agnostic, interpret=interpret,
+        )
+    if box_format == "xywh":
+        boxes = xywh2xyxy(boxes)
+    return nms_padded(
+        boxes, scores, classes, valid,
+        iou_thresh=iou_thresh, max_det=max_det,
+        class_agnostic=class_agnostic,
+    )
+
+
 def _gate_topk_nms(
     boxes: jnp.ndarray,
     scores: jnp.ndarray,
@@ -33,23 +64,25 @@ def _gate_topk_nms(
     max_det: int,
     max_nms: int,
     class_agnostic: bool = False,
+    box_format: str = "xyxy",
+    fused: bool = False,
+    interpret: bool = False,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Shared single-image tail: confidence gate -> top-k prefilter ->
     class-aware NMS -> packed (max_det, 6) rows. Invalid top-k slots
     carry the gate's -inf in ``gated`` but 0.0 in the packed output so
-    confs stay clean."""
+    confs stay clean. Gate + top-k stay XLA on purpose: the sort-based
+    top_k beats any in-kernel reformulation and fuses into the head."""
     gated = jnp.where(scores > conf_thresh, scores, -jnp.inf)
     k = min(max_nms, gated.shape[0])
     top_scores, top_idx = jax.lax.top_k(gated, k)
     top_valid = top_scores > -jnp.inf
-    return nms_padded(
+    return _packed_nms(
         boxes[top_idx],
         jnp.where(top_valid, top_scores, 0.0),
         classes[top_idx],
         top_valid,
-        iou_thresh=iou_thresh,
-        max_det=max_det,
-        class_agnostic=class_agnostic,
+        iou_thresh, max_det, class_agnostic, box_format, fused, interpret,
     )
 
 
@@ -61,6 +94,9 @@ def _multilabel_topk_nms(
     max_det: int,
     max_nms: int,
     class_agnostic: bool = False,
+    box_format: str = "xyxy",
+    fused: bool = False,
+    interpret: bool = False,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Single-image multi-label tail: every (box, class) pair over the
     threshold is a candidate. Top-k runs on the flat (N*nc,) scores;
@@ -72,19 +108,21 @@ def _multilabel_topk_nms(
     k = min(max_nms, gated.shape[0])
     top_scores, top_idx = jax.lax.top_k(gated, k)
     top_valid = top_scores > -jnp.inf
-    return nms_padded(
+    return _packed_nms(
         boxes[top_idx // nc],
         jnp.where(top_valid, top_scores, 0.0),
         top_idx % nc,
         top_valid,
-        iou_thresh=iou_thresh,
-        max_det=max_det,
-        class_agnostic=class_agnostic,
+        iou_thresh, max_det, class_agnostic, box_format, fused, interpret,
     )
 
 
 @functools.partial(
-    jax.jit, static_argnames=("max_det", "max_nms", "class_agnostic", "multi_label")
+    jax.jit,
+    static_argnames=(
+        "max_det", "max_nms", "class_agnostic", "multi_label", "fused",
+        "interpret",
+    ),
 )
 def extract_boxes(
     prediction: jnp.ndarray,
@@ -94,6 +132,8 @@ def extract_boxes(
     max_nms: int = 1024,
     class_agnostic: bool = False,
     multi_label: bool = False,
+    fused: bool = False,
+    interpret: bool = False,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Raw YOLO-style predictions -> packed per-image detections.
 
@@ -116,7 +156,11 @@ def extract_boxes(
     nc = prediction.shape[-1] - 5
 
     def one_image(pred: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
-        boxes = xywh2xyxy(pred[:, :4])
+        # fused path defers xywh->xyxy into the kernel (the "decode"
+        # half of decode+NMS — conversion commutes with the top-k
+        # gather, and *0.5 is exact, so rows stay bitwise-identical)
+        boxes = pred[:, :4] if fused else xywh2xyxy(pred[:, :4])
+        fmt = "xywh" if fused else "xyxy"
         obj = pred[:, 4]
         cls_conf = pred[:, 5:] * obj[:, None]  # conf = obj * cls
 
@@ -129,6 +173,9 @@ def extract_boxes(
                 max_det,
                 max_nms,
                 class_agnostic,
+                box_format=fmt,
+                fused=fused,
+                interpret=interpret,
             )
         return _gate_topk_nms(
             boxes,
@@ -139,12 +186,17 @@ def extract_boxes(
             max_det,
             max_nms,
             class_agnostic,
+            box_format=fmt,
+            fused=fused,
+            interpret=interpret,
         )
 
     return jax.vmap(one_image)(prediction)
 
 
-@functools.partial(jax.jit, static_argnames=("max_det", "max_nms"))
+@functools.partial(
+    jax.jit, static_argnames=("max_det", "max_nms", "fused", "interpret")
+)
 def extract_boxes_yolov4(
     boxes: jnp.ndarray,
     confs: jnp.ndarray,
@@ -152,6 +204,8 @@ def extract_boxes_yolov4(
     iou_thresh: float = 0.6,
     max_det: int = 300,
     max_nms: int = 1024,
+    fused: bool = False,
+    interpret: bool = False,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """YOLOv4 two-output wire contract -> packed per-image detections.
 
@@ -184,13 +238,19 @@ def extract_boxes_yolov4(
             iou_thresh,
             max_det,
             max_nms,
+            fused=fused,
+            interpret=interpret,
         )
 
     return jax.vmap(one_image)(boxes, confs)
 
 
 @functools.partial(
-    jax.jit, static_argnames=("max_det", "max_nms", "class_agnostic", "multi_label")
+    jax.jit,
+    static_argnames=(
+        "max_det", "max_nms", "class_agnostic", "multi_label", "fused",
+        "interpret",
+    ),
 )
 def extract_boxes_scored(
     boxes: jnp.ndarray,
@@ -201,6 +261,8 @@ def extract_boxes_scored(
     max_nms: int = 1024,
     class_agnostic: bool = False,
     multi_label: bool = True,
+    fused: bool = False,
+    interpret: bool = False,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Decoded-box detectors (RetinaNet/FCOS) -> packed detections.
 
@@ -231,6 +293,8 @@ def extract_boxes_scored(
                 max_det,
                 max_nms,
                 class_agnostic,
+                fused=fused,
+                interpret=interpret,
             )
         return _gate_topk_nms(
             b,
@@ -241,6 +305,8 @@ def extract_boxes_scored(
             max_det,
             max_nms,
             class_agnostic,
+            fused=fused,
+            interpret=interpret,
         )
 
     return jax.vmap(one_image)(boxes, scores)

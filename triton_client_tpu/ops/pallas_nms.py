@@ -34,6 +34,23 @@ _LANES = 128
 _NEG_INF = float("-inf")
 
 
+def masked_pick(sel: jnp.ndarray, row: jnp.ndarray) -> jnp.ndarray:
+    """Extract one lane's value with a masked lane reduction (Mosaic
+    has no dynamic_slice on values) — the gather-free idiom every
+    suppression-loop kernel here shares. ``sel``: (1, N) one-hot lane
+    mask; ``row``: (1, N) values."""
+    return jnp.sum(jnp.where(sel, row, 0.0))
+
+
+def write_lane_col(out_ref, r: int, out_lane: jnp.ndarray, i, value) -> None:
+    """Write ``value`` into column ``i`` of sublane row ``r`` of a
+    (rows, max_det) output block via an iota==i masked select — the
+    lane-parallel form of ``out[r, i] = value`` shared by the packing
+    epilogues (ops/pallas_decode) and this kernel's index writes."""
+    cur = out_ref[r : r + 1, :]
+    out_ref[r : r + 1, :] = jnp.where(out_lane == i, value, cur)
+
+
 def _nms_kernel(boxes_ref, scores_ref, thresh_ref, idx_ref, valid_ref, live_ref, *, max_det):
     """boxes_ref: (8, N) rows [x1, y1, x2, y2, area, 0, 0, 0];
     scores_ref: (1, N); thresh_ref: (1,) SMEM scalar IoU threshold

@@ -66,6 +66,48 @@ def ragged_row_bucket(n: int) -> int:
     return _round_up(n, step)
 
 
+def kernel_block_rows(n: int, block: int) -> int:
+    """Padded row count for a fused Pallas kernel launch over a packed
+    cloud/batch: the learned ragged bucket, rounded up to the kernel's
+    point-block multiple.
+
+    ``block`` must be a power of two >= ``_SUBLANES`` — that guarantee
+    is what keeps the two tables compatible: ``ragged_row_bucket``'s
+    step is ``max(8, bucket(n) // 8)``, itself a power of two, so for
+    every bucket >= ``8 * block`` the step is already a ``block``
+    multiple and the tables coincide exactly (asserted by
+    :func:`assert_block_divides_buckets`); below that the round-up
+    costs at most ``block - 1`` extra rows while the compiled-shape set
+    stays a subset of the bucket table's."""
+    if block < _SUBLANES or block & (block - 1):
+        raise ValueError(
+            f"kernel block must be a power of two >= {_SUBLANES}, got {block}"
+        )
+    return _round_up(ragged_row_bucket(n), block)
+
+
+def assert_block_divides_buckets(block: int, max_rows: int = 1 << 22) -> None:
+    """Assert the fused-kernel block size divides every learned bucket
+    in its regime (bucket >= 8 * block) — the invariant that lets a
+    channel reuse one packed array for BOTH the segment kernels (bucket
+    shapes) and a fused kernel launch (block-multiple shapes) without a
+    re-pad in between. Raises AssertionError naming the first violator."""
+    if block < _SUBLANES or block & (block - 1):
+        raise ValueError(
+            f"kernel block must be a power of two >= {_SUBLANES}, got {block}"
+        )
+    floor = 8 * block
+    n = floor
+    while n <= max_rows:
+        b = ragged_row_bucket(n)
+        if b >= floor:
+            assert b % block == 0, (
+                f"ragged_row_bucket({n}) = {b} is not a multiple of the "
+                f"fused kernel block {block}"
+            )
+        n += max(1, b // 16)  # sample densely enough to hit every step
+
+
 @dataclasses.dataclass(frozen=True)
 class RaggedLayout:
     """Row-offset/segment-id table for one packed ragged batch.

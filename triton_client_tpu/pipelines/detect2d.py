@@ -29,6 +29,7 @@ from triton_client_tpu.ops.detect_postprocess import (
     extract_boxes,
     extract_boxes_scored,
 )
+from triton_client_tpu.ops.fused import fused_interpret, resolve_fused_stages
 from triton_client_tpu.ops.preprocess import normalize_image
 from triton_client_tpu.runtime.precision import (
     KEEP_F32_2D,
@@ -57,6 +58,12 @@ class Detect2DConfig:
     # "scored": forward returns ((B, N, 4) boxes, (B, N, nc) scores) —
     # the detectron family, where decode happens in the model.
     head_style: str = "yolo"
+    # Fused Pallas decode+NMS routing (ops/fused): "auto" fuses on a
+    # real TPU backend (subject to TPU_FUSED_KERNELS), "on" forces the
+    # kernel everywhere (interpret mode off-TPU — the parity matrix),
+    # "off" is the spec-level opt-out. Published as
+    # spec.extra["fused_stages"].
+    fused: str = "auto"
 
 
 class Detect2DPipeline:
@@ -76,6 +83,7 @@ class Detect2DPipeline:
         self.config = config
         self._forward = forward
         self.precision = PrecisionPolicy.parse(precision)
+        self.fused_stages = resolve_fused_stages(config.fused, ("decode_nms",))
         self._jit = jax.jit(self._pipeline, static_argnames=("orig_hw",))
 
     def _pipeline(
@@ -97,6 +105,8 @@ class Detect2DPipeline:
         # decode, NMS scoring and pixel rescale below run in f32
         # regardless of policy
         pred = self.precision.boundary(self._forward(x))
+        fuse_tail = "decode_nms" in self.fused_stages
+        interpret = fused_interpret()
         if cfg.head_style == "scored":
             boxes_scores = pred
             dets, valid = extract_boxes_scored(
@@ -106,6 +116,8 @@ class Detect2DPipeline:
                 max_det=cfg.max_det,
                 max_nms=cfg.max_nms,
                 multi_label=cfg.multi_label,
+                fused=fuse_tail,
+                interpret=interpret,
             )
         else:
             dets, valid = extract_boxes(
@@ -115,6 +127,8 @@ class Detect2DPipeline:
                 max_det=cfg.max_det,
                 max_nms=cfg.max_nms,
                 multi_label=cfg.multi_label,
+                fused=fuse_tail,
+                interpret=interpret,
             )
         boxes = scale_boxes(dets[..., :4], cfg.input_hw, orig_hw)
         dets = jnp.concatenate([boxes, dets[..., 4:]], axis=-1)
@@ -255,6 +269,7 @@ def build_yolov5_pipeline(
     )
     pipeline = Detect2DPipeline(cfg, forward, precision=policy)
     spec = _detect2d_spec(cfg, num_predictions(cfg.input_hw))
+    spec.extra["fused_stages"] = list(pipeline.fused_stages)
     spec.extra.update(policy.spec_extra(cast_vars, KEEP_F32_2D))
     return pipeline, spec, variables
 
@@ -302,6 +317,7 @@ def build_yolov4_pipeline(
     )
     pipeline = Detect2DPipeline(cfg, forward, precision=policy)
     spec = _detect2d_spec(cfg, v4_num_predictions(cfg.input_hw))
+    spec.extra["fused_stages"] = list(pipeline.fused_stages)
     spec.extra.update(policy.spec_extra(cast_vars, KEEP_F32_2D))
     return pipeline, spec, variables
 
@@ -394,6 +410,7 @@ def build_retinanet_pipeline(
     )
     pipeline = Detect2DPipeline(cfg, forward, precision=policy)
     spec = _detectron_spec(cfg)
+    spec.extra["fused_stages"] = list(pipeline.fused_stages)
     spec.extra.update(policy.spec_extra(cast_vars, KEEP_F32_2D))
     return pipeline, spec, variables
 
@@ -439,6 +456,7 @@ def build_fcos_pipeline(
     )
     pipeline = Detect2DPipeline(cfg, forward, precision=policy)
     spec = _detectron_spec(cfg)
+    spec.extra["fused_stages"] = list(pipeline.fused_stages)
     spec.extra.update(policy.spec_extra(cast_vars, KEEP_F32_2D))
     return pipeline, spec, variables
 

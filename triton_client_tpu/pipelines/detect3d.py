@@ -36,6 +36,9 @@ from triton_client_tpu.ops.detect3d_postprocess import (
     extract_boxes_3d,
     nms_pack_3d,
 )
+from triton_client_tpu.ops.fused import fused_interpret, resolve_fused_stages
+from triton_client_tpu.ops.pallas_decode import fused_residual_decode
+from triton_client_tpu.ops.pallas_voxel import fused_mean_volume
 from triton_client_tpu.ops.voxelize import pad_points, voxelize
 from triton_client_tpu.runtime.precision import (
     KEEP_F32_3D,
@@ -77,6 +80,13 @@ class Detect3DConfig:
     # caps at max_voxels/max_points_per_voxel; the scatter path keeps
     # all points, which can only add information).
     vfe: str = "auto"
+    # Fused Pallas hot-path routing (ops/fused): "auto" fuses the
+    # eligible stages on a real TPU backend (subject to the
+    # TPU_FUSED_KERNELS env allowlist), "on" forces them everywhere
+    # (interpret mode off-TPU — the parity matrix), "off" is the
+    # spec-level opt-out. Resolved per stage at build time and
+    # published as spec.extra["fused_stages"].
+    fused: str = "auto"
 
 
 class Detect3DPipeline:
@@ -124,6 +134,28 @@ class Detect3DPipeline:
                 "for exact reference budget semantics",
                 config.model_name,
             )
+        # fused-stage eligibility is structural (which model surfaces
+        # exist), the routing decision layers env + config + backend on
+        # top (ops/fused). voxelize_scatter needs the dense-middle
+        # scatter VFE (fused_mean_volume is _scatter_mean_volume's
+        # twin); decode_nms applies to every 3D tail.
+        candidates = ("decode_nms",)
+        if (
+            self.use_scatter
+            and getattr(model, "scatter_any_nz", False)
+            and getattr(model.cfg, "middle", None) == "dense"
+            and hasattr(model, "from_volume")
+        ):
+            candidates = ("voxelize_scatter",) + candidates
+        self.fused_stages = resolve_fused_stages(config.fused, candidates)
+        if "voxelize_scatter" in self.fused_stages:
+            logger.info(
+                "fused voxelize->scatter caps occupied cells at max_voxels "
+                "(%d) — the grouped/OpenPCDet budget contract; the XLA "
+                "scatter path it replaces keeps every occupied cell, so "
+                "outputs differ once a scan exceeds the budget",
+                model.cfg.voxel.max_voxels,
+            )
         self._jit = jax.jit(self._pipeline)
 
     def _pipeline(self, points: jnp.ndarray, count: jnp.ndarray):
@@ -133,7 +165,19 @@ class Detect3DPipeline:
         # realize — HBM reads stay int8); voxelize below always sees the
         # f32 cloud (KEEP_F32_3D: cell coords are precision-sensitive)
         variables = realize(self.variables)
-        if use_scatter:
+        interpret = fused_interpret()
+        if "voxelize_scatter" in self.fused_stages:
+            # fused Pallas voxelize->scatter: sorted-segment mean via
+            # MXU one-hot matmuls + unique-index set-scatter epilogue,
+            # replacing the XLA scatter-add that dominates the dense
+            # SECOND front (ops/pallas_voxel module docstring)
+            volume = fused_mean_volume(
+                points, count, self.model.cfg.voxel, interpret=interpret
+            )
+            heads = self.model.apply(
+                variables, volume, train=False, method=self.model.from_volume
+            )
+        elif use_scatter:
             # sort-free path: pillar mean/max as dense-grid scatters,
             # no (V, K) grouping (see PointPillars.from_points)
             heads = self.model.apply(
@@ -152,18 +196,44 @@ class Detect3DPipeline:
         # keep-list boundary: box decode and NMS scoring below run in
         # f32 regardless of the model compute dtype
         heads = self.precision.boundary(heads)
+        fuse_tail = "decode_nms" in self.fused_stages
         if hasattr(self.model, "decode_topk"):
             # Fast path: gate + top-k on raw logits BEFORE box decode —
             # only pre_max boxes are ever decoded (see decode_topk).
-            cand = self.model.decode_topk(
-                heads, pre_max=cfg.pre_max, score_thresh=cfg.score_thresh
-            )
+            if fuse_tail and hasattr(self.model, "topk_candidates"):
+                # fused tail: residual decode + rectify as ONE
+                # elementwise launch, then suppression + packing as
+                # another (ops/pallas_decode) — detections never leave
+                # the device between stages
+                tc = self.model.topk_candidates(
+                    heads, pre_max=cfg.pre_max, score_thresh=cfg.score_thresh
+                )
+                mc = self.model.cfg
+                boxes = jax.vmap(
+                    lambda d, a, db: fused_residual_decode(
+                        d, a, db,
+                        num_dir_bins=mc.num_dir_bins,
+                        dir_offset=mc.dir_offset,
+                        interpret=interpret,
+                    )
+                )(tc["deltas"], tc["anchors"], tc["dir_bin"])
+                cand = {
+                    "boxes": boxes,
+                    "scores": tc["scores"],
+                    "labels": tc["labels"],
+                }
+            else:
+                cand = self.model.decode_topk(
+                    heads, pre_max=cfg.pre_max, score_thresh=cfg.score_thresh
+                )
             dets, valid = nms_pack_3d(
                 cand["boxes"],
                 cand["scores"],
                 cand["labels"],
                 iou_thresh=cfg.iou_thresh,
                 max_det=cfg.max_det,
+                fused=fuse_tail,
+                interpret=interpret,
             )
         else:
             pred = self.model.decode(heads)
@@ -180,6 +250,8 @@ class Detect3DPipeline:
                 iou_thresh=cfg.iou_thresh,
                 max_det=cfg.max_det,
                 pre_max=cfg.pre_max,
+                fused=fuse_tail,
+                interpret=interpret,
             )
         return dets[0], valid[0]
 
@@ -350,6 +422,7 @@ def build_pointpillars_pipeline(
     cfg = config or Detect3DConfig()
     pipeline = Detect3DPipeline(cfg, model, cast_vars, precision=policy)
     spec = _detect3d_spec(cfg, model_cfg)
+    spec.extra["fused_stages"] = list(pipeline.fused_stages)
     spec.extra.update(
         pipeline.precision.spec_extra(cast_vars, KEEP_F32_3D)
     )
@@ -382,6 +455,7 @@ def build_second_pipeline(
     cfg = config or Detect3DConfig(model_name="second_iou")
     pipeline = Detect3DPipeline(cfg, model, cast_vars, precision=policy)
     spec = _detect3d_spec(cfg, model_cfg, {"iou_alpha": model_cfg.iou_alpha})
+    spec.extra["fused_stages"] = list(pipeline.fused_stages)
     spec.extra.update(
         pipeline.precision.spec_extra(cast_vars, KEEP_F32_3D)
     )
@@ -427,6 +501,7 @@ def build_centerpoint_pipeline(
     cast_vars = policy.cast_params(variables)
     pipeline = Detect3DPipeline(cfg, model, cast_vars, precision=policy)
     spec = _detect3d_spec(cfg, model_cfg, {"with_velocity": model_cfg.with_velocity})
+    spec.extra["fused_stages"] = list(pipeline.fused_stages)
     spec.extra.update(
         pipeline.precision.spec_extra(cast_vars, KEEP_F32_3D)
     )
