@@ -1257,6 +1257,131 @@ def _serve_streaming_sessions_row(duration_s: float) -> dict:
         server.stop()
 
 
+def _serve_quality_plane_row(duration_s: float) -> dict:
+    """ISSUE 17 continuous quality plane: one in-process server with a
+    detection echo model, its ``_int8`` twin armed as a canary, and the
+    shadow sampler at the serve CLI's canary-default 25%. Two paced
+    open-loop windows, sampling OFF then ON, same seed; the row's
+    ``value`` is scored frames/sec off the mirror's own counter, and
+    ``quality_overhead_headroom`` (p99 off / p99 on) is gated by
+    perf/bench_diff.py: a >10% drop means the sampler started taxing
+    the primary path. Echo detector on purpose — the row measures the
+    route/observe/mirror machinery, not detector math."""
+    from triton_client_tpu.channel.tpu_channel import TPUChannel
+    from triton_client_tpu.config import ModelSpec, TensorSpec
+    from triton_client_tpu.eval.quality_plane import QualityPlane
+    from triton_client_tpu.runtime.repository import ModelRepository
+    from triton_client_tpu.runtime.server import InferenceServer
+    from triton_client_tpu.utils.loadgen import run_open_loop
+
+    det = np.zeros((6, 6), np.float32)
+    det[:, 0] = np.arange(6) * 30.0
+    det[:, 1] = np.arange(6) * 20.0
+    det[:, 2] = det[:, 0] + 24.0
+    det[:, 3] = det[:, 1] + 16.0
+    det[:, 4] = 0.9
+    det[:, 5] = np.arange(6) % 3
+
+    def _det_fn(inputs):
+        return {
+            "detections": det + np.float32(0.0) * inputs["x"][0, 0],
+            "valid": np.ones((6,), bool),
+        }
+
+    repo = ModelRepository()
+    for name in ("qp_det", "qp_det_int8"):
+        repo.register(
+            ModelSpec(
+                name=name, version="1", platform="jax",
+                inputs=(TensorSpec("x", (-1, 4), "FP32"),),
+                outputs=(
+                    TensorSpec("detections", (-1, 6), "FP32"),
+                    TensorSpec("valid", (-1,), "BOOL"),
+                ),
+            ),
+            _det_fn,
+        )
+    quality = QualityPlane(sample_rate=0.0, window_frames=16)
+    quality.set_canary("qp_det", "qp_det_int8", 0.25)
+    server = InferenceServer(
+        repo, TPUChannel(repo), address="127.0.0.1:0",
+        max_workers=8, quality=quality,
+    )
+    server.start()
+    try:
+        import dataclasses as _dc
+
+        from triton_client_tpu.channel.base import InferRequest
+        from triton_client_tpu.channel.grpc_channel import GRPCChannel
+
+        window = max(2.0, duration_s / 2.0)
+        scenarios = [("qp_det", {"x": np.ones((2, 4), np.float32)})]
+        addr = f"127.0.0.1:{server.port}"
+        rate = 120.0
+        # compile BOTH registrations (the canary slice routes to the
+        # variant mid-window otherwise) and the shadow dispatch path
+        # before any timed window
+        warm_chan = GRPCChannel(addr, timeout_s=30.0)
+        try:
+            for name in ("qp_det", "qp_det_int8"):
+                for i in range(3):
+                    warm_chan.do_inference(InferRequest(
+                        name, scenarios[0][1], request_id=f"warm-{name}-{i}"
+                    ))
+        finally:
+            warm_chan.close()
+        # deterministic per-arrival identity: the hash-sampled canary
+        # slice and shadow sample are then identical across runs
+        factory = lambda req, i: _dc.replace(req, request_id=f"qp-{i}")
+        off = run_open_loop(
+            addr, scenarios, rate_qps=rate, duration_s=window, seed=11,
+            deadline_s=30.0, request_factory=factory,
+        )
+        quality.set_sample_rate(0.25)
+        t0 = time.perf_counter()
+        on = run_open_loop(
+            addr, scenarios, rate_qps=rate, duration_s=window, seed=11,
+            deadline_s=30.0, request_factory=factory,
+        )
+        quality.drain(20.0)
+        wall = time.perf_counter() - t0
+        mirror = quality.snapshot()["mirror"]
+        p99_off = off.percentile(99.0)
+        p99_on = on.percentile(99.0)
+        # the gated ratio uses p95: the same signal (sidecar tax on the
+        # primary path) with far less single-sample jitter than p99
+        p95_off = off.percentile(95.0)
+        p95_on = on.percentile(95.0)
+        row = {
+            "metric": "quality_plane",
+            "value": round(mirror["scored"] / max(wall, 1e-9), 2),
+            "unit": "scored_frames/sec",
+            "sample_rate": 0.25,
+            "scored_frames": mirror["scored"],
+            "mirror_dropped": mirror["dropped"],
+            "shadow_lag_ms": round(mirror["mean_lag_s"] * 1e3, 3),
+            "p99_off_ms": round(p99_off, 3),
+            "p99_on_ms": round(p99_on, 3),
+            "p99_delta_ms": round(p99_on - p99_off, 3),
+            "p95_off_ms": round(p95_off, 3),
+            "p95_on_ms": round(p95_on, 3),
+            "shadow_overhead_ratio": round(p95_on / max(p95_off, 1e-9), 4),
+            "quality_overhead_headroom": round(
+                p95_off / max(p95_on, 1e-9), 4
+            ),
+            "canary": quality.canary.stats()["models"]
+            .get("qp_det", {}).get("state", "none"),
+            "precision": "f32",
+        }
+        if on.completed == 0 or off.completed == 0:
+            row["degraded"] = (
+                f"window incomplete; first error: {(off.errors or on.errors)[:1]}"
+            )
+        return row
+    finally:
+        server.stop()
+
+
 def _serve_multitenant_row(duration_s: float) -> dict:
     """ISSUE 9 multi-tenant lifecycle under pressure: five synthetic
     models (distinct multipliers, synthetic 100-byte HBM costs) over a
@@ -1816,6 +1941,22 @@ def main() -> None:
             print(
                 f"streaming sessions row skipped: {_remaining():.0f}s "
                 "left", file=sys.stderr,
+            )
+        # quality-plane sidecar row (ISSUE 17): synthetic and cheap —
+        # two short paced windows (sampling off/on) on an echo detector
+        if _remaining() > 40.0:
+            try:
+                row = _serve_quality_plane_row(
+                    duration_s=min(8.0, max(4.0, _remaining() - 30.0))
+                )
+                _emit_row(row, primary=False)
+                _write_local()
+            except Exception as e:
+                print(f"quality plane bench failed: {e}", file=sys.stderr)
+        else:
+            print(
+                f"quality plane row skipped: {_remaining():.0f}s left",
+                file=sys.stderr,
             )
     else:
         print(

@@ -161,6 +161,19 @@ python -m pytest tests/test_fused_parity.py -q \
 python perf/profile_fused.py --stages decode_nms_2d \
     --repeats 2 --cands 128
 
+echo "== quality-plane shard (shadow scoring, canary gate, rollback) =="
+# the continuous-quality contract (eval/shadow.py, eval/quality_plane.py
+# and the server/router/collector wiring): deterministic trace-id
+# sampling and canary slices, 2D/3D shadow-window scoring against the
+# f32 reference, gate budgets off runtime/precision.py, the canary
+# promote/rollback state machine (incl. the seeded quality_corrupt
+# ejection), folded legacy eval Summaries, and the tpu_quality_*
+# collector families + history-ring quality rows. The slow-marked live
+# E2E canary drive is tier-1-deselected but runs here with -m ''.
+python -m pytest tests/test_quality_plane.py -q -m '' \
+    --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+
 echo "== bench diff (optional shard: fresh bench vs BENCH_LOCAL.json) =="
 # perf-regression gate: compares a freshly produced bench results file
 # (BENCH_FRESH=<results.json>, written by a perf/ script on real

@@ -123,6 +123,11 @@ def diff_rows(
             # fused rows (profile_fused): reference ms / fused ms —
             # the per-stage device-time reduction the fusion claims
             ("speedup", "fused_speedup"),
+            # quality-plane row: p99(sampling off) / p99(sampling on).
+            # 1.0 means the sidecar is free; a DROP means the shadow
+            # sampler started taxing the primary path (the >10%
+            # threshold is the sidecar-tax gate from ISSUE 17)
+            ("quality_overhead_headroom", "quality_overhead_headroom"),
         ):
             f_v, b_v = f_row.get(key), b_row.get(key)
             if f_v is None or b_v is None or not b_v:

@@ -136,6 +136,82 @@ def test_evaluator_valid_mask_and_empty_frames():
     assert s["map50"] == pytest.approx(0.995, abs=1e-3)
 
 
+# -- ISSUE 17 edge cases: golden values for the corners the online
+# -- quality plane leans on (shadow windows hit these constantly) ------------
+
+
+def test_evaluator_empty_gt_frame_counts_false_positives():
+    # Detections on a frame with NO ground truth: zero TPs, so
+    # precision collapses and every AP is exactly 0 — not NaN, not
+    # skipped (the reference's evaluator drops such frames silently;
+    # ours must count them or an empty-scene hallucination is free).
+    ev = DetectionEvaluator()
+    dets = np.array([[0, 0, 10, 10, 0.9, 0], [20, 20, 40, 40, 0.8, 1]])
+    ev.add_frame(dets, None, np.zeros((0, 5)))
+    s = ev.summary()
+    assert s["frames"] == 1
+    assert s["map50"] == pytest.approx(0.0, abs=1e-9)
+    assert s["map"] == pytest.approx(0.0, abs=1e-9)
+    assert s["precision"] == pytest.approx(0.0, abs=1e-9)
+    assert s["recall"] == pytest.approx(0.0, abs=1e-9)
+
+
+def test_evaluator_zero_detection_frame_costs_recall():
+    # Frame 1 is perfect; frame 2 has GT but zero detections. The
+    # missed gt caps recall at 0.5; the 101-pt curve holds precision
+    # 1.0 to recall 0.5 then interpolates linearly to the (1.0, 0)
+    # closing sentinel: golden AP@0.5 = 0.5 + 0.25 = 0.75 exactly.
+    ev = DetectionEvaluator()
+    gt = np.array([[0, 0, 10, 10, 0]], np.float64)
+    ev.add_frame(np.array([[0, 0, 10, 10, 0.9, 0]]), None, gt)
+    ev.add_frame(np.zeros((0, 6)), None, gt)
+    s = ev.summary()
+    assert s["frames"] == 2
+    assert s["recall"] == pytest.approx(0.5, abs=1e-6)
+    assert s["map50"] == pytest.approx(0.75, abs=1e-3)
+
+
+def test_evaluator_single_class_collapse():
+    # Every det and gt in one class: per-class vectors collapse to
+    # length 1 and the macro-mean must equal the single class's AP
+    # (no phantom classes from the other frames' absence).
+    ev = DetectionEvaluator()
+    for k in range(3):
+        gt = np.array([[k * 50, 0, k * 50 + 20, 20, 2]], np.float64)
+        det = np.array([[k * 50, 0, k * 50 + 20, 20, 0.9, 2]])
+        ev.add_frame(det, None, gt)
+    s = ev.summary()
+    assert list(s["per_class_ap50"].keys()) == [2]
+    assert s["per_class_ap50"][2] == pytest.approx(0.995, abs=1e-3)
+    assert s["map50"] == pytest.approx(0.995, abs=1e-3)
+
+
+def test_greedy_match_keep_first_occurrence_dedup():
+    # Two dets over one gt: after the best-IoU-first sort, the
+    # keep-first-occurrence dedup awards the gt to the HIGHER-IoU det
+    # only — the duplicate is a hard FP at every threshold.
+    gt = np.array([[0, 0, 10, 10]], np.float64)
+    dets = np.array([[0, 0, 10, 10], [0, 1, 10, 11]])  # IoU 1.0 vs ~0.82
+    correct = match_predictions(
+        dets, np.zeros(2), gt, np.zeros(1)
+    )
+    assert correct[0].all()
+    assert not correct[1].any()
+    # ...and symmetrically one det over two gts: it matches the
+    # higher-IoU gt, the other gt stays unmatched (recall 0.5, not a
+    # double credit).
+    gts = np.array([[0, 0, 10, 10], [0, 2, 10, 12]], np.float64)
+    det = np.array([[0, 0, 10, 10]])
+    correct = match_predictions(det, np.zeros(1), gts, np.zeros(2))
+    assert correct[0, 0]  # matched (at 0.5) exactly once
+    ev = DetectionEvaluator()
+    ev.add_frame(
+        np.array([[0, 0, 10, 10, 0.9, 0]]), None,
+        np.concatenate([gts, np.zeros((2, 1))], axis=1),
+    )
+    assert ev.summary()["recall"] == pytest.approx(0.5, abs=1e-6)
+
+
 def test_prometheus_exporter_gated():
     from triton_client_tpu.eval import prometheus_export
 

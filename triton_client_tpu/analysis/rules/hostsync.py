@@ -158,6 +158,20 @@ HOT_PATH_ROOTS = (
     "pallas_decode.fused_suppress_pack_3d",
     "pallas_voxel.fused_mean_volume",
     "pallas_voxel.sorted_segment_mean_pallas",
+    # ISSUE 17 continuous quality plane: the sampler/mirror seams run
+    # per request on the RPC thread (server) or caller thread (router)
+    # — route before dispatch, observe after the readback, enqueue is
+    # the queue hand-off. They live on foreign objects the call graph
+    # cannot follow through `self._quality.route(...)`, so each is
+    # rooted directly; all numpy scoring must stay on the mirror's
+    # worker thread, never in these.
+    "QualityPlane.route",
+    "QualityPlane.observe",
+    "CanaryController.route",
+    "ShadowMirror.enqueue",
+    "shadow.sample_decision",
+    "shadow.slice_decision",
+    "FrontDoorRouter._observe_quality",
 )
 
 # module-level call targets that force a host sync

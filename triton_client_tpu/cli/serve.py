@@ -132,6 +132,39 @@ def main(argv=None) -> None:
         "drain and restore from it on startup (empty disables)",
     )
     p.add_argument(
+        "--canary", action="append", default=[],
+        help="arm a quality-gated canary: [primary:]variant=fraction "
+        "(e.g. det_int8=0.05 routes 5%% of det traffic — inferred from "
+        "the variant name — to det_int8). Promoted to full traffic "
+        "after --quality-promote-after consecutive clean shadow-scored "
+        "windows, auto-rolled-back to the f32 primary on the first "
+        "budget violation. Repeatable; implies the quality plane",
+    )
+    p.add_argument(
+        "--quality-sample", type=float, default=0.0,
+        help="continuous quality plane sampling rate in [0,1]: this "
+        "fraction of live traffic (deterministic trace-id hash) is "
+        "mirrored to the f32 reference and scored online "
+        "(tpu_quality_* metric families, /snapshot['quality']). "
+        "0 disables unless --canary arms it (then 0.25 is used)",
+    )
+    p.add_argument(
+        "--quality-window", type=int, default=32,
+        help="scored frames per quality window: gate verdicts, canary "
+        "promotion counting, and the tpu_quality_* gauges all advance "
+        "once per window",
+    )
+    p.add_argument(
+        "--quality-promote-after", type=int, default=3,
+        help="consecutive clean windows before a canary variant is "
+        "promoted to full traffic",
+    )
+    p.add_argument(
+        "--quality-pin-fused-off", action="store_true",
+        help="on quality rollback, also export TPU_FUSED_KERNELS=0 so "
+        "freshly compiled models take the reference (unfused) path",
+    )
+    p.add_argument(
         "--trace-capacity", type=int, default=256,
         help="recent request traces kept for /traces export "
         "(`trace-dump`); 0 disables request-scoped spans",
@@ -453,6 +486,69 @@ def build_server(args):
             f"pad_buckets={batcher == 'continuous' or getattr(args, 'pad_buckets', False)}",
             flush=True,
         )
+    # continuous quality plane: shadow-scored online accuracy + canary
+    # routing. Armed by --quality-sample > 0 or any --canary spec; the
+    # mirror dispatches through the server's own channel stack (wired
+    # inside InferenceServer), so shadow work queues behind live work.
+    quality = None
+    canary_specs = list(getattr(args, "canary", []) or [])
+    sample_rate = float(getattr(args, "quality_sample", 0.0) or 0.0)
+    if canary_specs and sample_rate <= 0.0:
+        # a canary without samples would never score a window — arm a
+        # rate high enough that promotion happens in human time
+        sample_rate = 0.25
+    if sample_rate > 0.0:
+        from triton_client_tpu.eval.quality_plane import (
+            QualityPlane,
+            infer_primary,
+            parse_canary_spec,
+            precision_of_name,
+        )
+
+        def _precision_of(variant):
+            # the repo's own precision tag wins over name sniffing
+            try:
+                return repo.get(variant, "").spec.extra.get(
+                    "precision"
+                ) or precision_of_name(variant)
+            except Exception:
+                return precision_of_name(variant)
+
+        quality = QualityPlane(
+            sample_rate=sample_rate,
+            window_frames=getattr(args, "quality_window", 32),
+            promote_after=getattr(args, "quality_promote_after", 3),
+            precision_of=_precision_of,
+            pin_fused_off=bool(
+                getattr(args, "quality_pin_fused_off", False)
+            ),
+        )
+        names = [name for name, _ in repo.list_models()]
+        for spec in canary_specs:
+            primary, variant, fraction = parse_canary_spec(spec)
+            if primary is None:
+                primary = infer_primary(variant, names)
+            if primary is None:
+                raise SystemExit(
+                    f"--canary {spec}: cannot infer the primary model "
+                    f"from {variant!r}; use the primary:variant=fraction "
+                    "form"
+                )
+            quality.set_canary(primary, variant, fraction)
+            print(
+                f"canary armed: {primary} -> {variant} at "
+                f"{fraction * 100:g}% of traffic "
+                f"(promote after {getattr(args, 'quality_promote_after', 3)}"
+                " clean windows, auto-rollback on budget violation)",
+                flush=True,
+            )
+        print(
+            f"quality plane: sample_rate={sample_rate:g} "
+            f"window_frames={getattr(args, 'quality_window', 32)} "
+            "(shadow-scored online mAP/velocity/ID-switch vs the f32 "
+            "reference; tpu_quality_* families)",
+            flush=True,
+        )
     uds = getattr(args, "uds", "auto") or "off"
     return InferenceServer(
         repo,
@@ -474,6 +570,7 @@ def build_server(args):
         history_interval_s=getattr(args, "history_interval", 10.0),
         history_capacity=getattr(args, "history_capacity", 360),
         history_path=getattr(args, "history_path", "") or None,
+        quality=quality,
     )
 
 

@@ -1,15 +1,36 @@
-"""Evaluation: online detection mAP (COCO 101-pt) + metric export."""
+"""Evaluation: online detection mAP (COCO 101-pt), metric export, and
+the continuous quality plane (shadow scoring + canary gating)."""
 
 from triton_client_tpu.eval.detection_map import (
+    Detection3DEvaluator,
     DetectionEvaluator,
     ap_per_class,
     compute_ap,
     match_predictions,
 )
+from triton_client_tpu.eval.quality_plane import (
+    CanaryController,
+    QualityGate,
+    QualityPlane,
+    QualityScorer,
+)
+from triton_client_tpu.eval.shadow import (
+    ShadowMirror,
+    sample_decision,
+    slice_decision,
+)
 
 __all__ = [
+    "CanaryController",
+    "Detection3DEvaluator",
     "DetectionEvaluator",
+    "QualityGate",
+    "QualityPlane",
+    "QualityScorer",
+    "ShadowMirror",
     "ap_per_class",
     "compute_ap",
     "match_predictions",
+    "sample_decision",
+    "slice_decision",
 ]

@@ -1,15 +1,26 @@
 """Prometheus export for the online evaluator.
 
 Parity with the reference's client-side exporter: five Summary metrics
-served from an HTTP endpoint on port 7658
 (communicator/evaluate_inference.py:52-61), observed per evaluated
 frame (:437-444). Import of prometheus_client is gated the same way the
 reference gates its optional deps (communicator/__init__.py:5-8):
 constructing the exporter without the package raises, and
 ``available()`` lets drivers degrade gracefully.
+
+ISSUE 17 folds this exporter into the runtime scrape plane: pass
+``registry=`` (the ``RuntimeCollector``'s registry) and the Summaries
+register **there** — one scrape endpoint, the legacy spellings
+(``model_precision`` / ``model_recall`` / ``model_ap`` / ``model_f1`` /
+``model_ap_class``) served next to the ``tpu_quality_*`` families with
+no dual-registry drift. The original standalone form (own registry, own
+HTTP server on port 7658) still works as a deprecation shim for the
+``evaluate`` CLI's ``--prometheus-port`` flag, but warns: new
+deployments should scrape the telemetry port.
 """
 
 from __future__ import annotations
+
+import warnings
 
 import numpy as np
 
@@ -29,13 +40,37 @@ def available() -> bool:
 
 
 class EvalPrometheusExporter:
-    """Five Summaries (precision/recall/ap/f1/ap_class), one HTTP port."""
+    """Five Summaries (precision/recall/ap/f1/ap_class).
 
-    def __init__(self, port: int = DEFAULT_PORT, start_server: bool = True) -> None:
+    ``registry=None`` (legacy): a private registry, optionally served
+    from its own HTTP port — the reference's standalone exporter, kept
+    as a deprecation shim. ``registry=<CollectorRegistry>``: register
+    the same Summaries into the shared runtime registry instead (the
+    folded, single-endpoint form; ``port``/``start_server`` are then
+    ignored — the telemetry server already serves the registry)."""
+
+    def __init__(
+        self,
+        port: int = DEFAULT_PORT,
+        start_server: bool = True,
+        registry=None,
+    ) -> None:
         if not _HAVE_PROMETHEUS:
             raise ImportError("prometheus_client is not installed")
-        registry = prometheus_client.CollectorRegistry()
+        folded = registry is not None
+        if not folded:
+            registry = prometheus_client.CollectorRegistry()
+            if start_server:
+                warnings.warn(
+                    "the standalone port-7658 eval exporter is "
+                    "deprecated: pass registry=<RuntimeCollector "
+                    "registry> (or scrape the serving telemetry port) "
+                    "instead",
+                    DeprecationWarning,
+                    stacklevel=2,
+                )
         self.registry = registry
+        self.folded = folded
         s = prometheus_client.Summary
         self.p_summary = s("model_precision", "per-class precision", registry=registry)
         self.r_summary = s("model_recall", "per-class recall", registry=registry)
@@ -44,8 +79,13 @@ class EvalPrometheusExporter:
         self.ap_class_summary = s(
             "model_ap_class", "class ids contributing AP", registry=registry
         )
-        if start_server:
+        if not folded and start_server:
             prometheus_client.start_http_server(port, registry=registry)
+
+    @classmethod
+    def into(cls, registry) -> "EvalPrometheusExporter":
+        """The folded spelling: Summaries on the shared registry."""
+        return cls(registry=registry)
 
     def observe(self, p, r, ap, f1, classes) -> None:
         """Observe one ap_per_class result, value-by-value as the
@@ -61,3 +101,12 @@ class EvalPrometheusExporter:
             self.f1_summary.observe(float(v))
         for v in np.atleast_1d(classes):
             self.ap_class_summary.observe(float(v))
+
+    def observe_window(self, window: dict) -> None:
+        """Quality-plane bridge: one finished rolling window observed
+        under the legacy spellings (aggregate precision/recall/AP@0.5/
+        F1 — the window summary has no per-class split to fan out)."""
+        self.p_summary.observe(float(window.get("precision", 0.0)))
+        self.r_summary.observe(float(window.get("recall", 0.0)))
+        self.ap_summary.observe(float(window.get("map50", 0.0)))
+        self.f1_summary.observe(float(window.get("f1", 0.0)))

@@ -1,0 +1,252 @@
+"""Shadow mirroring for the continuous quality plane (ISSUE 17).
+
+Two jobs, deliberately split from the scoring math in
+``quality_plane.py``:
+
+  * **Deterministic sampling** — :func:`sample_decision` and
+    :func:`slice_decision` reduce a PR-11 trace id to a uniform
+    ``[0, 1)`` point with the same keyed ``blake2b`` construction the
+    router's rendezvous affinity uses (``_rendezvous_score``), so a
+    router, a replica, and an offline replayer all agree on which
+    requests are sampled (and which ride the canary slice) with **no
+    coordination** and no shared RNG state. The two decisions hash in
+    different domains (a salt prefix), so the canary slice and the
+    shadow sample are statistically independent.
+
+  * **Shadow dispatch** — :class:`ShadowMirror` re-issues sampled
+    requests against the f32 reference (or a named candidate variant)
+    through any ``BaseChannel``-shaped handle — the ``FrontDoorRouter``
+    in a fleet, the server's own channel stack in a single-process
+    deployment — on a bounded background worker. The primary serving
+    path pays exactly one hash + one ``put_nowait``; a full queue
+    drops the sample (counted) rather than ever back-pressuring the
+    request thread.
+
+The ``quality_corrupt`` fault point (runtime/faults.py) is probed here,
+on the worker: when armed for the served variant it perturbs the
+primary detections deterministically (RNG seeded from the trace id)
+before scoring, so CI can drive the auto-rollback path without a
+genuinely broken quantization.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import queue
+import threading
+import time
+
+import numpy as np
+
+from triton_client_tpu.runtime import faults
+
+log = logging.getLogger(__name__)
+
+_HASH_SPAN = float(2**64)
+
+
+def _unit_hash(key: str) -> float:
+    """Map ``key`` to a uniform point in ``[0, 1)`` — pure, stateless,
+    process-independent (``hashlib``, never Python's salted ``hash``)."""
+    h = hashlib.blake2b(key.encode("utf-8", "replace"), digest_size=8)
+    return int.from_bytes(h.digest(), "big") / _HASH_SPAN
+
+
+def sample_decision(trace_id: str, rate: float) -> bool:
+    """Should this request be shadow-scored? Pure function of the trace
+    id: every process holding the same id reaches the same verdict."""
+    if rate <= 0.0 or not trace_id:
+        return False
+    if rate >= 1.0:
+        return True
+    return _unit_hash(f"shadow|{trace_id}") < rate
+
+
+def slice_decision(trace_id: str, fraction: float) -> bool:
+    """Does this request ride the canary slice? Hashes in a distinct
+    domain from :func:`sample_decision` so the canary's traffic is
+    sampled at the same rate as the primary's."""
+    if fraction <= 0.0 or not trace_id:
+        return False
+    if fraction >= 1.0:
+        return True
+    return _unit_hash(f"canary|{trace_id}") < fraction
+
+
+def corrupt_detections(outputs: dict, trace_id: str) -> dict:
+    """The ``quality_corrupt`` payload: a deterministic, unmistakably
+    out-of-budget perturbation of a detection output mapping (2D packed
+    ``detections`` or 3D ``pred_boxes``), seeded from the trace id so
+    identical drives corrupt identically."""
+    seed = int(_unit_hash(f"corrupt|{trace_id}") * 2**31)
+    rng = np.random.default_rng(seed)
+    out = dict(outputs)
+    if "detections" in out:
+        det = np.array(out["detections"], np.float32, copy=True)
+        if det.ndim == 3 and det.shape[0] == 1:
+            det = det[0]  # serving responses carry a unit batch axis
+        if det.ndim == 2 and det.shape[1] >= 6 and det.shape[0]:
+            # shove every box far off its truth and scramble the class
+            det[:, :4] += rng.uniform(50.0, 200.0, (det.shape[0], 4))
+            det[:, 5] = (det[:, 5] + 1 + rng.integers(0, 3, det.shape[0])) % 7
+        out["detections"] = det
+    if "pred_boxes" in out:
+        boxes = np.array(out["pred_boxes"], np.float32, copy=True)
+        if boxes.ndim == 3 and boxes.shape[0] == 1:
+            boxes = boxes[0]
+        if boxes.ndim == 2 and boxes.shape[0]:
+            boxes[:, :3] += rng.uniform(5.0, 20.0, (boxes.shape[0], 3))
+            if boxes.shape[1] >= 9:
+                boxes[:, 7:9] += rng.uniform(3.0, 9.0, (boxes.shape[0], 2))
+        out["pred_boxes"] = boxes
+    return out
+
+
+class ShadowMirror:
+    """Bounded-queue shadow dispatcher.
+
+    ``channel``: anything quacking ``do_inference`` (FrontDoorRouter,
+    a channel stack). ``score``: callback
+    ``(model, variant, primary_outputs, shadow_outputs, lag_s,
+    trace_id)`` — the quality plane's scorer. ``reference_for``: maps a
+    primary model name to the shadow/reference model name (identity by
+    default: the primary registration IS the f32 reference).
+    """
+
+    def __init__(
+        self,
+        channel=None,
+        score=None,
+        reference_for=None,
+        queue_depth: int = 256,
+    ) -> None:
+        self._channel = channel
+        self._score = score
+        self._reference_for = reference_for or (lambda model: model)
+        self._q: queue.Queue = queue.Queue(maxsize=max(1, int(queue_depth)))
+        self._lock = threading.Lock()
+        self._mirrored = 0
+        self._dropped = 0
+        self._scored = 0
+        self._errors = 0
+        self._corrupted = 0
+        self._last_lag_s = 0.0
+        self._lag_sum = 0.0
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="quality-shadow"
+        )
+        self._started = False
+
+    def attach_channel(self, channel) -> None:
+        """Late-bind the shadow dispatch handle (the server builds the
+        plane before its channel stack exists)."""
+        self._channel = channel
+
+    # -- hot-path seam (rooted in tpulint HOT_PATH_ROOTS) ---------------------
+
+    def enqueue(self, model, variant, inputs, outputs, trace_id) -> bool:
+        """Hand one sampled request to the worker. Never blocks, never
+        raises, never touches the arrays: a full queue drops the sample
+        and counts it."""
+        if self._closed:
+            return False
+        if not self._started:
+            self._start()
+        try:
+            self._q.put_nowait(
+                (model, variant, inputs, outputs, trace_id,
+                 time.perf_counter())
+            )
+        except queue.Full:
+            with self._lock:
+                self._dropped += 1
+            return False
+        with self._lock:
+            self._mirrored += 1
+        return True
+
+    # -- worker ---------------------------------------------------------------
+
+    def _start(self) -> None:
+        with self._lock:
+            if not self._started:
+                self._thread.start()
+                self._started = True
+
+    def _run(self) -> None:
+        from triton_client_tpu.channel.base import InferRequest
+
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            model, variant, inputs, outputs, trace_id, t0 = item
+            try:
+                reference = self._reference_for(model)
+                if self._channel is not None and variant != reference:
+                    resp = self._channel.do_inference(
+                        InferRequest(model_name=reference, inputs=inputs)
+                    )
+                    shadow_outputs = resp.outputs
+                else:
+                    # primary == reference (no canary in flight): the
+                    # served outputs ARE the reference — scoring them
+                    # against themselves keeps the window machinery,
+                    # lag accounting, and export live at zero extra
+                    # device cost
+                    shadow_outputs = outputs
+                if faults.probe_flag("quality_corrupt", variant):
+                    outputs = corrupt_detections(outputs, trace_id)
+                    with self._lock:
+                        self._corrupted += 1
+                lag_s = time.perf_counter() - t0
+                if self._score is not None:
+                    self._score(
+                        model, variant, outputs, shadow_outputs, lag_s,
+                        trace_id,
+                    )
+                with self._lock:
+                    self._scored += 1
+                    self._last_lag_s = lag_s
+                    self._lag_sum += lag_s
+            except Exception:
+                with self._lock:
+                    self._errors += 1
+                log.debug(
+                    "shadow scoring failed for model %s variant %s",
+                    model, variant, exc_info=True,
+                )
+
+    def stats(self) -> dict:
+        with self._lock:
+            scored = self._scored
+            return {
+                "mirrored": self._mirrored,
+                "dropped": self._dropped,
+                "scored": scored,
+                "errors": self._errors,
+                "corrupted": self._corrupted,
+                "queue_depth": self._q.qsize(),
+                "last_lag_s": self._last_lag_s,
+                "mean_lag_s": (self._lag_sum / scored) if scored else 0.0,
+            }
+
+    def drain(self, timeout_s: float = 5.0) -> bool:
+        """Test/ops helper: wait for the queue to empty (the worker may
+        still be scoring its in-hand item for one scheduling quantum)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self._q.empty():
+                return True
+            time.sleep(0.005)
+        return self._q.empty()
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self._started:
+            self._q.put(None)
+            self._thread.join(timeout=5.0)

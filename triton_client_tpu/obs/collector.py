@@ -26,7 +26,10 @@ latency.
 
 from __future__ import annotations
 
+import logging
 import threading
+
+log = logging.getLogger(__name__)
 
 # Every family the collector always exports, name -> prometheus type.
 # Families exist (HELP/TYPE lines) even when their component is absent
@@ -167,6 +170,25 @@ METRIC_TYPES: dict[str, str] = {
     "tpu_serving_model_arithmetic_intensity": "gauge",
     "tpu_serving_model_attainable_fps": "gauge",
     "tpu_serving_history_buffered": "gauge",
+    # continuous quality plane (ISSUE 17): shadow-scored online
+    # accuracy in rolling windows per model x served variant (mAP vs
+    # the f32 reference as pseudo-GT, CenterPoint velocity MAE,
+    # tracking ID-switch delta), the shadow sidecar's throughput/lag/
+    # drop accounting, and the canary lifecycle (hash-sliced traffic
+    # fraction, state info gauge, promote/rollback counters) — the
+    # accuracy column published next to every capacity family, own
+    # tpu_quality namespace so dashboards can select the plane whole
+    "tpu_quality_map50": "gauge",
+    "tpu_quality_map": "gauge",
+    "tpu_quality_velocity_mae": "gauge",
+    "tpu_quality_id_switch_rate": "gauge",
+    "tpu_quality_scored_frames_total": "counter",
+    "tpu_quality_shadow_lag_seconds": "gauge",
+    "tpu_quality_shadow_dropped_total": "counter",
+    "tpu_quality_canary_fraction": "gauge",
+    "tpu_quality_canary_info": "gauge",
+    "tpu_quality_promotions_total": "counter",
+    "tpu_quality_rollbacks_total": "counter",
 }
 
 _HBM_KINDS = ("bytes_in_use", "bytes_limit", "peak_bytes_in_use")
@@ -296,6 +318,7 @@ class RuntimeCollector:
         self._op_samples = 0
         self._sampler = None
         self._history = None
+        self._quality = None
         self._draining = False
         self._registry = None
         if registry is not None:
@@ -364,6 +387,32 @@ class RuntimeCollector:
         """Wire the MetricHistory whose ring depth this collector
         exports."""
         self._history = history
+
+    def attach_quality(self, quality, legacy_eval: bool = True) -> None:
+        """Wire the continuous quality plane (eval/quality_plane.py)
+        whose rolling windows export as the ``tpu_quality_*`` families
+        and land under ``/snapshot["quality"]``.
+
+        ``legacy_eval``: also fold the reference's eval Summaries
+        (``model_precision``/``model_recall``/``model_ap``/...) into
+        THIS collector's registry — the ISSUE 17 satellite retiring the
+        standalone port-7658 exporter: one scrape endpoint serves both
+        spellings from the same windows."""
+        self._quality = quality
+        if legacy_eval and self._registry is not None:
+            try:
+                from triton_client_tpu.eval import prometheus_export
+
+                if prometheus_export.available():
+                    quality.attach_legacy_exporter(
+                        prometheus_export.EvalPrometheusExporter(
+                            registry=self._registry
+                        )
+                    )
+            except Exception:  # pragma: no cover - registry collisions
+                log.debug(
+                    "legacy eval summaries not folded", exc_info=True
+                )
 
     def hlo_modules(self) -> dict[str, str]:
         """``{hlo_module: model_name}`` over every registered model —
@@ -452,6 +501,8 @@ class RuntimeCollector:
             snap["sampler"] = self._sampler.stats()
         if self._history is not None:
             snap["history"] = self._history.stats()
+        if self._quality is not None:
+            snap["quality"] = self._quality.snapshot()
         if self._histograms is not None:
             # numeric-leaved per-(model|stage) bucket counts + sum:
             # delta() of two snapshots is the WINDOW's histogram, and
@@ -1175,6 +1226,106 @@ class RuntimeCollector:
             f"{ns}_history_buffered",
             "metric-history snapshots buffered in the ring",
             hist_stats.get("buffered", 0),
+        )
+
+        # continuous quality plane (ISSUE 17): per model x served
+        # variant rolling-window accuracy vs the f32 shadow reference,
+        # the shadow sidecar's lag/drop accounting, and the canary
+        # lifecycle. Own tpu_quality namespace (not ns-prefixed): the
+        # accuracy column next to every capacity family.
+        q = snap.get("quality") or {}
+        q_pairs = q.get("pairs") or {}
+
+        def pair_window_samples(field):
+            out = []
+            for key in sorted(q_pairs):
+                last = q_pairs[key].get("last")
+                if last is not None and field in last:
+                    out.append((key.split("|", 1), last[field]))
+            return out
+
+        for field, doc in (
+            ("map50", "rolling-window online mAP@0.5 of the served "
+                      "variant scored against the shadow f32 reference "
+                      "as pseudo-GT (0.995 = parity ceiling)"),
+            ("map", "rolling-window online mAP@[.5:.95] vs the shadow "
+                    "reference"),
+            ("velocity_mae", "mean |velocity| error of matched "
+                             "detections vs the shadow reference "
+                             "(CenterPoint velocity head; 0 on 2D)"),
+            ("id_switch_rate", "excess track births per frame of the "
+                               "primary tracking stream vs the shadow "
+                               "reference stream (ops/tracking "
+                               "reference stepping)"),
+        ):
+            yield gauge(
+                f"tpu_quality_{field}", doc, 0,
+                labels=["model", "variant"],
+                samples=pair_window_samples(field),
+            )
+        yield counter(
+            "tpu_quality_scored_frames_total",
+            "sampled frames scored against the shadow reference",
+            0,
+            labels=["model", "variant"],
+            samples=[
+                (key.split("|", 1), q_pairs[key].get("scored_frames", 0))
+                for key in sorted(q_pairs)
+            ],
+        )
+        yield gauge(
+            "tpu_quality_shadow_lag_seconds",
+            "lag between a sampled request being served and its shadow "
+            "score landing (last scored frame)",
+            0,
+            labels=["model", "variant"],
+            samples=[
+                (key.split("|", 1), q_pairs[key].get("last_lag_s", 0.0))
+                for key in sorted(q_pairs)
+            ],
+        )
+        mirror = q.get("mirror") or {}
+        yield counter(
+            "tpu_quality_shadow_dropped_total",
+            "sampled frames dropped because the shadow queue was full "
+            "(the sidecar sheds itself, never the serving path)",
+            mirror.get("dropped", 0),
+        )
+        canary = q.get("canary") or {}
+        canary_models = canary.get("models") or {}
+        yield gauge(
+            "tpu_quality_canary_fraction",
+            "fraction of the primary's traffic hash-sliced to the "
+            "canary variant (1.0 = promoted, 0.0 = rolled back)",
+            0,
+            labels=["model", "variant"],
+            samples=[
+                ([m, c["variant"]], c["fraction"])
+                for m, c in sorted(canary_models.items())
+            ],
+        )
+        yield gauge(
+            "tpu_quality_canary_info",
+            "canary lifecycle state per model (info gauge: "
+            "canary/promoted/rolled_back)",
+            0,
+            labels=["model", "variant", "state"],
+            samples=[
+                ([m, c["variant"], c["state"]], 1)
+                for m, c in sorted(canary_models.items())
+            ],
+        )
+        yield counter(
+            "tpu_quality_promotions_total",
+            "canary variants promoted to full traffic after N clean "
+            "quality windows",
+            canary.get("promotions", 0),
+        )
+        yield counter(
+            "tpu_quality_rollbacks_total",
+            "canary variants auto-rolled-back on a quality-budget "
+            "violation (f32 re-pinned; exemplar trace ids in the log)",
+            canary.get("rollbacks", 0),
         )
 
         # host-transport plane: negotiated transport per request, the

@@ -11,11 +11,20 @@ the interval,
    "utilization": window busy ratio,
    "models": {"model|tenant": {"launches_per_s": ...,
                                "device_s_per_s": ...,
-                               "mfu": ...}}}
+                               "mfu": ...}},
+   "quality": {"model|variant": {"map50": ..., "map": ...,
+                                 "velocity_mae": ...,
+                                 "id_switch_rate": ...}}}
 
 exported live at ``GET /history`` (?n=K most recent) and persisted to
 JSON on drain, so a restart — or the autoscaler's offline trainer —
 reads the same shape the live endpoint serves.
+
+The ``quality`` key (ISSUE 17) appears when a quality plane is attached
+(:meth:`attach_quality`): the last finished shadow-scoring window per
+model×variant, so accuracy trends ride the same ring — and the same
+persist/restore path — as the rate/MFU rows they must be judged
+against.
 
 The ring is bounded (``capacity`` intervals, default 360 × 10 s = 1 h)
 and ``tick()`` is plain dict arithmetic off two ledger snapshots: no
@@ -49,6 +58,7 @@ class MetricHistory:
         capacity: int = 360,
     ) -> None:
         self._ledger = ledger
+        self._quality = None
         self.interval_s = max(0.5, float(interval_s))
         self.capacity = max(2, int(capacity))
         self._lock = threading.Lock()
@@ -58,6 +68,11 @@ class MetricHistory:
         self._ticks = 0
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+
+    def attach_quality(self, quality) -> None:
+        """Wire a quality plane whose ``history_row()`` (last finished
+        window per model×variant) lands on every tick."""
+        self._quality = quality
 
     # -- recording ------------------------------------------------------------
 
@@ -78,6 +93,12 @@ class MetricHistory:
             self._last, self._last_t = snap, t
             dt = max(t - prev_t, 1e-9) if prev is not None else None
             entry = self._entry(snap, prev, dt)
+            if self._quality is not None:
+                try:
+                    entry["quality"] = self._quality.history_row()
+                except Exception:
+                    log.debug("history tick: quality row failed",
+                              exc_info=True)
             self._ring.append(entry)
             self._ticks += 1
         return entry

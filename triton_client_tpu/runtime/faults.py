@@ -18,6 +18,7 @@ probe named injection points:
   batcher_stall   BatchingChannel dispatcher, slot time      sleep
   replica_down    _Servicer ServerReady/ModelReady/_issue    flag
   shm_detach      _Servicer before shm request parse         flag
+  quality_corrupt eval ShadowMirror worker, before scoring   flag
   ==============  ========================================== =========
 
 The ``replica_down`` point is flag-class (:func:`probe_flag`): the
@@ -31,6 +32,16 @@ drops its whole shared-memory registry before parsing the faulted
 request, simulating a server restart under a client that still holds
 mapped segments — the client must re-register its pool and re-issue
 (unary) or fall back per-member (stream), never serve stale bytes.
+
+``quality_corrupt`` (ISSUE 17) is flag-class, keyed by the *variant*
+model name: the shadow mirror's scoring worker consults it and, when
+armed, perturbs the variant's served detections deterministically
+(``eval.shadow.corrupt_detections``, RNG seeded from the trace id)
+before they are scored against the f32 reference — an unmistakably
+out-of-budget quality regression with zero real model damage, so the
+canary auto-rollback path is drivable in CI and the acceptance drive
+("corrupting variant ejected before it serves 1% of traffic") replays
+identically under a fixed plan.
 
 Determinism: rules fire by COUNT windows (requests ``after`` .. ``after
 + count`` at that point/model), and probabilistic rules draw from a

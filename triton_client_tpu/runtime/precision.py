@@ -71,8 +71,13 @@ _QMAX = 127.0
 
 # Declared accuracy budgets: max allowed synthetic-set mAP drop vs the
 # f32 reference (tests/test_precision.py asserts 1 - budget as the
-# floor; docs/OPERATIONS.md publishes the table).
+# floor; docs/OPERATIONS.md publishes the table). MAP_BUDGETS is the
+# public spelling: the continuous quality plane's QualityGate (ISSUE
+# 17, eval/quality_plane.py) gates live canary windows against these
+# SAME numbers, so the offline parity suite and the runtime rollback
+# trigger can never disagree about what "within budget" means.
 _MAP_BUDGETS = {"f32": 0.0, "bf16": 0.05, "int8w": 0.10, "int8": 0.15}
+MAP_BUDGETS = _MAP_BUDGETS
 
 
 @jax.tree_util.register_pytree_node_class

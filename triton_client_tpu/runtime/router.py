@@ -548,6 +548,19 @@ class FrontDoorRouter:
         self._affinity_routed = 0
         self._affinity_handoffs = 0
         self._errors = 0
+        self._quality = None
+
+    def attach_quality(self, quality) -> None:
+        """Wire an eval.quality_plane.QualityPlane at the front door:
+        canary slices are carved here (before replica pick, so every
+        replica serves the rewritten model uniformly) and sampled
+        responses feed the shadow mirror. The plane's mirror dispatches
+        through THIS router unless it already holds a channel — shadow
+        traffic then rides the same hedging/ejection machinery as live
+        traffic, hitting whichever replica is healthy."""
+        self._quality = quality
+        if getattr(quality.mirror, "_channel", None) is None:
+            quality.attach_channel(self)
 
     # -- BaseChannel quack ----------------------------------------------------
 
@@ -653,8 +666,23 @@ class FrontDoorRouter:
                 request_id=request.request_id,
                 context=ctx,
             )
+        requested = request.model_name
+        tid = None
+        if self._quality is not None:
+            # canary slice keyed on the front door's trace id — the
+            # exact key any replica adopting this traceparent hashes,
+            # so both tiers make the same decision for the same request
+            tid = (
+                ctx.trace_id if ctx is not None
+                else (request.request_id or "")
+            )
+            served = self._quality.route(requested, tid)
+            if served != requested:
+                request = dataclasses.replace(request, model_name=served)
         if trace is None:
-            return self._route(request, None, None)
+            resp = self._route(request, None, None)
+            self._observe_quality(requested, request, tid, resp)
+            return resp
         try:
             resp = self._route(request, trace, ctx)
         except BaseException as e:
@@ -663,7 +691,21 @@ class FrontDoorRouter:
             )
             raise
         self._tracer.finish(trace, status="ok")
+        self._observe_quality(requested, request, tid, resp)
         return resp
+
+    def _observe_quality(self, requested, request, tid, resp) -> None:
+        """Post-response sampling hook (no-op without a plane): one
+        keyed hash; sampled requests copy into the mirror queue."""
+        if self._quality is None:
+            return
+        try:
+            self._quality.observe(
+                requested, request.model_name, tid or "",
+                request.inputs, resp.outputs,
+            )
+        except Exception:
+            log.debug("quality observe failed", exc_info=True)
 
     @staticmethod
     def _attempt_span(trace, att: _Attempt, **extra) -> None:
@@ -959,6 +1001,8 @@ class FrontDoorRouter:
         snap = self.stats()
         snap["replicas"] = self.replica_set.snapshot()
         snap["latency"] = self._latency.snapshot()
+        if self._quality is not None:
+            snap["quality"] = self._quality.snapshot()
         return snap
 
     def close(self) -> None:

@@ -21,6 +21,7 @@ Differences from the reference's serving story, by design:
 from __future__ import annotations
 
 import concurrent.futures
+import itertools
 import json
 import logging
 import os
@@ -121,6 +122,7 @@ class _Servicer(service.GRPCInferenceServiceServicer):
         draining: threading.Event | None = None,
         lifecycle=None,
         replica_of: str | None = None,
+        quality=None,
     ) -> None:
         self._repo = repository
         self._channel = channel
@@ -138,6 +140,13 @@ class _Servicer(service.GRPCInferenceServiceServicer):
         self._slo = slo
         self._admission = admission
         self._draining = draining
+        # continuous quality plane (ISSUE 17): canary routing before
+        # dispatch, trace-hash shadow sampling after the readback —
+        # both one attribute read on the un-wired hot path. The counter
+        # backs an anonymous per-request key for id-less untraced
+        # requests (sampling stays live, just not replay-deterministic)
+        self._quality = quality
+        self._quality_seq = itertools.count()
         # in-flight request count independent of the (optional)
         # collector — drain() polls it to know when the building is empty
         self._active = 0
@@ -355,6 +364,18 @@ class _Servicer(service.GRPCInferenceServiceServicer):
                 model=request.model_name, request_id=request_id,
                 context=context,
             )
+        # quality plane: the sampling/canary key is the trace id when
+        # tracing is on (stable fleet-wide: the router's traceparent is
+        # adopted above, so router and replica decide identically) and
+        # the request id otherwise; routing may rewrite which registered
+        # model actually serves this request (canary slice)
+        tctx = getattr(trace, "context", None)
+        tid = tctx.trace_id if tctx is not None else (request_id or "")
+        served_name = request.model_name
+        if self._quality is not None:
+            if not tid:
+                tid = f"anon-{next(self._quality_seq)}"
+            served_name = self._quality.route(request.model_name, tid)
         deadline_s, priority = None, 0
         if self._slo is not None:
             deadline_s = self._slo.deadline_for(request.model_name, t0)
@@ -418,7 +439,7 @@ class _Servicer(service.GRPCInferenceServiceServicer):
                 # own acquire across the device window.
                 try:
                     lifecycle_key = self._lifecycle.acquire(
-                        request.model_name,
+                        served_name,
                         request.model_version,
                         deadline_s=deadline_s,
                     )
@@ -469,7 +490,7 @@ class _Servicer(service.GRPCInferenceServiceServicer):
                 trace.begin("channel")
             future = self._channel.do_inference_async(
                 InferRequest(
-                    model_name=request.model_name,
+                    model_name=served_name,
                     model_version=request.model_version,
                     inputs=inputs,
                     request_id=request_id,
@@ -510,6 +531,17 @@ class _Servicer(service.GRPCInferenceServiceServicer):
                 finally:
                     if trace is not None:
                         trace.end("channel")
+                if self._quality is not None:
+                    # post-readback: outputs are host numpy here, so the
+                    # sampled copy handed to the mirror queue costs no
+                    # device sync on the serving path
+                    try:
+                        self._quality.observe(
+                            request.model_name, served_name, tid,
+                            inputs, result.outputs,
+                        )
+                    except Exception:
+                        log.debug("quality observe failed", exc_info=True)
                 if trace is not None:
                     t_e0 = time.perf_counter()
                     resp = codec.build_infer_response(
@@ -869,6 +901,7 @@ class InferenceServer:
         history_interval_s: float = 10.0,
         history_capacity: int = 360,
         history_path: str | None = None,
+        quality=None,
     ) -> None:
         """``metrics_port``: serve the telemetry endpoint — Prometheus
         exposition on ``/metrics`` (Triton's :8002 role), Chrome-trace
@@ -925,7 +958,14 @@ class InferenceServer:
         ``history_interval_s``/``history_capacity``: the metric-history
         ring (obs/history.py) of per-model×tenant rate/util/MFU
         snapshots served at ``/history``; ``history_path`` persists the
-        ring there on drain (and restores from it on startup)."""
+        ring there on drain (and restores from it on startup).
+        ``quality``: an eval.quality_plane.QualityPlane — the servicer
+        then consults its canary router before dispatch and hands every
+        response to its trace-hash sampler; shadow mirroring runs
+        against this server's own channel stack unless the plane was
+        built with an explicit (router) channel. Exports as the
+        ``tpu_quality_*`` families, ``/snapshot["quality"]``, and the
+        history ring's ``quality`` rows when telemetry is on."""
         self.lifecycle = lifecycle
         self.tenants = tenants
         self.replica_of = replica_of
@@ -952,6 +992,14 @@ class InferenceServer:
         self.sampler = None
         self.history = None
         self._history_path = history_path
+        self.quality = quality
+        if quality is not None and getattr(
+            quality.mirror, "_channel", None
+        ) is None:
+            # shadow dispatch defaults to this server's own stack: the
+            # mirror re-issues sampled inputs at the back of the same
+            # batcher/channel queue every live request rides
+            quality.attach_channel(channel)
         self.metrics_enabled = False
         self._telemetry = None
         if metrics_port:
@@ -1051,6 +1099,10 @@ class InferenceServer:
             )
             if self.history is not None:
                 self.collector.attach_history(self.history)
+            if quality is not None:
+                self.collector.attach_quality(quality)
+                if self.history is not None:
+                    self.history.attach_quality(quality)
             try:
                 from triton_client_tpu.obs.http import TelemetryServer
 
@@ -1111,6 +1163,7 @@ class InferenceServer:
             draining=self._draining,
             lifecycle=lifecycle,
             replica_of=replica_of,
+            quality=quality,
         )
         service.add_servicer_to_server(self._servicer, self._server)
         self._port = self._server.add_insecure_port(address)
@@ -1207,6 +1260,12 @@ class InferenceServer:
                 drained = True
                 break
             time.sleep(poll_s)
+        # let the shadow mirror finish scoring what it already holds —
+        # the final history tick below should carry the last window
+        if self.quality is not None:
+            self.quality.drain(
+                max(0.0, deadline - time.monotonic()) or 1.0
+            )
         # final history tick + persist: the restart this ring is most
         # needed across is the one about to happen
         if self.history is not None:
@@ -1229,6 +1288,8 @@ class InferenceServer:
 
     def stop(self, grace: float = 1.0) -> None:
         self._server.stop(grace).wait()
+        if self.quality is not None:
+            self.quality.close()
         if self.sampler is not None:
             self.sampler.close()
             self.sampler = None

@@ -401,6 +401,7 @@ def run_open_loop(
     deadline_s: float = 60.0,
     warm: bool = True,
     resolvers: int = 16,
+    request_factory=None,
 ) -> OpenLoopResult:
     """Drive one open-loop window against a KServe v2 endpoint — or a
     replica fleet.
@@ -421,7 +422,15 @@ def run_open_loop(
     At heavy overload the pool itself queues, which can only OVERSTATE
     tail latency — the conservative direction for a capacity search.
     Completions after the window still count (with their true
-    latency); ``wall_s`` is the scheduled window."""
+    latency); ``wall_s`` is the scheduled window.
+
+    ``request_factory``: optional per-arrival hook
+    ``(base_request, arrival_index) -> InferRequest`` replacing the
+    default reuse of one InferRequest per scenario. Quality-plane
+    drives use it to stamp a deterministic per-arrival identity
+    (request_id / traceparent) so hash-sampled canary slices are
+    reproducible across runs; any exception falls back to the shared
+    base request."""
     import queue as _q
 
     from triton_client_tpu.channel.base import InferRequest
@@ -475,14 +484,20 @@ def run_open_loop(
         for w in workers:
             w.start()
         t_base = time.perf_counter()
-        for off, pick in zip(offsets, picks):
+        for i, (off, pick) in enumerate(zip(offsets, picks)):
             target = t_base + float(off)
             delay = target - time.perf_counter()
             if delay > 0:
                 time.sleep(delay)
             # behind schedule: issue immediately, latency still counts
             # from `target` — the CO-safe accounting
-            pending.put((target, chan.do_inference_async(requests[pick])))
+            req = requests[pick]
+            if request_factory is not None:
+                try:
+                    req = request_factory(req, i)
+                except Exception:
+                    req = requests[pick]
+            pending.put((target, chan.do_inference_async(req)))
         for _ in workers:
             pending.put(None)
         for w in workers:
