@@ -4,9 +4,11 @@
 # Order matters: tpulint and ruff are seconds, pytest is minutes — a
 # new serving hazard (use-after-donation, hot-path host sync, unguarded
 # shared state...) fails the build before any test runs. ruff/mypy are
-# OPTIONAL stages: the TPU pod image ships without them, so they run
-# only where installed (dev boxes, CI containers) and are skipped —
-# loudly — elsewhere. tpulint is stdlib-only and always runs.
+# REQUIRED stages pinned by the `lint` extra — install with
+# `pip install -e '.[lint]'`. A gate that silently skips its linters
+# drifts until someone installs them and inherits the backlog, so a
+# missing linter now FAILS the build instead of skipping. tpulint is
+# stdlib-only and needs no install.
 #
 # Usage: ./ci.sh [--fast]     (--fast skips the tier-1 pytest stage)
 set -euo pipefail
@@ -23,20 +25,24 @@ python -m triton_client_tpu lint triton_client_tpu/ \
     --jobs "$(nproc 2>/dev/null || echo 4)" \
     --sarif tpulint.sarif
 
-echo "== ruff (conventional lint, optional stage) =="
+echo "== ruff (conventional lint, required stage) =="
 if command -v ruff >/dev/null 2>&1; then
     ruff check triton_client_tpu/
 elif python -c "import ruff" >/dev/null 2>&1; then
     python -m ruff check triton_client_tpu/
 else
-    echo "ruff not installed; skipping (config: pyproject [tool.ruff])"
+    echo "FAIL: ruff is not installed (pinned by the 'lint' extra)." >&2
+    echo "  pip install -e '.[lint]'   # config: pyproject [tool.ruff]" >&2
+    exit 1
 fi
 
-echo "== mypy (loose types on analysis/obs/channel, optional stage) =="
+echo "== mypy (loose types on analysis/obs/channel, required stage) =="
 if command -v mypy >/dev/null 2>&1; then
     mypy
 else
-    echo "mypy not installed; skipping (config: pyproject [tool.mypy])"
+    echo "FAIL: mypy is not installed (pinned by the 'lint' extra)." >&2
+    echo "  pip install -e '.[lint]'   # config: pyproject [tool.mypy]" >&2
+    exit 1
 fi
 
 if [[ "${1:-}" == "--fast" ]]; then

@@ -1482,3 +1482,58 @@ class TestLintCliFlags:
         assert [f.fingerprint() for f in analysis.run_rules(par)] == [
             f.fingerprint() for f in analysis.run_rules(serial)
         ]
+
+
+# -- lint --stats + the whole-package time budget (round 18) ------------------
+
+
+class TestLintStats:
+    """``lint --stats`` per-rule cost table, and the whole-package lint
+    time budget the table exists to police: the ci.sh gate runs every
+    family over the full tree on every push, so per-rule cost must stay
+    visible and bounded as families grow."""
+
+    def _run(self, args):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        return subprocess.run(
+            [sys.executable, "-m", "triton_client_tpu", "lint", *args],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=300,
+        )
+
+    def test_stats_table_lists_every_family(self, tmp_path):
+        clean = tmp_path / "clean.py"
+        clean.write_text("X = 1\n")
+        r = self._run([str(clean), "--stats"])
+        assert r.returncode == 0, r.stdout + r.stderr
+        for code in ("TPL101", "TPL401", "TPL601", "TPL701", "TPL801",
+                     "TPL805"):
+            assert code in r.stderr, r.stderr
+        assert "elapsed_ms" in r.stderr
+        assert any(
+            ln.startswith("total") for ln in r.stderr.splitlines()
+        ), r.stderr
+
+    def test_stats_rides_json_summary(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text(LOCK_POSITIVE)
+        r = self._run([str(bad), "--stats", "--json"])
+        assert r.returncode == 1
+        doc = json.loads(r.stdout)
+        stats = doc["summary"]["stats"]
+        assert stats["TPL401"]["findings"] == 1
+        assert {"TPL801", "TPL802", "TPL803", "TPL804", "TPL805"} <= set(stats)
+        assert all(row["elapsed_ms"] >= 0 for row in stats.values())
+
+    def test_whole_package_lint_fits_time_budget(self):
+        """Hard ceiling on full-tree rule evaluation (load excluded —
+        parse cost is the gate's --jobs concern). Measured ~12 s for
+        eight families on this tree; 60 s is the do-not-cross line
+        before the gate stops being a pre-push tool."""
+        stats: dict = {}
+        package = analysis.load_package([PKG], root=REPO, jobs=4)
+        analysis.run_rules(package, stats=stats)
+        assert {"TPL801", "TPL802", "TPL803", "TPL804", "TPL805"} <= set(
+            stats
+        )
+        total_ms = sum(r["elapsed_ms"] for r in stats.values())
+        assert total_ms < 60_000, f"lint blew its budget: {total_ms:.0f} ms"

@@ -1,0 +1,704 @@
+"""tpulint TPL8xx (Pallas kernel analysis): fixture-proven behavior.
+
+Same contract as test_tpulint.py — per rule: a true-positive fixture, a
+true-negative fixture, and a pragma-suppressed case — plus what this
+family uniquely needs: extraction units for analysis/pallas_model.py
+run against the REAL kernel modules (the branch-paired voxel variants,
+decode's ``[spec] * 3`` replication, NMS's tuple out_shape), one-line
+near-miss mutations of the real kernels proving each rule re-fires on
+the exact bug class it was built for, and the TPL805 acceptance
+criterion on a copy of the real tree: deleting a parity test or the
+``interpret=`` plumbing for a fused stage must make TPL805 fail.
+
+Pure-stdlib AST work: CPU-only, tier-1 safe, no jax import required
+(the fixtures only *mention* jax/pallas textually). The companion
+runtime check — manual vs grid pipeline bitwise parity for the voxel
+kernel — lives in tests/test_fused_parity.py where jax is in scope.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+
+import pytest
+
+from triton_client_tpu import analysis
+from triton_client_tpu.analysis import lint_source
+from triton_client_tpu.analysis import pallas_model as pm
+from triton_client_tpu.analysis.rules.pallas import VMEM_LIMIT_BYTES
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "triton_client_tpu")
+
+VOXEL = os.path.join(PKG, "ops", "pallas_voxel.py")
+DECODE = os.path.join(PKG, "ops", "pallas_decode.py")
+NMS = os.path.join(PKG, "ops", "pallas_nms.py")
+RAGGED = os.path.join(PKG, "parallel", "ragged_kernels.py")
+KERNEL_MODULES = (VOXEL, DECODE, NMS, RAGGED)
+
+
+def codes(findings):
+    return sorted({f.code for f in findings})
+
+
+def _module(path):
+    package = analysis.load_package([path], root=REPO)
+    assert not package.errors, package.errors
+    (mod,) = package.modules
+    return mod
+
+
+PRELUDE = (
+    "import jax\n"
+    "import jax.numpy as jnp\n"
+    "from jax.experimental import pallas as pl\n"
+    "from jax.experimental.pallas import tpu as pltpu\n"
+    "def k(x_ref, o_ref):\n"
+    "    o_ref[...] = x_ref[...]\n"
+)
+
+
+# -- TPL801 tile alignment ---------------------------------------------------
+
+
+TILE_POSITIVE = PRELUDE + (
+    "def run(x):\n"
+    "    return pl.pallas_call(\n"
+    "        k,\n"
+    "        grid=(4,),\n"
+    "        in_specs=[pl.BlockSpec((1024, 1), lambda i: (i, 0))],\n"
+    "        out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),\n"
+    "        out_shape=jax.ShapeDtypeStruct((32, 128), jnp.float32),\n"
+    "    )(x)\n"
+)
+
+TILE_SUBLANE_POSITIVE = PRELUDE + (
+    "def run(x):\n"
+    "    return pl.pallas_call(\n"
+    "        k,\n"
+    "        grid=(4,),\n"
+    "        in_specs=[pl.BlockSpec((12, 256), lambda i: (i, 0))],\n"
+    "        out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),\n"
+    "        out_shape=jax.ShapeDtypeStruct((32, 128), jnp.float32),\n"
+    "    )(x)\n"
+)
+
+TILE_SCRATCH_POSITIVE = PRELUDE + (
+    "def run(x):\n"
+    "    return pl.pallas_call(\n"
+    "        k,\n"
+    "        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),\n"
+    "        scratch_shapes=[pltpu.VMEM((2, 1024, 1), jnp.float32)],\n"
+    "    )(x)\n"
+)
+
+TILE_RUN_SCOPED_POSITIVE = PRELUDE + (
+    "def kern(x_ref, o_ref):\n"
+    "    pl.run_scoped(lambda buf: None, buf=pltpu.VMEM((4, 132), jnp.float32))\n"
+    "def run(x):\n"
+    "    return pl.pallas_call(\n"
+    "        kern,\n"
+    "        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),\n"
+    "    )(x)\n"
+)
+
+TILE_NEGATIVE = PRELUDE + (
+    "def run(x):\n"
+    "    return pl.pallas_call(\n"
+    "        k,\n"
+    "        grid=(4,),\n"
+    "        in_specs=[pl.BlockSpec((8, 256), lambda i: (i, 0))],\n"
+    "        out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),\n"
+    "        out_shape=jax.ShapeDtypeStruct((32, 128), jnp.float32),\n"
+    "        scratch_shapes=[pltpu.VMEM((2, 16, 128), jnp.bfloat16)],\n"
+    "    )(x)\n"
+)
+
+TILE_PRAGMA = TILE_POSITIVE.replace(
+    "lambda i: (i, 0))],",
+    "lambda i: (i, 0))],  # tpulint: disable=TPL801",
+)
+
+
+class TestTileAlign:
+    def test_column_block_fires(self):
+        found = lint_source(TILE_POSITIVE, path="snip.py", codes=["TPL801"])
+        assert codes(found) == ["TPL801"]
+        assert "trailing dim 1 " in found[0].message
+
+    def test_ragged_sublane_fires(self):
+        found = lint_source(
+            TILE_SUBLANE_POSITIVE, path="snip.py", codes=["TPL801"]
+        )
+        assert codes(found) == ["TPL801"]
+        assert "sublane dim 12" in found[0].message
+
+    def test_scratch_shapes_fires(self):
+        found = lint_source(
+            TILE_SCRATCH_POSITIVE, path="snip.py", codes=["TPL801"]
+        )
+        assert codes(found) == ["TPL801"]
+        assert "scratch" in found[0].message
+
+    def test_run_scoped_scratch_fires(self):
+        found = lint_source(
+            TILE_RUN_SCOPED_POSITIVE, path="snip.py", codes=["TPL801"]
+        )
+        assert codes(found) == ["TPL801"]
+        assert "132" in found[0].message
+
+    def test_aligned_blocks_clean(self):
+        assert lint_source(TILE_NEGATIVE, path="snip.py", codes=["TPL801"]) == []
+
+    def test_pragma_suppresses(self):
+        assert lint_source(TILE_PRAGMA, path="snip.py", codes=["TPL801"]) == []
+
+
+# -- TPL802 VMEM budget ------------------------------------------------------
+
+
+VMEM_POSITIVE = PRELUDE + (
+    "def run(x):\n"
+    "    return pl.pallas_call(\n"
+    "        k,\n"
+    "        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),\n"
+    "        scratch_shapes=[pltpu.VMEM((4096, 2048), jnp.float32)],\n"
+    "    )(x)\n"
+)
+
+VMEM_DOUBLED_POSITIVE = PRELUDE + (
+    "def run(x):\n"
+    "    return pl.pallas_call(\n"
+    "        k,\n"
+    "        grid=(16,),\n"
+    "        in_specs=[pl.BlockSpec((8192, 128), lambda i: (i, 0))],\n"
+    "        out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),\n"
+    "        out_shape=jax.ShapeDtypeStruct((32, 128), jnp.float32),\n"
+    "    )(x)\n"
+)
+
+VMEM_NEGATIVE = VMEM_POSITIVE.replace("(4096, 2048)", "(8, 128)")
+
+VMEM_PRAGMA = VMEM_POSITIVE.replace(
+    "jnp.float32)],",
+    "jnp.float32)],  # tpulint: vmem=50000000",
+)
+
+
+class TestVmemBudget:
+    def test_oversized_scratch_fires(self):
+        found = lint_source(VMEM_POSITIVE, path="snip.py", codes=["TPL802"])
+        assert codes(found) == ["TPL802"]
+        assert str(VMEM_LIMIT_BYTES) in found[0].message
+
+    def test_grid_double_buffering_counts_twice(self):
+        # (8192, 128) f32 block = 4 MiB; x2 prefetch = 8 MiB... still
+        # under 16, so widen: assert the x2 shows in the arithmetic by
+        # checking a 10 MiB block (x2 = 20 MiB) fires while the same
+        # block gridless (10 MiB resident) does not.
+        big = VMEM_DOUBLED_POSITIVE.replace("(8192, 128)", "(10240, 256)")
+        found = lint_source(big, path="snip.py", codes=["TPL802"])
+        assert codes(found) == ["TPL802"]
+        gridless = big.replace("grid=(16,),\n        ", "")
+        assert lint_source(gridless, path="snip.py", codes=["TPL802"]) == []
+
+    def test_small_working_set_clean(self):
+        assert lint_source(VMEM_NEGATIVE, path="snip.py", codes=["TPL802"]) == []
+
+    def test_vmem_pragma_raises_limit(self):
+        assert lint_source(VMEM_PRAGMA, path="snip.py", codes=["TPL802"]) == []
+
+
+# -- TPL803 grid divisibility ------------------------------------------------
+
+
+GRID_POSITIVE = PRELUDE + (
+    "def run(x, n):\n"
+    "    return pl.pallas_call(\n"
+    "        k,\n"
+    "        grid=(n // 128,),\n"
+    "        in_specs=[pl.BlockSpec((8, 128), lambda i: (0, i))],\n"
+    "        out_specs=pl.BlockSpec((8, 128), lambda i: (0, i)),\n"
+    "        out_shape=jax.ShapeDtypeStruct((8, 4096), jnp.float32),\n"
+    "    )(x)\n"
+    "def caller(x):\n"
+    "    return run(x, 4096)\n"
+)
+
+GRID_GUARDED = GRID_POSITIVE.replace(
+    "def run(x, n):\n",
+    "def run(x, n):\n"
+    "    if n % 128:\n"
+    "        raise ValueError(n)\n",
+)
+
+GRID_ROUNDED = GRID_POSITIVE.replace(
+    "def run(x, n):\n",
+    "def run(x, n):\n"
+    "    n = kernel_block_rows(n, 128)\n",
+)
+
+GRID_PRAGMA = GRID_POSITIVE.replace(
+    "    return pl.pallas_call(\n",
+    "    return pl.pallas_call(  # tpulint: disable=TPL803\n",
+)
+
+
+class TestGridDivisibility:
+    def test_unguarded_grid_fires_and_names_callers(self):
+        found = lint_source(GRID_POSITIVE, path="snip.py", codes=["TPL803"])
+        assert codes(found) == ["TPL803"]
+        assert "no divisibility guard" in found[0].message
+        assert "caller" in found[0].message
+
+    def test_modulo_raise_guard_clean(self):
+        assert lint_source(GRID_GUARDED, path="snip.py", codes=["TPL803"]) == []
+
+    def test_round_up_helper_clean(self):
+        assert lint_source(GRID_ROUNDED, path="snip.py", codes=["TPL803"]) == []
+
+    def test_pragma_suppresses(self):
+        assert lint_source(GRID_PRAGMA, path="snip.py", codes=["TPL803"]) == []
+
+
+# -- TPL804 DMA discipline ---------------------------------------------------
+
+
+DMA_PRELUDE = (
+    "import jax\n"
+    "from jax.experimental import pallas as pl\n"
+    "from jax.experimental.pallas import tpu as pltpu\n"
+)
+
+DMA_NO_WAIT = DMA_PRELUDE + (
+    "def kern(hbm_ref, out_ref, buf, sem):\n"
+    "    cp = pltpu.make_async_copy(hbm_ref, buf, sem)\n"
+    "    cp.start()\n"
+    "    out_ref[...] = buf[...]\n"
+)
+
+DMA_COND_WAIT = DMA_PRELUDE + (
+    "def kern(hbm_ref, out_ref, buf, sem, flag):\n"
+    "    cp = pltpu.make_async_copy(hbm_ref, buf, sem)\n"
+    "    cp.start()\n"
+    "    @pl.when(flag)\n"
+    "    def _take():\n"
+    "        cp.wait()\n"
+    "    out_ref[...] = buf[...]\n"
+)
+
+DMA_SLOT_REUSE = DMA_PRELUDE + (
+    "def kern(hbm_ref, out_ref, buf, sem):\n"
+    "    cp = pltpu.make_async_copy(hbm_ref.at[0], buf.at[0], sem.at[0])\n"
+    "    cp.start()\n"
+    "    cp.start()\n"
+    "    cp.wait()\n"
+    "    out_ref[...] = buf[...]\n"
+)
+
+DMA_NEGATIVE = DMA_PRELUDE + (
+    "def kern(hbm_ref, out_ref, buf, sem):\n"
+    "    cp = pltpu.make_async_copy(hbm_ref, buf, sem)\n"
+    "    cp.start()\n"
+    "    cp.wait()\n"
+    "    out_ref[...] = buf[...]\n"
+)
+
+# the manual double-buffer idiom pallas_voxel ships: a pure factory
+# helper iterated per slot, warm-up start, pl.when prefetch,
+# unconditional wait — must lint clean.
+DMA_FACTORY_NEGATIVE = DMA_PRELUDE + (
+    "def kern(hbm_ref, out_ref, buf, sem):\n"
+    "    def copies(slot, bi):\n"
+    "        return (\n"
+    "            pltpu.make_async_copy(\n"
+    "                hbm_ref.at[pl.ds(bi * 8, 8)], buf.at[slot], sem.at[slot]\n"
+    "            ),\n"
+    "        )\n"
+    "    for c in copies(0, 0):\n"
+    "        c.start()\n"
+    "    def body(bi, acc):\n"
+    "        @pl.when(bi + 1 < 4)\n"
+    "        def _prefetch():\n"
+    "            for c in copies((bi + 1) % 2, bi + 1):\n"
+    "                c.start()\n"
+    "        for c in copies(bi % 2, bi):\n"
+    "            c.wait()\n"
+    "        return acc\n"
+    "    jax.lax.fori_loop(0, 4, body, 0)\n"
+)
+
+DMA_PRAGMA = DMA_NO_WAIT.replace(
+    "    cp.start()\n",
+    "    cp.start()  # tpulint: disable=TPL804\n",
+)
+
+
+class TestDmaDiscipline:
+    def test_start_without_wait_fires(self):
+        found = lint_source(DMA_NO_WAIT, path="snip.py", codes=["TPL804"])
+        assert codes(found) == ["TPL804"]
+        assert "never waited" in found[0].message
+
+    def test_conditional_only_wait_fires(self):
+        found = lint_source(DMA_COND_WAIT, path="snip.py", codes=["TPL804"])
+        assert codes(found) == ["TPL804"]
+        assert "only conditional waits" in found[0].message
+
+    def test_slot_reuse_fires(self):
+        found = lint_source(DMA_SLOT_REUSE, path="snip.py", codes=["TPL804"])
+        assert codes(found) == ["TPL804"]
+        assert "no intervening wait" in found[0].message
+
+    def test_start_wait_pair_clean(self):
+        assert lint_source(DMA_NEGATIVE, path="snip.py", codes=["TPL804"]) == []
+
+    def test_double_buffer_factory_idiom_clean(self):
+        assert (
+            lint_source(DMA_FACTORY_NEGATIVE, path="snip.py", codes=["TPL804"])
+            == []
+        )
+
+    def test_pragma_suppresses(self):
+        assert lint_source(DMA_PRAGMA, path="snip.py", codes=["TPL804"]) == []
+
+
+# -- TPL805 fused-route contract (multi-file tree fixtures) ------------------
+
+
+FUSED_SRC = 'FUSED_STAGES = ("alpha",)\n'
+
+KERNEL_SRC = (
+    "import jax\n"
+    "import jax.numpy as jnp\n"
+    "from jax.experimental import pallas as pl\n"
+    "def _k(x_ref, o_ref):\n"
+    "    o_ref[...] = x_ref[...]\n"
+    "def run_alpha(x, interpret=False):\n"
+    '    with jax.named_scope("fused:alpha"):\n'
+    "        return pl.pallas_call(\n"
+    "            _k,\n"
+    "            out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),\n"
+    "            interpret=interpret,\n"
+    "        )(x)\n"
+)
+
+ROUTE_SRC = (
+    "def route(stages, x):\n"
+    '    if "alpha" in stages:\n'
+    "        return x\n"
+    "    return None\n"
+)
+
+ROUTE_TUPLE_SRC = (
+    "def route(stage, x):\n"
+    '    if stage in ("alpha", "beta"):\n'
+    "        return x\n"
+    "    return None\n"
+)
+
+PARITY_SRC = (
+    "def test_alpha_parity():\n"
+    '    assert run_both("alpha")\n'
+)
+
+
+def _lint_tree(
+    tmp_path,
+    fused=FUSED_SRC,
+    kernel=KERNEL_SRC,
+    route=ROUTE_SRC,
+    parity=PARITY_SRC,
+):
+    """Build tmp/pkg/{ops,pipelines} + tmp/tests/test_fused_parity.py
+    and run TPL805 over the package (parity path resolves relative to
+    ops/fused.py's real location, mirroring the repo layout)."""
+    tree = {
+        ("pkg", "ops", "fused.py"): fused,
+        ("pkg", "ops", "pallas_alpha.py"): kernel,
+        ("pkg", "pipelines", "route.py"): route,
+    }
+    if parity is not None:
+        tree[("tests", "test_fused_parity.py")] = parity
+    for parts, text in tree.items():
+        p = tmp_path.joinpath(*parts)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(text)
+    package = analysis.load_package(
+        [str(tmp_path / "pkg")], root=str(tmp_path)
+    )
+    assert not package.errors, package.errors
+    return analysis.run_rules(package, codes=["TPL805"])
+
+
+class TestFusedContract:
+    def test_full_contract_clean(self, tmp_path):
+        assert _lint_tree(tmp_path) == []
+
+    def test_tuple_membership_routing_counts(self, tmp_path):
+        assert _lint_tree(tmp_path, route=ROUTE_TUPLE_SRC) == []
+
+    def test_missing_named_scope_fires(self, tmp_path):
+        bare = KERNEL_SRC.replace(
+            '    with jax.named_scope("fused:alpha"):\n', "    if True:\n"
+        )
+        found = _lint_tree(tmp_path, kernel=bare)
+        assert codes(found) == ["TPL805"]
+        assert "launches nothing" in found[0].message
+
+    def test_hardcoded_interpret_fires(self, tmp_path):
+        found = _lint_tree(
+            tmp_path,
+            kernel=KERNEL_SRC.replace(
+                "interpret=interpret,", "interpret=False,"
+            ),
+        )
+        assert codes(found) == ["TPL805"]
+        assert "hard-codes interpret=" in found[0].message
+
+    def test_missing_interpret_kwarg_fires(self, tmp_path):
+        found = _lint_tree(
+            tmp_path,
+            kernel=KERNEL_SRC.replace(
+                "            interpret=interpret,\n", ""
+            ),
+        )
+        assert codes(found) == ["TPL805"]
+        assert "no interpret= kwarg" in found[0].message
+
+    def test_missing_routing_fires(self, tmp_path):
+        found = _lint_tree(
+            tmp_path, route="def route(stages, x):\n    return x\n"
+        )
+        assert codes(found) == ["TPL805"]
+        assert "no reference routing" in found[0].message
+
+    def test_routing_inside_kernel_module_does_not_count(self, tmp_path):
+        # the membership test must live OUTSIDE the kernel modules
+        found = _lint_tree(
+            tmp_path,
+            kernel=KERNEL_SRC + '\nBACKUP = "alpha" in ("alpha",)\n',
+            route="def route(stages, x):\n    return x\n",
+        )
+        assert codes(found) == ["TPL805"]
+
+    def test_stage_absent_from_parity_tests_fires(self, tmp_path):
+        found = _lint_tree(
+            tmp_path,
+            parity='def test_beta_parity():\n    assert run_both("beta")\n',
+        )
+        assert codes(found) == ["TPL805"]
+        assert "not named in any test" in found[0].message
+
+    def test_parity_file_missing_fires(self, tmp_path):
+        found = _lint_tree(tmp_path, parity=None)
+        assert codes(found) == ["TPL805"]
+        assert "missing or unparseable" in found[0].message
+
+    def test_no_fused_module_is_inert(self, tmp_path):
+        p = tmp_path / "pkg" / "mod.py"
+        p.parent.mkdir(parents=True)
+        p.write_text("X = 1\n")
+        package = analysis.load_package(
+            [str(tmp_path / "pkg")], root=str(tmp_path)
+        )
+        assert analysis.run_rules(package, codes=["TPL805"]) == []
+
+
+# -- TPL805 acceptance on (a copy of) the real tree --------------------------
+
+
+class TestFusedContractOnRealTree:
+    @pytest.fixture()
+    def real_tree(self, tmp_path):
+        shutil.copytree(
+            PKG,
+            tmp_path / "triton_client_tpu",
+            ignore=shutil.ignore_patterns("__pycache__"),
+        )
+        (tmp_path / "tests").mkdir()
+        shutil.copy(
+            os.path.join(REPO, "tests", "test_fused_parity.py"),
+            tmp_path / "tests",
+        )
+        return tmp_path
+
+    def _tpl805(self, root):
+        package = analysis.load_package(
+            [str(root / "triton_client_tpu")], root=str(root)
+        )
+        return analysis.run_rules(package, codes=["TPL805"])
+
+    def test_real_tree_contract_holds(self, real_tree):
+        assert self._tpl805(real_tree) == []
+
+    def test_deleting_parity_coverage_fires(self, real_tree):
+        p = real_tree / "tests" / "test_fused_parity.py"
+        p.write_text(p.read_text().replace("decode_nms", "decode_nms_gone"))
+        found = self._tpl805(real_tree)
+        assert codes(found) == ["TPL805"]
+        assert any(
+            "decode_nms" in f.message and "not named in any test" in f.message
+            for f in found
+        )
+
+    def test_unplumbing_interpret_fires(self, real_tree):
+        p = real_tree / "triton_client_tpu" / "ops" / "pallas_decode.py"
+        p.write_text(
+            p.read_text().replace("interpret=interpret,", "interpret=False,")
+        )
+        found = self._tpl805(real_tree)
+        assert found and codes(found) == ["TPL805"]
+        assert all("hard-codes interpret=" in f.message for f in found)
+
+
+# -- pallas_model extraction against the real kernel modules -----------------
+
+
+class TestPallasModelExtraction:
+    def test_voxel_branch_variants_paired(self):
+        models = pm.extract_models(_module(VOXEL))
+        segment = [
+            m
+            for m in models
+            if m.wrapper_name.endswith("sorted_segment_mean_pallas")
+        ]
+        assert len(segment) == 2, [m.kernel_names for m in segment]
+        (manual,) = [m for m in segment if not m.gridded]
+        (grid,) = [m for m in segment if m.gridded]
+
+        # grid variant: 1-D grid of runtime extent, scalar-prefetched,
+        # lane-major (8, 1024)/(1, 1024) blocks, interpret plumbed
+        assert grid.grid == (None,)
+        assert grid.num_scalar_prefetch == 1
+        assert [b.shape for b in grid.in_blocks] == [(8, 1024), (1, 1024)]
+        assert grid.interpret == "plumbed"
+        assert "fused:voxelize_scatter" in grid.named_scopes
+        assert grid.kernel_names and "grid" in grid.kernel_names[0]
+
+        # manual variant: gridless, ANY-space operands, run_scoped
+        # double buffers (partial-bound block=POINT_BLOCK resolved)
+        assert manual.grid == ()
+        assert manual.kernel_names and "manual" in manual.kernel_names[0]
+        assert [b.memory_space for b in manual.in_blocks] == ["any", "any"]
+        scoped = {s.shape for s in manual.scratch if s.kind == "run_scoped"}
+        assert (2, 8, 1024) in scoped and (2, 1, 1024) in scoped
+        sems = [s for s in manual.scratch if s.kind == "semaphore"]
+        assert len(sems) == 2
+
+    def test_decode_partial_kernels_and_replication(self):
+        models = pm.extract_models(_module(DECODE))
+        assert len(models) == 3
+        assert all(m.interpret == "plumbed" for m in models)
+        assert all("fused:decode_nms" in m.named_scopes for m in models)
+        # the [pl.BlockSpec(memory_space=pltpu.VMEM)] * 3 call expands
+        assert any(
+            len(m.in_blocks) == 3
+            and all(b.memory_space == "vmem" for b in m.in_blocks)
+            for m in models
+        )
+
+    def test_nms_tuple_out_shapes(self):
+        models = pm.extract_models(_module(NMS))
+        assert models
+        assert any(len(m.out_shapes) >= 2 for m in models)
+
+    def test_dynamic_dims_fold_to_none_not_guessed(self):
+        # ragged kernels size everything off runtime k (_round_up):
+        # dims must fold to None so TPL801/802 skip, never misfire
+        package = analysis.load_package([RAGGED], root=REPO)
+        assert analysis.run_rules(package, codes=["TPL801", "TPL802"]) == []
+
+    def test_by_scope_index(self):
+        package = analysis.load_package(list(KERNEL_MODULES), root=REPO)
+        idx = package.pallas
+        assert idx.by_scope("fused:decode_nms")
+        assert idx.by_scope("fused:voxelize_scatter")
+        assert idx.by_scope("fused:nonexistent") == []
+
+    def test_fold_int_arithmetic(self):
+        import ast as _ast
+
+        env = {"A": 1024, "B": 128}
+        for expr, want in [
+            ("A + B", 1152),
+            ("A // B", 8),
+            ("-B", -128),
+            ("max(A, B)", 1024),
+            ("(A + 1 + B - 1) // B * B", 1152),
+            ("A * unknown", None),
+            ("A // 0", None),
+        ]:
+            node = _ast.parse(expr, mode="eval").body
+            assert pm.fold_int(node, env) == want, expr
+
+
+# -- near-miss mutations of the real kernels ---------------------------------
+
+
+class TestRealKernelNearMisses:
+    def _mutated(self, path, old, new, codes_sel):
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        assert src.count(old) == 1, f"mutation anchor drifted: {old!r}"
+        rel = os.path.relpath(path, REPO)
+        return lint_source(src.replace(old, new), path=rel, codes=codes_sel)
+
+    def test_real_kernel_modules_lint_clean(self):
+        package = analysis.load_package(list(KERNEL_MODULES), root=REPO)
+        found = analysis.run_rules(
+            package, codes=["TPL801", "TPL802", "TPL803", "TPL804"]
+        )
+        assert found == [], "\n".join(f.render() for f in found)
+
+    def test_voxel_column_block_refires_tpl801(self):
+        # the exact bug this PR fixed: a (N, 1) slot column pads 128x
+        found = self._mutated(
+            VOXEL,
+            "pl.BlockSpec((1, POINT_BLOCK), lambda i, bases: (0, i))",
+            "pl.BlockSpec((POINT_BLOCK, 1), lambda i, bases: (0, i))",
+            ["TPL801"],
+        )
+        assert codes(found) == ["TPL801"]
+        assert "trailing dim 1 " in found[0].message
+
+    def test_voxel_dropped_wait_refires_tpl804(self):
+        found = self._mutated(VOXEL, "c.wait()", "pass", ["TPL804"])
+        assert codes(found) == ["TPL804"]
+        assert "never waited" in found[0].message
+
+    def test_voxel_dropped_guard_refires_tpl803(self):
+        found = self._mutated(
+            VOXEL,
+            "if valsT.shape[0] != _SUBLANES or n % POINT_BLOCK:",
+            "if valsT.shape[0] != _SUBLANES:",
+            ["TPL803"],
+        )
+        assert codes(found) == ["TPL803"]
+        assert "no divisibility guard" in found[0].message
+
+
+# -- engine wiring -----------------------------------------------------------
+
+
+class TestTpl8Wiring:
+    def test_registry_has_tpl8_family(self):
+        reg = analysis.registry()
+        assert {"TPL801", "TPL802", "TPL803", "TPL804", "TPL805"} <= set(reg)
+
+    def test_sarif_carries_tpl8_rule_metadata(self):
+        found = lint_source(TILE_POSITIVE, path="snip.py", codes=["TPL801"])
+        doc = json.loads(analysis.render_sarif(found))
+        rules = doc["runs"][0]["tool"]["driver"]["rules"]
+        ids = {r["id"] for r in rules}
+        assert {"TPL801", "TPL802", "TPL803", "TPL804", "TPL805"} <= ids
+        tpl805 = next(r for r in rules if r["id"] == "TPL805")
+        assert "parity" in tpl805["fullDescription"]["text"]
+
+    def test_fingerprints_survive_line_churn(self):
+        a = lint_source(TILE_POSITIVE, path="snip.py", codes=["TPL801"])
+        b = lint_source("\n\n" + TILE_POSITIVE, path="snip.py", codes=["TPL801"])
+        assert [f.fingerprint() for f in a] == [f.fingerprint() for f in b]
+        assert a[0].line != b[0].line

@@ -21,7 +21,8 @@ Commands map 1:1 onto the reference's entry scripts:
                the attainable-fps ceiling (live /snapshot or bench
                JSON; measured flops/bytes from XLA's cost model)
   lint       — tpulint AST hazard analysis (recompilation / donation /
-               host-sync / lock / telemetry rules; docs/LINTING.md)
+               host-sync / lock / telemetry / concurrency / zero-copy /
+               Pallas-kernel rules; docs/LINTING.md)
   route      — probe a replica set (health/readiness/labels per
                endpoint — the FrontDoorRouter's rotation view)
 """

@@ -6,15 +6,17 @@ with a failure mode that is invisible to CPU-only tests and shows up
 only as a production perf/correctness regression: use-after-donation,
 silent retraces, host syncs inside the overlap window, unguarded
 shared counters, unbalanced spans/gauges, cross-thread races, hidden
-request-sized copies. All are *structural* — visible in the syntax
-tree — so this package lints for them at review time. Seven rule
-families:
+request-sized copies, mis-tiled or VMEM-oversubscribed Pallas kernels.
+All are *structural* — visible in the syntax tree — so this package
+lints for them at review time. Eight rule families:
 
   TPL1xx  recompilation hazards      TPL5xx  telemetry correctness
   TPL2xx  donation misuse            TPL6xx  whole-program concurrency
   TPL3xx  host sync on the hot path          (deadlock + race model,
   TPL4xx  lock discipline                     analysis/threads.py)
                                      TPL7xx  zero-copy / host path
+  TPL8xx  Pallas kernel analysis (tiling/VMEM/DMA + fused-route
+          contract; analysis/pallas_model.py)
 
 Entry points: ``python -m triton_client_tpu lint`` (CLI, see
 cli/tools.py), :func:`lint_paths` / :func:`lint_source` (library / test

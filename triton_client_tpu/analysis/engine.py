@@ -121,6 +121,7 @@ class Package:
         self.modules = modules
         self._callgraph = None
         self._threads = None
+        self._pallas = None
         self.errors: list[str] = []
 
     @property
@@ -141,6 +142,18 @@ class Package:
 
             self._threads = ThreadModel(self)
         return self._threads
+
+    @property
+    def pallas(self):
+        """Lazy :class:`analysis.pallas_model.PallasIndex` — every
+        ``pl.pallas_call`` site's static kernel model (grid, BlockSpecs,
+        scratch, interpret plumbing, named scopes), built once and
+        shared by the TPL8xx family (same contract as ``callgraph``)."""
+        if self._pallas is None:
+            from triton_client_tpu.analysis.pallas_model import PallasIndex
+
+            self._pallas = PallasIndex(self)
+        return self._pallas
 
 
 class Rule:
@@ -260,11 +273,20 @@ def load_source(
 
 
 def run_rules(
-    package: Package, codes: Iterable[str] | None = None
+    package: Package,
+    codes: Iterable[str] | None = None,
+    stats: dict[str, dict] | None = None,
 ) -> list[Finding]:
     """Run the (selected) registry over the package; pragma-suppressed
     findings are dropped here, baseline suppression happens in the CLI
-    so ``--write-baseline`` can see the full set."""
+    so ``--write-baseline`` can see the full set.
+
+    ``stats``, when given, is filled in place with per-rule cost rows
+    ``{code: {"findings": n, "elapsed_ms": ms}}`` (post-pragma counts)
+    — the ``lint --stats`` table that keeps the gate's cost visible as
+    families grow."""
+    import time
+
     selected = registry()
     if codes:
         wanted = {c.strip().upper() for c in codes}
@@ -275,12 +297,20 @@ def run_rules(
         }
     by_path = {m.relpath: m for m in package.modules}
     findings: list[Finding] = []
-    for cls in selected.values():
+    for code, cls in selected.items():
+        t0 = time.perf_counter()
+        kept = 0
         for f in cls().check(package):
             mod = by_path.get(f.path)
             if mod is not None and mod.suppressed(f.code, f.line):
                 continue
             findings.append(f)
+            kept += 1
+        if stats is not None:
+            stats[code] = {
+                "findings": kept,
+                "elapsed_ms": round((time.perf_counter() - t0) * 1e3, 3),
+            }
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.code))
     return findings
 

@@ -1,0 +1,771 @@
+"""Static per-call kernel models for ``pl.pallas_call`` sites (TPL8xx).
+
+tpulint's first seven families stop at the ``pallas_call`` boundary:
+they can see a host sync *around* a kernel launch but nothing about the
+launch itself. The bugs that live inside the boundary — a block shape
+that pads 128x in VMEM, a working set past the per-core VMEM limit, a
+grid the caller can starve, an async copy started and never waited —
+are silent under ``interpret=True`` on CPU and only surface as wrong
+answers or Mosaic errors on real hardware. This module recovers enough
+of each call site from the AST for the TPL8xx rules to reason about:
+
+  * the grid (``grid=`` or a ``PrefetchScalarGridSpec``), including
+    ``num_scalar_prefetch``;
+  * every ``BlockSpec``: block shape, memory space, index-map presence;
+  * ``out_shape`` ShapeDtypeStructs (shape + dtype);
+  * scratch allocations — both ``scratch_shapes=[pltpu.VMEM(...)]`` at
+    the call and ``pl.run_scoped(..., name=pltpu.VMEM(...))`` inside
+    the kernel body (partial-bound constants resolved);
+  * ``interpret=`` plumbing (parameter-plumbed vs constant vs absent);
+  * the enclosing ``jax.named_scope`` strings (the fused-route anchor);
+  * the kernel function(s) the call launches, through
+    ``functools.partial`` and branch-local ``kernel = ...`` rebinding.
+
+Extraction is best-effort by design: dimensions fold to ``int`` only
+when they reduce to module/wrapper-local integer constants (``_LANES``,
+``POINT_BLOCK``, ``a // b`` of constants...); anything data-dependent
+(``k_pad = _round_up(k, 128)`` over a runtime ``k``) folds to ``None``
+and the rules skip it — a lint must not guess. The same conservatism
+governs the DMA walk: ``pl.when``-decorated bodies are conditional,
+loop bodies are assumed to execute at least once (the double-buffer
+schedules this package ships always run >= 1 block).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Iterable, Iterator
+
+from triton_client_tpu.analysis.engine import (
+    Module,
+    call_name,
+    dotted_name,
+    qualname_contexts,
+)
+
+#: dtype name (the suffix of ``jnp.float32`` etc.) -> itemsize bytes.
+DTYPE_BYTES = {
+    "float64": 8, "int64": 8, "uint64": 8,
+    "float32": 4, "int32": 4, "uint32": 4,
+    "bfloat16": 2, "float16": 2, "int16": 2, "uint16": 2,
+    "int8": 1, "uint8": 1, "bool_": 1, "bool": 1,
+}
+
+#: dtype -> minimum sublane multiple of the native TPU tile
+#: (sublanes x 128 lanes): f32 packs 8 sublanes, 2-byte types 16,
+#: 1-byte types 32 (see the Pallas TPU tiling tables).
+DTYPE_SUBLANES = {1: 32, 2: 16, 4: 8, 8: 8}
+
+
+def dtype_name(node: ast.AST) -> str | None:
+    """``jnp.float32`` / ``np.int8`` -> 'float32' / 'int8'."""
+    name = dotted_name(node)
+    tail = name.rsplit(".", 1)[-1] if name else ""
+    return tail if tail in DTYPE_BYTES else None
+
+
+def itemsize(dtype: str | None, default: int = 4) -> int:
+    return DTYPE_BYTES.get(dtype or "", default)
+
+
+def sublane_multiple(dtype: str | None) -> int:
+    return DTYPE_SUBLANES.get(itemsize(dtype), 8)
+
+
+# -- constant folding --------------------------------------------------------
+
+
+def fold_int(node: ast.AST | None, env: dict[str, int | None]) -> int | None:
+    """Best-effort integer fold of ``node`` under ``env``; ``None`` when
+    anything non-constant participates."""
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant):
+        return node.value if isinstance(node.value, int) and not isinstance(
+            node.value, bool
+        ) else None
+    if isinstance(node, ast.Name):
+        return env.get(node.id)
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        v = fold_int(node.operand, env)
+        return -v if v is not None else None
+    if isinstance(node, ast.BinOp):
+        left = fold_int(node.left, env)
+        right = fold_int(node.right, env)
+        if left is None or right is None:
+            return None
+        try:
+            if isinstance(node.op, ast.Add):
+                return left + right
+            if isinstance(node.op, ast.Sub):
+                return left - right
+            if isinstance(node.op, ast.Mult):
+                return left * right
+            if isinstance(node.op, ast.FloorDiv):
+                return left // right
+            if isinstance(node.op, ast.Mod):
+                return left % right
+            if isinstance(node.op, ast.LShift):
+                return left << right
+            if isinstance(node.op, ast.Pow):
+                return left ** right
+        except (ZeroDivisionError, OverflowError, ValueError):
+            return None
+        return None
+    if isinstance(node, ast.Call) and call_name(node) in ("max", "min"):
+        vals = [fold_int(a, env) for a in node.args]
+        if vals and all(v is not None for v in vals):
+            return max(vals) if call_name(node) == "max" else min(vals)
+    return None
+
+
+def fold_shape(
+    node: ast.AST | None, env: dict[str, int | None]
+) -> tuple[int | None, ...] | None:
+    """A ``(a, b, ...)`` tuple/list expression -> per-dim ints (None for
+    dims that don't fold); None when the node isn't a shape literal."""
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(fold_int(el, env) for el in node.elts)
+    return None
+
+
+def module_const_env(module: Module) -> dict[str, int | None]:
+    """Module-level ``NAME = <int expr>`` constants; a second pass folds
+    constants defined in terms of earlier ones (``_WINDOW = POINT_BLOCK
+    + _LANES``)."""
+    env: dict[str, int | None] = {}
+    for _ in range(2):
+        for stmt in module.tree.body:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+                t = stmt.targets[0]
+                if isinstance(t, ast.Name):
+                    v = fold_int(stmt.value, env)
+                    if v is not None:
+                        env[t.id] = v
+    return env
+
+
+def function_env(
+    fn: ast.FunctionDef | ast.AsyncFunctionDef, base: dict[str, int | None]
+) -> dict[str, int | None]:
+    """``base`` extended with the function's own foldable straight-line
+    assignments (nested defs excluded — they run elsewhere)."""
+    env = dict(base)
+
+    def walk(node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            if isinstance(child, ast.Assign) and len(child.targets) == 1:
+                t = child.targets[0]
+                if isinstance(t, ast.Name):
+                    v = fold_int(child.value, env)
+                    if v is not None:
+                        env[t.id] = v
+            walk(child)
+
+    walk(fn)
+    return env
+
+
+# -- per-call models ---------------------------------------------------------
+
+
+@dataclasses.dataclass
+class BlockModel:
+    """One ``BlockSpec``: role 'in'|'out', ``shape`` per-dim ints (None
+    for unfoldable dims) or None when blockless (whole-operand),
+    ``memory_space`` 'vmem'|'smem'|'any'."""
+
+    role: str
+    shape: tuple[int | None, ...] | None
+    memory_space: str
+    has_index_map: bool
+    node: ast.AST
+
+
+@dataclasses.dataclass
+class ScratchModel:
+    """One scratch allocation: ``kind`` 'scratch_shapes'|'run_scoped'|
+    'semaphore'; semaphores carry no shape/bytes."""
+
+    kind: str
+    shape: tuple[int | None, ...] | None
+    dtype: str | None
+    node: ast.AST
+
+
+@dataclasses.dataclass
+class KernelModel:
+    """Everything statically known about one ``pl.pallas_call`` site
+    (one model per resolvable kernel/grid-spec branch variant)."""
+
+    module: Module
+    call: ast.Call
+    wrapper: ast.FunctionDef | None
+    wrapper_name: str
+    kernel_names: tuple[str, ...]
+    kernel_fn: ast.FunctionDef | None
+    grid: tuple[int | None, ...] | None
+    num_scalar_prefetch: int
+    in_blocks: list[BlockModel]
+    out_blocks: list[BlockModel]
+    out_shapes: list[tuple[tuple[int | None, ...] | None, str | None]]
+    scratch: list[ScratchModel]
+    interpret: str  # 'plumbed' | 'const' | 'missing'
+    named_scopes: tuple[str, ...]
+
+    @property
+    def gridded(self) -> bool:
+        return bool(self.grid)
+
+
+# -- BlockSpec / scratch / out_shape parsing --------------------------------
+
+
+_SPACE_SUFFIX = {"VMEM": "vmem", "SMEM": "smem", "ANY": "any"}
+
+
+def _parse_blockspec(
+    node: ast.AST, env: dict[str, int | None], role: str
+) -> BlockModel | None:
+    if not isinstance(node, ast.Call):
+        return None
+    name = call_name(node)
+    if not name.endswith("BlockSpec"):
+        return None
+    shape = fold_shape(node.args[0], env) if node.args else None
+    has_map = len(node.args) > 1
+    space = "vmem"
+    for kw in node.keywords:
+        if kw.arg == "index_map":
+            has_map = True
+        elif kw.arg == "block_shape":
+            shape = fold_shape(kw.value, env)
+        elif kw.arg == "memory_space":
+            tail = dotted_name(kw.value).rsplit(".", 1)[-1]
+            space = _SPACE_SUFFIX.get(tail, "vmem")
+    return BlockModel(role=role, shape=shape, memory_space=space,
+                      has_index_map=has_map, node=node)
+
+
+def _parse_spec_list(
+    node: ast.AST | None, env: dict[str, int | None], role: str,
+    wrapper: ast.FunctionDef | None,
+) -> list[BlockModel]:
+    """in_specs/out_specs expression -> BlockModels. Handles a list or
+    tuple of specs, a bare spec, ``[spec] * k`` replication, and a Name
+    bound earlier in the wrapper."""
+    if node is None:
+        return []
+    if isinstance(node, ast.Name) and wrapper is not None:
+        cands = _assignments_of(wrapper, node.id)
+        if cands:
+            node = cands[-1][0]
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mult):
+        seq, count = node.left, fold_int(node.right, env)
+        if not isinstance(seq, (ast.List, ast.Tuple)):
+            seq, count = node.right, fold_int(node.left, env)
+        if isinstance(seq, (ast.List, ast.Tuple)) and count:
+            base = [
+                b for el in seq.elts
+                if (b := _parse_blockspec(el, env, role)) is not None
+            ]
+            return base * count
+        return []
+    if isinstance(node, (ast.List, ast.Tuple)):
+        return [
+            b for el in node.elts
+            if (b := _parse_blockspec(el, env, role)) is not None
+        ]
+    one = _parse_blockspec(node, env, role)
+    return [one] if one else []
+
+
+def _parse_scratch_entry(
+    node: ast.AST, env: dict[str, int | None], kind: str
+) -> ScratchModel | None:
+    if not isinstance(node, ast.Call):
+        return None
+    name = call_name(node)
+    tail = name.rsplit(".", 1)[-1]
+    if "SemaphoreType" in name or tail == "DMA":
+        return ScratchModel(kind="semaphore", shape=None, dtype=None,
+                            node=node)
+    if tail in ("VMEM", "SMEM"):
+        shape = fold_shape(node.args[0], env) if node.args else None
+        dtype = dtype_name(node.args[1]) if len(node.args) > 1 else None
+        return ScratchModel(kind=kind, shape=shape, dtype=dtype, node=node)
+    return None
+
+
+def _parse_out_shapes(
+    node: ast.AST | None, env: dict[str, int | None]
+) -> list[tuple[tuple[int | None, ...] | None, str | None]]:
+    if node is None:
+        return []
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for el in node.elts:
+            out.extend(_parse_out_shapes(el, env))
+        return out
+    if isinstance(node, ast.Call) and call_name(node).endswith(
+        "ShapeDtypeStruct"
+    ):
+        shape = fold_shape(node.args[0], env) if node.args else None
+        dtype = dtype_name(node.args[1]) if len(node.args) > 1 else None
+        return [(shape, dtype)]
+    return []
+
+
+# -- branch-aware local resolution ------------------------------------------
+
+
+def _assignments_of(
+    fn: ast.AST, name: str
+) -> list[tuple[ast.AST, tuple | None]]:
+    """(value, branch_key) for every ``name = ...`` in ``fn`` (nested
+    defs excluded). ``branch_key`` identifies the innermost if/else arm
+    so ``kernel``/``grid_spec`` pairs rebound together in matching arms
+    stay paired."""
+    out: list[tuple[ast.AST, tuple | None]] = []
+
+    def walk(node: ast.AST, branch: tuple | None) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            if isinstance(child, ast.If):
+                for stmt in child.body:
+                    walk_stmt(stmt, (id(child), "body"))
+                for stmt in child.orelse:
+                    walk_stmt(stmt, (id(child), "orelse"))
+                continue
+            walk_stmt(child, branch)
+
+    def walk_stmt(child: ast.AST, branch: tuple | None) -> None:
+        if isinstance(child, ast.Assign) and len(child.targets) == 1:
+            t = child.targets[0]
+            if isinstance(t, ast.Name) and t.id == name:
+                out.append((child.value, branch))
+        walk(child, branch)
+
+    walk(fn, None)
+    return out
+
+
+def _variants(
+    wrapper: ast.FunctionDef | None, call: ast.Call
+) -> list[tuple[ast.AST | None, ast.AST | None]]:
+    """(kernel_expr, grid_spec_expr) per branch variant of the call —
+    a Name argument expands to its branch-local assignments, paired by
+    branch arm (the ``if pipeline == "manual"`` pattern)."""
+    kernel_expr = call.args[0] if call.args else None
+    spec_expr = next(
+        (kw.value for kw in call.keywords if kw.arg == "grid_spec"), None
+    )
+
+    def expand(expr):
+        if isinstance(expr, ast.Name) and wrapper is not None:
+            cands = _assignments_of(wrapper, expr.id)
+            if cands:
+                return cands
+        return [(expr, None)]
+
+    kernels = expand(kernel_expr)
+    specs = expand(spec_expr)
+    branches = sorted(
+        {b for _, b in kernels + specs if b is not None},
+        key=lambda b: (b[0], b[1]),
+    )
+    if not branches:
+        return [(kernels[-1][0], specs[-1][0])]
+
+    def pick(cands, branch):
+        for v, b in reversed(cands):
+            if b == branch:
+                return v
+        for v, b in reversed(cands):
+            if b is None:
+                return v
+        return cands[-1][0]
+
+    return [(pick(kernels, b), pick(specs, b)) for b in branches]
+
+
+# -- kernel resolution -------------------------------------------------------
+
+
+def _resolve_kernel(
+    expr: ast.AST | None, module: Module, env: dict[str, int | None]
+) -> tuple[tuple[str, ...], ast.FunctionDef | None, dict[str, int | None]]:
+    """Kernel expression -> (names, module-level FunctionDef, extra env
+    from foldable ``functools.partial`` keyword bindings)."""
+    extra: dict[str, int | None] = {}
+    names: tuple[str, ...] = ()
+    if isinstance(expr, ast.Call) and call_name(expr).endswith("partial"):
+        if expr.args:
+            inner = dotted_name(expr.args[0])
+            if inner:
+                names = (inner,)
+        for kw in expr.keywords:
+            if kw.arg:
+                extra[kw.arg] = fold_int(kw.value, env)
+    elif isinstance(expr, (ast.Name, ast.Attribute)):
+        n = dotted_name(expr)
+        if n:
+            names = (n,)
+    fn = None
+    if names:
+        target = names[0].rsplit(".", 1)[-1]
+        for stmt in module.tree.body:
+            if isinstance(stmt, ast.FunctionDef) and stmt.name == target:
+                fn = stmt
+                break
+    return names, fn, extra
+
+
+def _run_scoped_scratch(
+    kernel_fn: ast.FunctionDef, env: dict[str, int | None]
+) -> list[ScratchModel]:
+    out: list[ScratchModel] = []
+    for node in ast.walk(kernel_fn):
+        if isinstance(node, ast.Call) and call_name(node).endswith(
+            "run_scoped"
+        ):
+            for kw in node.keywords:
+                entry = _parse_scratch_entry(kw.value, env, "run_scoped")
+                if entry is not None:
+                    out.append(entry)
+            for arg in node.args[1:]:
+                entry = _parse_scratch_entry(arg, env, "run_scoped")
+                if entry is not None:
+                    out.append(entry)
+    return out
+
+
+# -- named scopes ------------------------------------------------------------
+
+
+def _named_scopes_around(
+    fn: ast.AST, call: ast.Call
+) -> tuple[str, ...]:
+    """Constant ``jax.named_scope("...")`` strings whose ``with`` body
+    lexically contains ``call``."""
+    scopes: list[str] = []
+    line = call.lineno
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.With):
+            continue
+        if not (node.lineno <= line <= getattr(node, "end_lineno", node.lineno)):
+            continue
+        for item in node.items:
+            ctx = item.context_expr
+            if (
+                isinstance(ctx, ast.Call)
+                and call_name(ctx).endswith("named_scope")
+                and ctx.args
+                and isinstance(ctx.args[0], ast.Constant)
+                and isinstance(ctx.args[0].value, str)
+            ):
+                scopes.append(ctx.args[0].value)
+    return tuple(scopes)
+
+
+# -- extraction entry points -------------------------------------------------
+
+
+def _is_pallas_call(node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) and call_name(node).rsplit(
+        ".", 1
+    )[-1] == "pallas_call"
+
+
+def _enclosing_function(
+    module: Module, node: ast.AST
+) -> tuple[ast.FunctionDef | None, str]:
+    best: ast.FunctionDef | None = None
+    best_name = ""
+    line = getattr(node, "lineno", 0)
+    for def_node, name in qualname_contexts(module.tree).items():
+        if not isinstance(def_node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if (
+            def_node.lineno <= line
+            and getattr(def_node, "end_lineno", def_node.lineno) >= line
+            and (best is None or len(name) > len(best_name))
+        ):
+            best, best_name = def_node, name
+    return best, best_name
+
+
+def extract_models(module: Module) -> list[KernelModel]:
+    """Every ``pl.pallas_call`` site in ``module`` -> KernelModels (one
+    per resolvable kernel/grid-spec branch variant)."""
+    env_mod = module_const_env(module)
+    models: list[KernelModel] = []
+    for node in ast.walk(module.tree):
+        if not _is_pallas_call(node):
+            continue
+        wrapper, wrapper_name = _enclosing_function(module, node)
+        env = (
+            function_env(wrapper, env_mod) if wrapper is not None else env_mod
+        )
+        kwargs = {kw.arg: kw.value for kw in node.keywords if kw.arg}
+
+        interp = "missing"
+        if "interpret" in kwargs:
+            interp = (
+                "const"
+                if isinstance(kwargs["interpret"], ast.Constant)
+                else "plumbed"
+            )
+
+        scopes = (
+            _named_scopes_around(wrapper, node) if wrapper is not None else ()
+        )
+        out_shapes = _parse_out_shapes(kwargs.get("out_shape"), env)
+        scratch_call = [
+            s
+            for el in (
+                kwargs["scratch_shapes"].elts
+                if isinstance(
+                    kwargs.get("scratch_shapes"), (ast.List, ast.Tuple)
+                )
+                else ()
+            )
+            if (s := _parse_scratch_entry(el, env, "scratch_shapes"))
+            is not None
+        ]
+
+        for kernel_expr, spec_expr in _variants(wrapper, node):
+            grid_node = kwargs.get("grid")
+            in_specs_node = kwargs.get("in_specs")
+            out_specs_node = kwargs.get("out_specs")
+            num_prefetch = 0
+            if isinstance(spec_expr, ast.Call):
+                spec_kwargs = {
+                    kw.arg: kw.value for kw in spec_expr.keywords if kw.arg
+                }
+                grid_node = spec_kwargs.get("grid", grid_node)
+                in_specs_node = spec_kwargs.get("in_specs", in_specs_node)
+                out_specs_node = spec_kwargs.get("out_specs", out_specs_node)
+                num_prefetch = (
+                    fold_int(spec_kwargs.get("num_scalar_prefetch"), env) or 0
+                )
+            grid = fold_shape(grid_node, env)
+            if grid is None and grid_node is not None:
+                v = fold_int(grid_node, env)
+                grid = (v,) if v is not None else (None,)
+
+            names, kernel_fn, partial_env = _resolve_kernel(
+                kernel_expr, module, env
+            )
+            kenv = dict(env_mod)
+            kenv.update({k: v for k, v in partial_env.items() if v is not None})
+            scratch = list(scratch_call)
+            if kernel_fn is not None:
+                scratch.extend(_run_scoped_scratch(kernel_fn, kenv))
+
+            models.append(
+                KernelModel(
+                    module=module,
+                    call=node,
+                    wrapper=wrapper,
+                    wrapper_name=wrapper_name,
+                    kernel_names=names,
+                    kernel_fn=kernel_fn,
+                    grid=grid,
+                    num_scalar_prefetch=num_prefetch,
+                    in_blocks=_parse_spec_list(
+                        in_specs_node, env, "in", wrapper
+                    ),
+                    out_blocks=_parse_spec_list(
+                        out_specs_node, env, "out", wrapper
+                    ),
+                    out_shapes=out_shapes,
+                    scratch=scratch,
+                    interpret=interp,
+                    named_scopes=scopes,
+                )
+            )
+    return models
+
+
+class PallasIndex:
+    """Package-wide lazy index of every pallas_call model, built once
+    and shared by the TPL8xx rules (the ``Package.pallas`` facility,
+    same contract as ``Package.callgraph``/``Package.threads``)."""
+
+    def __init__(self, package) -> None:
+        self.models: list[KernelModel] = []
+        for module in package.modules:
+            try:
+                self.models.extend(extract_models(module))
+            except RecursionError:  # pathological nesting: skip, don't die
+                continue
+
+    def by_scope(self, scope: str) -> list[KernelModel]:
+        return [m for m in self.models if scope in m.named_scopes]
+
+
+# -- DMA discipline walk (TPL804 substrate) ----------------------------------
+
+
+@dataclasses.dataclass
+class DMAEvent:
+    """One ``.start()``/``.wait()`` on an async-copy family. ``family``
+    is the copy variable or factory-helper name; ``conditional`` means
+    the event sits under ``pl.when`` or an ``if`` arm; ``signature`` is
+    the textual identity of the copy's construction (slot/index args)
+    for duplicate-start detection."""
+
+    family: str
+    kind: str  # 'start' | 'wait'
+    conditional: bool
+    signature: str
+    node: ast.AST
+
+
+def _contains_make_async_copy(node: ast.AST) -> bool:
+    return any(
+        isinstance(n, ast.Call)
+        and call_name(n).rsplit(".", 1)[-1] in (
+            "make_async_copy", "make_async_remote_copy"
+        )
+        for n in ast.walk(node)
+    )
+
+
+def _is_when_decorated(fn: ast.FunctionDef) -> bool:
+    return any(
+        isinstance(d, ast.Call) and call_name(d).rsplit(".", 1)[-1] == "when"
+        for d in fn.decorator_list
+    )
+
+
+def dma_events(fn: ast.FunctionDef) -> list[DMAEvent]:
+    """Linear, flow-classified start/wait event stream for every
+    async-copy family lexically inside ``fn``.
+
+    Families: a variable assigned from ``make_async_copy`` (family =
+    the variable), a nested helper whose body constructs copies and is
+    iterated via ``for c in helper(...)`` (family = the helper name —
+    the manual double-buffer idiom), or a chained
+    ``make_async_copy(...).start()`` (anonymous family, per line).
+    ``pl.when``-decorated nested defs and ``if`` arms mark their events
+    conditional; ``fori_loop``/``for``/``while`` bodies are treated as
+    executing at least once (the schedules here always run >= 1 block —
+    a deliberate, documented approximation)."""
+    factories: set[str] = set()
+    copy_vars: dict[str, str] = {}  # var -> construction signature
+    for node in ast.walk(fn):
+        if isinstance(node, ast.FunctionDef) and node is not fn:
+            if _contains_make_async_copy(node):
+                factories.add(node.name)
+
+    events: list[DMAEvent] = []
+
+    def sig_of(call: ast.Call) -> str:
+        return ast.dump(call, annotate_fields=False)
+
+    def classify_target(value: ast.AST) -> tuple[str, str] | None:
+        """A call expression -> (family, signature) when it constructs
+        or produces async copies."""
+        if not isinstance(value, ast.Call):
+            return None
+        tail = call_name(value).rsplit(".", 1)[-1]
+        if tail in ("make_async_copy", "make_async_remote_copy"):
+            return "<inline>", sig_of(value)
+        if tail in factories or call_name(value) in factories:
+            return call_name(value).rsplit(".", 1)[-1], sig_of(value)
+        return None
+
+    def walk(node: ast.AST, cond: bool, loop_var_family: dict[str, tuple[str, str]]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.FunctionDef):
+                if child.name in factories and not any(
+                    isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr in ("start", "wait")
+                    for n in ast.walk(child)
+                ):
+                    # pure factory helper: constructions are not events
+                    continue
+                walk(child, cond or _is_when_decorated(child),
+                     dict(loop_var_family))
+                continue
+            if isinstance(child, ast.Lambda):
+                continue
+            if isinstance(child, ast.If):
+                for stmt in child.body:
+                    walk_stmt(stmt, True, loop_var_family)
+                for stmt in child.orelse:
+                    walk_stmt(stmt, True, loop_var_family)
+                continue
+            if isinstance(child, ast.For):
+                fam = classify_target(child.iter)
+                inner = dict(loop_var_family)
+                if fam is not None and isinstance(child.target, ast.Name):
+                    inner[child.target.id] = fam
+                for stmt in child.body:
+                    walk_stmt(stmt, cond, inner)
+                continue
+            walk_stmt(child, cond, loop_var_family)
+
+    def walk_stmt(child: ast.AST, cond: bool,
+                  loop_var_family: dict[str, tuple[str, str]]) -> None:
+        if isinstance(child, ast.Assign) and len(child.targets) == 1:
+            t = child.targets[0]
+            fam = classify_target(child.value)
+            if isinstance(t, ast.Name) and fam is not None:
+                copy_vars[t.id] = fam[1]
+        if (
+            isinstance(child, ast.Call)
+            and isinstance(child.func, ast.Attribute)
+            and child.func.attr in ("start", "wait")
+        ):
+            base = child.func.value
+            fam_sig: tuple[str, str] | None = None
+            if isinstance(base, ast.Name):
+                if base.id in loop_var_family:
+                    fam_sig = loop_var_family[base.id]
+                elif base.id in copy_vars:
+                    fam_sig = (base.id, copy_vars[base.id])
+            else:
+                fam_sig = classify_target(base)
+                if fam_sig is not None and fam_sig[0] == "<inline>":
+                    fam_sig = (f"<inline>:{child.lineno}", fam_sig[1])
+            if fam_sig is not None:
+                events.append(
+                    DMAEvent(
+                        family=fam_sig[0],
+                        kind=child.func.attr,
+                        conditional=cond,
+                        signature=fam_sig[1],
+                        node=child,
+                    )
+                )
+        walk(child, cond, loop_var_family)
+
+    walk(fn, False, {})
+    return events
+
+
+def functions_with_dma(module: Module) -> Iterator[ast.FunctionDef]:
+    """Top-level (and method-level) functions whose subtree constructs
+    async copies — the TPL804 scan set. Nested defs are analyzed as
+    part of their encloser, so only outermost defs are yielded."""
+
+    def outermost(body: Iterable[ast.stmt]) -> Iterator[ast.FunctionDef]:
+        for stmt in body:
+            if isinstance(stmt, ast.FunctionDef):
+                yield stmt
+            elif isinstance(stmt, ast.ClassDef):
+                yield from outermost(stmt.body)
+
+    for fn in outermost(module.tree.body):
+        if _contains_make_async_copy(fn):
+            yield fn

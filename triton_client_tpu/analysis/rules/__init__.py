@@ -8,6 +8,7 @@ engine registry (each module calls ``@register`` at import time).
   TPL5xx  telemetry correctness     (rules.telemetry)
   TPL6xx  whole-program concurrency (rules.concurrency)
   TPL7xx  zero-copy / host path     (rules.zerocopy)
+  TPL8xx  Pallas kernel analysis    (rules.pallas)
 
 Adding a family: create ``rules/<name>.py``, subclass ``engine.Rule``
 with a fresh TPLnxx code, decorate with ``@register``, import it here,
@@ -20,6 +21,7 @@ from triton_client_tpu.analysis.rules import (  # noqa: F401
     donation,
     hostsync,
     locks,
+    pallas,
     recompile,
     telemetry,
     zerocopy,

@@ -1,0 +1,540 @@
+"""TPL8xx — Pallas TPU kernel analysis (tiling, VMEM, DMA, fused routes).
+
+PR 16 put ~1.3k LoC of hand-written Pallas kernels on the serving hot
+path, and every planned kernel (int8 MXU paths, ROI-gated recompute)
+rides the same machinery. The failure modes concentrate exactly where
+``interpret=True`` CPU tests cannot see them: interpret mode ignores
+tiling, VMEM capacity and DMA scheduling entirely, so a kernel can be
+bitwise-correct in CI and wrong (or 100x slow, or a Mosaic
+compile error) on real hardware. These rules audit every
+``pl.pallas_call`` site statically, via :mod:`..pallas_model`:
+
+  TPL801  tile alignment — a VMEM block/scratch shape whose trailing
+          dim is not a multiple of 128 lanes (or whose sublane dim is
+          not a multiple of the dtype tile) silently pads to the full
+          native tile: a (1024, 1) int32 block occupies the VMEM of
+          (1024, 128) — 128x waste — and every op on it wastes the
+          same factor of bandwidth.
+  TPL802  VMEM budget — the summed resident bytes (blocks, x2 when
+          grid-pipelined double buffering, + scratch) exceed the
+          per-core VMEM limit (v5e: 16 MiB). Mosaic fails late and
+          cryptically; this fails at review time. Override per call
+          with ``# tpulint: vmem=<bytes>`` on the call or wrapper-def
+          line when a rig's budget genuinely differs.
+  TPL803  grid/block divisibility — a gridded pallas_call whose
+          wrapper shows no size guard (a ``%``-test raise/assert or a
+          round-up helper): any caller can pass a size the grid does
+          not divide and silently drop the remainder rows. The message
+          names the callers (PR 3 callgraph) that can reach it.
+  TPL804  DMA discipline — an async copy family started without a
+          matching ``.wait()`` on every path (flow-sensitive: ``pl.when``
+          bodies and ``if`` arms are conditional), or a textually
+          identical start repeated with no intervening wait (the
+          double-buffer slot-reuse bug: the second start races the
+          first copy's landing).
+  TPL805  fused-route contract — every stage in ``ops/fused.py``'s
+          ``FUSED_STAGES`` must have (a) >= 1 pallas_call under
+          ``jax.named_scope("fused:<stage>")``, (b) parameter-plumbed
+          ``interpret=`` on each such call (the CPU escape hatch),
+          (c) a reachable reference routing test (a ``"<stage>" in ...``
+          membership check outside the kernel modules), and (d) a
+          bitwise parity test naming the stage in
+          ``tests/test_fused_parity.py`` — so no future fusion ships
+          ungated.
+
+Extraction is conservative: dims that don't fold to compile-time ints
+are skipped, never guessed (docs/LINTING.md has the full catalogue).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Iterator
+
+from triton_client_tpu.analysis.engine import (
+    Finding,
+    Module,
+    Package,
+    Rule,
+    call_name,
+    register,
+)
+from triton_client_tpu.analysis.pallas_model import (
+    BlockModel,
+    KernelModel,
+    ScratchModel,
+    dma_events,
+    functions_with_dma,
+    itemsize,
+    sublane_multiple,
+)
+
+_LANES = 128
+#: v5e per-core VMEM (the serving target; see /opt tiling guides and
+#: ops/pallas_nms.vmem_fits, which budgets 12 MiB of the same 16).
+VMEM_LIMIT_BYTES = 16 * 1024 * 1024
+
+#: per-call budget override: ``# tpulint: vmem=<bytes>`` on the
+#: pallas_call's line span or on the wrapper's def line.
+_VMEM_PRAGMA_RE = re.compile(r"#\s*tpulint:\s*vmem=(\d+)")
+
+_GUARD_HELPERS = (
+    "_round_up", "round_up", "kernel_block_rows", "ragged_row_bucket",
+)
+
+
+def _short(name: str) -> str:
+    return name.rsplit(".", 1)[-1] if name else ""
+
+
+def _shape_str(shape) -> str:
+    return "(" + ", ".join("?" if d is None else str(d) for d in shape) + ")"
+
+
+def _vmem_pragma(module: Module, model: KernelModel) -> int | None:
+    lines: list[int] = []
+    call = model.call
+    lines.extend(
+        range(call.lineno, getattr(call, "end_lineno", call.lineno) + 1)
+    )
+    if model.wrapper is not None:
+        lines.append(model.wrapper.lineno)
+    for ln in lines:
+        if 1 <= ln <= len(module.lines):
+            m = _VMEM_PRAGMA_RE.search(module.lines[ln - 1])
+            if m:
+                return int(m.group(1))
+    return None
+
+
+@register
+class TileAlignRule(Rule):
+    code = "TPL801"
+    name = "pallas-tile-misalignment"
+    doc = (
+        "A VMEM block or scratch shape whose trailing dim is not a "
+        "multiple of 128 lanes (or whose sublane dim is not a multiple "
+        "of the dtype tile height) pads to the full native TPU tile in "
+        "VMEM — a (N, 1) column block occupies 128x its logical bytes "
+        "and taxes every access. Lay the data out lane-major (a (1, N) "
+        "row) or pad the trailing dim to 128 explicitly."
+    )
+
+    def check(self, package: Package) -> Iterator[Finding]:
+        for model in package.pallas.models:
+            ctx = _short(model.wrapper_name)
+            for block in model.in_blocks + model.out_blocks:
+                if block.memory_space != "vmem" or block.shape is None:
+                    continue
+                yield from self._check_shape(
+                    model, block.shape, None, block.node,
+                    f"{block.role}_spec BlockSpec", ctx,
+                )
+            for s in model.scratch:
+                if s.kind == "semaphore" or s.shape is None:
+                    continue
+                yield from self._check_shape(
+                    model, s.shape, s.dtype, s.node,
+                    f"{s.kind} VMEM scratch", ctx,
+                )
+
+    def _check_shape(
+        self, model: KernelModel, shape, dtype, node, what: str, ctx: str
+    ) -> Iterator[Finding]:
+        if len(shape) < 2:
+            return
+        last = shape[-1]
+        if last is not None and last % _LANES != 0:
+            yield self.finding(
+                model.module,
+                node,
+                f"{what} {_shape_str(shape)} trailing dim {last} is not a "
+                f"multiple of {_LANES} lanes: it pads to the full native "
+                "tile in VMEM (lay out lane-major or pad to 128)",
+                context=ctx,
+            )
+        subl = sublane_multiple(dtype)
+        second = shape[-2]
+        if second is not None and second > subl and second % subl != 0:
+            yield self.finding(
+                model.module,
+                node,
+                f"{what} {_shape_str(shape)} sublane dim {second} is not a "
+                f"multiple of the {subl}-sublane "
+                f"{dtype or 'float32'} tile height",
+                context=ctx,
+            )
+
+
+@register
+class VmemBudgetRule(Rule):
+    code = "TPL802"
+    name = "pallas-vmem-budget"
+    doc = (
+        "The statically-known resident VMEM working set of a "
+        "pallas_call (block shapes — doubled under a grid pipeline for "
+        "the prefetch buffer — plus scratch and whole-array outputs) "
+        "exceeds the per-core VMEM limit (v5e: 16 MiB). Mosaic only "
+        "fails at compile time on hardware; override a deliberate "
+        "budget with `# tpulint: vmem=<bytes>` on the call line."
+    )
+
+    def check(self, package: Package) -> Iterator[Finding]:
+        for model in package.pallas.models:
+            total, parts = self._estimate(model)
+            if total <= 0:
+                continue
+            limit = _vmem_pragma(model.module, model) or VMEM_LIMIT_BYTES
+            if total > limit:
+                yield self.finding(
+                    model.module,
+                    model.call,
+                    f"estimated resident VMEM {total} bytes "
+                    f"({' + '.join(parts)}) exceeds the "
+                    f"{limit}-byte per-core budget; shrink blocks, spill "
+                    "to HBM/ANY, or annotate `# tpulint: vmem=<bytes>`",
+                    context=_short(model.wrapper_name),
+                )
+
+    @staticmethod
+    def _estimate(model: KernelModel) -> tuple[int, list[str]]:
+        total = 0
+        parts: list[str] = []
+        double = 2 if model.gridded else 1
+
+        def add(shape, dtype, label, buffered) -> None:
+            nonlocal total
+            if shape is None or any(d is None for d in shape):
+                return
+            n = itemsize(dtype)
+            for d in shape:
+                n *= d
+            n *= buffered
+            total += n
+            parts.append(f"{label} {_shape_str(shape)}={n}")
+
+        out_shape_iter = iter(model.out_shapes)
+        for block in model.in_blocks:
+            if block.memory_space != "vmem":
+                continue
+            add(block.shape, None, "in", double if block.shape else 1)
+        for block in model.out_blocks:
+            shape, dtype = block.shape, None
+            if shape is None:
+                # blockless out spec: the whole output is resident
+                shape, dtype = next(out_shape_iter, (None, None))
+            if block.memory_space != "vmem":
+                continue
+            add(shape, dtype, "out", double if block.shape else 1)
+        for s in model.scratch:
+            if s.kind == "semaphore":
+                continue
+            add(s.shape, s.dtype, "scratch", 1)
+        return total, parts
+
+
+@register
+class GridDivisibilityRule(Rule):
+    code = "TPL803"
+    name = "pallas-grid-divisibility"
+    doc = (
+        "A gridded pallas_call whose wrapper shows no input-size guard "
+        "(a %-divisibility raise/assert, or a round-up helper like "
+        "kernel_block_rows/_round_up): a caller passing a size the "
+        "grid does not divide silently drops the remainder rows. The "
+        "finding lists the callers that can reach the wrapper."
+    )
+
+    def check(self, package: Package) -> Iterator[Finding]:
+        for model in package.pallas.models:
+            if not model.gridded or model.wrapper is None:
+                continue
+            if self._has_guard(model.wrapper):
+                continue
+            callers = self._callers(package, model.wrapper_name)
+            via = (
+                " (callers that can reach it: " + ", ".join(callers) + ")"
+                if callers
+                else ""
+            )
+            yield self.finding(
+                model.module,
+                model.call,
+                f"gridded pallas_call with grid "
+                f"{_shape_str(model.grid or ())} but no divisibility "
+                "guard in the wrapper: add a `n % block` raise/assert or "
+                f"round inputs up via {_GUARD_HELPERS[2]}{via}",
+                context=_short(model.wrapper_name),
+            )
+
+    @staticmethod
+    def _has_guard(wrapper: ast.AST) -> bool:
+        for node in ast.walk(wrapper):
+            if isinstance(node, ast.Assert) and any(
+                isinstance(n, ast.BinOp) and isinstance(n.op, ast.Mod)
+                for n in ast.walk(node.test)
+            ):
+                return True
+            if isinstance(node, ast.If):
+                test_has_mod = any(
+                    isinstance(n, ast.BinOp) and isinstance(n.op, ast.Mod)
+                    for n in ast.walk(node.test)
+                )
+                body_raises = any(
+                    isinstance(s, ast.Raise)
+                    for stmt in node.body
+                    for s in ast.walk(stmt)
+                )
+                if test_has_mod and body_raises:
+                    return True
+            if isinstance(node, ast.Call) and _short(
+                call_name(node)
+            ) in _GUARD_HELPERS:
+                return True
+        return False
+
+    @staticmethod
+    def _callers(package: Package, wrapper_name: str) -> list[str]:
+        graph = package.callgraph
+        suffix = "." + wrapper_name
+        targets = {
+            qn for qn in graph.functions if qn.endswith(suffix)
+        }
+        callers = sorted(
+            caller
+            for caller, callees in graph.edges.items()
+            if callees & targets
+        )
+        return [c.split(".")[-1] for c in callers[:6]]
+
+
+@register
+class DmaDisciplineRule(Rule):
+    code = "TPL804"
+    name = "pallas-dma-discipline"
+    doc = (
+        "An async copy (`make_async_copy`) started without a matching "
+        "`.wait()` on every path — a wait under `pl.when`/`if` does not "
+        "cover an unconditional start — or a textually identical start "
+        "repeated with no intervening wait (double-buffer slot reuse "
+        "before the first copy lands). Both are silent under interpret "
+        "mode and data races on hardware."
+    )
+
+    def check(self, package: Package) -> Iterator[Finding]:
+        for module in package.modules:
+            for fn in functions_with_dma(module):
+                yield from self._check_fn(module, fn)
+
+    def _check_fn(self, module: Module, fn: ast.FunctionDef) -> Iterator[Finding]:
+        events = dma_events(fn)
+        families: dict[str, list] = {}
+        for ev in events:
+            families.setdefault(ev.family, []).append(ev)
+        for family, evs in sorted(families.items()):
+            starts = [e for e in evs if e.kind == "start"]
+            waits = [e for e in evs if e.kind == "wait"]
+            if starts and not waits:
+                yield self.finding(
+                    module,
+                    starts[0].node,
+                    f"async copy family `{family}` is started but never "
+                    "waited in this kernel: the DMA may still be in "
+                    "flight when its destination is read (or the kernel "
+                    "exits)",
+                    context=fn.name,
+                )
+                continue
+            if any(not s.conditional for s in starts) and waits and all(
+                w.conditional for w in waits
+            ):
+                yield self.finding(
+                    module,
+                    waits[0].node,
+                    f"async copy family `{family}` has an unconditional "
+                    "start but only conditional waits (`pl.when`/`if`): "
+                    "a path exists where the copy is never waited",
+                    context=fn.name,
+                )
+            # slot reuse: the same construction started twice with no
+            # intervening wait on the family — the second start targets
+            # a buffer the first copy may still be filling
+            last_start_sig: str | None = None
+            for ev in evs:
+                if ev.kind == "wait":
+                    last_start_sig = None
+                elif ev.conditional:
+                    continue
+                elif ev.signature == last_start_sig:
+                    yield self.finding(
+                        module,
+                        ev.node,
+                        f"async copy family `{family}` re-starts the same "
+                        "copy with no intervening wait: double-buffer "
+                        "slot reuse before the first copy lands",
+                        context=fn.name,
+                    )
+                else:
+                    last_start_sig = ev.signature
+
+
+@register
+class FusedContractRule(Rule):
+    code = "TPL805"
+    name = "fused-route-contract"
+    doc = (
+        "Every stage in ops/fused.py's FUSED_STAGES must keep its full "
+        "contract: >= 1 pallas_call under jax.named_scope('fused:<stage>'), "
+        "parameter-plumbed interpret= on each such call (the CPU escape "
+        "hatch), a reference routing membership test ('<stage>' in ...) "
+        "outside the kernel modules, and a bitwise parity test naming "
+        "the stage in tests/test_fused_parity.py. A fusion missing any "
+        "leg ships ungated."
+    )
+
+    PARITY_TEST = os.path.join("tests", "test_fused_parity.py")
+
+    def check(self, package: Package) -> Iterator[Finding]:
+        fused_mod, stages_node, stages = self._stages(package)
+        if fused_mod is None or not stages:
+            return  # no fused-route control plane in this package: inert
+        parity_names = self._parity_stage_names(fused_mod)
+        for stage in stages:
+            scope = f"fused:{stage}"
+            kernels = package.pallas.by_scope(scope)
+            if not kernels:
+                yield self.finding(
+                    fused_mod,
+                    stages_node,
+                    f"fused stage '{stage}' has no pallas_call under "
+                    f"jax.named_scope('{scope}'): the stage resolves but "
+                    "launches nothing",
+                    context=scope,
+                )
+            seen_calls = set()
+            for model in kernels:
+                key = (model.module.relpath, model.call.lineno)
+                if key in seen_calls:
+                    continue
+                seen_calls.add(key)
+                if model.interpret != "plumbed":
+                    how = (
+                        "hard-codes interpret="
+                        if model.interpret == "const"
+                        else "has no interpret= kwarg"
+                    )
+                    yield self.finding(
+                        model.module,
+                        model.call,
+                        f"fused stage '{stage}' pallas_call in "
+                        f"`{_short(model.wrapper_name)}` {how}: the CPU "
+                        "escape hatch must be plumbed from the wrapper so "
+                        "parity tests exercise the same kernel",
+                        context=scope,
+                    )
+            if not self._has_routing(package, stage):
+                yield self.finding(
+                    fused_mod,
+                    stages_node,
+                    f"fused stage '{stage}' has no reference routing "
+                    f"membership test ('{stage}' in ...) outside the "
+                    "kernel modules: there is no reachable reference "
+                    "path to fall back to or compare against",
+                    context=f"fused:{stage}",
+                )
+            if parity_names is None:
+                yield self.finding(
+                    fused_mod,
+                    stages_node,
+                    f"fused stage '{stage}' has no parity coverage: "
+                    f"{self.PARITY_TEST} is missing or unparseable",
+                    context=f"fused:{stage}",
+                )
+            elif stage not in parity_names:
+                yield self.finding(
+                    fused_mod,
+                    stages_node,
+                    f"fused stage '{stage}' is not named in any test in "
+                    f"{self.PARITY_TEST}: the bitwise parity matrix does "
+                    "not cover it",
+                    context=f"fused:{stage}",
+                )
+
+    @staticmethod
+    def _stages(
+        package: Package,
+    ) -> tuple[Module | None, ast.AST | None, tuple[str, ...]]:
+        for module in package.modules:
+            rel = module.relpath.replace(os.sep, "/")
+            if not rel.endswith("ops/fused.py"):
+                continue
+            for stmt in module.tree.body:
+                if (
+                    isinstance(stmt, ast.Assign)
+                    and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and stmt.targets[0].id == "FUSED_STAGES"
+                    and isinstance(stmt.value, (ast.Tuple, ast.List))
+                ):
+                    stages = tuple(
+                        el.value
+                        for el in stmt.value.elts
+                        if isinstance(el, ast.Constant)
+                        and isinstance(el.value, str)
+                    )
+                    return module, stmt, stages
+            return module, module.tree, ()
+        return None, None, ()
+
+    @staticmethod
+    def _is_kernel_module(module: Module) -> bool:
+        rel = module.relpath.replace(os.sep, "/")
+        base = os.path.basename(rel)
+        return base.startswith("pallas_") or rel.endswith("ops/fused.py")
+
+    def _has_routing(self, package: Package, stage: str) -> bool:
+        for module in package.modules:
+            if self._is_kernel_module(module):
+                continue
+            for node in ast.walk(module.tree):
+                if not isinstance(node, ast.Compare):
+                    continue
+                if not any(isinstance(op, (ast.In, ast.NotIn)) for op in node.ops):
+                    continue
+                exprs = [node.left, *node.comparators]
+                for e in list(exprs):
+                    if isinstance(e, (ast.Tuple, ast.List, ast.Set)):
+                        exprs.extend(e.elts)
+                if any(
+                    isinstance(e, ast.Constant) and e.value == stage
+                    for e in exprs
+                ):
+                    return True
+        return False
+
+    def _parity_stage_names(self, fused_mod: Module) -> set[str] | None:
+        """Stage-name string constants inside test_* functions of the
+        repo's parity test file (located relative to ops/fused.py's real
+        path — the tests tree is OUTSIDE the analyzed package)."""
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(fused_mod.path)
+        )))
+        path = os.path.join(pkg_root, self.PARITY_TEST)
+        try:
+            with open(path, encoding="utf-8") as f:
+                tree = ast.parse(f.read(), filename=path)
+        except (OSError, SyntaxError, ValueError):
+            return None
+        names: set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.FunctionDef) and node.name.startswith(
+                "test_"
+            ):
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Constant) and isinstance(
+                        sub.value, str
+                    ):
+                        names.add(sub.value)
+        return names

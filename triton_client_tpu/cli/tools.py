@@ -458,7 +458,8 @@ def lint(argv=None) -> None:
         prog="tpulint",
         description="AST hazard analysis for the JAX serving stack "
         "(TPL1xx recompilation, TPL2xx donation, TPL3xx host-sync, "
-        "TPL4xx locks, TPL5xx telemetry; see docs/LINTING.md)",
+        "TPL4xx locks, TPL5xx telemetry, TPL6xx concurrency, TPL7xx "
+        "zero-copy, TPL8xx Pallas kernels; see docs/LINTING.md)",
     )
     p.add_argument(
         "paths", nargs="*",
@@ -517,6 +518,12 @@ def lint(argv=None) -> None:
         "--no-stale-check", action="store_true",
         help="do not warn about baseline entries nothing matched",
     )
+    p.add_argument(
+        "--stats", action="store_true",
+        help="print a per-rule findings/elapsed-ms table (stderr in "
+        "text mode, summary.stats in --json) — keeps the ci.sh gate's "
+        "cost visible as rule families grow",
+    )
     args = p.parse_args(argv)
 
     import json as _json
@@ -548,12 +555,30 @@ def lint(argv=None) -> None:
         paths = args.paths or [pkg_dir]
     codes = args.rules.split(",") if args.rules else None
     package = analysis.load_package(paths, jobs=max(1, args.jobs))
-    findings = analysis.run_rules(package, codes=codes)
+    rule_stats: dict = {}
+    findings = analysis.run_rules(
+        package, codes=codes, stats=rule_stats if args.stats else None
+    )
     if args.changed:
         changed = {
             os.path.relpath(os.path.abspath(p)) for p in args.paths
         }
-        findings = [f for f in findings if f.path in changed]
+        # the TPL805 fused-route contract spans kernel modules, the
+        # routing pipelines, ops/fused.py AND the parity test file —
+        # its findings anchor in ops/fused.py, so a plain path filter
+        # would hide them exactly when a contract participant changed.
+        # Keep them whenever any changed file is a participant.
+        contract_changed = any(
+            os.path.basename(c).startswith("pallas_")
+            or c.replace(os.sep, "/").endswith("ops/fused.py")
+            or c.replace(os.sep, "/").endswith("tests/test_fused_parity.py")
+            for c in changed
+        )
+        findings = [
+            f for f in findings
+            if f.path in changed
+            or (contract_changed and f.code == "TPL805")
+        ]
 
     if args.write_baseline:
         prior = None
@@ -614,12 +639,32 @@ def lint(argv=None) -> None:
                 fh.write(body + "\n")
             print(f"tpulint: SARIF -> {args.sarif}", file=sys.stderr)
 
+    if args.stats and not args.json:
+        # pre-baseline counts: the rule's raw cost, not its residual
+        hdr = f"{'rule':<8} {'findings':>8} {'elapsed_ms':>11}"
+        print(hdr, file=sys.stderr)
+        print("-" * len(hdr), file=sys.stderr)
+        for code in sorted(rule_stats):
+            row = rule_stats[code]
+            print(
+                f"{code:<8} {row['findings']:>8} {row['elapsed_ms']:>11.1f}",
+                file=sys.stderr,
+            )
+        total_ms = sum(r["elapsed_ms"] for r in rule_stats.values())
+        print(
+            f"{'total':<8} {sum(r['findings'] for r in rule_stats.values()):>8} "
+            f"{total_ms:>11.1f}",
+            file=sys.stderr,
+        )
+
     if args.json:
         doc = _json.loads(
             analysis.render_json(
                 findings, suppressed=len(suppressed), errors=problems
             )
         )
+        if args.stats:
+            doc["summary"]["stats"] = rule_stats
         print(_json.dumps(doc, indent=2, sort_keys=True))
     else:
         analysis.render_text(findings)

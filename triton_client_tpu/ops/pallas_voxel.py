@@ -75,23 +75,26 @@ def pipeline_mode() -> str:
     return mode if mode in ("grid", "manual") else "grid"
 
 
-def _accum_block(out_ref, valsT, slots_col, base, *, window):
+def _accum_block(out_ref, valsT, slots_row, base, *, window):
     """Shared reduce step: one (8, block) values block x its one-hot
     slot selector into the VMEM accumulator's 128-aligned window.
-    ``slots_col``: (block, 1) int32 sorted slots; ``base``: scalar
-    128-aligned window start. Slots outside the window (the dump slot
-    of a mixed real/pad block) compare false everywhere and vanish —
-    their value rows are pre-zeroed by the validity weight anyway."""
-    block = slots_col.shape[0]
-    local = slots_col - base
-    col = jax.lax.broadcasted_iota(jnp.int32, (block, window), 1)
-    onehot = (col == local).astype(jnp.float32)  # (block, window)
+    ``slots_row``: (1, block) int32 sorted slots — lane-major, so the
+    block tiles VMEM exactly (a (block, 1) column would pad 128x,
+    TPL801); ``base``: scalar 128-aligned window start. Slots outside
+    the window (the dump slot of a mixed real/pad block) compare false
+    everywhere and vanish — their value rows are pre-zeroed by the
+    validity weight anyway."""
+    block = slots_row.shape[1]
+    local = slots_row - base
+    col = jax.lax.broadcasted_iota(jnp.int32, (window, block), 0)
+    onehotT = (col == local).astype(jnp.float32)  # (window, block)
     contrib = jax.lax.dot_general(
         valsT,
-        onehot,
-        (((1,), (0,)), ((), ())),
+        onehotT,
+        (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
-    )  # (8, window)
+    )  # (8, window): same contraction over the block dim as the old
+    # (block, window) one-hot, elementwise-identical operands — bitwise
     cur = out_ref[:, pl.ds(base, window)]
     out_ref[:, pl.ds(base, window)] = cur + contrib
 
@@ -143,7 +146,7 @@ def _segment_mean_manual_kernel(
                     vsem.at[slot],
                 ),
                 pltpu.make_async_copy(
-                    slots_hbm.at[pl.ds(bi * block, block), :],
+                    slots_hbm.at[:, pl.ds(bi * block, block)],
                     slots_vmem.at[slot],
                     ssem.at[slot],
                 ),
@@ -179,7 +182,7 @@ def _segment_mean_manual_kernel(
     pl.run_scoped(
         body,
         vals_vmem=pltpu.VMEM((2, _SUBLANES, block), jnp.float32),
-        slots_vmem=pltpu.VMEM((2, block, 1), jnp.int32),
+        slots_vmem=pltpu.VMEM((2, 1, block), jnp.int32),
         vsem=pltpu.SemaphoreType.DMA((2,)),
         ssem=pltpu.SemaphoreType.DMA((2,)),
     )
@@ -214,7 +217,7 @@ def sorted_segment_mean_pallas(
     # 128-aligned window base per block, from each block's first (lowest)
     # slot — scalar-prefetched so both kernel forms read it from SMEM.
     bases = (slots[::POINT_BLOCK] // _LANES) * _LANES
-    slots_col = slots.reshape(n, 1)
+    slots_row = slots.reshape(1, n)
 
     if pipeline == "manual":
         kernel = functools.partial(
@@ -245,7 +248,7 @@ def sorted_segment_mean_pallas(
             grid=(n_blocks,),
             in_specs=[
                 pl.BlockSpec((_SUBLANES, POINT_BLOCK), lambda i, bases: (0, i)),
-                pl.BlockSpec((POINT_BLOCK, 1), lambda i, bases: (i, 0)),
+                pl.BlockSpec((1, POINT_BLOCK), lambda i, bases: (0, i)),
             ],
             out_specs=pl.BlockSpec((_SUBLANES, v_out), lambda i, bases: (0, 0)),
         )
@@ -255,7 +258,7 @@ def sorted_segment_mean_pallas(
             grid_spec=grid_spec,
             out_shape=jax.ShapeDtypeStruct((_SUBLANES, v_out), jnp.float32),
             interpret=interpret,
-        )(bases.astype(jnp.int32), valsT, slots_col)
+        )(bases.astype(jnp.int32), valsT, slots_row)
 
 
 def fused_mean_volume(
