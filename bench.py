@@ -1382,6 +1382,167 @@ def _serve_quality_plane_row(duration_s: float) -> dict:
         server.stop()
 
 
+def _serve_temporal_reuse_row(duration_s: float) -> dict:
+    """ISSUE 19 temporal compute reuse: the same synthetic stream set
+    replayed twice against an in-process server with device-resident
+    tracking — reuse OFF (full detector every frame) then reuse ON
+    (adaptive keyframe scheduling, static scene so K opens wide and
+    coast dominates). The echo detector carries a fixed simulated
+    device cost so the per-stream device-seconds ledger (the PR 11
+    scoreboard) has something to save; the row's ``value`` is
+    streams-per-chip at the replay fps with reuse on, and
+    ``temporal_speedup`` (streams-per-chip on / off) is gated by
+    perf/bench_diff.py. ID switches ride along so a cheaper schedule
+    that costs identity stability shows up in the diff."""
+    from triton_client_tpu.channel.tpu_channel import TPUChannel
+    from triton_client_tpu.config import ModelSpec, TensorSpec
+    from triton_client_tpu.ops.tracking import TrackerConfig
+    from triton_client_tpu.runtime.repository import ModelRepository
+    from triton_client_tpu.runtime.server import InferenceServer
+    from triton_client_tpu.runtime.sessions import SessionManager
+    from triton_client_tpu.runtime.temporal import (
+        TemporalReuseConfig,
+        TemporalReusePlane,
+    )
+    from triton_client_tpu.utils.loadgen import run_streams, synthetic_stream
+
+    n_streams, fps, det_dim = 6, 10.0, 11
+    detector_iters = 60  # 128x128 matmul chain: the simulated det cost
+    n_frames = max(20, int(duration_s * 10))
+
+    def _window(reuse: bool) -> dict:
+        import jax.numpy as jnp
+
+        spec = ModelSpec(
+            name="tr_det",
+            version="1",
+            platform="jax",
+            inputs=(
+                TensorSpec("detections", (-1, det_dim), "FP32"),
+                TensorSpec("valid", (-1,), "BOOL"),
+            ),
+            outputs=(
+                TensorSpec("detections", (-1, det_dim), "FP32"),
+                TensorSpec("valid", (-1,), "BOOL"),
+            ),
+        )
+        repo = ModelRepository()
+
+        def _det_fn(inputs):
+            return {
+                "detections": inputs["detections"],
+                "valid": inputs["valid"],
+            }
+
+        # the simulated detector cost must be real async-dispatched
+        # device work (a jitted device_fn): the ledger's scoreboard
+        # window is launch -> execution-ready, so a host sleep would
+        # run before dispatch and charge the stream tenant nothing
+        eye = jnp.eye(128, dtype=jnp.float32)
+
+        def _det_device_fn(inputs):
+            det = inputs["detections"]
+            v = jnp.broadcast_to(det.reshape(-1)[:1], (128, 128)) + eye
+            for _ in range(detector_iters):
+                v = v @ eye
+            return {
+                "detections": det + v[0, 0] * jnp.float32(1e-30),
+                "valid": inputs["valid"],
+            }
+
+        repo.register(spec, _det_fn, device_fn=_det_device_fn)
+        chan = TPUChannel(repo)
+        manager = SessionManager(
+            max_sessions=n_streams * 2, ttl_s=300.0,
+            tracker=TrackerConfig(max_tracks=32),
+        )
+        chan.attach_sessions(manager)
+        temporal = None
+        if reuse:
+            temporal = TemporalReusePlane(
+                manager,
+                config=TemporalReuseConfig(mode="auto", k_max=8),
+                channel=chan,
+            )
+        # metrics on: the DeviceTimeLedger (the row's scoreboard) only
+        # exists on the telemetry plane
+        server = InferenceServer(
+            repo, chan, address="127.0.0.1:0", uds_address="auto",
+            max_workers=n_streams + 2, temporal=temporal,
+            metrics_port="auto",
+        )
+        server.start()
+        try:
+            run_streams(  # compile tracker step + coast outside window
+                server.uds_address, spec.name, n_streams=1,
+                source=lambda i: synthetic_stream(
+                    n_frames=6, fps=100.0, dynamics="static"
+                ),
+                deadline_s=60.0, stream_id_prefix="warm", realtime=False,
+            )
+            res = run_streams(
+                server.uds_address, spec.name, n_streams=n_streams,
+                source=lambda i: synthetic_stream(
+                    n_frames=n_frames, fps=fps, n_objects=4, seed=i,
+                    dynamics="static",
+                ),
+                deadline_s=duration_s + 120.0, realtime=False,
+            )
+            dev_s = 0.0
+            if server.device_time is not None:
+                dev_s = sum(
+                    v
+                    for k, v in server.device_time.device_seconds().items()
+                    if "|stream:stream-" in k
+                )
+            summary = res.summary()
+            frames = max(1, res.frames_ok)
+            dev_per_frame = dev_s / frames
+            # fixed-SLO capacity framing: one chip has 1 device-second
+            # per wall second; a stream at `fps` consumes
+            # dev_per_frame * fps of it
+            spc = (
+                1.0 / (dev_per_frame * fps) if dev_per_frame > 0 else 0.0
+            )
+            return {
+                "streams_per_chip": spc,
+                "device_seconds": dev_s,
+                "frames_ok": res.frames_ok,
+                "frames_coasted": summary["frames_coasted"],
+                "id_switches": summary["id_switches"],
+                "fragmentation": summary["fragmentation"],
+                "coast_track_drops": summary["coast_track_drops"],
+            }
+        finally:
+            server.stop()
+
+    off = _window(reuse=False)
+    on = _window(reuse=True)
+    speedup = on["streams_per_chip"] / max(off["streams_per_chip"], 1e-9)
+    row = {
+        "metric": "temporal_reuse",
+        "value": round(on["streams_per_chip"], 2),
+        "unit": "streams/chip",
+        "streams": n_streams,
+        "replay_fps": fps,
+        "detector_iters": detector_iters,
+        "streams_per_chip_off": round(off["streams_per_chip"], 2),
+        "streams_per_chip_on": round(on["streams_per_chip"], 2),
+        "temporal_speedup": round(speedup, 3),
+        "device_seconds_off": round(off["device_seconds"], 4),
+        "device_seconds_on": round(on["device_seconds"], 4),
+        "frames_coasted": on["frames_coasted"],
+        "id_switches_off": off["id_switches"],
+        "id_switches_on": on["id_switches"],
+        "id_switch_delta": on["id_switches"] - off["id_switches"],
+        "coast_track_drops": on["coast_track_drops"],
+        "precision": "f32",
+    }
+    if on["frames_ok"] == 0 or off["frames_ok"] == 0:
+        row["degraded"] = "a replay window completed no frames"
+    return row
+
+
 def _serve_multitenant_row(duration_s: float) -> dict:
     """ISSUE 9 multi-tenant lifecycle under pressure: five synthetic
     models (distinct multipliers, synthetic 100-byte HBM costs) over a
@@ -1956,6 +2117,23 @@ def main() -> None:
         else:
             print(
                 f"quality plane row skipped: {_remaining():.0f}s left",
+                file=sys.stderr,
+            )
+        # temporal-reuse row (ISSUE 19): two synthetic replay windows
+        # (reuse off/on) on an echo detector with a simulated device
+        # cost — the streams-per-chip scoreboard off the ledger
+        if _remaining() > 40.0:
+            try:
+                row = _serve_temporal_reuse_row(
+                    duration_s=min(8.0, max(4.0, _remaining() - 30.0))
+                )
+                _emit_row(row, primary=False)
+                _write_local()
+            except Exception as e:
+                print(f"temporal reuse bench failed: {e}", file=sys.stderr)
+        else:
+            print(
+                f"temporal reuse row skipped: {_remaining():.0f}s left",
                 file=sys.stderr,
             )
     else:

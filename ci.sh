@@ -180,6 +180,20 @@ python -m pytest tests/test_quality_plane.py -q -m '' \
     --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly
 
+echo "== temporal-reuse shard (keyframe scheduling, coast, ROI tiles) =="
+# the temporal compute-reuse contract (runtime/temporal.py,
+# ops/tracking.py coast, drivers/multicam.py suppression): coast-step
+# device/NumPy parity, tile extract/pack/merge round trips at
+# full-frame coordinates, forced-K cadence, innovation-driven K
+# adaptation, the seeded temporal_overskip fault caught by the
+# ID-churn auto-disable, quality-plane gating, and cross-camera
+# suppression — plus the slow-marked >=3x streams-per-chip acceptance
+# drive on the per-stream device-seconds ledger tier-1 deselects.
+python -m pytest tests/test_temporal_reuse.py tests/test_multicam.py \
+    -q -m '' \
+    --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+
 echo "== bench diff (optional shard: fresh bench vs BENCH_LOCAL.json) =="
 # perf-regression gate: compares a freshly produced bench results file
 # (BENCH_FRESH=<results.json>, written by a perf/ script on real

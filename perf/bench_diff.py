@@ -128,6 +128,11 @@ def diff_rows(
             # sampler started taxing the primary path (the >10%
             # threshold is the sidecar-tax gate from ISSUE 17)
             ("quality_overhead_headroom", "quality_overhead_headroom"),
+            # temporal-reuse row: streams-per-chip(reuse on) /
+            # streams-per-chip(reuse off) off the per-stream
+            # device-seconds ledger — a drop means coast/partial
+            # scheduling stopped saving detector work (ISSUE 19)
+            ("temporal_speedup", "temporal_speedup"),
         ):
             f_v, b_v = f_row.get(key), b_row.get(key)
             if f_v is None or b_v is None or not b_v:

@@ -5,7 +5,7 @@ import pytest
 
 jax = pytest.importorskip("jax")
 
-from triton_client_tpu.drivers.multicam import MultiCameraDriver
+from triton_client_tpu.drivers.multicam import MultiCameraDriver, OverlapRegion
 
 
 class _Frames:
@@ -179,3 +179,140 @@ class TestOnStreamEnd:
         with pytest.raises(ValueError, match="on_stream_end"):
             MultiCameraDriver(self._infer, [_Frames([1])],
                               on_stream_end="pause")
+
+
+class TestCrossCameraSuppression:
+    """ISSUE 19 tentpole (c): overlap-declared views whose tracked
+    objects are all covered by an already-processed peer skip the
+    detector entirely for that tick."""
+
+    def _tracking_infer(self, track_xy=(5.0, 5.0), n_valid=1):
+        """Echo-style infer: per-camera mean + a constant track table
+        (every camera reports ``n_valid`` tracks at ``track_xy``)."""
+
+        def infer(inputs):
+            c = inputs["images"].shape[0]
+            tracks = np.zeros((c, 2, 4), np.float32)
+            tracks[:, :, 0:2] = track_xy
+            valid = np.zeros((c, 2), bool)
+            valid[:, :n_valid] = True
+            return {
+                "mean": inputs["images"].mean(axis=(1, 2, 3)),
+                "tracks": tracks,
+                "tracks_valid": valid,
+            }
+
+        return infer
+
+    def test_covered_view_skipped_with_streak_cap(self):
+        # cam1's whole view overlaps cam0; its only track sits inside.
+        # Tick 0 processes both (no track context yet); then cam1 is
+        # suppressed until the streak cap forces a confirmation pass.
+        sinked = []
+        driver = MultiCameraDriver(
+            self._tracking_infer(track_xy=(5.0, 5.0)),
+            [_Frames([1] * 6), _Frames([10] * 6)],
+            sink=lambda ci, f, r: sinked.append(ci),
+            warmup=0,
+            suppression=[OverlapRegion(1, 0, (0.0, 0.0, 100.0, 100.0))],
+            max_consecutive_suppress=2,
+        )
+        stats = driver.run()
+        assert stats.ticks == 6
+        # t0 both; t1,t2 cam1 skipped; t3 forced; t4,t5 skipped
+        assert sinked == [0, 1, 0, 0, 0, 1, 0, 0]
+        assert driver.suppressed_views == 4
+        assert stats.suppressed == 4
+        assert stats.frames == 8  # skipped views cost no detector work
+
+    def test_track_outside_overlap_is_never_suppressed(self):
+        sinked = []
+        driver = MultiCameraDriver(
+            self._tracking_infer(track_xy=(50.0, 50.0)),
+            [_Frames([1, 2]), _Frames([10, 20])],
+            sink=lambda ci, f, r: sinked.append(ci),
+            warmup=0,
+            suppression=[OverlapRegion(1, 0, (0.0, 0.0, 10.0, 10.0))],
+        )
+        stats = driver.run()
+        assert driver.suppressed_views == 0
+        assert sinked == [0, 1, 0, 1]
+        assert stats.frames == 4
+
+    def test_empty_view_is_never_suppressed(self):
+        # nothing tracked: a new object could be entering the view, so
+        # full coverage alone must not skip it
+        driver = MultiCameraDriver(
+            self._tracking_infer(n_valid=0),
+            [_Frames([1, 2, 3]), _Frames([10, 20, 30])],
+            warmup=0,
+            suppression=[OverlapRegion(1, 0, (0.0, 0.0, 100.0, 100.0))],
+        )
+        stats = driver.run()
+        assert driver.suppressed_views == 0
+        assert stats.frames == 6
+
+    def test_absent_peer_cannot_cover(self):
+        # cam0 dries up after one tick (drop policy); cam1's overlap
+        # peer is no longer in the batch, so suppression must stop
+        sinked = []
+        driver = MultiCameraDriver(
+            self._tracking_infer(track_xy=(5.0, 5.0)),
+            [_Frames([1]), _Frames([10, 20, 30])],
+            sink=lambda ci, f, r: sinked.append(ci),
+            warmup=0,
+            on_stream_end="drop",
+            suppression=[OverlapRegion(1, 0, (0.0, 0.0, 100.0, 100.0))],
+        )
+        stats = driver.run()
+        assert driver.suppressed_views == 0
+        assert sinked == [0, 1, 1, 1]
+        assert stats.frames == 4
+
+    def test_mutual_overlap_resolves_to_lower_index(self):
+        # both views fully cover each other: the tick must never drop
+        # both — the lower camera index is processed and covers the peer
+        sinked = []
+        driver = MultiCameraDriver(
+            self._tracking_infer(track_xy=(5.0, 5.0)),
+            [_Frames([1] * 4), _Frames([10] * 4)],
+            sink=lambda ci, f, r: sinked.append(ci),
+            warmup=0,
+            suppression=[
+                OverlapRegion(0, 1, (0.0, 0.0, 100.0, 100.0)),
+                OverlapRegion(1, 0, (0.0, 0.0, 100.0, 100.0)),
+            ],
+            max_consecutive_suppress=10,
+        )
+        stats = driver.run()
+        assert stats.ticks == 4
+        # cam0 present every tick; cam1 suppressed after tick 0
+        assert sinked == [0, 1, 0, 0, 0]
+        assert driver.suppressed_views == 3
+
+    def test_suppression_counter_reaches_temporal_plane(self):
+        from triton_client_tpu.runtime.temporal import TemporalReusePlane
+
+        plane = TemporalReusePlane(sessions=None)
+        driver = MultiCameraDriver(
+            self._tracking_infer(track_xy=(5.0, 5.0)),
+            [_Frames([1] * 3), _Frames([10] * 3)],
+            warmup=0,
+            suppression=[OverlapRegion(1, 0, (0.0, 0.0, 100.0, 100.0))],
+            temporal=plane,
+        )
+        driver.run()
+        assert plane.stats()["suppressed_views_total"] == \
+            driver.suppressed_views == 2
+
+    def test_overlap_validation(self):
+        with pytest.raises(ValueError, match="overlap itself"):
+            OverlapRegion(0, 0, (0.0, 0.0, 1.0, 1.0))
+        with pytest.raises(ValueError, match="degenerate"):
+            OverlapRegion(0, 1, (5.0, 0.0, 5.0, 1.0))
+        with pytest.raises(ValueError, match="outside"):
+            MultiCameraDriver(
+                self._tracking_infer(),
+                [_Frames([1]), _Frames([2])],
+                suppression=[OverlapRegion(1, 2, (0.0, 0.0, 1.0, 1.0))],
+            )

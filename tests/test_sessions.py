@@ -209,6 +209,25 @@ class TestSessionPool:
             m.advance(_req("a", start=True), bad)
         assert m.stats()["inflight_frames"] == 0
 
+    def test_2d_rows_narrow_velocity_window(self):
+        # regression: the DEFAULT config carries CenterPoint's
+        # velocity_cols=(7, 9); a 2D detector's 6-column rows must
+        # narrow it to None instead of slicing a width-0 z_vel
+        # (IndexError) — the live yolov5 sessions+temporal path
+        m = SessionManager(max_sessions=4)  # default TrackerConfig
+        det = np.zeros((4, 6), np.float32)
+        det[0] = (10.0, 12.0, 20.0, 22.0, 0.9, 1.0)
+        valid = np.array([True, False, False, False])
+        out = m.advance(
+            _req("v2d", start=True), {"detections": det, "valid": valid}
+        )
+        m.release("v2d")
+        assert int(np.asarray(out["det_track_ids"])[0]) > 0
+        coasted = m.coast(_req("v2d"))
+        m.release("v2d")
+        assert coasted is not None
+        assert np.asarray(coasted["tracks"]).shape[-1] == 6
+
     def test_model_without_detections_passes_through(self):
         m = _manager()
         out = m.advance(_req("a", start=True), {"y": np.zeros(3)})

@@ -1,0 +1,1047 @@
+"""Temporal compute reuse (ISSUE 19): coast-path parity, ROI tile
+geometry round-trips, the adaptive keyframe scheduler, the per-stream
+ID-churn safety gate, and the end-to-end serving drives.
+
+The serving model in every end-to-end test is an ECHO detector (device
+fn returns the request's detections/valid unchanged), so the tracker's
+inputs are exactly what the replayer scripted and the reuse schedule is
+the only variable under test.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from triton_client_tpu.channel.base import InferRequest  # noqa: E402
+from triton_client_tpu.ops import tracking  # noqa: E402
+from triton_client_tpu.ops.tracking import TrackerConfig  # noqa: E402
+from triton_client_tpu.runtime import faults  # noqa: E402
+from triton_client_tpu.runtime import temporal  # noqa: E402
+from triton_client_tpu.runtime.sessions import SessionManager  # noqa: E402
+from triton_client_tpu.runtime.temporal import (  # noqa: E402
+    TemporalReuseConfig,
+    TemporalReusePlane,
+    extract_tiles,
+    merge_tile_detections,
+    pack_tile_sets,
+    select_tiles,
+    split_tile_sets,
+    tile_diff,
+    tile_grid,
+    tiles_covering,
+)
+
+DET_DIM = 11
+
+
+@pytest.fixture(autouse=True)
+def _no_fault_plan():
+    prev = faults.install_fault_plan(None)
+    yield
+    faults.install_fault_plan(prev)
+
+
+def _detections(rows, n_slots=6, det_dim=DET_DIM):
+    det = np.zeros((n_slots, det_dim), np.float32)
+    valid = np.zeros((n_slots,), bool)
+    for i, (x, y) in enumerate(rows):
+        det[i, 0], det[i, 1] = x, y
+        det[i, 3:6] = (4.0, 2.0, 1.5)
+        det[i, -2] = 0.9
+        valid[i] = True
+    return det, valid
+
+
+def _seeded_state(cfg, n_steps=3, seed=0):
+    """Tracker state warmed by ``n_steps`` reference steps of two
+    constant-velocity movers."""
+    rng = np.random.default_rng(seed)
+    state = tracking.init_state(cfg, DET_DIM)
+    for k in range(n_steps):
+        det, valid = _detections(
+            [(10.0 + k, 5.0), (30.0 - 2 * k, 40.0 + k)]
+        )
+        det[:, 0:2] += rng.normal(0, 0.01, det[:, 0:2].shape).astype(
+            np.float32
+        )
+        state, _ = tracking.reference_step(cfg, state, det, valid)
+    return state
+
+
+# -- coast step parity ---------------------------------------------------------
+
+
+class TestCoastParity:
+    def test_coast_bitwise_matches_reference(self):
+        cfg = TrackerConfig(max_tracks=8)
+        state = _seeded_state(cfg)
+        ref_state, ref_out = tracking.reference_coast(cfg, state)
+        dev_state, dev_out = tracking.make_coast_step(cfg)(
+            {k: jax.numpy.asarray(v) for k, v in state.items()}
+        )
+        for key in state:
+            np.testing.assert_array_equal(
+                np.asarray(dev_state[key]), ref_state[key], err_msg=key
+            )
+        assert set(dev_out) == set(tracking.COAST_OUTPUT_KEYS)
+        for key in tracking.COAST_OUTPUT_KEYS:
+            np.testing.assert_array_equal(
+                np.asarray(dev_out[key]), ref_out[key], err_msg=key
+            )
+
+    def test_coast_preserves_ids_ages_and_counters(self):
+        cfg = TrackerConfig(max_tracks=8)
+        state = _seeded_state(cfg)
+        new_state, out = tracking.reference_coast(cfg, state)
+        for key in ("tid", "age", "hits", "next_id", "births", "deaths"):
+            np.testing.assert_array_equal(new_state[key], state[key], key)
+        assert int(new_state["frame"]) == int(state["frame"]) + 1
+        live = np.asarray(out["tracks_valid"])
+        assert live.sum() == 2  # both movers still reported
+
+    def test_coast_advances_positions_by_velocity(self):
+        cfg = TrackerConfig(max_tracks=8)
+        state = _seeded_state(cfg, n_steps=6)
+        new_state, out = tracking.reference_coast(cfg, state)
+        live = state["tid"] > 0
+        expect = state["mean"][live, 0:2] + state["mean"][live, 2:4]
+        np.testing.assert_allclose(
+            np.asarray(out["tracks"])[live, 0:2], expect, atol=1e-5
+        )
+
+    def test_group_coast_is_vmapped_single_coast(self):
+        cfg = TrackerConfig(max_tracks=8)
+        s0 = _seeded_state(cfg, seed=1)
+        s1 = _seeded_state(cfg, seed=2)
+        group = {
+            k: jax.numpy.stack([jax.numpy.asarray(s0[k]),
+                                jax.numpy.asarray(s1[k])])
+            for k in s0
+        }
+        g_state, g_out = tracking.make_group_coast(cfg)(group)
+        for i, s in enumerate((s0, s1)):
+            ref_state, ref_out = tracking.reference_coast(cfg, s)
+            for key in s:
+                np.testing.assert_array_equal(
+                    np.asarray(g_state[key])[i], ref_state[key],
+                    err_msg=f"cam{i}.{key}",
+                )
+            for key in tracking.COAST_OUTPUT_KEYS:
+                np.testing.assert_array_equal(
+                    np.asarray(g_out[key])[i], ref_out[key],
+                    err_msg=f"cam{i}.{key}",
+                )
+
+    def test_full_step_outputs_innovation(self):
+        cfg = TrackerConfig(max_tracks=8)
+        state = tracking.init_state(cfg, DET_DIM)
+        det, valid = _detections([(10.0, 5.0), (30.0, 40.0)])
+        state, out = tracking.reference_step(cfg, state, det, valid)
+        assert "innovation" in out
+        first = float(out["innovation"])
+        assert first > 0  # newborns charge the full gate
+        # perfectly predicted frame: innovation collapses
+        det2 = det.copy()
+        det2[:, 0:2] = np.asarray(state["mean"][:6, 0:2])
+        _, out2 = tracking.reference_step(cfg, state, det2, valid)
+        assert float(out2["innovation"]) < first
+
+    def test_innovation_rides_device_step_bitwise(self):
+        cfg = TrackerConfig(max_tracks=8)
+        state = _seeded_state(cfg)
+        det, valid = _detections([(13.5, 5.0), (24.0, 43.0)])
+        _, ref_out = tracking.reference_step(cfg, state, det, valid)
+        _, dev_out = tracking.make_step(cfg)(
+            {k: jax.numpy.asarray(v) for k, v in state.items()},
+            jax.numpy.asarray(det), jax.numpy.asarray(valid),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(dev_out["innovation"]), ref_out["innovation"]
+        )
+
+
+# -- tile geometry -------------------------------------------------------------
+
+
+class TestTileGeometry:
+    @pytest.mark.parametrize("hw,tile", [
+        ((16, 16), 8), ((17, 23), 8), ((9, 9), 4), ((32, 48), 16),
+    ])
+    def test_diff_flags_only_changed_tiles(self, hw, tile):
+        h, w = hw
+        rng = np.random.default_rng(0)
+        prev = rng.uniform(0, 1, (h, w, 3)).astype(np.float32)
+        cur = prev.copy()
+        cur[0:min(tile, h), 0:min(tile, w)] += 1.0  # change tile 0 only
+        stat = tile_diff(prev, cur, tile)
+        gy, gx = tile_grid(h, w, tile)
+        assert stat.shape == (gy * gx,)
+        assert stat[0] > 0.05
+        np.testing.assert_allclose(stat[1:], 0.0, atol=1e-6)
+
+    def test_diff_rejects_shape_change(self):
+        with pytest.raises(ValueError, match="shape changed"):
+            tile_diff(np.zeros((8, 8)), np.zeros((8, 9)), 4)
+
+    def test_tiles_covering_marks_center_tiles(self):
+        cover = tiles_covering(
+            np.asarray([[1.0, 1.0], [12.0, 9.0]]), 16, 16, 8
+        )
+        gy, gx = tile_grid(16, 16, 8)
+        expect = np.zeros(gy * gx, bool)
+        expect[0] = True   # (1, 1) -> tile (0, 0)
+        expect[gx + 1] = True  # (12, 9) -> tile (1, 1)
+        np.testing.assert_array_equal(cover, expect)
+
+    def test_select_tiles_unions_diff_and_cover(self):
+        stat = np.asarray([0.5, 0.0, 0.0, 0.0], np.float32)
+        cover = np.asarray([False, False, True, False])
+        np.testing.assert_array_equal(
+            select_tiles(stat, 0.1, cover), [0, 2]
+        )
+
+    @pytest.mark.parametrize("hw,tile,ch", [
+        ((16, 16), 8, 3), ((17, 23), 8, 3), ((9, 9), 4, 1), ((8, 8), 8, 3),
+    ])
+    def test_extract_rows_invert_to_pixels(self, hw, tile, ch):
+        h, w = hw
+        rng = np.random.default_rng(1)
+        img = rng.uniform(0, 1, (h, w, ch)).astype(np.float32)
+        gy, gx = tile_grid(h, w, tile)
+        all_ids = np.arange(gy * gx, dtype=np.int32)
+        rows, origins = extract_tiles(img, all_ids, tile)
+        assert rows.shape == (gy * gx, tile * tile * ch)
+        for tid in all_ids:
+            x0, y0 = int(origins[tid, 0]), int(origins[tid, 1])
+            patch = np.zeros((tile, tile, ch), np.float32)
+            src = img[y0:y0 + tile, x0:x0 + tile]
+            patch[: src.shape[0], : src.shape[1]] = src
+            np.testing.assert_array_equal(
+                rows[tid].reshape(tile, tile, ch), patch,
+                err_msg=f"tile {tid}",
+            )
+
+    @pytest.mark.parametrize("sizes", [
+        (3, 1, 5), (0, 4, 2), (7,), (0, 0, 1),
+    ])
+    def test_pack_split_round_trip(self, sizes):
+        rng = np.random.default_rng(2)
+        parts = [
+            rng.uniform(0, 1, (n, 12)).astype(np.float32) for n in sizes
+        ]
+        layout, packed = pack_tile_sets(parts)
+        assert packed.shape[0] == layout.padded_rows
+        back = split_tile_sets(packed, layout)
+        assert len(back) == len(parts)
+        for a, b in zip(parts, back):
+            np.testing.assert_array_equal(a, b)
+
+    def test_merge_restores_full_frame_coordinates(self):
+        # two tiles at origins (8, 0) and (0, 16); detections local
+        origins = np.asarray([[8.0, 0.0], [0.0, 16.0]], np.float32)
+        dets = np.asarray(
+            [[1.0, 2.0, 0.5, 9.0], [3.0, 4.0, 0.5, 9.0],
+             [5.0, 6.0, 0.5, 9.0]],
+            np.float32,
+        )
+        det_tile = np.asarray([0, 1, 1])
+        valid = np.asarray([True, True, False])
+        out = merge_tile_detections(dets, det_tile, valid, origins)
+        np.testing.assert_allclose(
+            out[:, 0:2], [[9.0, 2.0], [3.0, 20.0]]
+        )
+        # non-coordinate columns untouched
+        np.testing.assert_allclose(out[:, 2:], [[0.5, 9.0], [0.5, 9.0]])
+
+    def test_merge_empty_and_all_invalid(self):
+        origins = np.zeros((1, 2), np.float32)
+        out = merge_tile_detections(
+            np.zeros((0, 4), np.float32), np.zeros((0,)), np.zeros((0,), bool),
+            origins,
+        )
+        assert out.shape == (0, 4)
+        out = merge_tile_detections(
+            np.ones((2, 4), np.float32), [0, 0], [False, False], origins
+        )
+        assert out.shape == (0, 4)
+
+    def test_extract_pack_merge_full_round_trip_across_streams(self):
+        """The serving composition: per-stream tile sets packed into one
+        ragged batch, per-tile results split back, merged to full-frame
+        coordinates — every stream independently exact."""
+        rng = np.random.default_rng(3)
+        tile = 8
+        streams = []
+        for hw in ((16, 24), (32, 32), (24, 16)):
+            img = rng.uniform(0, 1, (*hw, 3)).astype(np.float32)
+            gy, gx = tile_grid(*hw, tile)
+            ids = rng.choice(
+                gy * gx, size=rng.integers(1, gy * gx), replace=False
+            )
+            rows, origins = extract_tiles(img, np.sort(ids), tile)
+            streams.append((rows, origins))
+        layout, packed = pack_tile_sets([r for r, _ in streams])
+        back = split_tile_sets(packed, layout)
+        for (rows, origins), got in zip(streams, back):
+            np.testing.assert_array_equal(rows, got)
+            # toy per-tile detector: one detection at local (2, 3)
+            n = rows.shape[0]
+            dets = np.tile(
+                np.asarray([[2.0, 3.0, 0.9, 1.0]], np.float32), (n, 1)
+            )
+            merged = merge_tile_detections(
+                dets, np.arange(n), np.ones(n, bool), origins
+            )
+            np.testing.assert_allclose(
+                merged[:, 0:2], origins + np.asarray([2.0, 3.0])
+            )
+
+
+# -- the scheduler (plane unit tests over a fake session manager) --------------
+
+
+class _FakeSessions:
+    """Minimal SessionManager stand-in: coast returns a canned track
+    table once a 'keyframe' has seeded it."""
+
+    def __init__(self):
+        self.seeded = set()
+        self.coasts = 0
+        self.releases = 0
+
+    def seed(self, sid):
+        self.seeded.add(sid)
+
+    def coast(self, request):
+        if request.sequence_id not in self.seeded or request.sequence_start:
+            return None
+        self.coasts += 1
+        return {
+            "tracks": np.zeros((4, DET_DIM), np.float32),
+            "track_ids": np.asarray([1, 2, 0, 0], np.int32),
+            "tracks_valid": np.asarray([True, True, False, False]),
+        }
+
+    def advance(self, request, outputs):
+        return dict(outputs)
+
+    def release(self, sid):
+        self.releases += 1
+
+
+def _frame_req(sid, k, model="echo", n=None, start=None):
+    return InferRequest(
+        model_name=model,
+        inputs={},
+        sequence_id=sid,
+        sequence_start=(k == 0) if start is None else start,
+        request_id=f"{sid}/{k}",
+    )
+
+
+def _full_outputs(track_ids=(1, 2), innovation=0.0):
+    tid = np.zeros(4, np.int32)
+    tid[: len(track_ids)] = track_ids
+    return {
+        "detections": np.zeros((4, DET_DIM), np.float32),
+        "valid": np.ones(4, bool),
+        "tracks": np.zeros((4, DET_DIM), np.float32),
+        "track_ids": tid,
+        "tracks_valid": tid > 0,
+        "innovation": np.float32(innovation),
+    }
+
+
+class TestScheduler:
+    def test_forced_k_drives_detection_cadence(self):
+        sess = _FakeSessions()
+        plane = TemporalReusePlane(
+            sess, config=TemporalReuseConfig(mode="auto", forced_k=3)
+        )
+        modes = []
+        for k in range(9):
+            fut = plane.dispatch(_frame_req("s0", k))
+            if fut is None:
+                modes.append("full")
+                sess.seed("s0")
+                plane.observe("echo", "s0", {}, _full_outputs())
+            else:
+                resp = fut.result()
+                assert int(resp.outputs[temporal.REUSE_MODE_KEY]) == 1
+                modes.append("coast")
+                plane.observe("echo", "s0", {}, resp.outputs)
+        assert modes == ["full", "coast", "coast"] * 3
+        st = plane.stats()
+        assert st["frames_full_total"] == 3
+        assert st["frames_coast_total"] == 6
+        assert sess.releases == sess.coasts == 6
+
+    def test_mode_on_runs_fixed_k_max(self):
+        sess = _FakeSessions()
+        plane = TemporalReusePlane(
+            sess, config=TemporalReuseConfig(mode="on", k_max=4)
+        )
+        modes = []
+        for k in range(8):
+            fut = plane.dispatch(_frame_req("s0", k))
+            if fut is None:
+                modes.append("full")
+                sess.seed("s0")
+                plane.observe("echo", "s0", {}, _full_outputs())
+            else:
+                fut.result()
+                modes.append("coast")
+        assert modes == ["full", "coast", "coast", "coast"] * 2
+
+    def test_mode_off_never_coasts(self):
+        sess = _FakeSessions()
+        plane = TemporalReusePlane(
+            sess, config=TemporalReuseConfig(mode="off")
+        )
+        sess.seed("s0")
+        for k in range(5):
+            assert plane.dispatch(_frame_req("s0", k)) is None
+        assert plane.stats()["frames_coast_total"] == 0
+
+    def test_per_model_extra_overrides_serve_mode(self):
+        sess = _FakeSessions()
+        extras = {"pinned_off": {temporal.MODE_EXTRA_KEY: "off"}}
+        plane = TemporalReusePlane(
+            sess,
+            config=TemporalReuseConfig(mode="on", k_max=4),
+            spec_extra_fn=lambda m: extras.get(m, {}),
+        )
+        sess.seed("s0")
+        plane.dispatch(_frame_req("s0", 0, model="pinned_off"))
+        assert (
+            plane.dispatch(_frame_req("s0", 1, model="pinned_off")) is None
+        )
+
+    def test_first_frame_without_state_falls_back_to_full(self):
+        sess = _FakeSessions()  # never seeded: coast returns None
+        plane = TemporalReusePlane(
+            sess, config=TemporalReuseConfig(mode="on", k_max=4)
+        )
+        assert plane.dispatch(_frame_req("s0", 0)) is None
+        # non-key frame, but no resident state: full again, counted full
+        assert plane.dispatch(_frame_req("s0", 1)) is None
+        assert plane.stats()["frames_full_total"] == 2
+
+    def test_innovation_adapts_k_both_directions(self):
+        sess = _FakeSessions()
+        cfg = TemporalReuseConfig(
+            mode="auto", k_max=6, innovation_low=0.5, innovation_high=3.0
+        )
+        plane = TemporalReusePlane(sess, config=cfg)
+        sess.seed("s0")
+        plane.dispatch(_frame_req("s0", 0))
+        # quiet keyframes: K walks up to k_max
+        for _ in range(8):
+            plane.observe("echo", "s0", {}, _full_outputs(innovation=0.1))
+        assert plane.stats()["effective_k"]["s0"] == 6
+        # one burst keyframe: K collapses to k_min immediately
+        plane.observe("echo", "s0", {}, _full_outputs(innovation=9.0))
+        assert plane.stats()["effective_k"]["s0"] == cfg.k_min
+
+    def test_sequence_start_resets_stream_state(self):
+        sess = _FakeSessions()
+        plane = TemporalReusePlane(
+            sess, config=TemporalReuseConfig(mode="auto")
+        )
+        sess.seed("s0")
+        plane.dispatch(_frame_req("s0", 0))
+        for _ in range(6):
+            plane.observe("echo", "s0", {}, _full_outputs(innovation=0.1))
+        assert plane.stats()["effective_k"]["s0"] > 1
+        plane.dispatch(_frame_req("s0", 0, start=True))
+        assert plane.stats()["effective_k"]["s0"] == 1
+
+    def test_churn_gate_auto_disables_stream(self):
+        sess = _FakeSessions()
+        cfg = TemporalReuseConfig(
+            mode="auto", forced_k=2, churn_window=3, churn_limit=1.5
+        )
+        plane = TemporalReusePlane(sess, config=cfg)
+        sess.seed("s0")
+        ids = 1
+        disabled_at = None
+        for k in range(30):
+            fut = plane.dispatch(_frame_req("s0", k))
+            if fut is None:
+                # every keyframe reports a fully churned track table
+                ids += 2
+                plane.observe(
+                    "echo", "s0", {},
+                    _full_outputs(track_ids=(ids, ids + 1)),
+                )
+            else:
+                fut.result()
+            if plane.stats()["disabled_streams"]:
+                disabled_at = k
+                break
+        assert disabled_at is not None
+        st = plane.stats()
+        assert st["auto_disabled_total"] == 1
+        # once disabled, every subsequent frame is a full detection
+        for k in range(disabled_at + 1, disabled_at + 5):
+            assert plane.dispatch(_frame_req("s0", k)) is None
+
+    def test_churn_gate_never_arms_without_skipped_work(self):
+        sess = _FakeSessions()
+        cfg = TemporalReuseConfig(
+            mode="off", churn_window=2, churn_limit=0.5
+        )
+        plane = TemporalReusePlane(sess, config=cfg)
+        sess.seed("s0")
+        ids = 1
+        for k in range(12):
+            plane.dispatch(_frame_req("s0", k))
+            ids += 2
+            plane.observe(
+                "echo", "s0", {}, _full_outputs(track_ids=(ids, ids + 1))
+            )
+        assert plane.stats()["disabled_streams"] == 0
+
+    def test_overskip_fault_pins_k_and_churn_gate_catches_it(self):
+        """The ISSUE 19 acceptance drive, scheduler half: the injected
+        over-aggressive scheduler (K pinned wide open, innovation
+        ignored) must be caught by the ID-churn window and reuse
+        auto-disabled for exactly that stream."""
+        faults.install_fault_plan(faults.FaultPlan(rules=[
+            {"point": "temporal_overskip", "model": "s-bad", "count": 10_000}
+        ], seed=7))
+        sess = _FakeSessions()
+        cfg = TemporalReuseConfig(
+            mode="auto", k_max=6, churn_window=3, churn_limit=1.5,
+            innovation_high=0.5,
+        )
+        plane = TemporalReusePlane(sess, config=cfg)
+        for sid in ("s-bad", "s-ok"):
+            sess.seed(sid)
+        ids = {"s-bad": 1, "s-ok": 1}
+        for k in range(60):
+            for sid in ("s-bad", "s-ok"):
+                fut = plane.dispatch(_frame_req(sid, k))
+                if fut is None:
+                    # the faulted stream churns on every keyframe (the
+                    # damage over-coasting causes); the healthy stream
+                    # reports a bursty scene (high innovation) with
+                    # STABLE ids — its K stays collapsed, no churn
+                    if sid == "s-bad":
+                        ids[sid] += 2
+                    plane.observe(
+                        sid.replace("s-", "m-"), sid, {},
+                        _full_outputs(
+                            track_ids=(ids[sid], ids[sid] + 1),
+                            innovation=9.0,
+                        ),
+                    )
+                else:
+                    fut.result()
+            if plane.stats()["disabled_streams"]:
+                break
+        st = plane.stats()
+        assert st["auto_disabled_total"] == 1
+        assert st["disabled_streams"] == 1
+        # the healthy stream is untouched and still scheduling
+        assert "s-ok" in st["effective_k"]
+        for k in range(60, 64):
+            assert plane.dispatch(_frame_req("s-bad", k)) is None
+
+    def test_quality_violation_disables_model(self):
+        sess = _FakeSessions()
+        plane = TemporalReusePlane(
+            sess, config=TemporalReuseConfig(mode="on", k_max=4)
+        )
+        sess.seed("s0")
+        plane.dispatch(_frame_req("s0", 0))
+        assert plane.dispatch(_frame_req("s0", 1)) is not None
+        plane.note_quality_violation("echo")
+        plane.note_quality_violation("echo")  # idempotent
+        for k in range(2, 6):
+            assert plane.dispatch(_frame_req("s0", k)) is None
+        st = plane.stats()
+        assert st["quality_disabled_total"] == 1
+        assert st["quality_disabled_models"] == ["echo"]
+
+    def test_end_stream_drops_scheduler_state(self):
+        sess = _FakeSessions()
+        plane = TemporalReusePlane(sess)
+        sess.seed("s0")
+        plane.dispatch(_frame_req("s0", 0))
+        assert plane.stats()["streams"] == 1
+        plane.end_stream("s0")
+        assert plane.stats()["streams"] == 0
+
+
+# -- ROI partial recompute through a real channel ------------------------------
+
+
+def _partial_rig(tile=8, hw=(16, 24), n_rows=4, forced_k=4):
+    """Repo with an image-input echo-ish detector (tile-capable) and a
+    toy ragged tile detector; a real SessionManager and TPUChannel; the
+    plane wired the way cli/serve.py wires it."""
+    from triton_client_tpu.channel.tpu_channel import TPUChannel
+    from triton_client_tpu.config import ModelSpec, TensorSpec
+    from triton_client_tpu.runtime.repository import ModelRepository
+
+    h, w = hw
+    base_det = np.zeros((n_rows, DET_DIM), np.float32)
+    base_det[0, 0:2] = (4.0, 4.0)     # object in tile (0, 0)
+    base_det[1, 0:2] = (12.0, 12.0)   # object in tile (1, 1)
+    base_det[:2, 3:6] = (4.0, 2.0, 1.5)
+    base_det[:2, -2] = 0.9
+    base_valid = np.zeros((n_rows,), bool)
+    base_valid[:2] = True
+
+    def det_fn(inputs):
+        return {
+            "detections": base_det
+            + np.float32(0.0) * np.asarray(
+                inputs["image"], np.float32
+            ).mean(),
+            "valid": base_valid,
+        }
+
+    def tile_fn(inputs):
+        n = np.shape(inputs["tiles"])[0]
+        dets = np.zeros((n, DET_DIM), np.float32)
+        dets[:, 0:2] = (2.0, 3.0)  # tile-local detection
+        dets[:, 3:6] = (4.0, 2.0, 1.5)
+        dets[:, -2] = 0.9
+        return {
+            "tile_detections": dets,
+            "tile_det_tile": np.arange(n, dtype=np.int32),
+            "tile_valid": np.ones((n,), bool),
+        }
+
+    repo = ModelRepository()
+    pspec = ModelSpec(
+        name="pdet", version="1", platform="jax",
+        inputs=(TensorSpec("image", (h, w, 3), "FP32"),),
+        outputs=(
+            TensorSpec("detections", (n_rows, DET_DIM), "FP32"),
+            TensorSpec("valid", (n_rows,), "BOOL"),
+        ),
+        extra={
+            temporal.TILE_EXTRA_KEY: {
+                "model": "tiledet", "image": "image", "tile": tile,
+                "diff_threshold": 0.05,
+            },
+        },
+    )
+    repo.register(pspec, det_fn)
+    repo.register(
+        ModelSpec(
+            name="tiledet", version="1", platform="jax",
+            inputs=(
+                TensorSpec("tiles", (-1, tile * tile * 3), "FP32"),
+                TensorSpec("tile_origin", (-1, 2), "FP32"),
+            ),
+            outputs=(
+                TensorSpec("tile_detections", (-1, DET_DIM), "FP32"),
+                TensorSpec("tile_det_tile", (-1,), "INT32"),
+                TensorSpec("tile_valid", (-1,), "BOOL"),
+            ),
+        ),
+        tile_fn,
+    )
+    chan = TPUChannel(repo)
+    manager = SessionManager(
+        max_sessions=4, ttl_s=60.0, tracker=TrackerConfig(max_tracks=8)
+    )
+    chan.attach_sessions(manager)
+    plane = TemporalReusePlane(
+        manager,
+        config=TemporalReuseConfig(mode="auto", forced_k=forced_k),
+        channel=chan,
+        spec_extra_fn=lambda m: repo.get(m, "").spec.extra,
+    )
+    return chan, manager, plane, (h, w)
+
+
+def _issue_like_server(plane, chan, req):
+    """The _Servicer._issue composition: plane first, channel on None,
+    observe on the resolved outputs."""
+    fut = plane.dispatch(req)
+    if fut is None:
+        fut = chan.do_inference_async(req)
+    resp = fut.result()
+    outputs = {k: np.asarray(v) for k, v in resp.outputs.items()}
+    plane.observe(req.model_name, req.sequence_id, req.inputs, outputs)
+    return outputs
+
+
+class TestPartialRecompute:
+    def test_changed_corner_triggers_partial_with_merged_coords(self):
+        chan, manager, plane, (h, w) = _partial_rig()
+        rng = np.random.default_rng(0)
+        img = rng.uniform(0, 1, (h, w, 3)).astype(np.float32)
+
+        req0 = InferRequest(
+            model_name="pdet", inputs={"image": img},
+            sequence_id="cam0", sequence_start=True,
+        )
+        out0 = _issue_like_server(plane, chan, req0)
+        assert int(out0[temporal.REUSE_MODE_KEY]) == temporal.MODE_FULL
+        assert np.asarray(out0["tracks_valid"]).sum() == 2
+
+        # frame 1: bottom-right tile changes; objects' tiles also
+        # re-detect (cover set), everything else coasts as virtual
+        img1 = img.copy()
+        img1[h - 4:, w - 4:] += 1.0
+        req1 = InferRequest(
+            model_name="pdet", inputs={"image": img1}, sequence_id="cam0",
+        )
+        out1 = _issue_like_server(plane, chan, req1)
+        assert int(out1[temporal.REUSE_MODE_KEY]) == temporal.MODE_PARTIAL
+        # the tracker advanced on merged full-frame detections: both
+        # original tracks must survive the partial frame
+        assert np.asarray(out1["tracks_valid"]).sum() >= 2
+        st = plane.stats()
+        assert st["frames_partial_total"] == 1
+        assert 0 < st["partial_tiles_total"] < st[
+            "partial_tiles_possible_total"
+        ]
+
+    def test_static_frame_redetects_only_track_cover_tiles(self):
+        # zero pixel diff: the selection must be exactly the tiles the
+        # live tracks sit in (the confirmation set), nothing else
+        chan, manager, plane, (h, w) = _partial_rig()
+        img = np.zeros((h, w, 3), np.float32)
+        req0 = InferRequest(
+            model_name="pdet", inputs={"image": img},
+            sequence_id="cam0", sequence_start=True,
+        )
+        _issue_like_server(plane, chan, req0)
+        req1 = InferRequest(
+            model_name="pdet", inputs={"image": img}, sequence_id="cam0",
+        )
+        out1 = _issue_like_server(plane, chan, req1)
+        # static pixels: only the 2 track-cover tiles re-detect
+        assert int(out1[temporal.REUSE_MODE_KEY]) == temporal.MODE_PARTIAL
+        assert plane.stats()["partial_tiles_total"] == 2
+
+    def test_whole_frame_change_falls_back_to_full(self):
+        chan, manager, plane, (h, w) = _partial_rig()
+        img = np.zeros((h, w, 3), np.float32)
+        req0 = InferRequest(
+            model_name="pdet", inputs={"image": img},
+            sequence_id="cam0", sequence_start=True,
+        )
+        _issue_like_server(plane, chan, req0)
+        req1 = InferRequest(
+            model_name="pdet", inputs={"image": img + 5.0},
+            sequence_id="cam0",
+        )
+        out1 = _issue_like_server(plane, chan, req1)
+        assert int(out1[temporal.REUSE_MODE_KEY]) == temporal.MODE_FULL
+        assert plane.stats()["frames_partial_total"] == 0
+        assert plane.stats()["frames_full_total"] == 2
+
+
+# -- end-to-end serving drives -------------------------------------------------
+
+
+def _temporal_server(temporal_cfg, detector_iters=0, max_sessions=8):
+    """In-process server with an echo detector and optional attached
+    temporal plane. ``detector_iters`` > 0 registers the echo body as a
+    jitted ``device_fn`` chaining that many 128x128 matmuls — real
+    asynchronously-dispatched device work, so the DeviceTimeLedger's
+    launch->ready window (the streams-per-chip scoreboard) sees an
+    honest per-detection cost. A host ``time.sleep`` would run before
+    dispatch and charge nothing."""
+    from triton_client_tpu.channel.tpu_channel import TPUChannel
+    from triton_client_tpu.config import ModelSpec, TensorSpec
+    from triton_client_tpu.runtime.repository import ModelRepository
+    from triton_client_tpu.runtime.server import InferenceServer
+
+    spec = ModelSpec(
+        name="echo", version="1", platform="jax",
+        inputs=(
+            TensorSpec("detections", (-1, DET_DIM), "FP32"),
+            TensorSpec("valid", (-1,), "BOOL"),
+        ),
+        outputs=(
+            TensorSpec("detections", (-1, DET_DIM), "FP32"),
+            TensorSpec("valid", (-1,), "BOOL"),
+        ),
+    )
+
+    def infer(inputs):
+        return {
+            "detections": inputs["detections"],
+            "valid": inputs["valid"],
+        }
+
+    device_fn = None
+    if detector_iters:
+        import jax.numpy as jnp
+
+        eye = jnp.eye(128, dtype=jnp.float32)
+
+        def device_fn(inputs):
+            det = inputs["detections"]
+            v = jnp.broadcast_to(det.reshape(-1)[:1], (128, 128)) + eye
+            for _ in range(detector_iters):
+                v = v @ eye
+            return {
+                # epsilon-coupled to the matmul chain so XLA cannot
+                # dead-code the synthetic detector cost away
+                "detections": det + v[0, 0] * jnp.float32(1e-30),
+                "valid": inputs["valid"],
+            }
+
+    repo = ModelRepository()
+    repo.register(spec, infer, device_fn=device_fn)
+    chan = TPUChannel(repo)
+    manager = SessionManager(
+        max_sessions=max_sessions, ttl_s=60.0,
+        tracker=TrackerConfig(max_tracks=8),
+    )
+    chan.attach_sessions(manager)
+    plane = None
+    if temporal_cfg is not None:
+        plane = TemporalReusePlane(manager, config=temporal_cfg, channel=chan)
+    server = InferenceServer(
+        repo, chan, address="127.0.0.1:0", metrics_port="auto",
+        temporal=plane,
+    )
+    server.start()
+    return server, manager, plane
+
+
+class TestServingE2E:
+    def test_forced_k_cadence_and_reuse_mode_outputs(self):
+        from triton_client_tpu.channel.grpc_channel import GRPCChannel
+
+        server, manager, plane = _temporal_server(
+            TemporalReuseConfig(mode="auto", forced_k=3)
+        )
+        try:
+            chan = GRPCChannel(f"127.0.0.1:{server.port}", timeout_s=30.0)
+            try:
+                modes = []
+                for k in range(9):
+                    det, valid = _detections(
+                        [(10.0 + 0.1 * k, 5.0), (30.0, 40.0 + 0.1 * k)]
+                    )
+                    resp = chan.do_inference(InferRequest(
+                        model_name="echo",
+                        inputs={"detections": det, "valid": valid},
+                        sequence_id="s0",
+                        sequence_start=(k == 0),
+                        sequence_end=(k == 8),
+                    ))
+                    modes.append(int(np.asarray(
+                        resp.outputs[temporal.REUSE_MODE_KEY]
+                    )))
+                    # coast frames still serve a live track table
+                    assert (
+                        np.asarray(resp.outputs["tracks_valid"]).sum() == 2
+                    )
+            finally:
+                chan.close()
+            assert modes == [0, 1, 1] * 3
+            stats = manager.stats()
+            assert stats["coast_frames_total"] == 6
+            tstats = plane.stats()
+            assert tstats["frames_full_total"] == 3
+            assert tstats["frames_coast_total"] == 6
+            # the ledger charged coast frames to the stream's tenant
+            dev = server.device_time.device_seconds()
+            assert any(k.endswith("|stream:s0") for k in dev)
+        finally:
+            server.stop()
+
+    def test_coast_frames_match_reference_pipeline(self):
+        """Replay one scripted stream with forced K; mirror every frame
+        host-side (reference_step on keyframes, reference_coast
+        between) and require the served track table to match: ids
+        bitwise, float tracks at the repo's device-parity tolerance
+        (XLA contracts x + v*dt into an FMA; see TestStepParity)."""
+        from triton_client_tpu.channel.grpc_channel import GRPCChannel
+
+        cfg = TrackerConfig(max_tracks=8)
+        server, manager, plane = _temporal_server(
+            TemporalReuseConfig(mode="auto", forced_k=3)
+        )
+        try:
+            chan = GRPCChannel(f"127.0.0.1:{server.port}", timeout_s=30.0)
+            try:
+                state = None
+                for k in range(9):
+                    det, valid = _detections(
+                        [(10.0 + 0.5 * k, 5.0), (30.0, 40.0 - 0.5 * k)]
+                    )
+                    resp = chan.do_inference(InferRequest(
+                        model_name="echo",
+                        inputs={"detections": det, "valid": valid},
+                        sequence_id="par0",
+                        sequence_start=(k == 0),
+                    ))
+                    mode = int(np.asarray(
+                        resp.outputs[temporal.REUSE_MODE_KEY]
+                    ))
+                    if mode == temporal.MODE_FULL:
+                        if state is None:
+                            # mirror the server's id_base so tid columns
+                            # compare exactly
+                            state = tracking.init_state(
+                                cfg, DET_DIM,
+                                id_base=manager._slots["par0"].id_base,
+                            )
+                        state, out = tracking.reference_step(
+                            cfg, state, det, valid
+                        )
+                    else:
+                        state, out = tracking.reference_coast(cfg, state)
+                    np.testing.assert_allclose(
+                        np.asarray(resp.outputs["tracks"]),
+                        out["tracks"], atol=1e-5,
+                        err_msg=f"frame {k} mode {mode}",
+                    )
+                    np.testing.assert_array_equal(
+                        np.asarray(resp.outputs["track_ids"]),
+                        out["track_ids"], err_msg=f"frame {k}",
+                    )
+                    np.testing.assert_array_equal(
+                        np.asarray(resp.outputs["tracks_valid"]),
+                        out["tracks_valid"], err_msg=f"frame {k}",
+                    )
+            finally:
+                chan.close()
+        finally:
+            server.stop()
+
+    def test_collector_exports_temporal_plane(self):
+        import urllib.request
+
+        server, manager, plane = _temporal_server(
+            TemporalReuseConfig(mode="auto", forced_k=2)
+        )
+        try:
+            from triton_client_tpu.channel.grpc_channel import GRPCChannel
+
+            chan = GRPCChannel(f"127.0.0.1:{server.port}", timeout_s=30.0)
+            try:
+                for k in range(4):
+                    det, valid = _detections([(10.0, 5.0)])
+                    chan.do_inference(InferRequest(
+                        model_name="echo",
+                        inputs={"detections": det, "valid": valid},
+                        sequence_id="s0", sequence_start=(k == 0),
+                    ))
+            finally:
+                chan.close()
+            snap = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{server.metrics_port}/snapshot",
+                timeout=10,
+            ).read())
+            tmp = snap["temporal"]
+            assert tmp["frames_full_total"] == 2
+            assert tmp["frames_coast_total"] == 2
+            assert tmp["effective_k"] == {"s0": 1}
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{server.metrics_port}/metrics",
+                timeout=10,
+            ).read().decode()
+            assert 'tpu_serving_frames_total{mode="coast"} 2.0' in body
+            assert 'tpu_serving_stream_effective_k{stream="s0"}' in body
+            assert (
+                'tpu_serving_temporal_disabled_total{reason="churn"} 0.0'
+                in body
+            )
+        finally:
+            server.stop()
+
+    def test_quality_plane_violation_disables_reuse_for_model(self):
+        """The quality-gate integration: a window violation reported by
+        the QualityPlane turns reuse off for the model, canary-style."""
+        server, manager, plane = _temporal_server(
+            TemporalReuseConfig(mode="auto", forced_k=4)
+        )
+        try:
+            from triton_client_tpu.eval.quality_plane import QualityPlane
+
+            quality = QualityPlane(sample_rate=0.0, window_frames=4)
+            quality.attach_temporal(plane)
+            # simulate what _on_window does on a dirty window
+            quality.temporal.note_quality_violation("echo")
+            from triton_client_tpu.channel.grpc_channel import GRPCChannel
+
+            chan = GRPCChannel(f"127.0.0.1:{server.port}", timeout_s=30.0)
+            try:
+                for k in range(6):
+                    det, valid = _detections([(10.0, 5.0)])
+                    resp = chan.do_inference(InferRequest(
+                        model_name="echo",
+                        inputs={"detections": det, "valid": valid},
+                        sequence_id="s0", sequence_start=(k == 0),
+                    ))
+                    assert int(np.asarray(
+                        resp.outputs[temporal.REUSE_MODE_KEY]
+                    )) == temporal.MODE_FULL
+            finally:
+                chan.close()
+            assert plane.stats()["quality_disabled_models"] == ["echo"]
+        finally:
+            server.stop()
+
+
+@pytest.mark.slow
+def test_reuse_on_triples_streams_per_chip_at_equal_quality():
+    """The ISSUE 19 acceptance drive: the same scripted stream set,
+    reuse off vs on, scored by the per-stream device-seconds ledger.
+    Reuse on must sustain >= 3x streams-per-chip with zero additional
+    ID switches or fragmentation and no coast track drops."""
+    from triton_client_tpu.utils.loadgen import run_streams, synthetic_stream
+
+    def drive(cfg):
+        server, manager, plane = _temporal_server(cfg, detector_iters=60)
+        try:
+            run_streams(  # warm: compile step + coast off the clock
+                f"127.0.0.1:{server.port}", "echo",
+                n_streams=1,
+                source=lambda i: synthetic_stream(
+                    n_frames=6, fps=100.0, dynamics="static"
+                ),
+                deadline_s=60.0, stream_id_prefix="warm", realtime=False,
+            )
+            res = run_streams(
+                f"127.0.0.1:{server.port}", "echo", n_streams=4,
+                source=lambda i: synthetic_stream(
+                    n_frames=40, fps=10.0, n_objects=4, seed=i,
+                    dynamics="static",
+                ),
+                deadline_s=120.0, realtime=False,
+            )
+            dev_s = sum(
+                v for k, v in server.device_time.device_seconds().items()
+                if "|stream:stream-" in k
+            )
+            return res.summary(), dev_s
+        finally:
+            server.stop()
+
+    off, dev_off = drive(None)
+    on, dev_on = drive(TemporalReuseConfig(mode="auto", k_max=8))
+    assert off["goodput"] == on["goodput"] == 1.0
+    assert on["frames_coasted"] > on["frames_detected"]
+    # device-seconds per frame is the streams-per-chip scoreboard
+    per_off = dev_off / off["frames_ok"]
+    per_on = dev_on / on["frames_ok"]
+    assert per_off / per_on >= 3.0, (
+        f"reuse saved only {per_off / per_on:.2f}x device time "
+        f"({per_off * 1e3:.2f}ms -> {per_on * 1e3:.2f}ms/frame)"
+    )
+    # equal tracking quality: no extra switches, fragments, or drops
+    assert on["id_switches"] <= off["id_switches"]
+    assert on["fragmentation"] <= off["fragmentation"]
+    assert on["coast_track_drops"] == 0

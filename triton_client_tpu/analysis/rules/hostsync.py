@@ -172,6 +172,18 @@ HOT_PATH_ROOTS = (
     "shadow.sample_decision",
     "shadow.slice_decision",
     "FrontDoorRouter._observe_quality",
+    # ISSUE 19 temporal compute reuse: dispatch runs per session frame
+    # in _Servicer._issue BEFORE the channel (a host sync there taxes
+    # every streaming request, keyframe or not); observe runs per frame
+    # post-readback on the reply thread; the coast path's session step
+    # must stay one async jit dispatch — a host read inside
+    # SessionManager.coast or the plane's tile-selection path would
+    # serialize every stream the way a sync in advance/_step would.
+    "TemporalReusePlane.dispatch",
+    "TemporalReusePlane.observe",
+    "TemporalReusePlane._try_partial",
+    "SessionManager.coast",
+    "MultiCameraDriver._suppress",
 )
 
 # module-level call targets that force a host sync

@@ -262,6 +262,33 @@ def main(argv=None) -> None:
         "ids stay globally unique across session re-homing",
     )
     p.add_argument(
+        "--temporal-reuse", default="off",
+        choices=("auto", "on", "off"),
+        help="temporal compute reuse for streaming sessions "
+        "(runtime/temporal.py): full detection every K frames with "
+        "tracker-coast between, ROI-tile partial recompute on "
+        "tile-capable models. 'auto' adapts K per stream from the "
+        "Kalman innovation; 'on' runs a fixed K=--temporal-k-max; "
+        "'off' (default) disables the plane. Per-model "
+        "spec.extra['temporal_reuse'] overrides. Quality-gated: the "
+        "plane auto-disables per stream on ID churn, and the quality "
+        "plane's window violations disable it per model",
+    )
+    p.add_argument(
+        "--temporal-k-max", type=int, default=8,
+        help="keyframe-interval ceiling: at most K-1 consecutive "
+        "coast/partial frames between full detections",
+    )
+    p.add_argument(
+        "--temporal-tile", type=int, default=8,
+        help="ROI recompute tile edge (pixels) for tile-capable models",
+    )
+    p.add_argument(
+        "--temporal-forced-k", type=int, default=0,
+        help="pin K to this value, no adaptation (cadence tests and "
+        "over-aggressive-K drives; 0 = adaptive)",
+    )
+    p.add_argument(
         "--replica-of", default="",
         help="replica-set label: this server is one replica of the named "
         "fleet. Advertised via ServerMetadata extensions (the `route` "
@@ -407,6 +434,7 @@ def build_server(args):
     # streaming sessions: device-resident per-stream tracker state keyed
     # by the KServe sequence_id parameter (runtime/sessions.py)
     max_sessions = int(getattr(args, "max_sessions", 64) or 0)
+    sessions = None
     if max_sessions > 0 and hasattr(base_channel, "attach_sessions"):
         from triton_client_tpu.runtime.sessions import SessionManager
 
@@ -549,6 +577,49 @@ def build_server(args):
             "reference; tpu_quality_* families)",
             flush=True,
         )
+    # temporal compute reuse: per-stream keyframe scheduling + ROI
+    # partial recompute, riding the session plane (ISSUE 19). The plane
+    # dispatches tile sub-requests at the TOP of the channel stack so
+    # the continuous batcher can pack them across streams.
+    temporal = None
+    t_mode = getattr(args, "temporal_reuse", "off") or "off"
+    if t_mode != "off" and sessions is not None:
+        from triton_client_tpu.runtime.temporal import (
+            TemporalReuseConfig,
+            TemporalReusePlane,
+        )
+
+        def _extra_of(name):
+            try:
+                return repo.get(name, "").spec.extra
+            except Exception:
+                return None
+
+        t_cfg = TemporalReuseConfig(
+            mode=t_mode,
+            k_max=max(1, int(getattr(args, "temporal_k_max", 8))),
+            tile=max(1, int(getattr(args, "temporal_tile", 8))),
+            forced_k=max(0, int(getattr(args, "temporal_forced_k", 0))),
+        )
+        temporal = TemporalReusePlane(
+            sessions, config=t_cfg, channel=channel,
+            spec_extra_fn=_extra_of,
+        )
+        print(
+            f"temporal reuse: mode={t_cfg.mode} "
+            f"k=[{t_cfg.k_min},{t_cfg.k_max}] tile={t_cfg.tile} "
+            + (f"forced_k={t_cfg.forced_k} " if t_cfg.forced_k else "")
+            + "(keyframe scheduling + ROI partial recompute; coast "
+            "frames skip the detector, charged per-stream in the "
+            "device-time ledger)",
+            flush=True,
+        )
+    elif t_mode != "off":
+        print(
+            "temporal reuse requested but sessions are disabled "
+            "(--max-sessions 0); ignoring --temporal-reuse",
+            flush=True,
+        )
     uds = getattr(args, "uds", "auto") or "off"
     return InferenceServer(
         repo,
@@ -571,6 +642,7 @@ def build_server(args):
         history_capacity=getattr(args, "history_capacity", 360),
         history_path=getattr(args, "history_path", "") or None,
         quality=quality,
+        temporal=temporal,
     )
 
 

@@ -46,6 +46,11 @@ class DriverStats:
     # device dispatches; == frames single-stream, frames/cameras for the
     # multi-camera lockstep driver (latency percentiles are per-tick)
     ticks: int = 0
+    # camera views skipped by cross-camera suppression (ISSUE 19): the
+    # multi-camera driver omits a view from the tick's batch when every
+    # tracked object in it projects into overlap regions already covered
+    # by a processed peer this tick
+    suppressed: int = 0
 
     def to_dict(self) -> dict[str, float]:
         return dataclasses.asdict(self)

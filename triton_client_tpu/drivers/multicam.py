@@ -8,16 +8,44 @@ axis TPUChannel shards over the mesh's ``data`` axis, inferred in ONE
 device dispatch, and the packed results are demuxed back to per-camera
 sinks. With C cameras on a data=C mesh each chip serves one camera, and
 the batch rides ICI instead of C separate host round-trips.
+
+Cross-camera suppression (ISSUE 19): rigidly mounted rigs overlap, so
+an object fully visible in camera A's processed view need not be
+re-detected in camera B's overlap strip the same tick. ``OverlapRegion``
+declares those strips; when every tracked object in a view falls inside
+overlap regions whose peer camera IS in this tick's batch, the view is
+skipped entirely — zero detector cost for that camera this tick.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import time
 from typing import Any, Callable, Mapping, Sequence
 
 import numpy as np
 
 from triton_client_tpu.drivers.driver import DriverStats, latency_stats
+
+
+@dataclasses.dataclass(frozen=True)
+class OverlapRegion:
+    """One directed overlap declaration: the axis-aligned strip
+    ``(x0, y0, x1, y1)`` in ``camera``'s pixel coordinates that is also
+    covered by ``peer``'s field of view. A view may be suppressed for a
+    tick only against peers actually processed that tick — suppression
+    never chains through another suppressed view."""
+
+    camera: int
+    peer: int
+    region: tuple[float, float, float, float]
+
+    def __post_init__(self) -> None:
+        if self.camera == self.peer:
+            raise ValueError("a camera cannot overlap itself")
+        x0, y0, x1, y1 = self.region
+        if not (x1 > x0 and y1 > y0):
+            raise ValueError(f"degenerate overlap region {self.region}")
 
 
 class MultiCameraDriver:
@@ -39,7 +67,23 @@ class MultiCameraDriver:
       * ``"drop"`` — the exhausted camera leaves the lockstep group and
         the survivors keep ticking until every source is dry. The batch
         (and any downstream session group) SHRINKS at that tick; only
-        use this when the consumer tolerates a camera-axis resize."""
+        use this when the consumer tolerates a camera-axis resize.
+
+    ``suppression`` (ISSUE 19): a sequence of OverlapRegion. Each tick,
+    views are considered in camera-index order; a view is dropped from
+    the batch when it has at least one currently tracked object and
+    EVERY tracked center (read from the previous tick's per-camera
+    ``tracks``/``tracks_valid`` outputs) lies inside an overlap region
+    whose peer is in this tick's batch. Empty views (nothing tracked)
+    are never suppressed — a new object could be entering. A view is
+    force-processed after ``max_consecutive_suppress`` skips so stale
+    track positions cannot pin it suppressed forever. CAVEAT: like
+    ``"drop"``, suppression shrinks the batch (shape change -> retrace)
+    and is incompatible with a single server-side session GROUP, which
+    rejects a camera-axis resize; use per-camera sessions or a
+    stateless consumer. ``temporal`` optionally names a
+    runtime.temporal.TemporalReusePlane whose suppression counter
+    (``tpu_serving_suppressed_views_total``) each skip increments."""
 
     def __init__(
         self,
@@ -48,6 +92,9 @@ class MultiCameraDriver:
         sink: Callable[[int, Any, Mapping[str, Any]], None] | None = None,
         warmup: int = 1,
         on_stream_end: str = "stop",
+        suppression: Sequence[OverlapRegion] | None = None,
+        max_consecutive_suppress: int = 2,
+        temporal=None,
     ) -> None:
         if not sources:
             raise ValueError("need at least one camera source")
@@ -61,6 +108,98 @@ class MultiCameraDriver:
         self.sink = sink
         self.warmup = warmup
         self.on_stream_end = on_stream_end
+        self.temporal = temporal
+        self.max_consecutive_suppress = max(1, int(max_consecutive_suppress))
+        self._overlaps: dict[int, list[OverlapRegion]] = {}
+        for ov in suppression or ():
+            if not (0 <= ov.camera < len(sources)) or not (
+                0 <= ov.peer < len(sources)
+            ):
+                raise ValueError(
+                    f"overlap {ov} references a camera outside "
+                    f"0..{len(sources) - 1}"
+                )
+            self._overlaps.setdefault(ov.camera, []).append(ov)
+        self.suppressed_views = 0
+
+    # -- suppression ---------------------------------------------------------
+
+    def _suppress(
+        self,
+        frames: list,
+        last_tracks: dict[int, tuple[np.ndarray, np.ndarray]],
+        streak: dict[int, int],
+    ) -> tuple[list, list]:
+        """Partition the tick's (ci, frame) list into (kept, skipped).
+
+        Views are scanned in ascending camera order; a view's overlap
+        peers count only if they are already KEPT this tick, so two
+        mutually overlapping views can never suppress each other in the
+        same tick (the lower index is processed and covers the other)."""
+        kept: list = []
+        kept_ids: set[int] = set()
+        skipped: list = []
+        # peers later in index order can still cover an earlier view, as
+        # long as they are present this tick and not themselves
+        # suppressed — precompute presence, then resolve in order with
+        # the rule that a peer must not be suppressed.
+        present = {ci for ci, _ in frames}
+        for ci, frame in frames:
+            regs = self._overlaps.get(ci, ())
+            tr = last_tracks.get(ci)
+            if (
+                regs
+                and tr is not None
+                and streak.get(ci, 0) < self.max_consecutive_suppress
+                and self._all_covered(tr, regs, present, kept_ids, ci)
+            ):
+                skipped.append((ci, frame))
+                streak[ci] = streak.get(ci, 0) + 1
+                continue
+            kept.append((ci, frame))
+            kept_ids.add(ci)
+            streak[ci] = 0
+        return kept, skipped
+
+    def _all_covered(
+        self,
+        tr: tuple[np.ndarray, np.ndarray],
+        regs: Sequence[OverlapRegion],
+        present: set[int],
+        kept_ids: set[int],
+        ci: int,
+    ) -> bool:
+        tracks, valid = tr
+        centers = np.asarray(tracks, np.float32).reshape(
+            len(tracks), -1
+        )[np.asarray(valid, bool)][:, :2]
+        if centers.size == 0:
+            return False  # nothing tracked: a new object could enter
+        # usable peers: present this tick AND either already kept (lower
+        # index, decided) or not themselves suppressible (no overlap
+        # declarations) — never another still-undecided suppressible view
+        usable = {
+            r.peer
+            for r in regs
+            if r.peer in present
+            and (r.peer in kept_ids or (r.peer > ci and r.peer not in self._overlaps))
+        }
+        if not usable:
+            return False
+        covered = np.zeros(len(centers), bool)
+        for r in regs:
+            if r.peer not in usable:
+                continue
+            x0, y0, x1, y1 = r.region
+            covered |= (
+                (centers[:, 0] >= x0)
+                & (centers[:, 0] < x1)
+                & (centers[:, 1] >= y0)
+                & (centers[:, 1] < y1)
+            )
+        return bool(covered.all())
+
+    # -- run loop ------------------------------------------------------------
 
     def run(self, max_ticks: int = 0) -> DriverStats:
         iters = [iter(s) for s in self.sources]
@@ -69,6 +208,8 @@ class MultiCameraDriver:
         ticks = 0
         frames_served = 0
         t_start = None
+        last_tracks: dict[int, tuple[np.ndarray, np.ndarray]] = {}
+        streak: dict[int, int] = {}
         while not max_ticks or ticks < max_ticks:
             frames = []  # (original camera index, frame)
             still = []
@@ -85,6 +226,20 @@ class MultiCameraDriver:
             if stopped or not frames:
                 break
             live = still
+            if self._overlaps:
+                frames, skipped = self._suppress(frames, last_tracks, streak)
+                if skipped:
+                    self.suppressed_views += len(skipped)
+                    if self.temporal is not None:
+                        try:
+                            self.temporal.record_suppressed(len(skipped))
+                        except Exception:
+                            pass
+                if not frames:
+                    # every view suppressed (mutual-coverage pathology);
+                    # the streak cap breaks the cycle next tick
+                    ticks += 1
+                    continue
             batch = np.stack([np.asarray(f.data) for _, f in frames])
             if ticks == 0:
                 for _ in range(self.warmup):
@@ -93,18 +248,25 @@ class MultiCameraDriver:
             t0 = time.perf_counter()
             result = self.infer({"images": batch})
             latencies.append(time.perf_counter() - t0)
-            if self.sink is not None:
-                for bi, (ci, frame) in enumerate(frames):
-                    per_cam = {
-                        k: np.asarray(v)[bi]
-                        for k, v in result.items()
-                        if np.ndim(v) > 0 and np.shape(v)[0] == len(frames)
-                    }
+            for bi, (ci, frame) in enumerate(frames):
+                per_cam = {
+                    k: np.asarray(v)[bi]
+                    for k, v in result.items()
+                    if np.ndim(v) > 0 and np.shape(v)[0] == len(frames)
+                }
+                if "tracks" in per_cam and "tracks_valid" in per_cam:
+                    last_tracks[ci] = (
+                        per_cam["tracks"],
+                        per_cam["tracks_valid"],
+                    )
+                if self.sink is not None:
                     self.sink(ci, frame, per_cam)
             ticks += 1
             frames_served += len(frames)
 
         wall = (time.perf_counter() - t_start) if t_start is not None else 0.0
-        return latency_stats(
+        stats = latency_stats(
             latencies, frames=frames_served, wall_s=wall, ticks=ticks
         )
+        stats.suppressed = self.suppressed_views
+        return stats

@@ -631,11 +631,19 @@ class QualityPlane:
         self._observed = 0
         self._sampled = 0
         self.legacy_exporter = None  # optional EvalPrometheusExporter
+        self.temporal = None  # optional runtime.temporal.TemporalReusePlane
 
     # -- wiring ---------------------------------------------------------------
 
     def attach_channel(self, channel) -> None:
         self.mirror.attach_channel(channel)
+
+    def attach_temporal(self, temporal) -> None:
+        """Quality-gate the temporal-reuse plane (ISSUE 19): a dirty
+        rolling window on a model disables its frame-skipping shortcuts
+        the same way a canary rolls back — the coast path can never
+        silently spend tracking quality."""
+        self.temporal = temporal
 
     def attach_legacy_exporter(self, exporter) -> None:
         """Satellite 1: the folded legacy eval Summaries (model_precision
@@ -679,6 +687,14 @@ class QualityPlane:
     def _on_window(self, model: str, variant: str, window: dict) -> None:
         clean, reason = self.gate.evaluate(variant, window)
         self.canary.on_window(model, variant, window, clean, reason)
+        if not clean and self.temporal is not None and variant == model:
+            # the PRIMARY path's own online quality regressed (not a
+            # canary variant's): stop trading accuracy for throughput
+            # on this model until an operator re-enables reuse
+            try:
+                self.temporal.note_quality_violation(model)
+            except Exception:
+                log.debug("temporal quality gate failed", exc_info=True)
         exporter = self.legacy_exporter
         if exporter is not None:
             try:

@@ -162,6 +162,16 @@ METRIC_TYPES: dict[str, str] = {
     "tpu_serving_session_frames_total": "counter",
     "tpu_serving_track_births_total": "counter",
     "tpu_serving_track_deaths_total": "counter",
+    # temporal-reuse plane (ISSUE 19): per-frame reuse decisions
+    # (full detector / tracker-coast / ROI-tile partial recompute),
+    # the per-stream adaptive keyframe interval, reuse auto-disables
+    # (per-stream ID-churn gate, quality-plane window violations),
+    # cross-camera suppressed views, and the ROI tile economy
+    "tpu_serving_frames_total": "counter",
+    "tpu_serving_stream_effective_k": "gauge",
+    "tpu_serving_temporal_disabled_total": "counter",
+    "tpu_serving_suppressed_views_total": "counter",
+    "tpu_serving_partial_tiles_total": "counter",
     "tpu_serving_op_device_seconds": "gauge",
     "tpu_serving_op_sample_window_seconds": "gauge",
     "tpu_serving_op_samples_total": "counter",
@@ -319,6 +329,7 @@ class RuntimeCollector:
         self._sampler = None
         self._history = None
         self._quality = None
+        self._temporal = None
         self._draining = False
         self._registry = None
         if registry is not None:
@@ -387,6 +398,13 @@ class RuntimeCollector:
         """Wire the MetricHistory whose ring depth this collector
         exports."""
         self._history = history
+
+    def attach_temporal(self, temporal) -> None:
+        """Wire the temporal reuse plane (runtime/temporal.py) whose
+        per-stream coast/partial/suppression decisions export as the
+        ``tpu_serving_frames_total``-family metrics and land under
+        ``/snapshot["temporal"]`` (ISSUE 19)."""
+        self._temporal = temporal
 
     def attach_quality(self, quality, legacy_eval: bool = True) -> None:
         """Wire the continuous quality plane (eval/quality_plane.py)
@@ -503,6 +521,8 @@ class RuntimeCollector:
             snap["history"] = self._history.stats()
         if self._quality is not None:
             snap["quality"] = self._quality.snapshot()
+        if self._temporal is not None:
+            snap["temporal"] = self._temporal.stats()
         if self._histograms is not None:
             # numeric-leaved per-(model|stage) bucket counts + sum:
             # delta() of two snapshots is the WINDOW's histogram, and
@@ -1140,6 +1160,66 @@ class RuntimeCollector:
             f"{ns}_track_deaths_total",
             "tracks retired across all sessions",
             ses.get("track_deaths_total", 0),
+        )
+
+        # temporal-reuse plane (ISSUE 19): every frame's reuse decision
+        # (full detector / tracker coast / ROI-tile partial recompute),
+        # the per-stream adaptive keyframe interval, reuse disables by
+        # reason, cross-camera suppression, and the tile economy
+        tmp = snap.get("temporal") or {}
+        yield counter(
+            f"{ns}_frames_total",
+            "stream frames by reuse decision: full detector pass, "
+            "tracker-coast, or ROI-tile partial recompute",
+            0,
+            labels=["mode"],
+            samples=[
+                (["full"], tmp.get("frames_full_total", 0)),
+                (["coast"], tmp.get("frames_coast_total", 0)),
+                (["partial"], tmp.get("frames_partial_total", 0)),
+            ],
+        )
+        yield gauge(
+            f"{ns}_stream_effective_k",
+            "current adaptive keyframe interval per stream (frames "
+            "between full detector passes; 1 = every frame)",
+            0,
+            labels=["stream"],
+            samples=[
+                ([str(sid)], k)
+                for sid, k in sorted(
+                    (tmp.get("effective_k") or {}).items()
+                )
+            ],
+        )
+        yield counter(
+            f"{ns}_temporal_disabled_total",
+            "streams/models where temporal reuse auto-disabled: "
+            "per-stream ID-churn gate (churn) or quality-plane window "
+            "violation (quality)",
+            0,
+            labels=["reason"],
+            samples=[
+                (["churn"], tmp.get("auto_disabled_total", 0)),
+                (["quality"], tmp.get("quality_disabled_total", 0)),
+            ],
+        )
+        yield counter(
+            f"{ns}_suppressed_views_total",
+            "camera views skipped because all their tracked objects "
+            "project into already-processed overlap regions",
+            tmp.get("suppressed_views_total", 0),
+        )
+        yield counter(
+            f"{ns}_partial_tiles_total",
+            "ROI tiles actually re-detected (selected) vs the full "
+            "tile-grid size of those frames (possible)",
+            0,
+            labels=["kind"],
+            samples=[
+                (["selected"], tmp.get("partial_tiles_total", 0)),
+                (["possible"], tmp.get("partial_tiles_possible_total", 0)),
+            ],
         )
 
         # kernel-attribution plane (ISSUE 14): per-op device time over

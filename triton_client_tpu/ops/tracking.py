@@ -105,7 +105,12 @@ STATE_KEYS = (
 #: output tensor names the session hook adds to a response
 OUTPUT_KEYS = (
     "tracks", "track_ids", "tracks_valid", "track_assign", "det_track_ids",
+    "innovation",
 )
+
+#: outputs a coast (predict-only) frame produces — the track table only;
+#: there are no detections to associate on a coasted frame
+COAST_OUTPUT_KEYS = ("tracks", "track_ids", "tracks_valid")
 
 
 def init_state(cfg: TrackerConfig, det_dim: int, id_base: int = 0):
@@ -330,6 +335,30 @@ def _step(xp, cfg: TrackerConfig, state, detections, valid):
     if cfg.velocity_cols is not None:
         a, b = cfg.velocity_cols
         z_vel = detections[:, a:b][gather]
+
+    # scene-dynamics statistic for the temporal-reuse scheduler
+    # (runtime/temporal.py): mean normalized position innovation over
+    # matched tracks — the same d2/s the Mahalanobis gate tests — plus
+    # each unmatched HIGH detection charged the full gate (it beat no
+    # prediction, i.e. a newly appeared object: maximal surprise). A
+    # quiet scene reads ~0, a cut/burst reads >= the gate value, and K
+    # adapts from it without any extra device work (computed pre-update
+    # from values the step already holds).
+    ivx = z_pos[:, 0] - mean[:, 0]
+    ivy = z_pos[:, 1] - mean[:, 1]
+    i_s = cov[:, 0] + np.float32(cfg.r_pos)
+    i_d2 = ivx * ivx + ivy * ivy
+    newborn_stat = high & (det_track < 0)
+    gate_full = np.float32(cfg.gate_maha2 if cfg.gate_maha2 > 0 else 9.21)
+    n_match_f = xp.sum(matched.astype(xp.float32))
+    n_new_f = xp.sum(newborn_stat.astype(xp.float32))
+    innov_sum = xp.sum(
+        xp.where(matched, i_d2 / i_s, xp.float32(0.0))
+    ) + gate_full * n_new_f
+    innovation = (
+        innov_sum / xp.maximum(n_match_f + n_new_f, xp.float32(1.0))
+    ).astype(xp.float32)
+
     mean, cov = _update(xp, cfg, mean, cov, z_pos, z_vel, matched)
 
     # misses age; past max_age an active track's slot frees (and is
@@ -407,6 +436,44 @@ def _step(xp, cfg: TrackerConfig, state, detections, valid):
         "tracks_valid": tid > 0,
         "track_assign": assign_slot,
         "det_track_ids": det_track_ids.astype(xp.int32),
+        "innovation": innovation,
+    }
+    return new_state, outputs
+
+
+def _coast(xp, cfg: TrackerConfig, state):
+    """One predict-only (coast) frame: the constant-velocity prior
+    advances every slot, covariance inflates by the process noise, and
+    the reported boxes are refreshed from the predicted mean — no
+    association, no update, no births or deaths. Ages and ids are
+    untouched: a coast frame is a *deliberate* skip, not a miss, so the
+    next keyframe sees exactly the miss-age it would have seen had the
+    stream paused. Mirrors ``_step``'s expression sequence for the
+    predict + box-refresh stanzas, so the parity gate compares bitwise."""
+    mean, cov = _predict(xp, cfg, state["mean"], state["cov"])
+    box = state["box"]
+    box = xp.concatenate([mean[:, 0:2], box[:, 2:]], axis=1)
+    if cfg.velocity_cols is not None and box.shape[1] >= cfg.velocity_cols[1]:
+        a = cfg.velocity_cols[0]
+        box = xp.concatenate([box[:, :a], mean[:, 2:4], box[:, a + 2:]],
+                             axis=1)
+    tid = state["tid"]
+    new_state = {
+        "mean": mean,
+        "cov": cov,
+        "box": box,
+        "tid": tid,
+        "age": state["age"],
+        "hits": state["hits"],
+        "next_id": state["next_id"],
+        "frame": state["frame"] + xp.int32(1),
+        "births": state["births"],
+        "deaths": state["deaths"],
+    }
+    outputs = {
+        "tracks": box,
+        "track_ids": tid,
+        "tracks_valid": tid > 0,
     }
     return new_state, outputs
 
@@ -426,9 +493,31 @@ def make_group_step(cfg: TrackerConfig):
     return jax.jit(jax.vmap(functools.partial(_step, jnp, cfg)))
 
 
+@functools.lru_cache(maxsize=32)
+def make_coast_step(cfg: TrackerConfig):
+    """The jit-compiled predict-only step for one stream:
+    (state,) -> (state, outputs). Cached per config, one trace per
+    (config, shape) — the whole temporal-reuse coast path is this one
+    launch."""
+    return jax.jit(functools.partial(_coast, jnp, cfg))
+
+
+@functools.lru_cache(maxsize=32)
+def make_group_coast(cfg: TrackerConfig):
+    """vmap of the coast step over a leading session-group axis."""
+    return jax.jit(jax.vmap(functools.partial(_coast, jnp, cfg)))
+
+
 def reference_step(cfg: TrackerConfig, state, detections, valid):
     """NumPy mirror of the device step — same expression sequence, so
     associations are bitwise-comparable. The tests' ground truth."""
     state = {k: np.asarray(v) for k, v in state.items()}
     det = np.asarray(detections, np.float32)
     return _step(np, cfg, state, det, np.asarray(valid, bool))
+
+
+def reference_coast(cfg: TrackerConfig, state):
+    """NumPy mirror of the coast step — the temporal-reuse parity
+    gate's ground truth."""
+    state = {k: np.asarray(v) for k, v in state.items()}
+    return _coast(np, cfg, state)

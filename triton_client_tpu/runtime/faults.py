@@ -19,6 +19,7 @@ probe named injection points:
   replica_down    _Servicer ServerReady/ModelReady/_issue    flag
   shm_detach      _Servicer before shm request parse         flag
   quality_corrupt eval ShadowMirror worker, before scoring   flag
+  temporal_overskip TemporalReusePlane.dispatch, per stream  flag
   ==============  ========================================== =========
 
 The ``replica_down`` point is flag-class (:func:`probe_flag`): the
@@ -42,6 +43,16 @@ out-of-budget quality regression with zero real model damage, so the
 canary auto-rollback path is drivable in CI and the acceptance drive
 ("corrupting variant ejected before it serves 1% of traffic") replays
 identically under a fixed plan.
+
+``temporal_overskip`` (ISSUE 19) is flag-class, keyed by the STREAM id
+(sequence_id), not a model name: while armed, the temporal reuse plane
+pins that stream's keyframe interval wide open (K = k_max) and ignores
+the innovation feedback that would normally collapse it — a
+deterministically over-aggressive scheduler. The acceptance drive uses
+it to prove the safety net: the per-stream ID-churn window must detect
+the resulting track instability and auto-disable reuse for that stream
+(``tpu_serving_temporal_disabled_total{reason="churn"}``) before the
+quality budgets are violated.
 
 Determinism: rules fire by COUNT windows (requests ``after`` .. ``after
 + count`` at that point/model), and probabilistic rules draw from a

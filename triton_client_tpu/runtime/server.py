@@ -123,6 +123,7 @@ class _Servicer(service.GRPCInferenceServiceServicer):
         lifecycle=None,
         replica_of: str | None = None,
         quality=None,
+        temporal=None,
     ) -> None:
         self._repo = repository
         self._channel = channel
@@ -147,6 +148,11 @@ class _Servicer(service.GRPCInferenceServiceServicer):
         # requests (sampling stays live, just not replay-deterministic)
         self._quality = quality
         self._quality_seq = itertools.count()
+        # temporal-reuse plane (ISSUE 19): consulted before dispatch on
+        # session frames — a coast/partial decision bypasses the full
+        # detector launch entirely; the keyframe innovation feeds back
+        # through finish(). One attribute read on the un-wired path.
+        self._temporal = temporal
         # in-flight request count independent of the (optional)
         # collector — drain() polls it to know when the building is empty
         self._active = 0
@@ -488,20 +494,27 @@ class _Servicer(service.GRPCInferenceServiceServicer):
                 # land inside it, plus the cross-thread hand-off gaps
                 # none of those sub-spans can see)
                 trace.begin("channel")
-            future = self._channel.do_inference_async(
-                InferRequest(
-                    model_name=served_name,
-                    model_version=request.model_version,
-                    inputs=inputs,
-                    request_id=request_id,
-                    trace=trace,
-                    deadline_s=deadline_s,
-                    priority=priority,
-                    sequence_id=sequence_id or "",
-                    sequence_start=sequence_start,
-                    sequence_end=sequence_end,
-                )
+            ireq = InferRequest(
+                model_name=served_name,
+                model_version=request.model_version,
+                inputs=inputs,
+                request_id=request_id,
+                trace=trace,
+                deadline_s=deadline_s,
+                priority=priority,
+                sequence_id=sequence_id or "",
+                sequence_start=sequence_start,
+                sequence_end=sequence_end,
             )
+            future = None
+            if self._temporal is not None and sequence_id:
+                # temporal reuse: the plane may serve this frame from
+                # the stream's device-resident tracker alone (coast) or
+                # from a changed-tiles sub-launch (partial); None means
+                # keyframe — run the full detector below
+                future = self._temporal.dispatch(ireq)
+            if future is None:
+                future = self._channel.do_inference_async(ireq)
             # overlapped with device execution: shm placement parsing
             # needs only the request, not the result
             shm_outputs = (
@@ -542,6 +555,17 @@ class _Servicer(service.GRPCInferenceServiceServicer):
                         )
                     except Exception:
                         log.debug("quality observe failed", exc_info=True)
+                if self._temporal is not None and sequence_id:
+                    # keyframe feedback: stamps reuse_mode on the
+                    # response, adapts K from the ridden-along
+                    # innovation, runs the per-stream ID-churn gate
+                    try:
+                        self._temporal.observe(
+                            request.model_name, sequence_id,
+                            inputs, result.outputs,
+                        )
+                    except Exception:
+                        log.debug("temporal observe failed", exc_info=True)
                 if trace is not None:
                     t_e0 = time.perf_counter()
                     resp = codec.build_infer_response(
@@ -902,6 +926,7 @@ class InferenceServer:
         history_capacity: int = 360,
         history_path: str | None = None,
         quality=None,
+        temporal=None,
     ) -> None:
         """``metrics_port``: serve the telemetry endpoint — Prometheus
         exposition on ``/metrics`` (Triton's :8002 role), Chrome-trace
@@ -965,7 +990,14 @@ class InferenceServer:
         against this server's own channel stack unless the plane was
         built with an explicit (router) channel. Exports as the
         ``tpu_quality_*`` families, ``/snapshot["quality"]``, and the
-        history ring's ``quality`` rows when telemetry is on."""
+        history ring's ``quality`` rows when telemetry is on.
+        ``temporal``: a runtime.temporal.TemporalReusePlane — session
+        frames then consult the per-stream keyframe scheduler before
+        dispatch (coast/partial frames skip the detector), the device-
+        time ledger is attached so skipped work is charged honestly,
+        and the quality plane's window violations disable reuse per
+        model. Exports under ``/snapshot["temporal"]`` and the
+        ``tpu_serving_frames_total{mode=...}`` families."""
         self.lifecycle = lifecycle
         self.tenants = tenants
         self.replica_of = replica_of
@@ -993,6 +1025,13 @@ class InferenceServer:
         self.history = None
         self._history_path = history_path
         self.quality = quality
+        self.temporal = temporal
+        if temporal is not None and quality is not None and hasattr(
+            quality, "attach_temporal"
+        ):
+            # quality-gated reuse: a rolling-window violation on a
+            # model turns its temporal shortcuts off, canary-style
+            quality.attach_temporal(temporal)
         if quality is not None and getattr(
             quality.mirror, "_channel", None
         ) is None:
@@ -1071,6 +1110,11 @@ class InferenceServer:
                     tenants=tenant_table, devices=devices
                 )
                 target.attach_device_time(self.device_time)
+                if temporal is not None:
+                    # coast/partial frames charge their (small) device
+                    # windows to stream:<id> like full frames do — the
+                    # per-stream device-seconds scoreboard stays honest
+                    temporal.attach_ledger(self.device_time)
             # metric history: a fixed-interval ring of ledger deltas
             # (per-model×tenant rates, utilization, MFU) served at
             # /history and persisted across the drain/restart boundary
@@ -1103,6 +1147,8 @@ class InferenceServer:
                 self.collector.attach_quality(quality)
                 if self.history is not None:
                     self.history.attach_quality(quality)
+            if temporal is not None:
+                self.collector.attach_temporal(temporal)
             try:
                 from triton_client_tpu.obs.http import TelemetryServer
 
@@ -1164,6 +1210,7 @@ class InferenceServer:
             lifecycle=lifecycle,
             replica_of=replica_of,
             quality=quality,
+            temporal=temporal,
         )
         service.add_servicer_to_server(self._servicer, self._server)
         self._port = self._server.add_insecure_port(address)

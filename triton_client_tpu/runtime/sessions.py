@@ -131,6 +131,7 @@ class SessionManager:
         self._rejected = 0
         self._ended = 0
         self._frames = 0
+        self._coasted = 0
         self._births_total = 0
         self._deaths_total = 0
 
@@ -249,13 +250,25 @@ class SessionManager:
                 self._ended += 1
         return out
 
+    def _cfg_for(self, det_dim: int) -> tracking.TrackerConfig:
+        """The stream tracker config adapted to this model's detection
+        row width. The default config carries CenterPoint's
+        ``velocity_cols=(7, 9)``; a 2D detector's 6-column rows hold no
+        measured velocity, so the window must narrow to ``None`` rather
+        than slice past the row (a width-0 ``z_vel`` crashes the
+        update)."""
+        cfg = self.tracker
+        if cfg.velocity_cols is not None and det_dim < cfg.velocity_cols[1]:
+            cfg = dataclasses.replace(cfg, velocity_cols=None)
+        return cfg
+
     def _step(self, slot: _Slot, outputs):
         det = outputs.get(DET_KEY)
         valid = outputs.get(VALID_KEY)
         if det is None or valid is None:
             return outputs  # model has no tracking-compatible head
         ndim = getattr(det, "ndim", 2)
-        cfg = self.tracker
+        cfg = self._cfg_for(int(det.shape[-1]))
         with slot.step_lock:
             if ndim == 3:
                 # leading dim = synchronized camera group (B==1 is a
@@ -298,6 +311,57 @@ class SessionManager:
         out = dict(outputs)
         out.update(track_out)
         return out
+
+    def coast(self, request):
+        """Advance one frame by Kalman predict alone — the detector is
+        skipped entirely (runtime/temporal.py's keyframe scheduler
+        decided this frame is temporally redundant). Returns the coast
+        outputs dict (track table only), or ``None`` when the stream
+        has no device state yet — a coast before the first keyframe is
+        meaningless and the caller must fall back to full detection.
+
+        Same refcount contract as :meth:`advance`: bumps the slot ref,
+        caller MUST pair with :meth:`release`. Pure device work — one
+        jit dispatch over the resident state pytree, nothing crosses
+        the host boundary."""
+        sid = request.sequence_id
+        now = self._time()
+        with self._lock:
+            slot = self._slots.get(sid)
+            if slot is None or slot.state is None or slot.ended \
+                    or request.sequence_start:
+                return None
+            slot.refs += 1
+            slot.last_used = now
+        try:
+            with slot.step_lock:
+                if slot.state is None:  # reset raced us
+                    with self._lock:
+                        slot.refs -= 1
+                    return None
+                # same det-width-narrowed config as _step, so the coast
+                # jit shares the keyframe step's cache entry per stream
+                cfg = self._cfg_for(int(slot.state["box"].shape[-1]))
+                coast = (
+                    tracking.make_group_coast(cfg)
+                    if slot.group
+                    else tracking.make_coast_step(cfg)
+                )
+                new_state, track_out = coast(slot.state)
+                slot.state = new_state
+                slot.frames += 1
+        except Exception:
+            with self._lock:
+                slot.refs -= 1
+            raise
+        with self._lock:
+            self._frames += 1
+            self._coasted += 1
+        if request.sequence_end:
+            with self._lock:
+                slot.ended = True
+                self._ended += 1
+        return dict(track_out)
 
     def release(self, stream_id: str) -> None:
         """Drop the in-flight ref taken by :meth:`advance`. Ended slots
@@ -355,6 +419,7 @@ class SessionManager:
                 "reclaimed_total": self._reclaimed,
                 "rejected_total": self._rejected,
                 "frames_total": self._frames,
+                "coast_frames_total": self._coasted,
                 "track_births_total": self._births_total,
                 "track_deaths_total": self._deaths_total,
             }

@@ -1,0 +1,725 @@
+"""Temporal compute reuse: adaptive keyframes, ROI tiles, suppression.
+
+ROADMAP item 4. PR 15 made streams first-class — device-resident
+tracker state, session affinity, per-stream device-seconds — but every
+frame still paid the full detector even though live streams are ~95%
+temporally redundant. This plane decides, per stream per frame, how
+much of the detector to run:
+
+  * **full** — the detector runs; the frame is a *keyframe*. One full
+    detection every K frames, where K adapts per stream to scene
+    dynamics: the tracker step (ops/tracking.py) already computes the
+    Mahalanobis position innovation, and it rides back with the
+    response outputs (``innovation``) at zero extra device cost. Quiet
+    scene -> K grows toward ``k_max``; a burst (innovation above
+    ``innovation_high``) collapses K to ``k_min`` so the very next
+    frame detects.
+  * **coast** — the detector is skipped entirely;
+    :meth:`runtime.sessions.SessionManager.coast` advances the stream
+    by Kalman predict alone (one jit dispatch over the resident state
+    pytree). The frame's device-seconds — just the predict — are still
+    charged to ``stream:<id>`` in the PR 11 ledger, so the ledger
+    stays the honest scoreboard for the >=3x streams-per-chip claim.
+  * **partial** — ROI-gated recompute: only image tiles whose content
+    changed (cheap per-tile diff statistic vs the previous frame) plus
+    tiles containing coasting tracks are re-detected. The variable
+    tile sets are issued as *stateless* sub-requests against a
+    tile-capable detector (``spec.extra["tile_recompute"]``), which
+    the continuous batcher packs ACROSS streams into one ragged launch
+    (runtime/continuous.py + parallel/ragged_kernels.py — session
+    frames themselves solo-dispatch, but the tile sub-requests carry
+    no sequence id precisely so they can merge). Tile detections merge
+    back to full-frame coordinates (:func:`merge_tile_detections`),
+    unchanged-region tracks ride as virtual detections at their
+    predicted positions, and the composite advances the tracker
+    normally.
+
+Safety: reuse trades accuracy for throughput, so it is gated twice.
+The plane keeps its own per-stream ID-churn window over keyframes
+(births + deaths between consecutive keyframe track tables — the
+leading indicator of an over-aggressive K) and auto-disables reuse for
+that stream when it trips, exactly like a canary rollback. The PR 17
+quality plane's rolling ID-switch/mAP windows gate the whole model:
+:meth:`TemporalReusePlane.note_quality_violation` (wired from
+``eval/quality_plane.py``) turns reuse off for every stream of a
+model whose online quality regressed.
+
+Every decision is counted (``tpu_serving_frames_total{mode=...}``,
+per-stream effective-K gauge, suppression counters — obs/collector.py)
+and the ``reuse_mode`` output tensor stamps each response 0=full /
+1=coast / 2=partial so replay scoring (utils/loadgen.py) can hold
+coasted frames to their own accuracy bar.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import logging
+import threading
+import time
+
+import numpy as np
+
+from triton_client_tpu.channel.base import (
+    InferFuture,
+    InferRequest,
+    InferResponse,
+)
+from triton_client_tpu.parallel.ragged_kernels import RaggedLayout, pack_rows
+from triton_client_tpu.runtime import faults
+
+log = logging.getLogger(__name__)
+
+#: response output stamped on every session frame the plane touches
+REUSE_MODE_KEY = "reuse_mode"
+MODE_FULL, MODE_COAST, MODE_PARTIAL = 0, 1, 2
+MODE_NAMES = {MODE_FULL: "full", MODE_COAST: "coast", MODE_PARTIAL: "partial"}
+
+#: ``spec.extra`` key marking a model tile-recompute-capable; value is
+#: a dict: ``model`` (the registered ragged tile detector), ``image``
+#: (the image input name, default "image"), ``tile`` (tile edge,
+#: pixels), optional ``diff_threshold`` and output-name overrides
+TILE_EXTRA_KEY = "tile_recompute"
+#: ``spec.extra`` key overriding the serve-wide mode per model
+MODE_EXTRA_KEY = "temporal_reuse"
+
+
+@dataclasses.dataclass(frozen=True)
+class TemporalReuseConfig:
+    """Serve-wide reuse policy (``serve --temporal-reuse ...``).
+
+    ``mode``: ``auto`` adapts K per stream from the innovation;
+    ``on`` runs a fixed K = ``k_max`` (no adaptation — benchmarking
+    and forced-cadence tests); ``off`` disables the plane. Per-model
+    ``spec.extra["temporal_reuse"]`` overrides the serve-wide mode.
+    """
+
+    mode: str = "auto"
+    #: keyframe-interval bounds; K adapts inside [k_min, k_max]
+    k_min: int = 1
+    k_max: int = 8
+    #: innovation EMA below this -> scene is quiet, K may grow
+    innovation_low: float = 0.5
+    #: instantaneous innovation above this -> K collapses to k_min
+    innovation_high: float = 3.0
+    ema_alpha: float = 0.4
+    #: default tile edge (pixels) for ROI partial recompute
+    tile: int = 8
+    #: per-tile mean-abs-diff above this -> tile re-detects
+    tile_diff_threshold: float = 0.08
+    #: per-stream quality gate: mean ID churn (births+deaths between
+    #: consecutive keyframes) over the last ``churn_window`` keyframes
+    #: above ``churn_limit`` auto-disables reuse for the stream
+    churn_window: int = 6
+    churn_limit: float = 2.0
+    #: test/fault override: force K to this value, no adaptation
+    forced_k: int = 0
+
+    def __post_init__(self):
+        if self.mode not in ("auto", "on", "off"):
+            raise ValueError(
+                f"temporal-reuse mode must be auto|on|off, not {self.mode!r}"
+            )
+        if not 1 <= self.k_min <= self.k_max:
+            raise ValueError(
+                f"need 1 <= k_min <= k_max, got [{self.k_min}, {self.k_max}]"
+            )
+
+
+# -- tile geometry (host-side helpers, pure numpy) -----------------------------
+
+
+def tile_grid(h: int, w: int, tile: int) -> tuple[int, int]:
+    """(rows, cols) of the tile grid covering an h x w frame —
+    ceil-division, so edge tiles may be partial (zero-padded)."""
+    t = max(1, int(tile))
+    return (-(-int(h) // t), -(-int(w) // t))
+
+
+def _as_hwc(image: np.ndarray) -> np.ndarray:
+    img = np.asarray(image, np.float32)
+    return img[..., None] if img.ndim == 2 else img
+
+
+def _pad_to_grid(img: np.ndarray, tile: int) -> np.ndarray:
+    h, w = img.shape[0], img.shape[1]
+    gy, gx = tile_grid(h, w, tile)
+    return np.pad(img, ((0, gy * tile - h), (0, gx * tile - w), (0, 0)))
+
+
+def tile_diff(prev, cur, tile: int) -> np.ndarray:
+    """(gy*gx,) mean absolute per-tile difference — the cheap change
+    statistic that gates partial recompute. Identical zero padding on
+    both frames, so edge tiles compare like-for-like."""
+    p, c = _as_hwc(prev), _as_hwc(cur)
+    if p.shape != c.shape:
+        raise ValueError(f"frame shape changed {p.shape} -> {c.shape}")
+    gy, gx = tile_grid(c.shape[0], c.shape[1], tile)
+    d = _pad_to_grid(np.abs(c - p), tile)
+    ch = d.shape[2]
+    return (
+        d.reshape(gy, tile, gx, tile, ch)
+        .mean(axis=(1, 3, 4))
+        .reshape(-1)
+        .astype(np.float32)
+    )
+
+
+def tiles_covering(
+    points: np.ndarray, h: int, w: int, tile: int
+) -> np.ndarray:
+    """(gy*gx,) bool — tiles containing any of the (m, 2) ``[x, y]``
+    points (track centers): the confirmation set a partial frame must
+    re-detect even when the pixels look static."""
+    gy, gx = tile_grid(h, w, tile)
+    mask = np.zeros(gy * gx, bool)
+    pts = np.asarray(points, np.float32).reshape(-1, 2)
+    if pts.size:
+        xs = np.clip((pts[:, 0] // tile).astype(np.int64), 0, gx - 1)
+        ys = np.clip((pts[:, 1] // tile).astype(np.int64), 0, gy - 1)
+        mask[ys * gx + xs] = True
+    return mask
+
+
+def select_tiles(
+    diff_stat: np.ndarray, threshold: float, cover: np.ndarray | None = None
+) -> np.ndarray:
+    """Ascending int32 ids of tiles to re-detect: changed-content tiles
+    union the track-cover set."""
+    sel = np.asarray(diff_stat, np.float32) > np.float32(threshold)
+    if cover is not None:
+        sel = sel | np.asarray(cover, bool)
+    return np.nonzero(sel)[0].astype(np.int32)
+
+
+def extract_tiles(
+    image, tile_ids: np.ndarray, tile: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """Selected tiles as flat rows.
+
+    Returns ``(rows, origins)``: ``rows`` (n, tile*tile*C) f32 — the
+    fixed-width row format the ragged pack ships — and ``origins``
+    (n, 2) f32 ``[x0, y0]`` full-frame offsets that invert the crop
+    (:func:`merge_tile_detections`)."""
+    img = _pad_to_grid(_as_hwc(image), tile)
+    h, w = _as_hwc(image).shape[0], _as_hwc(image).shape[1]
+    gy, gx = tile_grid(h, w, tile)
+    ch = img.shape[2]
+    view = (
+        img.reshape(gy, tile, gx, tile, ch)
+        .transpose(0, 2, 1, 3, 4)
+        .reshape(gy * gx, tile * tile * ch)
+    )
+    ids = np.asarray(tile_ids, np.int64).reshape(-1)
+    rows = view[ids]
+    origins = np.stack(
+        [(ids % gx) * tile, (ids // gx) * tile], axis=1
+    ).astype(np.float32)
+    return rows, origins
+
+
+def pack_tile_sets(
+    parts: list[np.ndarray],
+) -> tuple[RaggedLayout, np.ndarray]:
+    """Pack per-stream tile-row blocks into ONE ragged batch — the
+    cross-stream launch shape (parallel/ragged_kernels.py owns the
+    layout/padding contract). In serving this packing happens inside
+    the continuous batcher; this wrapper is the direct path bench and
+    the round-trip tests drive."""
+    layout = RaggedLayout(tuple(int(np.shape(p)[0]) for p in parts))
+    return layout, pack_rows([np.asarray(p) for p in parts], layout)
+
+
+def split_tile_sets(
+    packed: np.ndarray, layout: RaggedLayout
+) -> list[np.ndarray]:
+    """Inverse of :func:`pack_tile_sets`: per-stream row blocks back
+    out of the packed batch (pad rows dropped)."""
+    off = layout.offsets
+    return [
+        np.asarray(packed)[off[i]: off[i + 1]]
+        for i in range(layout.n_segments)
+    ]
+
+
+def merge_tile_detections(
+    dets, det_tile, valid, origins
+) -> np.ndarray:
+    """Tile-local detections -> full-frame coordinates.
+
+    ``dets`` (m, D) packed detection rows in TILE-LOCAL coordinates,
+    ``det_tile`` (m,) index of the producing tile into ``origins``
+    (n, 2) ``[x0, y0]``, ``valid`` (m,) bool. Returns the valid rows
+    with columns 0:2 offset back to full-frame coordinates — the array
+    the tracker step consumes as if the full detector had run."""
+    d = np.array(dets, np.float32, copy=True)
+    d = d.reshape(-1, d.shape[-1]) if d.ndim != 2 else d
+    idx = np.asarray(det_tile, np.int64).reshape(-1)
+    v = np.asarray(valid, bool).reshape(-1)
+    org = np.asarray(origins, np.float32).reshape(-1, 2)
+    if d.shape[0] == 0 or not v.any():
+        return np.zeros((0, d.shape[1]), np.float32)
+    idx = np.clip(idx, 0, len(org) - 1)
+    d[:, 0:2] += org[idx]
+    return d[v]
+
+
+# -- per-stream scheduler state ------------------------------------------------
+
+
+class _Stream:
+    __slots__ = (
+        "k", "since_key", "ema", "disabled", "prev_ids", "churn",
+        "full", "coast", "partial", "prev_image", "last_tracks",
+        "last_valid", "det_shape",
+    )
+
+    def __init__(self, k: int) -> None:
+        self.reset(k)
+
+    def reset(self, k: int) -> None:
+        self.k = k
+        self.since_key = 0
+        self.ema = 0.0
+        self.disabled = False
+        self.prev_ids: frozenset | None = None
+        self.churn: collections.deque = collections.deque(maxlen=64)
+        self.full = 0
+        self.coast = 0
+        self.partial = 0
+        self.prev_image: np.ndarray | None = None
+        self.last_tracks: np.ndarray | None = None
+        self.last_valid: np.ndarray | None = None
+        self.det_shape: tuple | None = None
+
+
+class TemporalReusePlane:
+    """The per-frame reuse decision, wired into ``_Servicer._issue``.
+
+    ``sessions``: the SessionManager holding device-resident tracker
+    state. ``channel``: the serving channel stack (tile sub-requests
+    enter at the top so the continuous batcher can pack them across
+    streams). ``ledger``: the DeviceTimeLedger; coast/partial frames
+    charge their (small) device windows to ``stream:<id>`` exactly
+    like full frames, keeping per-stream device-seconds honest.
+    ``spec_extra_fn``: ``model_name -> spec.extra`` mapping for the
+    per-model mode / tile-capability lookup.
+    """
+
+    def __init__(
+        self,
+        sessions,
+        config: TemporalReuseConfig | None = None,
+        channel=None,
+        ledger=None,
+        spec_extra_fn=None,
+        time_fn=time.perf_counter,
+    ) -> None:
+        self.config = config or TemporalReuseConfig()
+        self._sessions = sessions
+        self._channel = channel
+        self._ledger = ledger
+        self._spec_extra = spec_extra_fn
+        self._time = time_fn
+        self._lock = threading.Lock()
+        self._streams: dict[str, _Stream] = {}
+        self._extra_cache: dict[str, dict] = {}
+        self._model_disabled: set[str] = set()
+        self._full = 0
+        self._coast = 0
+        self._partial = 0
+        self._auto_disabled = 0
+        self._quality_disabled = 0
+        self._suppressed_views = 0
+        self._partial_tiles = 0
+        self._partial_tiles_possible = 0
+
+    def attach_ledger(self, ledger) -> None:
+        """Late-bind the DeviceTimeLedger (InferenceServer builds it
+        after the serving channel stack exists)."""
+        self._ledger = ledger
+
+    def attach_channel(self, channel) -> None:
+        """Late-bind the channel stack tile sub-requests dispatch on."""
+        self._channel = channel
+
+    # -- config plumbing ------------------------------------------------------
+
+    def _extra_for(self, model: str) -> dict:
+        try:
+            return self._extra_cache[model]
+        except KeyError:
+            pass
+        extra = None
+        if self._spec_extra is not None:
+            try:
+                extra = self._spec_extra(model)
+            except Exception:
+                extra = None
+        return self._extra_cache.setdefault(model, dict(extra or {}))
+
+    def _mode_for(self, model: str) -> str:
+        if model in self._model_disabled:
+            return "off"
+        mode = self._extra_for(model).get(MODE_EXTRA_KEY)
+        return mode if mode in ("auto", "on", "off") else self.config.mode
+
+    def _tile_cfg(self, model: str) -> dict | None:
+        tr = self._extra_for(model).get(TILE_EXTRA_KEY)
+        return tr if isinstance(tr, dict) and tr.get("model") else None
+
+    def _stream(self, sid: str) -> _Stream:
+        st = self._streams.get(sid)
+        if st is None:
+            with self._lock:
+                st = self._streams.setdefault(
+                    sid, _Stream(self.config.k_min)
+                )
+        return st
+
+    # -- the per-frame decision (hot path: runtime/server.py _issue) ----------
+
+    def dispatch(self, request: InferRequest):
+        """Decide this session frame's mode. Returns an InferFuture
+        when the plane serves the frame itself (coast / partial), or
+        ``None`` when the full detector must run (keyframe, reuse off,
+        stream disabled, or no resident state yet)."""
+        sid = request.sequence_id
+        if not sid or self._sessions is None:
+            return None
+        cfg = self.config
+        mode = self._mode_for(request.model_name)
+        st = self._stream(sid)
+        if request.sequence_start:
+            st.reset(cfg.k_min)
+        if mode == "off" or st.disabled:
+            self._count(st, MODE_FULL)
+            st.since_key = 0
+            return None
+        k = cfg.forced_k or (cfg.k_max if mode == "on" else st.k)
+        if faults.probe_flag("temporal_overskip", sid):
+            # injected over-aggressive scheduler: pin K wide open and
+            # ignore the innovation collapse — the churn gate must
+            # catch the damage (the ISSUE 19 auto-disable drive)
+            k = cfg.k_max
+        if st.since_key + 1 >= max(1, k):
+            self._count(st, MODE_FULL)
+            st.since_key = 0
+            return None
+        # non-key frame: partial when the model is tile-capable and
+        # the stream has the context for it, else pure coast
+        tile_cfg = self._tile_cfg(request.model_name)
+        if tile_cfg is not None and self._channel is not None:
+            fut = self._try_partial(request, st, tile_cfg)
+            if fut == "full":
+                self._count(st, MODE_FULL)
+                st.since_key = 0
+                return None
+            if fut is not None:
+                return fut
+        out = self._sessions.coast(request)
+        if out is None:
+            # no resident state yet (first frame / restart): keyframe
+            self._count(st, MODE_FULL)
+            st.since_key = 0
+            return None
+        self._count(st, MODE_COAST)
+        st.since_key += 1
+        return self._coast_future(request, out)
+
+    def _count(self, st: _Stream, mode: int) -> None:
+        with self._lock:
+            if mode == MODE_FULL:
+                st.full += 1
+                self._full += 1
+            elif mode == MODE_COAST:
+                st.coast += 1
+                self._coast += 1
+            else:
+                st.partial += 1
+                self._partial += 1
+
+    def _coast_future(self, request: InferRequest, out) -> InferFuture:
+        import jax
+
+        sid = request.sequence_id
+        t0 = self._time()
+
+        def resolve() -> InferResponse:
+            try:
+                # same device window the staged resolve charges for a
+                # full launch: dispatch -> execution complete. A coast
+                # frame's honest cost is one predict-only jit.
+                jax.block_until_ready(out)
+                t_ready = self._time()
+                if self._ledger is not None:
+                    self._ledger.record(
+                        request.model_name, t_ready - t0, None,
+                        tenant=f"stream:{sid}",
+                    )
+                host = {k: np.asarray(v) for k, v in out.items()}
+                host[REUSE_MODE_KEY] = np.asarray(MODE_COAST, np.int32)
+                return InferResponse(
+                    model_name=request.model_name,
+                    model_version=request.model_version,
+                    outputs=host,
+                    request_id=request.request_id,
+                    latency_s=self._time() - t0,
+                )
+            finally:
+                self._sessions.release(sid)
+
+        return InferFuture(resolve)
+
+    # -- ROI-gated partial recompute ------------------------------------------
+
+    def _try_partial(self, request: InferRequest, st: _Stream, tr: dict):
+        """Issue the changed-tile sub-request; returns the partial
+        InferFuture, ``"full"`` when a full detection is the cheaper
+        correct move (most of the frame changed), or ``None`` to fall
+        back to pure coast (nothing changed, or missing context)."""
+        img_name = tr.get("image", "image")
+        img = request.inputs.get(img_name)
+        if (
+            img is None
+            or st.prev_image is None
+            or st.det_shape is None
+            or len(st.det_shape) != 2
+            or st.last_tracks is None
+        ):
+            return None
+        cur = np.asarray(img, np.float32)
+        if cur.shape != st.prev_image.shape:
+            return None
+        tile = int(tr.get("tile") or self.config.tile)
+        h, w = cur.shape[0], cur.shape[1]
+        stat = tile_diff(st.prev_image, cur, tile)
+        centers = st.last_tracks[st.last_valid][:, 0:2]
+        cover = tiles_covering(centers, h, w, tile)
+        threshold = float(
+            tr.get("diff_threshold", self.config.tile_diff_threshold)
+        )
+        sel = select_tiles(stat, threshold, cover)
+        gy, gx = tile_grid(h, w, tile)
+        n_tiles = gy * gx
+        if sel.size == 0:
+            st.prev_image = cur
+            return None
+        if sel.size >= n_tiles:
+            return "full"  # everything changed: the shortcut costs more
+        rows, origins = extract_tiles(cur, sel, tile)
+        sub = InferRequest(
+            model_name=str(tr["model"]),
+            inputs={"tiles": rows, "tile_origin": origins},
+            request_id=(
+                f"{request.request_id}/tiles" if request.request_id else ""
+            ),
+            deadline_s=request.deadline_s,
+            priority=request.priority,
+        )
+        try:
+            subfut = self._channel.do_inference_async(sub)
+        except Exception:
+            return None  # tile detector unavailable: coast instead
+        # unchanged-region tracks ride as virtual detections at their
+        # predicted positions so they neither age out nor re-detect
+        sel_mask = np.zeros(n_tiles, bool)
+        sel_mask[sel] = True
+        xs = np.clip((centers[:, 0] // tile).astype(np.int64), 0, gx - 1)
+        ys = np.clip((centers[:, 1] // tile).astype(np.int64), 0, gy - 1)
+        outside = ~sel_mask[ys * gx + xs]
+        virtual = st.last_tracks[st.last_valid][outside]
+        st.prev_image = cur
+        self._count(st, MODE_PARTIAL)
+        st.since_key += 1
+        with self._lock:
+            self._partial_tiles += int(sel.size)
+            self._partial_tiles_possible += int(n_tiles)
+        return self._partial_future(request, st, tr, subfut, origins, virtual)
+
+    def _partial_future(
+        self, request, st, tr, subfut, origins, virtual
+    ) -> InferFuture:
+        import jax
+
+        sid = request.sequence_id
+        t0 = self._time()
+        det_key = tr.get("detections_output", "tile_detections")
+        idx_key = tr.get("tile_index_output", "tile_det_tile")
+        valid_key = tr.get("valid_output", "tile_valid")
+        n_rows, det_dim = st.det_shape
+
+        def resolve() -> InferResponse:
+            resp = subfut.result()  # tile launch (ragged-packed upstream)
+            tile_dets = merge_tile_detections(
+                np.asarray(resp.outputs[det_key]),
+                np.asarray(resp.outputs[idx_key]),
+                np.asarray(resp.outputs[valid_key]),
+                origins,
+            )
+            rows = [r for r in (tile_dets, np.asarray(virtual)) if len(r)]
+            merged = (
+                np.concatenate(rows)[:n_rows]
+                if rows
+                else np.zeros((0, det_dim), np.float32)
+            )
+            n = merged.shape[0]
+            detections = np.zeros((n_rows, det_dim), np.float32)
+            detections[:n] = merged
+            valid = np.zeros((n_rows,), bool)
+            valid[:n] = True
+            t_adv = self._time()
+            out = self._sessions.advance(
+                request, {"detections": detections, "valid": valid}
+            )
+            try:
+                jax.block_until_ready(out)
+                t_ready = self._time()
+                if self._ledger is not None:
+                    # the tile launch already accrued under the tile
+                    # model; this charges the stream's tracker window
+                    self._ledger.record(
+                        request.model_name, t_ready - t_adv, None,
+                        tenant=f"stream:{sid}",
+                    )
+                host = {k: np.asarray(v) for k, v in out.items()}
+                host[REUSE_MODE_KEY] = np.asarray(MODE_PARTIAL, np.int32)
+                return InferResponse(
+                    model_name=request.model_name,
+                    model_version=request.model_version,
+                    outputs=host,
+                    request_id=request.request_id,
+                    latency_s=self._time() - t0,
+                )
+            finally:
+                self._sessions.release(sid)
+
+        return InferFuture(resolve)
+
+    # -- feedback (runtime/server.py finish(), post-readback) -----------------
+
+    def observe(self, model: str, sid: str, inputs, outputs) -> None:
+        """Fold one resolved frame back into the scheduler: stamp
+        ``reuse_mode`` on full frames, adapt K from the keyframe
+        innovation, cache the track/image context the partial path
+        needs, and run the per-stream ID-churn quality gate. Host-side
+        numpy throughout — the response was already read back."""
+        if not sid:
+            return
+        cfg = self.config
+        st = self._stream(sid)
+        mode_out = outputs.get(REUSE_MODE_KEY)
+        if mode_out is None:
+            outputs[REUSE_MODE_KEY] = np.asarray(MODE_FULL, np.int32)
+            mode_val = MODE_FULL
+        else:
+            mode_val = int(np.asarray(mode_out))
+        tracks, tvalid = outputs.get("tracks"), outputs.get("tracks_valid")
+        if tracks is not None and tvalid is not None:
+            tk = np.asarray(tracks)
+            if tk.ndim == 2:  # partial/tile context is single-camera only
+                st.last_tracks = tk
+                st.last_valid = np.asarray(tvalid, bool)
+        tile_cfg = self._tile_cfg(model)
+        if tile_cfg is not None:
+            img = inputs.get(tile_cfg.get("image", "image")) \
+                if inputs is not None else None
+            if img is not None and np.ndim(img) in (2, 3):
+                st.prev_image = np.asarray(img, np.float32)
+        if mode_val != MODE_FULL:
+            return
+        det = outputs.get("detections")
+        if det is not None and np.ndim(det) == 2:
+            st.det_shape = tuple(np.shape(det))
+        mode = self._mode_for(model)
+        innov = outputs.get("innovation")
+        if innov is not None and mode == "auto" and not cfg.forced_k:
+            v = float(np.mean(np.asarray(innov, np.float32)))
+            st.ema = cfg.ema_alpha * v + (1.0 - cfg.ema_alpha) * st.ema
+            if faults.probe_flag("temporal_overskip", sid):
+                pass  # injected scheduler ignores the innovation signal
+            elif v >= cfg.innovation_high:
+                st.k = cfg.k_min
+            elif st.ema <= cfg.innovation_low:
+                st.k = min(cfg.k_max, st.k + 1)
+            else:
+                st.k = max(cfg.k_min, st.k - 1)
+        # ID-churn gate: births+deaths between consecutive keyframe
+        # track tables. Only armed once reuse actually skipped work —
+        # a reuse-off stream can never be disabled by its own churn.
+        tid = outputs.get("track_ids")
+        if tid is not None and tvalid is not None and np.ndim(tid) == 1:
+            ids = frozenset(
+                int(i) for i in np.asarray(tid)[np.asarray(tvalid, bool)]
+            )
+            if st.prev_ids is not None:
+                st.churn.append(len(ids ^ st.prev_ids))
+            st.prev_ids = ids
+            recent = list(st.churn)[-cfg.churn_window:]
+            if (
+                not st.disabled
+                and (st.coast + st.partial) > 0
+                and len(recent) >= cfg.churn_window
+                and sum(recent) / len(recent) > cfg.churn_limit
+            ):
+                st.disabled = True
+                with self._lock:
+                    self._auto_disabled += 1
+                log.warning(
+                    "temporal reuse auto-disabled for stream %s: "
+                    "ID churn %.2f/keyframe over %d keyframes "
+                    "(limit %.2f) — coasting is costing track identity",
+                    sid, sum(recent) / len(recent), len(recent),
+                    cfg.churn_limit,
+                )
+
+    # -- external gates / counters --------------------------------------------
+
+    def note_quality_violation(self, model: str) -> None:
+        """Quality-plane hook (eval/quality_plane.py): a rolling-window
+        quality violation on ``model`` turns reuse off for every one of
+        its streams — same reflex as a canary rollback."""
+        with self._lock:
+            if model in self._model_disabled:
+                return
+            self._model_disabled.add(model)
+            self._quality_disabled += 1
+        log.warning(
+            "temporal reuse disabled for model '%s': online quality "
+            "window violated", model,
+        )
+
+    def record_suppressed(self, views: int = 1) -> None:
+        """Count cross-camera suppressed views (drivers/multicam.py)."""
+        with self._lock:
+            self._suppressed_views += int(views)
+
+    def end_stream(self, sid: str) -> None:
+        with self._lock:
+            self._streams.pop(sid, None)
+
+    # -- observability --------------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            streams = dict(self._streams)
+            return {
+                "mode": self.config.mode,
+                "frames_full_total": self._full,
+                "frames_coast_total": self._coast,
+                "frames_partial_total": self._partial,
+                "streams": len(streams),
+                "disabled_streams": sum(
+                    1 for s in streams.values() if s.disabled
+                ),
+                "auto_disabled_total": self._auto_disabled,
+                "quality_disabled_models": sorted(self._model_disabled),
+                "quality_disabled_total": self._quality_disabled,
+                "suppressed_views_total": self._suppressed_views,
+                "partial_tiles_total": self._partial_tiles,
+                "partial_tiles_possible_total": self._partial_tiles_possible,
+                "effective_k": {
+                    sid: int(s.k) for sid, s in streams.items()
+                },
+            }

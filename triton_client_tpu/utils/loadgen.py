@@ -649,6 +649,18 @@ class StreamStats:
     stream_id: str
     frames_sent: int = 0
     frames_ok: int = 0
+    # temporal-reuse split (ISSUE 19): how each OK frame was served,
+    # read from the response's ``reuse_mode`` output (0 full detector,
+    # 1 tracker-coast, 2 ROI-tile partial). Coasted frames carry no
+    # per-detection assignment, so they are scored separately:
+    # ``coast_track_drops`` counts bound ground-truth tracks whose id
+    # vanished from a coast frame's live track set — the coast-path
+    # quality failure an ID-switch counter (detection frames only)
+    # cannot see.
+    frames_detected: int = 0
+    frames_coasted: int = 0
+    frames_partial: int = 0
+    coast_track_drops: int = 0
     wall_s: float = 0.0
     latencies_ms: list = field(default_factory=list)
     inter_frame_ms: list = field(default_factory=list)
@@ -704,6 +716,18 @@ class StreamsResult:
     def aliases(self) -> int:
         return sum(s.aliases for s in self.streams)
 
+    @property
+    def frames_coasted(self) -> int:
+        return sum(s.frames_coasted for s in self.streams)
+
+    @property
+    def frames_partial(self) -> int:
+        return sum(s.frames_partial for s in self.streams)
+
+    @property
+    def coast_track_drops(self) -> int:
+        return sum(s.coast_track_drops for s in self.streams)
+
     def summary(self) -> dict:
         per99 = [s.inter_frame_p99() for s in self.streams]
         fps = [s.sustained_fps for s in self.streams]
@@ -712,6 +736,10 @@ class StreamsResult:
             "frames_sent": self.frames_sent,
             "frames_ok": self.frames_ok,
             "goodput": round(self.goodput, 4),
+            "frames_detected": sum(s.frames_detected for s in self.streams),
+            "frames_coasted": self.frames_coasted,
+            "frames_partial": self.frames_partial,
+            "coast_track_drops": self.coast_track_drops,
             "id_switches": self.id_switches,
             "fragmentation": self.fragmentation,
             "track_id_aliases": self.aliases,
@@ -731,6 +759,8 @@ def synthetic_stream(
     seed: int = 0,
     speed: float = 1.0,
     clutter: int = 2,
+    dynamics: str | None = None,
+    phase_frames: int = 12,
 ):
     """Generate a synthetic timestamped detection stream for replay:
     ``n_objects`` constant-velocity movers plus ``clutter`` low-score
@@ -739,17 +769,57 @@ def synthetic_stream(
     ``detections (N, det_dim) f32`` rows
     ``[x y z dx dy dz heading vx vy ... score label]`` and a ``valid``
     bool mask; ``gt_ids`` aligns ground-truth object ids with rows
-    (clutter rows are ``-1``, never scored for ID switches)."""
+    (clutter rows are ``-1``, never scored for ID switches).
+
+    ``dynamics`` (ISSUE 19) shapes the scene motion so temporal-reuse
+    drives can exercise the adaptive keyframe scheduler's whole range:
+      * ``None``    — legacy constant-velocity movers;
+      * ``"static"`` — objects hold position (innovation -> 0, K opens
+        wide, coast dominates);
+      * ``"pan"``   — every object shares one coherent drift (a panning
+        rig: large pixel motion, perfectly predictable — the case the
+        Kalman coast should absorb);
+      * ``"burst"`` — static with sudden re-drawn high-speed velocities
+        every ``phase_frames`` frames (innovation spikes, K must
+        collapse to 1 at each burst edge);
+      * ``"mixed"`` — cycles static -> pan -> burst phases of
+        ``phase_frames`` each."""
     import numpy as np
 
     if det_dim < 11:
         raise ValueError("synthetic_stream needs det_dim >= 11")
+    if dynamics not in (None, "static", "pan", "burst", "mixed"):
+        raise ValueError(
+            f"dynamics must be None/static/pan/burst/mixed, not {dynamics!r}"
+        )
+    phase_frames = max(1, int(phase_frames))
     rng = np.random.default_rng(seed)
     pos = rng.uniform(-20.0, 20.0, size=(n_objects, 2))
-    vel = rng.uniform(-1.0, 1.0, size=(n_objects, 2)) * speed
+    base_vel = rng.uniform(-1.0, 1.0, size=(n_objects, 2)) * speed
+    pan_vel = rng.uniform(-1.0, 1.0, size=(1, 2)) * speed * 2.0
     dt = 1.0 / fps
     n_rows = n_objects + clutter
+    vel = base_vel
     for k in range(n_frames):
+        if dynamics is not None:
+            phase = dynamics
+            if dynamics == "mixed":
+                phase = ("static", "pan", "burst")[
+                    (k // phase_frames) % 3
+                ]
+            if phase == "static":
+                vel = np.zeros_like(base_vel)
+            elif phase == "pan":
+                vel = np.broadcast_to(pan_vel, base_vel.shape)
+            elif phase == "burst":
+                # burst edge: re-draw high-speed velocities at each
+                # phase boundary, hold them through the phase
+                if k % phase_frames == 0:
+                    vel = (
+                        rng.uniform(-1.0, 1.0, base_vel.shape)
+                        * speed
+                        * 4.0
+                    )
         det = np.zeros((n_rows, det_dim), dtype=np.float32)
         det[:n_objects, 0:2] = pos + rng.normal(0.0, 0.05, pos.shape)
         det[:n_objects, 3:6] = (4.0, 2.0, 1.5)
@@ -794,6 +864,29 @@ def _score_tracking(stats, det_tids, gt_ids, gt_to_tid, tids_per_gt):
         bound = stats.track_map.setdefault(tid, g)
         if bound != g:
             stats.aliases += 1
+
+
+def _score_coast(stats, outputs, gt_to_tid) -> None:
+    """Score one coasted frame (ISSUE 19): no per-detection assignment
+    exists, so the only checkable claim is track PERSISTENCE — every
+    ground-truth object's bound track id must still be live in the
+    coast frame's ``track_ids``/``tracks_valid``. Each vanished binding
+    counts one ``coast_track_drops``."""
+    import numpy as np
+
+    tids = outputs.get("track_ids")
+    if tids is None or not gt_to_tid:
+        return
+    live = np.asarray(tids).reshape(-1)
+    valid = outputs.get("tracks_valid")
+    if valid is not None:
+        mask = np.asarray(valid, bool).reshape(-1)
+        if mask.shape == live.shape:
+            live = live[mask]
+    live_set = {int(t) for t in live.tolist() if t > 0}
+    stats.coast_track_drops += sum(
+        1 for tid in gt_to_tid.values() if tid not in live_set
+    )
 
 
 def run_streams(
@@ -881,10 +974,30 @@ def run_streams(
             if last_done is not None:
                 stats.inter_frame_ms.append((now - last_done) * 1e3)
             last_done = now
+            mode = resp.outputs.get("reuse_mode")
+            if mode is not None:
+                import numpy as _np
+
+                mode = int(_np.asarray(mode).reshape(-1)[0])
+            else:
+                mode = 0
+            if mode == 1:
+                stats.frames_coasted += 1
+            elif mode == 2:
+                stats.frames_partial += 1
+            else:
+                stats.frames_detected += 1
             if gt is not None:
-                tids = resp.outputs.get(track_output)
-                if tids is not None:
-                    _score_tracking(stats, tids, gt, gt_to_tid, tids_per_gt)
+                if mode == 1:
+                    # coasted: no per-detection assignment came back —
+                    # score track persistence instead of ID switches
+                    _score_coast(stats, resp.outputs, gt_to_tid)
+                else:
+                    tids = resp.outputs.get(track_output)
+                    if tids is not None:
+                        _score_tracking(
+                            stats, tids, gt, gt_to_tid, tids_per_gt
+                        )
         stats.wall_s = time.perf_counter() - t0
         stats.fragmentation = sum(len(s) - 1 for s in tids_per_gt.values())
 
